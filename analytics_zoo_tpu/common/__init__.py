@@ -1,0 +1,34 @@
+from analytics_zoo_tpu.common.config import ZooConfig, get_config
+from analytics_zoo_tpu.common.zoo_context import (
+    init_zoo_context,
+    get_zoo_context,
+    ZooContext,
+)
+from analytics_zoo_tpu.common.triggers import (
+    Trigger,
+    EveryEpoch,
+    MaxEpoch,
+    MaxIteration,
+    SeveralIteration,
+    MinLoss,
+    MaxScore,
+    TriggerAnd,
+    TriggerOr,
+)
+
+__all__ = [
+    "ZooConfig",
+    "get_config",
+    "init_zoo_context",
+    "get_zoo_context",
+    "ZooContext",
+    "Trigger",
+    "EveryEpoch",
+    "MaxEpoch",
+    "MaxIteration",
+    "SeveralIteration",
+    "MinLoss",
+    "MaxScore",
+    "TriggerAnd",
+    "TriggerOr",
+]

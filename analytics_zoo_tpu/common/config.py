@@ -1,0 +1,146 @@
+"""Layered configuration for the framework.
+
+The reference stacks four config layers (SURVEY.md §5 "Config / flag
+system"): a conf file of perf-critical defaults
+(zoo/src/main/resources/spark-analytics-zoo.conf, read by
+NNContext.readConf NNContext.scala:188-200), Java system properties
+(``bigdl.*``), environment variables (KMP_*/OMP_*), and per-example CLI
+flags.  We reproduce the same layering TPU-natively:
+
+    defaults  <  conf file (zoo-tpu.conf)  <  env (ZOO_TPU_*)  <  code overrides
+
+Keys use dotted lowercase names, e.g. ``train.retry_times`` mirrors the
+reference's ``bigdl.failure.retryTimes`` system property
+(Topology.scala:1179-1261).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+# Perf-critical defaults: the analogue of spark-analytics-zoo.conf.
+_DEFAULTS: Dict[str, Any] = {
+    # Numerics ---------------------------------------------------------
+    # Params kept in f32, matmul/conv compute in bf16 on the MXU.
+    "dtype.param": "float32",
+    "dtype.compute": "bfloat16",
+    # Matmul precision passed to jax ops ("default"|"high"|"highest").
+    "dtype.matmul_precision": "default",
+    # Mesh / distribution ---------------------------------------------
+    # Default mesh shape; "auto" = all devices on the data axis,
+    # else "data:4,model:2"-style axis sizes.
+    "mesh.shape": "auto",
+    # Training engine --------------------------------------------------
+    # Failure-retry loop, mirroring bigdl.failure.retryTimes /
+    # retryTimeInterval (Topology.scala:1179-1261).
+    "train.retry_times": 5,
+    "train.retry_interval_s": 120,
+    # Donate input buffers in the jitted train step (saves HBM).
+    "train.donate": True,
+    # Gradient allreduce in bf16 (the analogue of BigDL's compressed
+    # FP16 gradient serialization during sync, SURVEY.md §2.4).
+    "train.grad_sync_dtype": "float32",
+    # Input pipeline ---------------------------------------------------
+    # Device-batch prefetch depth (background thread overlapping host
+    # batch assembly + H2D copy with device compute); 0 disables.
+    "data.prefetch": 2,
+    "data.shuffle_seed": 1,
+    # Checkpointing ----------------------------------------------------
+    "checkpoint.keep": 5,
+    # Logging ----------------------------------------------------------
+    "log.level": "INFO",
+}
+
+_ENV_PREFIX = "ZOO_TPU_"
+
+
+def _parse_value(raw: str) -> Any:
+    s = raw.strip()
+    low = s.lower()
+    if low in ("true", "false"):
+        return low == "true"
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    return s
+
+
+def _read_conf_file(path: str) -> Dict[str, Any]:
+    """Read a ``key value`` / ``key=value`` conf file (same shape as the
+    reference's spark-analytics-zoo.conf)."""
+    out: Dict[str, Any] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" in line:
+                k, v = line.split("=", 1)
+            else:
+                parts = line.split(None, 1)
+                if len(parts) != 2:
+                    continue
+                k, v = parts
+            out[k.strip()] = _parse_value(v)
+    return out
+
+
+class ZooConfig:
+    """Resolved configuration with the four-layer precedence."""
+
+    def __init__(self, conf_file: Optional[str] = None,
+                 overrides: Optional[Dict[str, Any]] = None):
+        self._values: Dict[str, Any] = dict(_DEFAULTS)
+        # Layer 2: conf file.
+        if conf_file is None:
+            for cand in ("zoo-tpu.conf", os.path.expanduser("~/.zoo-tpu.conf")):
+                if os.path.isfile(cand):
+                    conf_file = cand
+                    break
+        if conf_file and os.path.isfile(conf_file):
+            self._values.update(_read_conf_file(conf_file))
+        # Layer 3: environment. ZOO_TPU_TRAIN_RETRY_TIMES → train.retry_times
+        for env_key, raw in os.environ.items():
+            if env_key.startswith(_ENV_PREFIX):
+                key = env_key[len(_ENV_PREFIX):].lower().replace("_", ".", 1)
+                # Only the first underscore becomes a dot; the rest stay.
+                self._values[key] = _parse_value(raw)
+        # Layer 4: programmatic overrides.
+        if overrides:
+            self._values.update(overrides)
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self._values.get(key, default)
+
+    def __getitem__(self, key: str) -> Any:
+        return self._values[key]
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._values
+
+    def set(self, key: str, value: Any) -> None:
+        self._values[key] = value
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+_global_config: Optional[ZooConfig] = None
+
+
+def get_config() -> ZooConfig:
+    global _global_config
+    if _global_config is None:
+        _global_config = ZooConfig()
+    return _global_config
+
+
+def set_config(cfg: ZooConfig) -> None:
+    global _global_config
+    _global_config = cfg

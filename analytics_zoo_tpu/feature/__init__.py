@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.feature.feature_set import FeatureSet
+from analytics_zoo_tpu.feature.common import Preprocessing, ChainedPreprocessing
+
+__all__ = ["FeatureSet", "Preprocessing", "ChainedPreprocessing"]

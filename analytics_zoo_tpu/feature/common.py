@@ -1,0 +1,50 @@
+"""Composable preprocessing — the ``Preprocessing[A,B]`` analogue
+(ref: zoo/feature/common/Preprocessing.scala, chained with ``->``).
+
+A Preprocessing maps one sample to another; chains compose with ``>>``
+(and ``->`` is spelled ``.then``).  They run on the host, feeding the
+device input pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List
+
+
+class Preprocessing:
+    def apply(self, sample: Any) -> Any:
+        raise NotImplementedError
+
+    def __call__(self, sample: Any) -> Any:
+        return self.apply(sample)
+
+    def then(self, other: "Preprocessing") -> "ChainedPreprocessing":
+        return ChainedPreprocessing([self, other])
+
+    __rshift__ = then
+
+    def apply_all(self, samples: Iterable[Any]) -> List[Any]:
+        return [self.apply(s) for s in samples]
+
+
+class ChainedPreprocessing(Preprocessing):
+    def __init__(self, stages: List[Preprocessing]):
+        self.stages = []
+        for s in stages:
+            if isinstance(s, ChainedPreprocessing):
+                self.stages.extend(s.stages)
+            else:
+                self.stages.append(s)
+
+    def apply(self, sample):
+        for s in self.stages:
+            sample = s.apply(sample)
+        return sample
+
+
+class FnPreprocessing(Preprocessing):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def apply(self, sample):
+        return self.fn(sample)

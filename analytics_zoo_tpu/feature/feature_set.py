@@ -1,0 +1,162 @@
+"""FeatureSet — the input pipeline feeding the TPU mesh.
+
+Reference (zoo/feature/FeatureSet.scala): partition-cached data with a
+shuffled index array and an endless wraparound iterator for training
+(CachedDistributedFeatureSet :229-329), finite ordered iteration for
+eval, memory tiers (DRAM / PMEM / DISK_AND_DRAM(n) slices :585-662), and
+``->`` transformer chaining.
+
+TPU redesign: data lives host-side as *columnar numpy pytrees* (struct
+of arrays, not the reference's array of Sample structs) so a minibatch
+is a zero-copy slice + gather, ready for ``jax.device_put`` into HBM.
+Per-epoch shuffling uses a deterministic per-epoch RNG — the analogue of
+the reference's per-partition index shuffle, reproducible across hosts
+(each host computes the same global permutation and takes its own
+shard).  Disk-slice mode memory-maps .npy files and loads 1/num_slices
+per sub-epoch.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Callable, Iterator, List, Optional, Tuple
+
+import numpy as np
+import jax
+
+from analytics_zoo_tpu.feature.common import Preprocessing
+
+
+def _tree_len(tree) -> int:
+    return len(jax.tree_util.tree_leaves(tree)[0])
+
+
+def _tree_take(tree, idx):
+    return jax.tree_util.tree_map(lambda a: a[idx], tree)
+
+
+class FeatureSet:
+    """Columnar in-memory dataset with train/eval iteration semantics."""
+
+    def __init__(self, x, y=None, shuffle: bool = True,
+                 num_slices: int = 1, seed: Optional[int] = None):
+        self.x = x
+        self.y = y
+        self.shuffle = shuffle
+        self.num_slices = max(int(num_slices), 1)
+        if seed is None:
+            from analytics_zoo_tpu.common.config import get_config
+            seed = int(get_config().get("data.shuffle_seed"))
+        self.seed = seed
+        self._size = _tree_len(x)
+        if y is not None:
+            ylen = _tree_len(y)
+            if ylen != self._size:
+                raise ValueError(f"x has {self._size} samples, y has {ylen}")
+
+    # ------------------------------------------------------------- factories
+    @classmethod
+    def from_ndarrays(cls, x, y=None, shuffle: bool = True,
+                      seed: Optional[int] = None) -> "FeatureSet":
+        """From numpy arrays / pytrees of arrays (leading dim = samples)."""
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t)
+        return cls(to_np(x), to_np(y) if y is not None else None,
+                   shuffle=shuffle, seed=seed)
+
+    @classmethod
+    def from_samples(cls, samples: List[Tuple[Any, Any]],
+                     shuffle: bool = True) -> "FeatureSet":
+        """From a list of (x, y) sample pytrees — stacked columnar."""
+        xs = [s[0] for s in samples]
+        ys = [s[1] for s in samples]
+        stack = lambda seq: jax.tree_util.tree_map(
+            lambda *leaves: np.stack(leaves), *seq)
+        return cls(stack(xs), stack(ys), shuffle=shuffle)
+
+    @classmethod
+    def from_npy_dir(cls, path: str, num_slices: int = 1,
+                     shuffle: bool = True) -> "FeatureSet":
+        """Disk-backed mode: memory-mapped ``x.npy``/``y.npy``; with
+        ``num_slices > 1`` only 1/num_slices is materialised per
+        sub-epoch (DiskFeatureSet analogue, FeatureSet.scala:585-662)."""
+        x = np.load(os.path.join(path, "x.npy"), mmap_mode="r")
+        ypath = os.path.join(path, "y.npy")
+        y = np.load(ypath, mmap_mode="r") if os.path.exists(ypath) else None
+        return cls(x, y, shuffle=shuffle, num_slices=num_slices)
+
+    # ------------------------------------------------------------ transforms
+    def transform(self, fn) -> "FeatureSet":
+        """Apply a Preprocessing / callable to the whole columnar x."""
+        f = fn.apply if isinstance(fn, Preprocessing) else fn
+        return FeatureSet(f(self.x), self.y, shuffle=self.shuffle,
+                          num_slices=self.num_slices, seed=self.seed)
+
+    __rshift__ = transform
+
+    # -------------------------------------------------------------- iteration
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def num_batches(self, batch_size: int, train: bool = True) -> int:
+        if train:
+            return self._size // batch_size
+        return math.ceil(self._size / batch_size)
+
+    def _epoch_perm(self, epoch: int) -> np.ndarray:
+        rng = np.random.default_rng(self.seed * 1_000_003 + epoch)
+        return rng.permutation(self._size)
+
+    def epoch_batches(self, epoch: int, batch_size: int,
+                      train: bool = True) -> Iterator[Tuple]:
+        """Finite per-epoch batch iterator.
+
+        Train: deterministically shuffled per epoch, remainder dropped
+        (the global batch must tile the data-parallel mesh).  Eval: in
+        order; the tail batch is zero-padded and a float mask column
+        marks real rows so metric partials stay exact.
+        """
+        n = self._size
+        if train:
+            idx = self._epoch_perm(epoch) if self.shuffle else np.arange(n)
+            nb = n // batch_size
+            for b in range(nb):
+                sel = idx[b * batch_size:(b + 1) * batch_size]
+                yield (_tree_take(self.x, sel),
+                       _tree_take(self.y, sel) if self.y is not None
+                       else None)
+        else:
+            nb = math.ceil(n / batch_size)
+            for b in range(nb):
+                lo = b * batch_size
+                hi = min(lo + batch_size, n)
+                sel = np.arange(lo, hi)
+                xb = _tree_take(self.x, sel)
+                yb = _tree_take(self.y, sel) if self.y is not None else None
+                mask = np.ones(hi - lo, np.float32)
+                if hi - lo < batch_size:
+                    pad = batch_size - (hi - lo)
+                    pad_fn = lambda a: np.concatenate(
+                        [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+                    xb = jax.tree_util.tree_map(pad_fn, xb)
+                    if yb is not None:
+                        yb = jax.tree_util.tree_map(pad_fn, yb)
+                    mask = np.concatenate([mask, np.zeros(pad, np.float32)])
+                yield (xb, yb, mask)
+
+    def slice_batches(self, epoch: int, slice_index: int, batch_size: int
+                      ) -> Iterator[Tuple]:
+        """Disk-slice training: iterate one 1/num_slices shard of this
+        epoch's permutation (materialising only that shard)."""
+        idx = self._epoch_perm(epoch) if self.shuffle \
+            else np.arange(self._size)
+        per = self._size // self.num_slices
+        lo = slice_index * per
+        hi = self._size if slice_index == self.num_slices - 1 \
+            else lo + per
+        shard = np.sort(idx[lo:hi])  # sorted → sequential mmap reads
+        x = _tree_take(self.x, shard)
+        y = _tree_take(self.y, shard) if self.y is not None else None
+        sub = FeatureSet(x, y, shuffle=self.shuffle, seed=self.seed + 7)
+        yield from sub.epoch_batches(epoch, batch_size, train=True)

@@ -1,0 +1,2 @@
+from analytics_zoo_tpu.ops import initializers, activations
+from analytics_zoo_tpu.ops.dtypes import Policy, get_policy, set_policy

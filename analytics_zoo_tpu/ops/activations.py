@@ -1,0 +1,109 @@
+"""Activation functions addressable by Keras-1 string names.
+
+Mirrors the activation set of the reference's keras layer API
+(zoo/pipeline/api/keras/layers/ activation handling via
+KerasUtils.getActivation / Activation layer).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def linear(x):
+    return x
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def relu6(x):
+    return jnp.minimum(jax.nn.relu(x), 6.0)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def hard_sigmoid(x):
+    # Keras-1 definition: clip(0.2 * x + 0.5, 0, 1)
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def softmax(x):
+    return jax.nn.softmax(x, axis=-1)
+
+
+def log_softmax(x):
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def softsign(x):
+    return jax.nn.soft_sign(x)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def selu(x):
+    return jax.nn.selu(x)
+
+
+def gelu(x):
+    return jax.nn.gelu(x, approximate=True)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+_REGISTRY = {
+    "linear": linear, None: linear,
+    "relu": relu,
+    "relu6": relu6,
+    "tanh": tanh,
+    "sigmoid": sigmoid,
+    "hard_sigmoid": hard_sigmoid,
+    "softmax": softmax,
+    "log_softmax": log_softmax,
+    "softplus": softplus,
+    "softsign": softsign,
+    "elu": elu,
+    "selu": selu,
+    "gelu": gelu,
+    "swish": swish,
+    "silu": swish,
+    "exp": exp,
+}
+
+
+def get(activation) -> Optional[Callable]:
+    """Resolve a name/callable; returns None for identity (no-op)."""
+    if activation is None:
+        return None
+    if callable(activation):
+        return activation
+    name = str(activation).lower()
+    if name == "linear":
+        return None
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(f"unknown activation: {activation!r}") from None

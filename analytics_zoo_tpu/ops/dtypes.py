@@ -1,0 +1,54 @@
+"""Mixed-precision policy.
+
+TPU MXU peak throughput needs bfloat16 inputs; parameters and the
+optimizer state stay float32 for stable accumulation.  The reference has
+no equivalent (MKL float32 everywhere); this is TPU-native design.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.common.config import get_config
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "int8": jnp.int8,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: object
+    compute_dtype: object
+
+    def cast_compute(self, x):
+        if x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16):
+            return x.astype(self.compute_dtype)
+        return x
+
+
+_policy = None
+
+
+def get_policy() -> Policy:
+    global _policy
+    if _policy is None:
+        cfg = get_config()
+        _policy = Policy(
+            param_dtype=_DTYPES[str(cfg.get("dtype.param"))],
+            compute_dtype=_DTYPES[str(cfg.get("dtype.compute"))],
+        )
+    return _policy
+
+
+def set_policy(param_dtype: str = "float32",
+               compute_dtype: str = "bfloat16") -> Policy:
+    global _policy
+    _policy = Policy(param_dtype=_DTYPES[param_dtype],
+                     compute_dtype=_DTYPES[compute_dtype])
+    return _policy

@@ -1,0 +1,108 @@
+"""Weight initializers, addressable by Keras-1 string names.
+
+The reference exposes init via strings on every layer ("glorot_uniform",
+"one", "zero", ... — e.g. Dense init arg, keras/layers/Core.scala) and
+BigDL InitializationMethod underneath.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape: Sequence[int]):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels (spatial..., in, out)
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def zero(rng, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def one(rng, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def uniform(rng, shape, dtype=jnp.float32, scale=0.05):
+    return jax.random.uniform(rng, shape, dtype, -scale, scale)
+
+
+def normal(rng, shape, dtype=jnp.float32, stddev=0.05):
+    return stddev * jax.random.normal(rng, shape, dtype)
+
+
+def glorot_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def glorot_normal(rng, shape, dtype=jnp.float32):
+    fan_in, fan_out = _fans(shape)
+    stddev = math.sqrt(2.0 / (fan_in + fan_out))
+    return stddev * jax.random.normal(rng, shape, dtype)
+
+
+def he_normal(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    return math.sqrt(2.0 / fan_in) * jax.random.normal(rng, shape, dtype)
+
+
+def he_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    limit = math.sqrt(6.0 / fan_in)
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def lecun_uniform(rng, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    limit = math.sqrt(3.0 / fan_in)
+    return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+
+def orthogonal(rng, shape, dtype=jnp.float32, gain=1.0):
+    if len(shape) < 2:
+        return normal(rng, shape, dtype)
+    rows = math.prod(shape[:-1])
+    cols = shape[-1]
+    flat = jax.random.normal(rng, (max(rows, cols), min(rows, cols)))
+    q, r = jnp.linalg.qr(flat)
+    q = q * jnp.sign(jnp.diagonal(r))
+    if rows < cols:
+        q = q.T
+    return (gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+_REGISTRY: dict = {
+    "zero": zero, "zeros": zero,
+    "one": one, "ones": one,
+    "uniform": uniform,
+    "normal": normal, "gaussian": normal,
+    "glorot_uniform": glorot_uniform, "xavier": glorot_uniform,
+    "glorot_normal": glorot_normal,
+    "he_normal": he_normal, "msra": he_normal,
+    "he_uniform": he_uniform,
+    "lecun_uniform": lecun_uniform,
+    "orthogonal": orthogonal,
+}
+
+
+def get(init) -> Callable:
+    """Resolve a string name or callable to an initializer function."""
+    if callable(init):
+        return init
+    try:
+        return _REGISTRY[str(init)]
+    except KeyError:
+        raise ValueError(f"unknown initializer: {init!r}") from None

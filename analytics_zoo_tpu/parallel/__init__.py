@@ -1,0 +1,25 @@
+from analytics_zoo_tpu.parallel.mesh import (
+    create_mesh,
+    data_sharding,
+    replicated,
+    batch_shardings,
+    fsdp_shardings,
+    local_batch_size,
+    DATA_AXIS,
+    FSDP_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+)
+
+__all__ = [
+    "create_mesh",
+    "data_sharding",
+    "replicated",
+    "batch_shardings",
+    "fsdp_shardings",
+    "local_batch_size",
+    "DATA_AXIS",
+    "FSDP_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+]

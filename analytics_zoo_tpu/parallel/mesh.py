@@ -1,0 +1,126 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference's distribution fabric is Spark: ``Engine.init`` discovers
+node/core counts and ``AllReduceParameter`` partitions the parameter
+vector across Spark block managers (SURVEY.md §2.4).  TPU-natively the
+fabric is a ``jax.sharding.Mesh``: ICI links inside a slice, DCN between
+slices, with XLA inserting collectives from sharding annotations.
+
+Axis convention (outer → inner, fastest collectives innermost):
+
+- ``data``  : pure data parallelism (gradient psum) — the reference's
+              only training parallelism (wp-bigdl.md:113-171).
+- ``fsdp``  : optional parameter/optimizer sharding (ZeRO-style) —
+              a new TPU-native capability.
+- ``model`` : tensor parallelism for wide layers.
+- ``seq``   : sequence/context parallelism (ring attention).
+
+A 1-chip mesh is simply shape ``{"data": 1}`` — every code path is
+written against the mesh so that single-chip and pod runs share code.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+ALL_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS)
+
+
+def create_mesh(shape: Optional[Dict[str, int]] = None,
+                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh from an axis→size dict.
+
+    ``shape=None`` puts every device on the ``data`` axis (matching the
+    reference's pure-DP posture).  Axes with size 1 are still created so
+    sharding specs can always name them.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n = len(devices)
+    if shape is None:
+        shape = {DATA_AXIS: n}
+    # Fill in implicit axes with size 1, preserving canonical order.
+    sizes = {ax: int(shape.get(ax, 1)) for ax in ALL_AXES}
+    # Allow a -1 wildcard on one axis.
+    wild = [ax for ax, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    fixed = math.prod(s for s in sizes.values() if s != -1)
+    if wild:
+        if n % fixed != 0:
+            raise ValueError(
+                f"cannot infer {wild[0]}: {n} devices not divisible by {fixed}")
+        sizes[wild[0]] = n // fixed
+    total = math.prod(sizes.values())
+    if total != n:
+        raise ValueError(
+            f"mesh shape {sizes} needs {total} devices, have {n}")
+    arr = np.array(devices).reshape([sizes[ax] for ax in ALL_AXES])
+    return Mesh(arr, ALL_AXES)
+
+
+def data_sharding(mesh: Mesh, ndim: int = 1) -> NamedSharding:
+    """Shard dim 0 across data(+fsdp) axes; replicate the rest.
+
+    Batches are split over every data-parallel device, the way the
+    reference splits an RDD's partitions across executors.
+    """
+    spec = [None] * ndim
+    spec[0] = (DATA_AXIS, FSDP_AXIS)
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_shardings(mesh: Mesh, batch_pytree):
+    """Per-leaf data shardings for an arbitrary batch pytree."""
+    return jax.tree_util.tree_map(
+        lambda x: data_sharding(mesh, np.ndim(x)), batch_pytree)
+
+
+def fsdp_shardings(mesh: Mesh, params, min_size: int = 2 ** 12):
+    """ZeRO-style sharding spec for a parameter pytree.
+
+    Each large-enough leaf is sharded along its largest dimension that
+    divides the fsdp axis size; small leaves replicate.  This is the
+    TPU-native answer to the reference's *partitioned*
+    ``AllReduceParameter`` (the parameter vector chunked across nodes,
+    Topology.scala:1126-1128) — except here the optimizer update also
+    runs sharded and XLA handles the gather.
+    """
+    axis = mesh.shape[FSDP_AXIS]
+
+    def leaf_spec(x):
+        if axis == 1 or x.size < min_size:
+            return NamedSharding(mesh, P())
+        dims = list(np.argsort(x.shape)[::-1])
+        for d in dims:
+            if x.shape[d] % axis == 0:
+                spec = [None] * x.ndim
+                spec[d] = FSDP_AXIS
+                return NamedSharding(mesh, P(*spec))
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map(leaf_spec, params)
+
+
+def local_batch_size(mesh: Mesh, global_batch: int) -> int:
+    dp = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+    if global_batch % dp != 0:
+        raise ValueError(
+            f"global batch {global_batch} not divisible by data-parallel "
+            f"degree {dp}")
+    return global_batch // dp

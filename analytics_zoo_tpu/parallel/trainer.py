@@ -1,0 +1,261 @@
+"""The distributed training engine.
+
+Reference: ``InternalDistriOptimizer`` (Topology.scala:1069-1598) — per
+iteration it launches a Spark job that runs forward/backward on every
+executor's model replicas, then syncs gradients through a partitioned
+allreduce over the Spark BlockManager, applies the OptimMethod per
+parameter chunk, and broadcasts updated weights back.
+
+TPU redesign: the *entire* iteration is ONE jit-compiled XLA program
+over the device mesh.  The batch is sharded on the ``data`` axis;
+params/optimizer state are replicated (or fsdp-sharded); XLA inserts the
+gradient all-reduce over ICI automatically from the sharding contract —
+there is no hand-written communication.  Buffer donation makes the
+update in-place in HBM.
+
+Supports the reference's optimizer features: constant / L2-norm gradient
+clipping (Topology.scala setConstantGradientClipping etc.), multiple
+optim methods over disjoint parameter groups (Topology.scala:1130-1151),
+and bf16 gradient sync (the analogue of BigDL's compressed FP16
+parameter exchange).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+
+@dataclasses.dataclass
+class ClipSpec:
+    kind: str          # "const" | "l2norm"
+    a: float = 0.0
+    b: float = 0.0
+
+
+def _apply_clipping(grads, clip: Optional[ClipSpec]):
+    if clip is None:
+        return grads
+    if clip.kind == "const":
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, clip.a, clip.b), grads)
+    if clip.kind == "l2norm":
+        gnorm = optax.global_norm(grads)
+        scale = jnp.minimum(1.0, clip.a / (gnorm + 1e-12))
+        return jax.tree_util.tree_map(lambda g: g * scale, grads)
+    raise ValueError(clip.kind)
+
+
+def _group_params(params, groups: Dict[str, Sequence[str]]):
+    """Split a top-level params dict into named disjoint groups.
+
+    ``groups`` maps group name -> list of top-level layer names; one
+    group may be "*" (the rest).  Mirrors the reference's
+    multi-optimMethod parameter splits (Topology.scala:1130-1151).
+    """
+    assigned = set()
+    for names in groups.values():
+        if names != "*":
+            assigned.update(names)
+    out = {}
+    for gname, names in groups.items():
+        if names == "*":
+            out[gname] = [k for k in params if k not in assigned]
+        else:
+            out[gname] = list(names)
+    return out
+
+
+class DistributedTrainer:
+    """Builds and runs the jitted train/eval/predict steps."""
+
+    def __init__(self, model, loss_fn: Callable, optim_method=None,
+                 mesh=None, clip: Optional[ClipSpec] = None,
+                 optim_groups: Optional[Dict[str, Tuple[Any, Sequence[str]]]]
+                 = None):
+        from analytics_zoo_tpu.common.zoo_context import get_zoo_context
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optim = optim_method
+        self.mesh = mesh if mesh is not None else get_zoo_context().mesh
+        self.clip = clip
+        self.optim_groups = optim_groups  # {name: (OptimMethod, layer_names)}
+        cfg = get_config()
+        self.donate = bool(cfg.get("train.donate"))
+        self.grad_sync_dtype = str(cfg.get("train.grad_sync_dtype"))
+        self._train_step = None
+        self._eval_step = None
+        self._predict_step = None
+        self._rep = mesh_lib.replicated(self.mesh)
+
+    # ----------------------------------------------------------- optimizer
+    def init_opt_state(self, params):
+        if self.optim_groups:
+            groups = _group_params(
+                params, {k: v[1] for k, v in self.optim_groups.items()})
+            return {
+                g: self.optim_groups[g][0].init(
+                    {k: params[k] for k in names})
+                for g, names in groups.items()
+            }
+        return self.optim.init(params)
+
+    def _optimizer_update(self, grads, opt_state, params):
+        if self.optim_groups:
+            groups = _group_params(
+                params, {k: v[1] for k, v in self.optim_groups.items()})
+            new_params = dict(params)
+            new_state = {}
+            for g, names in groups.items():
+                method = self.optim_groups[g][0]
+                sub_p = {k: params[k] for k in names}
+                sub_g = {k: grads[k] for k in names}
+                updates, new_state[g] = method.update(
+                    sub_g, opt_state[g], sub_p)
+                upd = optax.apply_updates(sub_p, updates)
+                new_params.update(upd)
+            return new_params, new_state
+        updates, new_state = self.optim.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_state
+
+    # ---------------------------------------------------------- train step
+    def _build_train_step(self):
+        model, loss_fn, clip = self.model, self.loss_fn, self.clip
+        sync_dtype = self.grad_sync_dtype
+
+        def step(params, opt_state, state, batch, rng):
+            x, y = batch
+
+            def objective(p):
+                out, new_state = model.apply(p, x, state=state,
+                                             training=True, rng=rng)
+                loss = loss_fn(y, out)
+                reg = model.regularization_loss(p)
+                return loss + reg, (new_state, loss)
+
+            grads, (new_state, loss) = jax.grad(
+                objective, has_aux=True)(params)
+            if sync_dtype == "bfloat16":
+                grads = jax.tree_util.tree_map(
+                    lambda g: g.astype(jnp.bfloat16).astype(jnp.float32),
+                    grads)
+            grads = _apply_clipping(grads, clip)
+            new_params, new_opt_state = self._optimizer_update(
+                grads, opt_state, params)
+            return new_params, new_opt_state, new_state, loss
+
+        donate = (0, 1, 2) if self.donate else ()
+        return jax.jit(
+            step,
+            out_shardings=(self._rep, self._rep, self._rep, self._rep),
+            donate_argnums=donate)
+
+    def train_step(self, params, opt_state, state, batch, rng):
+        """Run one step; ``batch`` must already be device-placed
+        (see ``prefetch``/``put_batch``)."""
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        return self._train_step(params, opt_state, state, batch, rng)
+
+    # ----------------------------------------------------------- eval step
+    def _build_eval_step(self, metrics):
+        model = self.model
+
+        def step(params, state, batch):
+            x, y, mask = batch
+            out, _ = model.apply(params, x, state=state, training=False)
+            return tuple(m.batch_update(y, out, mask) for m in metrics)
+
+        return jax.jit(step, out_shardings=self._rep)
+
+    def make_eval_runner(self, metrics):
+        step = self._build_eval_step(metrics)
+
+        def run(params, state, batches):
+            partials = None
+            for batch in self.prefetch(batches):
+                upd = step(params, state, batch)
+                if partials is None:
+                    partials = list(upd)
+                else:
+                    partials = [m.merge(a, b) for m, a, b in
+                                zip(metrics, partials, upd)]
+            return {
+                m.name: m.finalize(p)
+                for m, p in zip(metrics, partials or
+                                [None] * len(metrics))
+                if p is not None
+            }
+        return run
+
+    # -------------------------------------------------------- predict step
+    def predict_fn(self):
+        model = self.model
+        if self._predict_step is None:
+            def step(params, state, x):
+                out, _ = model.apply(params, x, state=state, training=False)
+                return out
+            self._predict_step = jax.jit(step, out_shardings=self._rep)
+        return self._predict_step
+
+    # ------------------------------------------------------- data movement
+    def put_batch(self, batch):
+        """Place a host batch onto the mesh, sharded on the data axis.
+
+        Single-host path: ``device_put`` with NamedSharding.  Multi-host
+        path would use ``jax.make_array_from_process_local_data`` — the
+        per-host FeatureSet shard becomes this host's slice.
+        """
+        return jax.tree_util.tree_map(
+            lambda a: a if a is None else jax.device_put(
+                a, mesh_lib.data_sharding(self.mesh, np.ndim(a))),
+            batch, is_leaf=lambda v: v is None)
+
+    def replicate(self, tree):
+        return jax.device_put(tree, self._rep)
+
+    def prefetch(self, batches, depth: Optional[int] = None):
+        """Overlap host batch assembly + H2D transfer with device compute.
+
+        A background thread pulls host batches, places them on the mesh
+        (``put_batch``) and queues them ``depth`` deep — the analogue of
+        the reference's MTSampleToMiniBatch worker threads feeding the
+        training tasks (MTSampleToMiniBatch.scala:28).
+        """
+        import queue
+        import threading
+        if depth is None:
+            depth = int(get_config().get("data.prefetch"))
+        if depth <= 0:
+            for b in batches:
+                yield self.put_batch(b)
+            return
+        q: "queue.Queue" = queue.Queue(maxsize=depth)
+        _END = object()
+
+        def worker():
+            try:
+                for b in batches:
+                    q.put(self.put_batch(b))
+                q.put(_END)
+            except BaseException as e:   # propagate into consumer
+                q.put(e)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item

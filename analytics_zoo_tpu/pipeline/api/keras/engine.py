@@ -1,0 +1,277 @@
+"""Keras-style layer engine, TPU-native.
+
+The reference's model-definition layer (SURVEY.md §2.3) is a Keras-1
+API compiled onto BigDL modules (zoo/pipeline/api/keras/layers, built on
+``AbstractModule`` with mutable ``output``/``gradInput`` buffers).  The
+TPU-native redesign keeps the *user-facing surface* (Sequential/Model,
+``input_shape`` without batch dim, string activations/initializers) but
+the execution model is pure-functional JAX:
+
+- a ``Layer`` owns no arrays; ``build`` returns a params *pytree* and
+  ``init_state`` a non-trainable state pytree (BatchNorm moving stats),
+- ``apply(params, inputs, state, training, rng) -> (outputs, state)`` is
+  a pure function, traceable under ``jit``/``grad``/``vmap``/``pjit``,
+- graph construction is symbolic: calling a layer on a ``KTensor``
+  records a ``Node``; ``Model(input, output)`` topologically sorts the
+  node graph (the analogue of zoo's ``ModuleNode`` graph,
+  Topology.scala:603-824).
+
+Shapes follow Keras convention: ``input_shape`` excludes the batch dim;
+internally shapes are batch-inclusive with ``None`` in dim 0.
+"""
+
+from __future__ import annotations
+
+import zlib
+from collections import defaultdict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops import initializers as inits
+from analytics_zoo_tpu.ops.dtypes import get_policy
+
+Shape = Tuple[Optional[int], ...]
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+
+def to_batch_shape(shape) -> Shape:
+    """Normalise a user shape (no batch dim) to (None, ...)."""
+    shape = tuple(shape)
+    if len(shape) > 0 and shape[0] is None:
+        return shape
+    return (None,) + shape
+
+
+def fold_name(rng, name: str):
+    """Deterministic per-layer rng derivation (stable across runs)."""
+    return jax.random.fold_in(rng, zlib.crc32(name.encode()) & 0x7FFFFFFF)
+
+
+def _is_shape(x) -> bool:
+    return isinstance(x, (tuple, list)) and all(
+        v is None or isinstance(v, (int, np.integer)) for v in x)
+
+
+class KTensor:
+    """Symbolic tensor flowing through the layer graph."""
+
+    __slots__ = ("shape", "dtype", "node", "index")
+
+    def __init__(self, shape: Shape, dtype=jnp.float32,
+                 node: Optional["Node"] = None, index: int = 0):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.node = node        # producing Node (None for placeholders)
+        self.index = index      # position among the node's outputs
+
+    def __repr__(self):
+        return f"KTensor(shape={self.shape}, dtype={self.dtype})"
+
+
+class Node:
+    """One application of a layer to a set of input tensors."""
+
+    __slots__ = ("layer", "inbound", "outputs", "call_kwargs")
+
+    def __init__(self, layer: "Layer", inbound: List[KTensor],
+                 outputs: List[KTensor], call_kwargs: Optional[dict] = None):
+        self.layer = layer
+        self.inbound = inbound
+        self.outputs = outputs
+        self.call_kwargs = call_kwargs or {}
+
+
+def Input(shape=None, dtype=jnp.float32, name: Optional[str] = None) -> KTensor:
+    """Placeholder tensor — entry point of a graph ``Model``.
+
+    Mirrors zoo's ``Input``/``InputLayer`` (keras/layers/Input.scala).
+    """
+    if shape is None:
+        raise ValueError("Input(shape=...) is required")
+    return KTensor(to_batch_shape(shape), dtype=dtype, node=None)
+
+
+class Layer:
+    """Base layer: pure-functional params + symbolic graph building."""
+
+    _counters: Dict[str, int] = defaultdict(int)
+
+    @classmethod
+    def reset_name_counters(cls) -> None:
+        """Reset auto-naming (e.g. before rebuilding a model that must
+        produce checkpoint-compatible parameter names)."""
+        Layer._counters.clear()
+
+    def __init__(self, input_shape=None, name: Optional[str] = None,
+                 input_dtype=jnp.float32):
+        cls = type(self).__name__
+        if name is None:
+            Layer._counters[cls] += 1
+            name = f"{cls}_{Layer._counters[cls]}".lower()
+        self.name = name
+        self.built = False
+        self.batch_input_shape: Optional[Shape] = (
+            to_batch_shape(input_shape) if input_shape is not None else None)
+        self.input_dtype = input_dtype
+        self._output_shape: Optional[Shape] = None
+        self._nodes: List[Node] = []
+        # param_name -> (l1, l2) weight-decay coefficients
+        self.param_regularizers: Dict[str, Tuple[float, float]] = {}
+
+    # ---------------------------------------------------------------- numeric
+    def build(self, rng, input_shape) -> Params:
+        """Create the parameter pytree for ``input_shape`` (batch-incl.)."""
+        return {}
+
+    def init_state(self, input_shape) -> State:
+        """Create the non-trainable state pytree (e.g. BN moving stats)."""
+        return {}
+
+    def call(self, params: Params, inputs, training: bool = False,
+             rng=None):
+        """Stateless forward. Stateful layers override ``apply`` instead."""
+        raise NotImplementedError(type(self).__name__)
+
+    def apply(self, params: Params, inputs, state: Optional[State] = None,
+              training: bool = False, rng=None):
+        """Pure forward returning ``(outputs, new_state)``."""
+        return self.call(params, inputs, training=training, rng=rng), state
+
+    def compute_output_shape(self, input_shape):
+        return input_shape
+
+    # ------------------------------------------------------------- lifecycle
+    def init(self, rng, input_shape=None):
+        """Build params+state. Returns ``{"params": ..., "state": ...}``."""
+        shape = self._resolve_input_shape(input_shape)
+        self._mark_built(shape)
+        return {"params": self.build(rng, shape),
+                "state": self.init_state(shape)}
+
+    def _resolve_input_shape(self, input_shape):
+        if input_shape is None:
+            if self.batch_input_shape is None:
+                raise ValueError(
+                    f"layer {self.name}: no input shape available")
+            return self.batch_input_shape
+        if _is_shape(input_shape):
+            return to_batch_shape(input_shape)
+        # multi-input: list of shapes
+        return [to_batch_shape(s) for s in input_shape]
+
+    def _mark_built(self, input_shape):
+        self.built = True
+        self._built_input_shape = input_shape
+        self._output_shape = self.compute_output_shape(input_shape)
+
+    # ------------------------------------------------------ shape accessors
+    def get_output_shape(self) -> Shape:
+        if self._output_shape is None:
+            if self.batch_input_shape is not None:
+                self._output_shape = self.compute_output_shape(
+                    self.batch_input_shape)
+            else:
+                raise ValueError(f"layer {self.name} has no known shape yet")
+        return self._output_shape
+
+    def get_input_shape(self) -> Shape:
+        if self.batch_input_shape is not None:
+            return self.batch_input_shape
+        if getattr(self, "_built_input_shape", None) is not None:
+            return self._built_input_shape
+        raise ValueError(f"layer {self.name} has no known input shape")
+
+    # ------------------------------------------------------------- symbolic
+    def __call__(self, inputs, **call_kwargs):
+        single = not isinstance(inputs, (list, tuple))
+        in_list = [inputs] if single else list(inputs)
+        for t in in_list:
+            if not isinstance(t, KTensor):
+                raise TypeError(
+                    f"layer {self.name} called on non-KTensor {type(t)}; "
+                    "use .apply/.call for numeric execution")
+        shapes = [t.shape for t in in_list]
+        in_shape = shapes[0] if (single or len(shapes) == 1) else shapes
+        if self.batch_input_shape is None and _is_shape(in_shape):
+            self.batch_input_shape = in_shape
+        out_shape = self.compute_output_shape(in_shape)
+        self._output_shape = out_shape
+        multi_out = (isinstance(out_shape, list))
+        out_shapes = out_shape if multi_out else [out_shape]
+        dtype = in_list[0].dtype
+        outs = [KTensor(s, dtype=dtype, index=i) for i, s in
+                enumerate(out_shapes)]
+        node = Node(self, in_list, outs, call_kwargs)
+        for t in outs:
+            t.node = node
+        self._nodes.append(node)
+        return outs[0] if not multi_out else outs
+
+    # --------------------------------------------------------------- params
+    def add_weight(self, params: Params, rng, name: str, shape,
+                   init="glorot_uniform", dtype=None, regularizer=None):
+        """Helper used inside ``build`` implementations."""
+        dtype = dtype or get_policy().param_dtype
+        params[name] = inits.get(init)(fold_name(rng, name), shape, dtype)
+        if regularizer is not None:
+            self.param_regularizers[name] = regularizer
+        return params
+
+    def regularization_loss(self, params: Params):
+        """Sum of L1/L2 penalties registered on this layer's params."""
+        total = 0.0
+        for pname, (l1, l2) in self.param_regularizers.items():
+            if pname not in params:
+                continue
+            w = params[pname]
+            if l1:
+                total = total + l1 * jnp.sum(jnp.abs(w))
+            if l2:
+                total = total + l2 * jnp.sum(jnp.square(w))
+        return total
+
+    # ---------------------------------------------------------------- misc
+    @property
+    def num_params(self) -> int:
+        if not self.built:
+            return 0
+        return 0
+
+    def __repr__(self):
+        return f"{type(self).__name__}(name={self.name})"
+
+
+class Container(Layer):
+    """A layer composed of sub-layers; params keyed by sub-layer name.
+
+    Name uniqueness is enforced, mirroring ``checkDuplicate``
+    (Topology.scala:895).
+    """
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.layers: List[Layer] = []
+
+    def _check_duplicate(self):
+        seen = set()
+        for l in self.layers:
+            if l.name in seen:
+                raise ValueError(f"duplicate layer name: {l.name}")
+            seen.add(l.name)
+
+    def regularization_loss_tree(self, params: Params):
+        total = 0.0
+        for l in self.layers:
+            sub = params.get(l.name, {})
+            if isinstance(l, Container):
+                total = total + l.regularization_loss_tree(sub)
+            else:
+                total = total + l.regularization_loss(sub)
+        return total
+
+    def regularization_loss(self, params: Params):
+        return self.regularization_loss_tree(params)

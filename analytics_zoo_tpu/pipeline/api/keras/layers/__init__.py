@@ -1,0 +1,18 @@
+from analytics_zoo_tpu.pipeline.api.keras.layers.core import (
+    Activation, Dense, Dropout, Flatten, Highway, Lambda, Masking,
+    MaxoutDense, Permute, RepeatVector, Reshape, SparseDense,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.embedding import (
+    Embedding, WordEmbedding,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.merge import Merge, merge
+from analytics_zoo_tpu.pipeline.api.keras.layers.normalization import (
+    BatchNormalization, L2Normalization, LayerNorm,
+)
+
+__all__ = [
+    "Activation", "Dense", "Dropout", "Flatten", "Highway", "Lambda",
+    "Masking", "MaxoutDense", "Permute", "RepeatVector", "Reshape",
+    "SparseDense", "Embedding", "WordEmbedding", "Merge", "merge",
+    "BatchNormalization", "L2Normalization", "LayerNorm",
+]

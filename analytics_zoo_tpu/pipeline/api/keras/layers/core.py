@@ -1,0 +1,308 @@
+"""Core layers: Dense family, Dropout, shape ops, Activation.
+
+Reference: zoo/pipeline/api/keras/layers/Core.scala (Dense, Dropout,
+Flatten, Reshape, Permute, RepeatVector, Masking, Highway, MaxoutDense,
+Activation...).  TPU notes: Dense lowers to one MXU matmul with inputs
+cast to the compute dtype (bf16) and f32 accumulation
+(``preferred_element_type``); shape ops are free under XLA fusion.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops import activations as acts
+from analytics_zoo_tpu.ops.dtypes import get_policy
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params
+
+
+def _matmul(x, w):
+    """MXU-friendly matmul: bf16 inputs, f32 accumulation."""
+    policy = get_policy()
+    return jax.lax.dot_general(
+        policy.cast_compute(x), policy.cast_compute(w),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+class Dense(Layer):
+    """Fully-connected layer (Core.scala Dense).
+
+    Input may have rank > 2; the contraction is over the last dim, as in
+    the reference's ``Dense`` on 3D input.
+    """
+
+    def __init__(self, output_dim: int, init="glorot_uniform",
+                 activation=None, W_regularizer=None, b_regularizer=None,
+                 bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.output_dim = int(output_dim)
+        self.kernel_init = init
+        self.activation = acts.get(activation)
+        self.use_bias = bias
+        self.W_regularizer = W_regularizer
+        self.b_regularizer = b_regularizer
+
+    def build(self, rng, input_shape) -> Params:
+        in_dim = input_shape[-1]
+        params: Params = {}
+        self.add_weight(params, rng, "kernel", (in_dim, self.output_dim),
+                        init=self.kernel_init, regularizer=self.W_regularizer)
+        if self.use_bias:
+            self.add_weight(params, rng, "bias", (self.output_dim,),
+                            init="zero", regularizer=self.b_regularizer)
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        y = _matmul(x, params["kernel"])
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class Activation(Layer):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self.activation = acts.get(activation) or (lambda x: x)
+
+    def call(self, params, x, training=False, rng=None):
+        return self.activation(x)
+
+
+class Dropout(Layer):
+    """Inverted dropout; identity at inference (Core.scala Dropout)."""
+
+    def __init__(self, p: float, **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+
+    def call(self, params, x, training=False, rng=None):
+        if not training or self.p <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(
+                f"dropout layer {self.name} needs an rng when training")
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class Flatten(Layer):
+    def call(self, params, x, training=False, rng=None):
+        return x.reshape(x.shape[0], -1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], int(np.prod(input_shape[1:])))
+
+
+class Reshape(Layer):
+    """Reshape non-batch dims; supports a single -1 (Core.scala Reshape)."""
+
+    def __init__(self, target_shape: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.target_shape = tuple(int(d) for d in target_shape)
+
+    def _resolve(self, input_shape):
+        n = int(np.prod(input_shape[1:]))
+        tgt = list(self.target_shape)
+        if -1 in tgt:
+            i = tgt.index(-1)
+            known = int(np.prod([d for d in tgt if d != -1]))
+            tgt[i] = n // known
+        return tuple(tgt)
+
+    def call(self, params, x, training=False, rng=None):
+        return x.reshape((x.shape[0],) + self._resolve((None,) + x.shape[1:]))
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + self._resolve(input_shape)
+
+
+class Permute(Layer):
+    """Permute non-batch dims; dims are 1-indexed as in Keras."""
+
+    def __init__(self, dims: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.dims = tuple(int(d) for d in dims)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.transpose(x, (0,) + self.dims)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0],) + tuple(
+            input_shape[d] for d in self.dims)
+
+
+class RepeatVector(Layer):
+    """(B, F) -> (B, n, F)."""
+
+    def __init__(self, n: int, **kwargs):
+        super().__init__(**kwargs)
+        self.n = int(n)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.repeat(x[:, None, :], self.n, axis=1)
+
+    def compute_output_shape(self, input_shape):
+        return (input_shape[0], self.n, input_shape[1])
+
+
+class Masking(Layer):
+    """Zero out timesteps equal to mask_value (Core.scala Masking)."""
+
+    def __init__(self, mask_value: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.mask_value = float(mask_value)
+
+    def call(self, params, x, training=False, rng=None):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+class Highway(Layer):
+    """Highway network layer: t*h(x) + (1-t)*x (Core.scala Highway)."""
+
+    def __init__(self, activation="tanh", bias: bool = True,
+                 W_regularizer=None, b_regularizer=None, **kwargs):
+        super().__init__(**kwargs)
+        self.activation = acts.get(activation) or (lambda v: v)
+        self.use_bias = bias
+        self.W_regularizer = W_regularizer
+        self.b_regularizer = b_regularizer
+
+    def build(self, rng, input_shape) -> Params:
+        d = input_shape[-1]
+        params: Params = {}
+        self.add_weight(params, rng, "kernel", (d, d),
+                        regularizer=self.W_regularizer)
+        self.add_weight(params, rng, "gate_kernel", (d, d),
+                        regularizer=self.W_regularizer)
+        if self.use_bias:
+            self.add_weight(params, rng, "bias", (d,), init="zero",
+                            regularizer=self.b_regularizer)
+            # negative gate bias: start mostly carrying input through
+            params["gate_bias"] = jnp.full((d,), -2.0,
+                                           get_policy().param_dtype)
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        h = _matmul(x, params["kernel"])
+        t = _matmul(x, params["gate_kernel"])
+        if self.use_bias:
+            h = h + params["bias"]
+            t = t + params["gate_bias"]
+        h = self.activation(h)
+        t = jax.nn.sigmoid(t)
+        return t * h + (1.0 - t) * x
+
+
+class MaxoutDense(Layer):
+    """Dense with maxout over nb_feature linear pieces."""
+
+    def __init__(self, output_dim: int, nb_feature: int = 4,
+                 W_regularizer=None, b_regularizer=None, bias: bool = True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.output_dim = int(output_dim)
+        self.nb_feature = int(nb_feature)
+        self.use_bias = bias
+        self.W_regularizer = W_regularizer
+        self.b_regularizer = b_regularizer
+
+    def build(self, rng, input_shape) -> Params:
+        d = input_shape[-1]
+        params: Params = {}
+        self.add_weight(params, rng, "kernel",
+                        (d, self.nb_feature * self.output_dim),
+                        regularizer=self.W_regularizer)
+        if self.use_bias:
+            self.add_weight(params, rng, "bias",
+                            (self.nb_feature * self.output_dim,),
+                            init="zero", regularizer=self.b_regularizer)
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        y = _matmul(x, params["kernel"])
+        if self.use_bias:
+            y = y + params["bias"]
+        y = y.reshape(y.shape[:-1] + (self.nb_feature, self.output_dim))
+        return jnp.max(y, axis=-2)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class SparseDense(Layer):
+    """Dense over sparse-ish input. TPU-natively the input is a dense
+    (possibly mostly-zero) array — XLA has no sparse matmul on MXU, so
+    the win of the reference's SparseDense (sparse gradients) is instead
+    obtained via embedding-style gathers; this layer keeps API parity.
+    """
+
+    def __init__(self, output_dim: int, init="glorot_uniform",
+                 activation=None, bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self._dense = None
+        self.output_dim = int(output_dim)
+        self.kernel_init = init
+        self.activation = acts.get(activation)
+        self.use_bias = bias
+
+    def build(self, rng, input_shape) -> Params:
+        d = input_shape[-1]
+        params: Params = {}
+        self.add_weight(params, rng, "kernel", (d, self.output_dim),
+                        init=self.kernel_init)
+        if self.use_bias:
+            self.add_weight(params, rng, "bias", (self.output_dim,),
+                            init="zero")
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        y = _matmul(x, params["kernel"])
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)
+
+
+class Lambda(Layer):
+    """Wrap an arbitrary jax function as a layer."""
+
+    def __init__(self, function, output_shape=None, **kwargs):
+        super().__init__(**kwargs)
+        self.function = function
+        self._out_shape_fn = output_shape
+
+    def call(self, params, x, training=False, rng=None):
+        return self.function(x)
+
+    def compute_output_shape(self, input_shape):
+        if self._out_shape_fn is None:
+            # probe with zeros on concrete batch of 1
+            def concretize(s):
+                return tuple(1 if d is None else d for d in s)
+            if isinstance(input_shape, list):
+                probe = [jnp.zeros(concretize(s)) for s in input_shape]
+            else:
+                probe = jnp.zeros(concretize(input_shape))
+            out = jax.eval_shape(self.function, probe)
+            return (None,) + tuple(out.shape[1:])
+        if callable(self._out_shape_fn):
+            return self._out_shape_fn(input_shape)
+        return (input_shape[0] if not isinstance(input_shape, list)
+                else input_shape[0][0],) + tuple(self._out_shape_fn)

@@ -1,0 +1,67 @@
+"""Embedding layers (ref: keras/layers/Embedding.scala,
+SparseEmbedding.scala).
+
+TPU note: embedding lookup is a gather from an HBM-resident table; for
+model-parallel runs the table rows can be sharded on the ``model`` axis
+and XLA turns the gather into an all-to-all — no custom code needed.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.dtypes import get_policy
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params
+
+
+class Embedding(Layer):
+    """Integer ids (B, T) -> vectors (B, T, D)."""
+
+    def __init__(self, input_dim: int, output_dim: int, init="uniform",
+                 W_regularizer=None, mask_zero: bool = False, **kwargs):
+        super().__init__(**kwargs)
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.kernel_init = init
+        self.mask_zero = mask_zero
+        self.W_regularizer = W_regularizer
+
+    def build(self, rng, input_shape) -> Params:
+        params: Params = {}
+        self.add_weight(params, rng, "embeddings",
+                        (self.input_dim, self.output_dim), init=self.kernel_init,
+                        regularizer=self.W_regularizer)
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        ids = x.astype(jnp.int32)
+        out = jnp.take(params["embeddings"], ids, axis=0)
+        if self.mask_zero:
+            out = out * (ids != 0)[..., None].astype(out.dtype)
+        return out
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape) + (self.output_dim,)
+
+
+class WordEmbedding(Embedding):
+    """Embedding initialised from pretrained vectors, optionally frozen
+    (ref: keras/layers/WordEmbedding.scala — GloVe loading)."""
+
+    def __init__(self, embedding_matrix, trainable: bool = False, **kwargs):
+        import numpy as np
+        mat = np.asarray(embedding_matrix)
+        super().__init__(mat.shape[0], mat.shape[1], **kwargs)
+        self._pretrained = mat
+        self.trainable = trainable
+
+    def build(self, rng, input_shape) -> Params:
+        return {"embeddings": jnp.asarray(
+            self._pretrained, get_policy().param_dtype)}
+
+    def call(self, params, x, training=False, rng=None):
+        emb = params["embeddings"]
+        if not self.trainable:
+            emb = jax.lax.stop_gradient(emb)
+        return jnp.take(emb, x.astype(jnp.int32), axis=0)

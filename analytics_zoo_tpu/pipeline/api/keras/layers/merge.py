@@ -1,0 +1,81 @@
+"""Merge layers — combine multiple branches
+(ref: keras/layers/Merge.scala: modes sum/mul/max/min/ave/concat/dot/cosine).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+
+class Merge(Layer):
+    def __init__(self, mode: str = "sum", concat_axis: int = -1, **kwargs):
+        super().__init__(**kwargs)
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def call(self, params, inputs: List, training=False, rng=None):
+        mode = self.mode
+        if mode == "concat":
+            return jnp.concatenate(inputs, axis=self.concat_axis)
+        if mode == "sum":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out
+        if mode == "mul":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out * x
+            return out
+        if mode == "max":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        if mode == "min":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = jnp.minimum(out, x)
+            return out
+        if mode == "ave":
+            out = inputs[0]
+            for x in inputs[1:]:
+                out = out + x
+            return out / len(inputs)
+        if mode == "dot":
+            a, b = inputs
+            return jnp.sum(a * b, axis=-1, keepdims=True)
+        if mode == "cosine":
+            a, b = inputs
+            na = jnp.linalg.norm(a, axis=-1, keepdims=True)
+            nb = jnp.linalg.norm(b, axis=-1, keepdims=True)
+            return jnp.sum(a * b, axis=-1, keepdims=True) / (na * nb + 1e-8)
+        raise ValueError(f"unknown merge mode {mode}")
+
+    def compute_output_shape(self, input_shape):
+        shapes = input_shape
+        if self.mode == "concat":
+            ax = self.concat_axis
+            base = list(shapes[0])
+            nd = len(base)
+            ax = ax % nd
+            total = 0
+            for s in shapes:
+                if s[ax] is None:
+                    total = None
+                    break
+                total += s[ax]
+            base[ax] = total
+            return tuple(base)
+        if self.mode in ("dot", "cosine"):
+            return (shapes[0][0], 1)
+        return tuple(shapes[0])
+
+
+def merge(inputs, mode="sum", concat_axis=-1, name=None):
+    """Functional helper mirroring zoo's ``merge``."""
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(inputs)

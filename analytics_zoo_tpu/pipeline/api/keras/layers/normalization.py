@@ -1,0 +1,111 @@
+"""Normalization layers (ref: keras/layers/BatchNormalization.scala,
+LayerNorm in keras/layers/ internal transformer utils).
+
+BatchNormalization is the framework's canonical *stateful* layer: its
+moving statistics live in the ``state`` collection and ``apply`` returns
+the updated state (pure-functionally) when training.  Under data
+parallelism the batch statistics are computed per-shard, matching the
+reference's per-replica BN behavior in BigDL.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.dtypes import get_policy
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params, State
+
+
+class BatchNormalization(Layer):
+    def __init__(self, epsilon: float = 1e-3, momentum: float = 0.99,
+                 beta_init="zero", gamma_init="one", axis: int = -1,
+                 scale: bool = True, center: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.epsilon = float(epsilon)
+        self.momentum = float(momentum)
+        self.axis = axis
+        self.scale = scale
+        self.center = center
+        self.beta_init = beta_init
+        self.gamma_init = gamma_init
+
+    def _dim(self, input_shape):
+        return input_shape[self.axis]
+
+    def build(self, rng, input_shape) -> Params:
+        d = self._dim(input_shape)
+        params: Params = {}
+        if self.scale:
+            self.add_weight(params, rng, "gamma", (d,), init=self.gamma_init)
+        if self.center:
+            self.add_weight(params, rng, "beta", (d,), init=self.beta_init)
+        return params
+
+    def init_state(self, input_shape) -> State:
+        d = self._dim(input_shape)
+        dtype = get_policy().param_dtype
+        return {"moving_mean": jnp.zeros((d,), dtype),
+                "moving_var": jnp.ones((d,), dtype)}
+
+    def apply(self, params, x, state=None, training=False, rng=None):
+        ax = self.axis % x.ndim
+        reduce_axes = tuple(i for i in range(x.ndim) if i != ax)
+        bshape = [1] * x.ndim
+        bshape[ax] = x.shape[ax]
+
+        if training:
+            mean = jnp.mean(x, axis=reduce_axes)
+            var = jnp.var(x, axis=reduce_axes)
+            m = self.momentum
+            new_state = {
+                "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
+                "moving_var": m * state["moving_var"] + (1 - m) * var,
+            }
+        else:
+            mean = state["moving_mean"]
+            var = state["moving_var"]
+            new_state = state
+
+        y = (x - mean.reshape(bshape)) / jnp.sqrt(
+            var.reshape(bshape) + self.epsilon)
+        if self.scale:
+            y = y * params["gamma"].reshape(bshape)
+        if self.center:
+            y = y + params["beta"].reshape(bshape)
+        return y.astype(x.dtype), new_state
+
+
+class LayerNorm(Layer):
+    """Layer normalization over the last dim (transformer building block,
+    ref: keras/layers/ internal LayerNorm used by BERT.scala)."""
+
+    def __init__(self, epsilon: float = 1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.epsilon = float(epsilon)
+
+    def build(self, rng, input_shape) -> Params:
+        d = input_shape[-1]
+        params: Params = {}
+        self.add_weight(params, rng, "gamma", (d,), init="one")
+        self.add_weight(params, rng, "beta", (d,), init="zero")
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) / jnp.sqrt(var + self.epsilon)
+        return (y * params["gamma"] + params["beta"]).astype(x.dtype)
+
+
+class L2Normalization(Layer):
+    """Unit-L2 normalize along an axis (objectdetection Normalize
+    analogue)."""
+
+    def __init__(self, axis: int = -1, epsilon: float = 1e-12, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+        self.epsilon = epsilon
+
+    def call(self, params, x, training=False, rng=None):
+        norm = jnp.linalg.norm(x, axis=self.axis, keepdims=True)
+        return x / jnp.maximum(norm, self.epsilon)

@@ -1,0 +1,170 @@
+"""Loss functions — the 16-strong objective set of the reference
+(zoo/pipeline/api/keras/objectives/: (Sparse)CategoricalCrossEntropy,
+BinaryCrossEntropy, MSE/MAE/MAPE/MSLE, Hinge/SquaredHinge/RankHinge,
+Poisson, CosineProximity, KLD, ClassNLL).
+
+Each Objective is ``loss(y_true, y_pred) -> scalar`` (mean over batch),
+pure and jit-safe.  ``get`` resolves Keras-style string names.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+class Objective:
+    def __init__(self, fn: Callable, name: str):
+        self.fn = fn
+        self.name = name
+
+    def __call__(self, y_true, y_pred):
+        return self.fn(y_true, y_pred)
+
+
+def _clip(p):
+    return jnp.clip(p, _EPS, 1.0 - _EPS)
+
+
+def mean_squared_error(y_true, y_pred):
+    return jnp.mean(jnp.square(y_pred - y_true))
+
+
+def mean_absolute_error(y_true, y_pred):
+    return jnp.mean(jnp.abs(y_pred - y_true))
+
+
+def mean_absolute_percentage_error(y_true, y_pred):
+    diff = jnp.abs((y_true - y_pred) /
+                   jnp.clip(jnp.abs(y_true), _EPS, None))
+    return 100.0 * jnp.mean(diff)
+
+
+def mean_squared_logarithmic_error(y_true, y_pred):
+    a = jnp.log(jnp.clip(y_pred, _EPS, None) + 1.0)
+    b = jnp.log(jnp.clip(y_true, _EPS, None) + 1.0)
+    return jnp.mean(jnp.square(a - b))
+
+
+def binary_crossentropy(y_true, y_pred):
+    p = _clip(y_pred)
+    return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
+
+
+def categorical_crossentropy(y_true, y_pred):
+    """One-hot targets vs probability predictions."""
+    p = _clip(y_pred)
+    return -jnp.mean(jnp.sum(y_true * jnp.log(p), axis=-1))
+
+
+def sparse_categorical_crossentropy(y_true, y_pred):
+    """Integer targets vs probability predictions."""
+    p = _clip(y_pred)
+    labels = y_true.astype(jnp.int32)
+    if labels.ndim == p.ndim:            # (B,1) -> (B,)
+        labels = labels.squeeze(-1)
+    ll = jnp.take_along_axis(jnp.log(p), labels[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def categorical_crossentropy_with_logits(y_true, logits):
+    return -jnp.mean(jnp.sum(y_true * jax.nn.log_softmax(logits), axis=-1))
+
+
+def sparse_categorical_crossentropy_with_logits(y_true, logits):
+    labels = y_true.astype(jnp.int32)
+    if labels.ndim == logits.ndim:
+        labels = labels.squeeze(-1)
+    lsm = jax.nn.log_softmax(logits)
+    ll = jnp.take_along_axis(lsm, labels[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def class_nll(y_true, log_probs):
+    """Negative log-likelihood over log-probability inputs (BigDL
+    ClassNLLCriterion semantics, zero-based labels here)."""
+    labels = y_true.astype(jnp.int32)
+    if labels.ndim == log_probs.ndim:
+        labels = labels.squeeze(-1)
+    ll = jnp.take_along_axis(log_probs, labels[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def hinge(y_true, y_pred):
+    return jnp.mean(jnp.maximum(1.0 - y_true * y_pred, 0.0))
+
+
+def squared_hinge(y_true, y_pred):
+    return jnp.mean(jnp.square(jnp.maximum(1.0 - y_true * y_pred, 0.0)))
+
+
+def rank_hinge(y_true, y_pred, margin: float = 1.0):
+    """Pairwise ranking hinge for text matching (RankHinge.scala).
+
+    Expects interleaved (positive, negative) pairs along the batch dim,
+    as produced by the reference's relation-pair sampling.
+    """
+    pos = y_pred[0::2]
+    neg = y_pred[1::2]
+    return jnp.mean(jnp.maximum(margin - pos + neg, 0.0))
+
+
+def poisson(y_true, y_pred):
+    return jnp.mean(y_pred - y_true * jnp.log(y_pred + _EPS))
+
+
+def cosine_proximity(y_true, y_pred):
+    t = y_true / jnp.clip(
+        jnp.linalg.norm(y_true, axis=-1, keepdims=True), _EPS, None)
+    p = y_pred / jnp.clip(
+        jnp.linalg.norm(y_pred, axis=-1, keepdims=True), _EPS, None)
+    return -jnp.mean(jnp.sum(t * p, axis=-1))
+
+
+def kullback_leibler_divergence(y_true, y_pred):
+    t = _clip(y_true)
+    p = _clip(y_pred)
+    return jnp.mean(jnp.sum(t * jnp.log(t / p), axis=-1))
+
+
+_REGISTRY = {
+    "mse": mean_squared_error,
+    "mean_squared_error": mean_squared_error,
+    "mae": mean_absolute_error,
+    "mean_absolute_error": mean_absolute_error,
+    "mape": mean_absolute_percentage_error,
+    "mean_absolute_percentage_error": mean_absolute_percentage_error,
+    "msle": mean_squared_logarithmic_error,
+    "mean_squared_logarithmic_error": mean_squared_logarithmic_error,
+    "binary_crossentropy": binary_crossentropy,
+    "categorical_crossentropy": categorical_crossentropy,
+    "sparse_categorical_crossentropy": sparse_categorical_crossentropy,
+    "categorical_crossentropy_with_logits":
+        categorical_crossentropy_with_logits,
+    "sparse_categorical_crossentropy_with_logits":
+        sparse_categorical_crossentropy_with_logits,
+    "class_nll": class_nll,
+    "hinge": hinge,
+    "squared_hinge": squared_hinge,
+    "rank_hinge": rank_hinge,
+    "poisson": poisson,
+    "cosine_proximity": cosine_proximity,
+    "kld": kullback_leibler_divergence,
+    "kullback_leibler_divergence": kullback_leibler_divergence,
+}
+
+
+def get(loss) -> Objective:
+    if isinstance(loss, Objective):
+        return loss
+    if callable(loss):
+        return Objective(loss, getattr(loss, "__name__", "custom"))
+    name = str(loss).lower()
+    try:
+        return Objective(_REGISTRY[name], name)
+    except KeyError:
+        raise ValueError(f"unknown loss: {loss!r}") from None

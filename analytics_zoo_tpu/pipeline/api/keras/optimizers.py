@@ -1,0 +1,175 @@
+"""Optimizers with the reference's semantics, built on optax.
+
+Reference surface (SURVEY.md §2.3): Keras-style Adam
+(keras/optimizers/Adam.scala), AdamWeightDecay (BERT recipe,
+AdamWeightDecay.scala), plus BigDL SGD with Poly/Warmup learning-rate
+schedules used by the ImageNet recipes (examples/inception/Train.scala:
+75-99 — SGD momentum 0.9, Poly(0.5) decay with warmup) and
+``Optim.Fixed`` (common/Optim.scala).
+
+An ``OptimMethod`` wraps an optax ``GradientTransformation``; the
+schedule is iteration-indexed, matching the reference's per-iteration
+``LearningRateSchedule.updateHyperParameter``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import optax
+
+
+# --------------------------------------------------------------- schedules
+def fixed(lr: float) -> Callable:
+    return lambda step: lr
+
+
+def poly(lr: float, power: float, max_iteration: int) -> Callable:
+    """BigDL SGD.Poly: lr * (1 - iter/max_iter)^power."""
+    return optax.polynomial_schedule(
+        init_value=lr, end_value=0.0, power=power,
+        transition_steps=max_iteration)
+
+
+def warmup_then(base_lr: float, warmup_iterations: int,
+                after: Callable) -> Callable:
+    """Linear warmup 0→base_lr then hand off (BigDL Warmup + Sequential
+    Schedule as used in examples/inception/Train.scala:75-99)."""
+    warm = optax.linear_schedule(0.0, base_lr, warmup_iterations)
+    return optax.join_schedules([warm, after], [warmup_iterations])
+
+
+def plateau(lr: float, factor: float = 0.1, patience: int = 10):
+    raise NotImplementedError(
+        "metric-driven Plateau schedule is applied by the Estimator "
+        "driver loop, not inside the jitted step")
+
+
+class OptimMethod:
+    """A named optimizer: optax transformation + lr schedule."""
+
+    def __init__(self, tx: optax.GradientTransformation, name: str,
+                 learning_rate: Union[float, Callable] = None):
+        self.tx = tx
+        self.name = name
+        self.learning_rate = learning_rate
+
+    def init(self, params):
+        return self.tx.init(params)
+
+    def update(self, grads, opt_state, params):
+        return self.tx.update(grads, opt_state, params)
+
+
+def _sched(learning_rate, schedule):
+    if schedule is not None:
+        return schedule
+    if callable(learning_rate):
+        return learning_rate
+    return float(learning_rate)
+
+
+class SGD(OptimMethod):
+    """SGD + momentum + optional schedule + weight decay
+    (BigDL optim.SGD semantics)."""
+
+    def __init__(self, learning_rate: float = 0.01, momentum: float = 0.0,
+                 dampening: float = 0.0, nesterov: bool = False,
+                 weight_decay: float = 0.0, schedule=None):
+        lr = _sched(learning_rate, schedule)
+        chain = []
+        if weight_decay:
+            chain.append(optax.add_decayed_weights(weight_decay))
+        chain.append(optax.sgd(lr, momentum=momentum or None,
+                               nesterov=nesterov))
+        super().__init__(optax.chain(*chain), "sgd", lr)
+
+
+class Adam(OptimMethod):
+    """Keras-semantics Adam (keras/optimizers/Adam.scala: lr decay via
+    ``decay`` per iteration)."""
+
+    def __init__(self, lr: float = 1e-3, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-8,
+                 decay: float = 0.0, schedule=None):
+        if schedule is None and decay > 0:
+            schedule = lambda step: lr / (1.0 + decay * step)
+        sched = _sched(lr, schedule)
+        super().__init__(
+            optax.adam(sched, b1=beta_1, b2=beta_2, eps=epsilon),
+            "adam", sched)
+
+
+class AdamWeightDecay(OptimMethod):
+    """BERT-style AdamW with linear warmup + linear decay
+    (keras/optimizers/AdamWeightDecay.scala)."""
+
+    def __init__(self, lr: float = 1e-3, warmup_portion: float = -1.0,
+                 total: int = -1, schedule_name: str = "linear",
+                 beta_1: float = 0.9, beta_2: float = 0.999,
+                 epsilon: float = 1e-6, weight_decay: float = 0.01):
+        if total > 0:
+            warm = int(max(warmup_portion, 0.0) * total)
+            sched = optax.join_schedules(
+                [optax.linear_schedule(0.0, lr, warm or 1),
+                 optax.linear_schedule(lr, 0.0, total - warm)],
+                [warm or 1])
+        else:
+            sched = lr
+        super().__init__(
+            optax.adamw(sched, b1=beta_1, b2=beta_2, eps=epsilon,
+                        weight_decay=weight_decay),
+            "adamw", sched)
+
+
+class RMSprop(OptimMethod):
+    def __init__(self, lr: float = 1e-3, decay_rate: float = 0.9,
+                 epsilon: float = 1e-8, schedule=None):
+        sched = _sched(lr, schedule)
+        super().__init__(optax.rmsprop(sched, decay=decay_rate, eps=epsilon),
+                         "rmsprop", sched)
+
+
+class Adagrad(OptimMethod):
+    def __init__(self, lr: float = 1e-2, epsilon: float = 1e-10,
+                 schedule=None):
+        sched = _sched(lr, schedule)
+        super().__init__(optax.adagrad(sched, eps=epsilon), "adagrad", sched)
+
+
+class Adadelta(OptimMethod):
+    def __init__(self, lr: float = 1.0, rho: float = 0.95,
+                 epsilon: float = 1e-8):
+        super().__init__(optax.adadelta(lr, rho=rho, eps=epsilon),
+                         "adadelta", lr)
+
+
+class Adamax(OptimMethod):
+    def __init__(self, lr: float = 2e-3, beta_1: float = 0.9,
+                 beta_2: float = 0.999, epsilon: float = 1e-8):
+        super().__init__(optax.adamax(lr, b1=beta_1, b2=beta_2, eps=epsilon),
+                         "adamax", lr)
+
+
+_REGISTRY = {
+    "sgd": SGD,
+    "adam": Adam,
+    "adamw": AdamWeightDecay,
+    "adamweightdecay": AdamWeightDecay,
+    "rmsprop": RMSprop,
+    "adagrad": Adagrad,
+    "adadelta": Adadelta,
+    "adamax": Adamax,
+}
+
+
+def get(optimizer) -> Optional[OptimMethod]:
+    if optimizer is None or isinstance(optimizer, OptimMethod):
+        return optimizer
+    if isinstance(optimizer, optax.GradientTransformation):
+        return OptimMethod(optimizer, "custom")
+    name = str(optimizer).lower()
+    try:
+        return _REGISTRY[name]()
+    except KeyError:
+        raise ValueError(f"unknown optimizer: {optimizer!r}") from None

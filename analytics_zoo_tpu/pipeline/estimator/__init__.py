@@ -1,0 +1,3 @@
+from analytics_zoo_tpu.pipeline.estimator.estimator import Estimator
+
+__all__ = ["Estimator"]

@@ -1,0 +1,108 @@
+"""Checkpoint serialization.
+
+Reference checkpointing (SURVEY.md §5): timestamped ``model.<ts>`` +
+``optimMethod-<name>.<ts>`` snapshot files with latest-file resume
+(Topology.scala:1293-1306, getLatestFile :1519).  We keep the same
+latest-snapshot directory contract; payloads are msgpack-encoded pytrees
+(flax.serialization) written atomically.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Any, Optional
+
+from flax import serialization as fser
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def save_variables(path: str, variables: Any, over_write: bool = True) -> None:
+    if os.path.exists(path) and not over_write:
+        raise FileExistsError(path)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    _atomic_write(path, fser.to_bytes(variables))
+
+
+def load_variables(path: str, like: Any) -> Any:
+    """Load a pytree saved by ``save_variables``.
+
+    Primary path matches by structure (layer names).  If names differ —
+    e.g. the model was rebuilt in the same process so auto-names shifted
+    (``dense_1`` → ``dense_3``) — falls back to positional matching with
+    a strict shape check.
+    """
+    import logging
+
+    import jax
+    import numpy as np
+
+    with open(path, "rb") as f:
+        data = f.read()
+    try:
+        return fser.from_bytes(like, data)
+    except (ValueError, KeyError):
+        raw = fser.msgpack_restore(data)
+        raw_leaves = jax.tree_util.tree_leaves(raw)
+        like_leaves, treedef = jax.tree_util.tree_flatten(like)
+        if len(raw_leaves) == len(like_leaves) and all(
+                np.shape(a) == np.shape(b)
+                for a, b in zip(raw_leaves, like_leaves)):
+            logging.getLogger("analytics_zoo_tpu").warning(
+                "checkpoint %s: layer names differ from target; matched "
+                "%d arrays positionally", path, len(raw_leaves))
+            return jax.tree_util.tree_unflatten(treedef, raw_leaves)
+        raise
+
+
+class Checkpoint:
+    """Timestamped snapshot dir with latest-resume and retention."""
+
+    PATTERN = re.compile(r"snapshot\.(\d+)\.ckpt$")
+
+    def __init__(self, directory: str, keep: Optional[int] = None):
+        from analytics_zoo_tpu.common.config import get_config
+        self.directory = directory
+        self.keep = keep if keep is not None \
+            else int(get_config().get("checkpoint.keep"))
+        os.makedirs(directory, exist_ok=True)
+
+    def save(self, payload: Any, step: int) -> str:
+        path = os.path.join(self.directory, f"snapshot.{step}.ckpt")
+        _atomic_write(path, fser.to_bytes(payload))
+        self._retain()
+        return path
+
+    def latest_path(self) -> Optional[str]:
+        best, best_step = None, -1
+        for name in os.listdir(self.directory):
+            m = self.PATTERN.match(name)
+            if m and int(m.group(1)) > best_step:
+                best_step = int(m.group(1))
+                best = os.path.join(self.directory, name)
+        return best
+
+    def restore_latest(self, like: Any) -> Optional[Any]:
+        path = self.latest_path()
+        if path is None:
+            return None
+        with open(path, "rb") as f:
+            return fser.from_bytes(like, f.read())
+
+    def _retain(self) -> None:
+        snaps = sorted(
+            (int(self.PATTERN.match(n).group(1)), n)
+            for n in os.listdir(self.directory) if self.PATTERN.match(n))
+        while len(snaps) > self.keep:
+            _, name = snaps.pop(0)
+            try:
+                os.remove(os.path.join(self.directory, name))
+            except OSError:
+                pass

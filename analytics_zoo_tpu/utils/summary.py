@@ -1,0 +1,69 @@
+"""Training/validation summaries (ref: the pure-Scala TensorBoard writer
+— tensorboard/FileWriter.scala, Summary.scala: TrainSummary /
+ValidationSummary with scalar tags Loss, LearningRate, Throughput and
+per-metric validation scalars, surfaced via Topology.scala:205-237).
+
+Scalars are appended to a JSONL event log per app (crash-safe, trivially
+parseable) with the same tag names and a ``read_scalar`` read-back API.
+A TensorBoard-proto writer can layer on later without changing callers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+class _ScalarWriter:
+    def __init__(self, log_dir: str, app_name: str, kind: str):
+        self.dir = os.path.join(log_dir, app_name, kind)
+        os.makedirs(self.dir, exist_ok=True)
+        self.path = os.path.join(self.dir, "events.jsonl")
+        self._f = open(self.path, "a")
+
+    def add_scalar(self, tag: str, value: float, step: int) -> None:
+        rec = {"tag": tag, "value": float(value), "step": int(step),
+               "wall_time": time.time()}
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()
+
+    def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
+        out = []
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("tag") == tag:
+                    out.append((rec["step"], rec["value"]))
+        return out
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class TrainSummary(_ScalarWriter):
+    """Tags: Loss, LearningRate, Throughput (Topology.scala:221-223)."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "train")
+
+
+class ValidationSummary(_ScalarWriter):
+    """One scalar per validation metric name."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "validation")
+
+
+class InferenceSummary(_ScalarWriter):
+    """Serving-side tags: 'Serving Throughput', 'Total Records Number'
+    (ClusterServing.scala:294-317)."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "inference")

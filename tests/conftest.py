@@ -1,0 +1,31 @@
+"""Test harness: simulate an 8-device TPU pod on CPU.
+
+Mirrors the reference's test strategy (SURVEY.md §4): distributed paths
+are exercised on a multi-partition local backend — here an 8-device
+virtual CPU mesh via XLA_FLAGS, the analogue of `local[N]` Spark specs.
+Env vars must be set before jax initialises.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon site hook forces jax_platforms="axon,cpu"; tests must run on
+# the virtual 8-device CPU mesh, so override before backend init.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_context():
+    """Reset the global ZooContext between tests."""
+    yield
+    from analytics_zoo_tpu.common.zoo_context import reset_zoo_context
+    reset_zoo_context()

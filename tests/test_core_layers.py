@@ -1,0 +1,242 @@
+"""Layer unit tests — forward shape/value checks against numpy golden
+computations (the KerasRunner-style golden strategy, SURVEY.md §4.1,
+with numpy as the reference implementation instead of a Keras
+subprocess)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model, Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Activation, BatchNormalization, Dense, Dropout, Embedding, Flatten,
+    Highway, LayerNorm, Masking, MaxoutDense, Merge, Permute, RepeatVector,
+    Reshape, merge,
+)
+
+RNG = jax.random.PRNGKey(0)
+
+
+def apply_layer(layer, x, input_shape=None, training=False, rng=None):
+    variables = layer.init(RNG, input_shape or x.shape[1:])
+    out, _ = layer.apply(variables["params"], x,
+                         state=variables["state"], training=training,
+                         rng=rng)
+    return variables, out
+
+
+class TestDense:
+    def test_forward_matches_numpy(self):
+        x = np.random.RandomState(0).randn(4, 7).astype(np.float32)
+        layer = Dense(5)
+        variables, out = apply_layer(layer, x)
+        w = np.asarray(variables["params"]["kernel"])
+        b = np.asarray(variables["params"]["bias"])
+        np.testing.assert_allclose(np.asarray(out), x @ w + b,
+                                   rtol=2e-2, atol=2e-2)
+        assert out.shape == (4, 5)
+
+    def test_3d_input(self):
+        x = np.ones((2, 3, 7), np.float32)
+        layer = Dense(4, activation="relu")
+        _, out = apply_layer(layer, x)
+        assert out.shape == (2, 3, 4)
+        assert layer.compute_output_shape((None, 3, 7)) == (None, 3, 4)
+
+    def test_no_bias(self):
+        x = np.ones((2, 3), np.float32)
+        layer = Dense(4, bias=False)
+        variables, _ = apply_layer(layer, x)
+        assert "bias" not in variables["params"]
+
+
+class TestShapeOps:
+    def test_flatten(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        _, out = apply_layer(Flatten(), x)
+        assert out.shape == (2, 12)
+
+    def test_reshape_with_minus_one(self):
+        layer = Reshape((4, -1))
+        x = np.zeros((2, 3, 8), np.float32)
+        _, out = apply_layer(layer, x)
+        assert out.shape == (2, 4, 6)
+        assert layer.compute_output_shape((None, 3, 8)) == (None, 4, 6)
+
+    def test_permute(self):
+        layer = Permute((2, 1))
+        x = np.zeros((2, 3, 5), np.float32)
+        _, out = apply_layer(layer, x)
+        assert out.shape == (2, 5, 3)
+
+    def test_repeat_vector(self):
+        x = np.ones((2, 6), np.float32)
+        _, out = apply_layer(RepeatVector(4), x)
+        assert out.shape == (2, 4, 6)
+
+    def test_masking(self):
+        x = np.array([[[0.0, 0.0], [1.0, 2.0]]], np.float32)
+        _, out = apply_layer(Masking(0.0), x)
+        np.testing.assert_array_equal(np.asarray(out)[0, 0], [0.0, 0.0])
+        np.testing.assert_array_equal(np.asarray(out)[0, 1], [1.0, 2.0])
+
+
+class TestDropout:
+    def test_identity_at_inference(self):
+        x = np.random.randn(8, 16).astype(np.float32)
+        _, out = apply_layer(Dropout(0.5), x, training=False)
+        np.testing.assert_array_equal(np.asarray(out), x)
+
+    def test_scales_when_training(self):
+        x = np.ones((64, 128), np.float32)
+        _, out = apply_layer(Dropout(0.5), x, training=True,
+                             rng=jax.random.PRNGKey(1))
+        arr = np.asarray(out)
+        assert set(np.unique(arr)).issubset({0.0, 2.0})
+        assert abs(arr.mean() - 1.0) < 0.1
+
+    def test_requires_rng_when_training(self):
+        x = np.ones((2, 2), np.float32)
+        with pytest.raises(ValueError):
+            apply_layer(Dropout(0.5), x, training=True)
+
+
+class TestEmbedding:
+    def test_lookup(self):
+        layer = Embedding(10, 4)
+        ids = np.array([[1, 2], [3, 4]], np.int32)
+        variables, out = apply_layer(layer, ids, input_shape=(2,))
+        assert out.shape == (2, 2, 4)
+        table = np.asarray(variables["params"]["embeddings"])
+        np.testing.assert_allclose(np.asarray(out)[0, 0], table[1])
+
+    def test_mask_zero(self):
+        layer = Embedding(10, 4, mask_zero=True)
+        ids = np.array([[0, 2]], np.int32)
+        _, out = apply_layer(layer, ids, input_shape=(2,))
+        np.testing.assert_array_equal(np.asarray(out)[0, 0], np.zeros(4))
+
+
+class TestNormalization:
+    def test_batchnorm_train_and_infer(self):
+        x = np.random.RandomState(0).randn(32, 6).astype(np.float32) * 3 + 1
+        layer = BatchNormalization()
+        variables = layer.init(RNG, (6,))
+        out, new_state = layer.apply(
+            variables["params"], x, state=variables["state"], training=True)
+        arr = np.asarray(out)
+        np.testing.assert_allclose(arr.mean(axis=0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(arr.std(axis=0), 1.0, atol=1e-2)
+        # moving stats moved toward batch stats
+        assert not np.allclose(np.asarray(new_state["moving_mean"]), 0.0)
+        # inference path uses moving stats, returns state unchanged
+        out2, state2 = layer.apply(
+            variables["params"], x, state=new_state, training=False)
+        assert state2 is new_state
+
+    def test_layernorm(self):
+        x = np.random.RandomState(0).randn(4, 9).astype(np.float32)
+        _, out = apply_layer(LayerNorm(), x)
+        arr = np.asarray(out)
+        np.testing.assert_allclose(arr.mean(axis=-1), 0.0, atol=1e-5)
+
+
+class TestMergeAndGraph:
+    def test_merge_modes(self):
+        a = np.ones((2, 3), np.float32)
+        b = 2 * np.ones((2, 3), np.float32)
+        for mode, expect in [("sum", 3.0), ("mul", 2.0), ("max", 2.0),
+                             ("min", 1.0), ("ave", 1.5)]:
+            layer = Merge(mode=mode)
+            out, _ = layer.apply({}, [a, b])
+            assert np.allclose(np.asarray(out), expect), mode
+        out, _ = Merge(mode="concat").apply({}, [a, b])
+        assert out.shape == (2, 6)
+
+    def test_graph_model_two_branches(self):
+        left = Input(shape=(4,))
+        right = Input(shape=(4,))
+        la = Dense(8, activation="relu")(left)
+        rb = Dense(8, activation="relu")(right)
+        joined = merge([la, rb], mode="concat")
+        out = Dense(2)(joined)
+        model = Model([left, right], out)
+        model.init(RNG)
+        x1 = np.ones((3, 4), np.float32)
+        x2 = np.zeros((3, 4), np.float32)
+        variables = model.get_variables()
+        y, _ = model.apply(variables["params"], [x1, x2],
+                           state=variables["state"])
+        assert y.shape == (3, 2)
+
+    def test_shared_layer(self):
+        shared = Dense(5)
+        i1, i2 = Input(shape=(3,)), Input(shape=(3,))
+        o = merge([shared(i1), shared(i2)], mode="sum")
+        model = Model([i1, i2], o)
+        variables = model.init(RNG)
+        # one params entry for the shared layer
+        assert sum(1 for k in variables["params"] if "dense" in k) == 1
+        x = np.ones((2, 3), np.float32)
+        y, _ = model.apply(variables["params"], [x, x],
+                           state=variables["state"])
+        y1, _ = shared.apply(variables["params"][shared.name], x)
+        np.testing.assert_allclose(np.asarray(y), 2 * np.asarray(y1),
+                                   rtol=1e-5)
+
+
+class TestSequential:
+    def test_stack_and_shapes(self):
+        model = Sequential()
+        model.add(Dense(16, activation="relu", input_shape=(8,)))
+        model.add(Dropout(0.2))
+        model.add(Dense(4))
+        model.add(Activation("softmax"))
+        assert model.get_output_shape() == (None, 4)
+        variables = model.init(RNG)
+        x = np.random.randn(5, 8).astype(np.float32)
+        y, _ = model.apply(variables["params"], x,
+                           state=variables["state"])
+        arr = np.asarray(y)
+        assert arr.shape == (5, 4)
+        np.testing.assert_allclose(arr.sum(axis=-1), 1.0, rtol=1e-5)
+
+    def test_first_layer_needs_shape(self):
+        model = Sequential()
+        with pytest.raises(ValueError):
+            model.add(Dense(4))
+
+    def test_nested_sequential(self):
+        inner = Sequential()
+        inner.add(Dense(6, input_shape=(8,)))
+        outer = Sequential()
+        outer.add(inner)
+        outer.add(Dense(3))
+        variables = outer.init(RNG)
+        x = np.ones((2, 8), np.float32)
+        y, _ = outer.apply(variables["params"], x,
+                           state=variables["state"])
+        assert y.shape == (2, 3)
+
+
+class TestMisc:
+    def test_highway_and_maxout(self):
+        x = np.random.randn(4, 6).astype(np.float32)
+        _, out = apply_layer(Highway(), x)
+        assert out.shape == (4, 6)
+        _, out = apply_layer(MaxoutDense(3, nb_feature=2), x)
+        assert out.shape == (4, 3)
+
+    def test_jit_composes(self):
+        model = Sequential()
+        model.add(Dense(4, input_shape=(3,)))
+        variables = model.init(RNG)
+
+        @jax.jit
+        def fwd(params, x):
+            y, _ = model.apply(params, x, state={})
+            return y
+
+        out = fwd(variables["params"], jnp.ones((2, 3)))
+        assert out.shape == (2, 4)

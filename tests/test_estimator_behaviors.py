@@ -1,0 +1,103 @@
+"""Regression tests for estimator/trainer behaviors found in review:
+iteration-level triggers, default validation loss, positional weight
+reload, prefetch correctness."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.triggers import MaxIteration, SeveralIteration
+from analytics_zoo_tpu.feature.feature_set import FeatureSet
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+
+def small_data(n=512, d=8):
+    rs = np.random.RandomState(0)
+    return (rs.randn(n, d).astype(np.float32),
+            rs.randn(n, 1).astype(np.float32))
+
+
+def small_model():
+    m = Sequential()
+    m.add(Dense(1, input_shape=(8,)))
+    m.compile(optimizer="sgd", loss="mse")
+    return m
+
+
+def test_max_iteration_stops_exactly():
+    x, y = small_data()
+    m = small_model()
+    est = Estimator(m, optim_method=m.optim_method)
+    est.train(FeatureSet.from_ndarrays(x, y), "mse",
+              end_trigger=MaxIteration(10), batch_size=64)
+    assert est.train_state.iteration == 10
+
+
+def test_several_iteration_checkpoints_midepoch(tmp_path):
+    x, y = small_data()  # 8 batches/epoch at 64
+    m = small_model()
+    est = Estimator(m, optim_method=m.optim_method,
+                    model_dir=str(tmp_path))
+    est.train(FeatureSet.from_ndarrays(x, y), "mse",
+              end_trigger=MaxIteration(13),
+              checkpoint_trigger=SeveralIteration(5), batch_size=64)
+    import os
+    steps = sorted(int(f.split(".")[1]) for f in os.listdir(tmp_path)
+                   if f.endswith(".ckpt"))
+    assert 5 in steps and 10 in steps
+
+
+def test_fit_reports_val_loss_without_metrics():
+    x, y = small_data(n=128)
+    m = small_model()  # compiled without metrics
+    history = m.fit(x, y, batch_size=64, nb_epoch=2,
+                    validation_data=(x, y))
+    assert "val" in history[-1]
+    assert "loss" in history[-1]["val"]
+
+
+def test_positional_weight_reload(tmp_path):
+    x, y = small_data(n=128)
+    m1 = small_model()
+    m1.fit(x, y, batch_size=64, nb_epoch=1)
+    path = str(tmp_path / "w.ckpt")
+    m1.save_model(path)
+    # rebuild WITHOUT resetting name counters: names shift, shapes match
+    m2 = small_model()
+    m2.load_weights(path)
+    np.testing.assert_allclose(
+        np.concatenate([w.ravel() for w in m1.get_weights()]),
+        np.concatenate([w.ravel() for w in m2.get_weights()]))
+
+
+def test_featureset_with_validation_split_raises():
+    x, y = small_data(n=128)
+    m = small_model()
+    with pytest.raises(ValueError):
+        m.fit(FeatureSet.from_ndarrays(x, y), batch_size=64, nb_epoch=1,
+              validation_split=0.2)
+
+
+def test_prefetch_preserves_batch_order_and_count():
+    from analytics_zoo_tpu.parallel.trainer import DistributedTrainer
+    m = small_model()
+    trainer = DistributedTrainer(m, None)
+    batches = [(np.full((8, 2), i, np.float32), None) for i in range(20)]
+    out = list(trainer.prefetch(iter(batches), depth=3))
+    assert len(out) == 20
+    for i, (xb, yb) in enumerate(out):
+        assert float(np.asarray(xb)[0, 0]) == i
+
+
+def test_hit_ratio_batch_size_message():
+    from analytics_zoo_tpu.pipeline.api.keras.metrics import HitRatio
+    import jax.numpy as jnp
+    hr = HitRatio(k=10, neg_num=100)
+    with pytest.raises(ValueError, match="multiple of the group size"):
+        hr.batch_update(jnp.zeros((256, 1)), jnp.zeros((256, 1)),
+                        jnp.ones((256,)))
+    # aligned batch works: 2 groups of 101
+    num, den = hr.batch_update(jnp.zeros((202, 1)), jnp.zeros((202, 1)),
+                               jnp.ones((202,)))
+    assert float(den) == 2
