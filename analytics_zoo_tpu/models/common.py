@@ -1,0 +1,58 @@
+"""Model-zoo base classes.
+
+Reference: ``ZooModel`` (zoo/models/common/ZooModel.scala:37-154) —
+build/saveModel/loadModel/predictClasses — and ``KerasZooModel``
+(common/KerasZooModel.scala:183) adding the KerasNet training surface.
+
+Here a ZooModel *is* a thin facade over an inner KerasNet graph built by
+``build_model``; compile/fit/evaluate/predict/save delegate to it.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class ZooModel:
+    """Base: subclasses implement ``build_model() -> KerasNet``."""
+
+    def __init__(self, **kwargs):
+        self.model = self.build_model()
+
+    def build_model(self):
+        raise NotImplementedError
+
+    # ------------------------------------------------------------ delegate
+    def compile(self, *args, **kwargs):
+        self.model.compile(*args, **kwargs)
+        return self
+
+    def fit(self, *args, **kwargs):
+        return self.model.fit(*args, **kwargs)
+
+    def evaluate(self, *args, **kwargs):
+        return self.model.evaluate(*args, **kwargs)
+
+    def predict(self, *args, **kwargs):
+        return self.model.predict(*args, **kwargs)
+
+    def predict_classes(self, *args, **kwargs):
+        return self.model.predict_classes(*args, **kwargs)
+
+    def summary(self):
+        return self.model.summary()
+
+    def get_variables(self):
+        return self.model.get_variables()
+
+    def set_variables(self, variables):
+        self.model.set_variables(variables)
+
+    def save_model(self, path: str, over_write: bool = True):
+        self.model.save_model(path, over_write=over_write)
+
+    def load_weights(self, path: str):
+        self.model.load_weights(path)
+        return self

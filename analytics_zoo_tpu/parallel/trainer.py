@@ -214,11 +214,24 @@ class DistributedTrainer:
         Single-host path: ``device_put`` with NamedSharding.  Multi-host
         path would use ``jax.make_array_from_process_local_data`` — the
         per-host FeatureSet shard becomes this host's slice.
+
+        Leaves whose leading dim doesn't tile the data axis (e.g. a
+        group-aligned ranking-eval batch) are replicated instead — same
+        math, no shard speedup for that batch.
         """
+        dp = self.mesh.shape[mesh_lib.DATA_AXIS] * \
+            self.mesh.shape[mesh_lib.FSDP_AXIS]
+
+        def put(a):
+            if a is None:
+                return None
+            if np.ndim(a) == 0 or np.shape(a)[0] % dp != 0:
+                return jax.device_put(a, self._rep)
+            return jax.device_put(
+                a, mesh_lib.data_sharding(self.mesh, np.ndim(a)))
+
         return jax.tree_util.tree_map(
-            lambda a: a if a is None else jax.device_put(
-                a, mesh_lib.data_sharding(self.mesh, np.ndim(a))),
-            batch, is_leaf=lambda v: v is None)
+            put, batch, is_leaf=lambda v: v is None)
 
     def replicate(self, tree):
         return jax.device_put(tree, self._rep)

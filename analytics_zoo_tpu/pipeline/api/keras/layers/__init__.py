@@ -9,10 +9,14 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.merge import Merge, merge
 from analytics_zoo_tpu.pipeline.api.keras.layers.normalization import (
     BatchNormalization, L2Normalization, LayerNorm,
 )
+from analytics_zoo_tpu.pipeline.api.keras.layers.recurrent import (
+    GRU, LSTM, Bidirectional, SimpleRNN,
+)
 
 __all__ = [
     "Activation", "Dense", "Dropout", "Flatten", "Highway", "Lambda",
     "Masking", "MaxoutDense", "Permute", "RepeatVector", "Reshape",
     "SparseDense", "Embedding", "WordEmbedding", "Merge", "merge",
     "BatchNormalization", "L2Normalization", "LayerNorm",
+    "GRU", "LSTM", "Bidirectional", "SimpleRNN",
 ]

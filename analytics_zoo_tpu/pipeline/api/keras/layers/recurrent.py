@@ -1,0 +1,188 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU / Bidirectional.
+
+Reference: zoo/pipeline/api/keras/layers/Recurrent.scala (LSTM, GRU,
+SimpleRNN, Bidirectional wrappers over BigDL Recurrent containers).
+
+TPU design: the input projection ``x @ W`` for ALL timesteps is one
+large batched matmul (MXU-friendly, outside the loop); only the
+recurrent ``h @ U`` term runs inside ``lax.scan``.  No Python loops —
+the scan compiles to a single fused XLA while-loop with static shapes.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops import activations as acts
+from analytics_zoo_tpu.ops.dtypes import get_policy
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params
+
+
+def _mm(x, w):
+    policy = get_policy()
+    return jax.lax.dot_general(
+        policy.cast_compute(x), policy.cast_compute(w),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+class _RNNBase(Layer):
+    def __init__(self, output_dim: int, activation="tanh",
+                 inner_activation="sigmoid", return_sequences: bool = False,
+                 go_backwards: bool = False, init="glorot_uniform",
+                 inner_init="orthogonal", W_regularizer=None,
+                 U_regularizer=None, b_regularizer=None, **kwargs):
+        super().__init__(**kwargs)
+        self.output_dim = int(output_dim)
+        self.activation = acts.get(activation) or (lambda v: v)
+        self.inner_activation = acts.get(inner_activation) or (lambda v: v)
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+        self.kernel_init = init
+        self.inner_init = inner_init
+        self.W_regularizer = W_regularizer
+        self.U_regularizer = U_regularizer
+        self.b_regularizer = b_regularizer
+
+    n_gates = 1
+
+    def build(self, rng, input_shape) -> Params:
+        d = input_shape[-1]
+        h = self.output_dim
+        params: Params = {}
+        self.add_weight(params, rng, "kernel", (d, self.n_gates * h),
+                        init=self.kernel_init,
+                        regularizer=self.W_regularizer)
+        self.add_weight(params, rng, "recurrent_kernel",
+                        (h, self.n_gates * h), init=self.inner_init,
+                        regularizer=self.U_regularizer)
+        self.add_weight(params, rng, "bias", (self.n_gates * h,),
+                        init="zero", regularizer=self.b_regularizer)
+        return params
+
+    def initial_carry(self, batch: int):
+        h = jnp.zeros((batch, self.output_dim), jnp.float32)
+        return h
+
+    def step(self, params, carry, x_proj):
+        """One timestep: carry, pre-projected input slice -> carry, out."""
+        raise NotImplementedError
+
+    def call(self, params, x, training=False, rng=None):
+        # x: (B, T, D); all-timestep input projection in one matmul
+        x_proj = _mm(x, params["kernel"]) + params["bias"]
+        seq = jnp.swapaxes(x_proj, 0, 1)          # (T, B, G*H)
+        if self.go_backwards:
+            seq = seq[::-1]
+
+        def scan_fn(carry, xt):
+            new_carry, out = self.step(params, carry, xt)
+            return new_carry, out if self.return_sequences else None
+
+        carry = self.initial_carry(x.shape[0])
+        last_carry, outs = jax.lax.scan(scan_fn, carry, seq)
+        if self.return_sequences:
+            outs = jnp.swapaxes(outs, 0, 1)       # (B, T, H)
+            return outs[:, ::-1] if self.go_backwards else outs
+        h = last_carry[0] if isinstance(last_carry, tuple) else last_carry
+        return h
+
+    def compute_output_shape(self, input_shape):
+        if self.return_sequences:
+            return (input_shape[0], input_shape[1], self.output_dim)
+        return (input_shape[0], self.output_dim)
+
+
+class SimpleRNN(_RNNBase):
+    n_gates = 1
+
+    def step(self, params, h, xt):
+        new_h = self.activation(xt + _mm(h, params["recurrent_kernel"]))
+        return new_h, new_h
+
+
+class LSTM(_RNNBase):
+    """Gate order i, f, c, o (Keras-1 / Recurrent.scala LSTM)."""
+    n_gates = 4
+
+    def initial_carry(self, batch: int):
+        z = jnp.zeros((batch, self.output_dim), jnp.float32)
+        return (z, z)
+
+    def step(self, params, carry, xt):
+        h_prev, c_prev = carry
+        gates = xt + _mm(h_prev, params["recurrent_kernel"])
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = self.inner_activation(i)
+        f = self.inner_activation(f)
+        g = self.activation(g)
+        o = self.inner_activation(o)
+        c = f * c_prev + i * g
+        h = o * self.activation(c)
+        return (h, c), h
+
+
+class GRU(_RNNBase):
+    """Gate order z, r, h (Keras-1 / Recurrent.scala GRU)."""
+    n_gates = 3
+
+    def step(self, params, h_prev, xt):
+        hdim = self.output_dim
+        u = params["recurrent_kernel"]
+        xz, xr, xh = jnp.split(xt, 3, axis=-1)
+        uz = u[:, :hdim]
+        ur = u[:, hdim:2 * hdim]
+        uh = u[:, 2 * hdim:]
+        z = self.inner_activation(xz + _mm(h_prev, uz))
+        r = self.inner_activation(xr + _mm(h_prev, ur))
+        hh = self.activation(xh + _mm(r * h_prev, uh))
+        h = z * h_prev + (1.0 - z) * hh
+        return h, h
+
+
+class Bidirectional(Layer):
+    """Run a copy of ``layer`` in each direction and merge
+    (Recurrent.scala Bidirectional; merge_mode concat/sum/mul/ave)."""
+
+    def __init__(self, layer: _RNNBase, merge_mode: str = "concat",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.forward_layer = layer
+        self.backward_layer = copy.deepcopy(layer)
+        self.backward_layer.name = layer.name + "_bwd"
+        self.backward_layer.go_backwards = not layer.go_backwards
+        self.merge_mode = merge_mode
+
+    def build(self, rng, input_shape) -> Params:
+        from analytics_zoo_tpu.pipeline.api.keras.engine import fold_name
+        return {
+            "forward": self.forward_layer.init(
+                fold_name(rng, "fwd"), input_shape)["params"],
+            "backward": self.backward_layer.init(
+                fold_name(rng, "bwd"), input_shape)["params"],
+        }
+
+    def call(self, params, x, training=False, rng=None):
+        f = self.forward_layer.call(params["forward"], x,
+                                    training=training, rng=rng)
+        b = self.backward_layer.call(params["backward"], x,
+                                     training=training, rng=rng)
+        if self.merge_mode == "concat":
+            return jnp.concatenate([f, b], axis=-1)
+        if self.merge_mode == "sum":
+            return f + b
+        if self.merge_mode == "mul":
+            return f * b
+        if self.merge_mode == "ave":
+            return 0.5 * (f + b)
+        raise ValueError(f"unknown merge_mode {self.merge_mode}")
+
+    def compute_output_shape(self, input_shape):
+        base = self.forward_layer.compute_output_shape(input_shape)
+        if self.merge_mode == "concat":
+            return tuple(base[:-1]) + (2 * base[-1],)
+        return base

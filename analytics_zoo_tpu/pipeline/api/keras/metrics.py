@@ -152,6 +152,10 @@ class HitRatio(Metric):
 
     def _groups(self, y_pred, mask):
         g = self.neg_num + 1
+        # class outputs -> positive-class score per row
+        if y_pred.ndim > 1:
+            y_pred = y_pred[..., -1] if y_pred.shape[-1] > 1 \
+                else y_pred[..., 0]
         if y_pred.shape[0] % g != 0:
             raise ValueError(
                 f"{self.name}: eval batch size {y_pred.shape[0]} must be a "

@@ -405,8 +405,8 @@ class Model(KerasNet):
     def apply(self, params, inputs, state=None, training=False, rng=None):
         state = state or {}
         new_state = dict(state)
-        in_list = [inputs] if self._single_input and not isinstance(
-            inputs, (list, tuple)) else list(inputs)
+        in_list = [inputs] if not isinstance(inputs, (list, tuple)) \
+            else list(inputs)
         if len(in_list) != len(self.inputs):
             raise ValueError(
                 f"model {self.name} expects {len(self.inputs)} inputs, "

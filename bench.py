@@ -1,0 +1,92 @@
+"""Benchmark entrypoint — run by the driver on real TPU hardware.
+
+Workload: NCF on a MovieLens-1M-scale corpus (BASELINE.md config 1:
+"NCF on MovieLens-1M, Keras API"), implicit feedback with 4 sampled
+negatives per positive — the reference's headline recommender workload
+(zoo/models/recommendation/NeuralCF.scala + pyzoo NCF example).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+The reference publishes no absolute numbers (BASELINE.json published={}),
+so vs_baseline is reported against a recorded v5e-chip starting point
+once one exists (null until then).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from analytics_zoo_tpu.feature.datasets import movielens
+    from analytics_zoo_tpu.feature.feature_set import FeatureSet
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+    from analytics_zoo_tpu.parallel.trainer import DistributedTrainer
+
+    # ML-1M scale: 6040 users, 3706 items, 1M interactions.
+    ratings = movielens.synthetic_ratings()
+    train_x, train_y, _, _ = movielens.build_ncf_samples(
+        ratings, movielens.ML1M_USERS, movielens.ML1M_ITEMS,
+        neg_per_pos=4)
+    n = len(train_y)
+
+    model = NeuralCF(user_count=movielens.ML1M_USERS,
+                     item_count=movielens.ML1M_ITEMS, class_num=2,
+                     user_embed=64, item_embed=64, mf_embed=64,
+                     hidden_layers=(128, 64, 32)).model
+    model.compile(optimizer=Adam(lr=1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits")
+
+    batch_size = 16384
+    train_set = FeatureSet.from_ndarrays(train_x, train_y)
+    loss_fn = objectives.get(model.loss)
+    trainer = DistributedTrainer(model, loss_fn,
+                                 optim_method=model.optim_method)
+    variables = model.get_variables()
+    params = trainer.replicate(variables["params"])
+    state = trainer.replicate(variables["state"])
+    opt_state = trainer.replicate(trainer.init_opt_state(params))
+    rng = jax.random.PRNGKey(0)
+
+    # warmup: compile + first steps
+    it = train_set.epoch_batches(0, batch_size, train=True)
+    for i, batch in enumerate(trainer.prefetch(it)):
+        params, opt_state, state, loss = trainer.train_step(
+            params, opt_state, state, batch, rng)
+        if i >= 4:
+            break
+    jax.block_until_ready(loss)
+
+    # timed: one full epoch
+    t0 = time.time()
+    steps = 0
+    for batch in trainer.prefetch(train_set.epoch_batches(
+            1, batch_size, train=True)):
+        params, opt_state, state, loss = trainer.train_step(
+            params, opt_state, state, batch, rng)
+        steps += 1
+    jax.block_until_ready(loss)
+    wall = time.time() - t0
+
+    samples = steps * batch_size
+    throughput = samples / wall
+    print(json.dumps({
+        "metric": "ncf_movielens1m_train_throughput",
+        "value": round(throughput, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": None,
+        "epoch_time_s": round(wall, 2),
+        "epoch_samples": samples,
+        "steps": steps,
+        "batch_size": batch_size,
+        "final_loss": float(loss),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

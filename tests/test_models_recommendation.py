@@ -1,0 +1,187 @@
+"""Model-zoo tests: NCF, Wide&Deep, SessionRecommender — mirrors the
+reference's per-model test dirs (pyzoo/test/zoo/models/recommendation)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.datasets import movielens
+from analytics_zoo_tpu.models.recommendation import (
+    ColumnFeatureInfo, NeuralCF, SessionRecommender, WideAndDeep,
+)
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+
+def _toy_ratings(users=50, items=40, n=2000, seed=0):
+    return movielens.synthetic_ratings(users, items, n, seed=seed)
+
+
+class TestNeuralCF:
+    def test_forward_shapes(self):
+        m = NeuralCF(user_count=50, item_count=40, class_num=2)
+        x = m.pair_features(np.arange(1, 9), np.arange(1, 9))
+        out = m.predict(x, batch_size=8)
+        assert out.shape == (8, 2)
+
+    def test_trains_on_implicit_feedback(self):
+        ratings = _toy_ratings()
+        tx, ty, ex, ey = movielens.build_ncf_samples(
+            ratings, 50, 40, neg_per_pos=2, eval_neg=10)
+        m = NeuralCF(user_count=50, item_count=40, class_num=2,
+                     hidden_layers=(16, 8))
+        m.compile(optimizer=Adam(lr=0.01),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+        hist = m.fit(tx, ty, batch_size=256, nb_epoch=3)
+        # baseline entropy for the 1:2 pos/neg mix is ~0.64; random-init
+        # logits give ~0.69 — training must beat both
+        assert hist[-1]["loss"] < 0.62
+
+    def test_recommend_for_user(self):
+        m = NeuralCF(user_count=20, item_count=15, class_num=2)
+        recs = m.recommend_for_user([1, 2], candidate_items=range(1, 16),
+                                    max_items=3)
+        assert set(recs.keys()) == {1, 2}
+        assert len(recs[1]) == 3
+        scores = [r.probability for r in recs[1]]
+        assert scores == sorted(scores, reverse=True)
+
+    def test_hit_ratio_eval_path(self):
+        from analytics_zoo_tpu.pipeline.api.keras.metrics import (
+            HitRatio, NDCG)
+        ratings = _toy_ratings()
+        tx, ty, ex, ey = movielens.build_ncf_samples(
+            ratings, 50, 40, eval_neg=10)
+        m = NeuralCF(user_count=50, item_count=40, class_num=2)
+        m.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=[HitRatio(k=5, neg_num=10),
+                           NDCG(k=5, neg_num=10)])
+        # positive-class score drives ranking: evaluate over grouped rows
+        from analytics_zoo_tpu.feature.feature_set import FeatureSet
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        # use a batch that's a multiple of the group size (11)
+        scores = m.model.evaluate(ex, ey, batch_size=44)
+        assert "hit_ratio@5" in scores and "ndcg@5" in scores
+        assert 0.0 <= scores["hit_ratio@5"] <= 1.0
+
+
+class TestWideAndDeep:
+    def _info(self):
+        return ColumnFeatureInfo(
+            wide_base_cols=["gender", "age"], wide_base_dims=[3, 10],
+            wide_cross_cols=["gender_age"], wide_cross_dims=[30],
+            embed_cols=["occupation"], embed_in_dims=[21],
+            embed_out_dims=[8], continuous_cols=["hours"])
+
+    def _columns(self, n=200, seed=0):
+        rs = np.random.RandomState(seed)
+        gender = rs.randint(0, 3, n)
+        age = rs.randint(0, 10, n)
+        return {
+            "gender": gender, "age": age,
+            "gender_age": gender * 10 + age,
+            "occupation": rs.randint(0, 21, n),
+            "hours": rs.rand(n).astype(np.float32),
+        }
+
+    @pytest.mark.parametrize("model_type", ["wide", "deep", "wide_n_deep"])
+    def test_forward_all_types(self, model_type):
+        m = WideAndDeep(2, self._info(), model_type=model_type)
+        cols = self._columns(64)
+        x = m.features_from_columns(cols)
+        out = m.predict(x, batch_size=64)
+        assert out.shape == (64, 2)
+
+    def test_trains(self):
+        m = WideAndDeep(2, self._info())
+        cols = self._columns(512)
+        x = m.features_from_columns(cols)
+        # label correlated with gender for learnability
+        y = (cols["gender"] > 0).astype(np.int32).reshape(-1, 1)
+        m.compile(optimizer=Adam(lr=0.05),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+        m.fit(x, y, batch_size=64, nb_epoch=5, validation_data=(x, y))
+        scores = m.evaluate(x, y, batch_size=64)
+        assert scores["sparse_categorical_accuracy"] > 0.9
+
+
+class TestSessionRecommender:
+    def test_forward_and_recommend(self):
+        m = SessionRecommender(item_count=30, item_embed=16,
+                               rnn_hidden_layers=(16,), session_length=5)
+        sessions = np.random.RandomState(0).randint(1, 31, (12, 5))
+        recs = m.recommend_for_session(sessions, max_items=4)
+        assert len(recs) == 12
+        assert len(recs[0]) == 4
+
+    def test_with_history(self):
+        m = SessionRecommender(item_count=30, item_embed=16,
+                               rnn_hidden_layers=(16,), session_length=5,
+                               include_history=True, history_length=7,
+                               mlp_hidden_layers=(8,))
+        rs = np.random.RandomState(0)
+        sessions = rs.randint(1, 31, (8, 5))
+        history = rs.randint(1, 31, (8, 7))
+        recs = m.recommend_for_session(sessions, history=history)
+        assert len(recs) == 8
+
+    def test_trains_next_item(self):
+        rs = np.random.RandomState(0)
+        # trivially learnable: next item == last item of session
+        n = 512
+        sessions = rs.randint(1, 20, (n, 5)).astype(np.int32)
+        labels = sessions[:, -1].reshape(-1, 1).astype(np.int32)
+        m = SessionRecommender(item_count=20, item_embed=16,
+                               rnn_hidden_layers=(32,), session_length=5)
+        m.compile(optimizer=Adam(lr=0.02),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+        hist = m.fit(sessions, labels, batch_size=64, nb_epoch=8,
+                     validation_data=(sessions, labels))
+        assert hist[-1]["val"]["sparse_categorical_accuracy"] > 0.5
+
+
+class TestRecurrentLayers:
+    def test_lstm_gru_shapes(self):
+        import jax
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (
+            GRU, LSTM, Bidirectional, SimpleRNN)
+        x = np.random.RandomState(0).randn(4, 6, 5).astype(np.float32)
+        for cls in (SimpleRNN, LSTM, GRU):
+            layer = cls(7)
+            v = layer.init(jax.random.PRNGKey(0), (6, 5))
+            out, _ = layer.apply(v["params"], x, state=v["state"])
+            assert out.shape == (4, 7), cls.__name__
+            layer2 = cls(7, return_sequences=True)
+            v2 = layer2.init(jax.random.PRNGKey(0), (6, 5))
+            out2, _ = layer2.apply(v2["params"], x, state=v2["state"])
+            assert out2.shape == (4, 6, 7), cls.__name__
+
+    def test_bidirectional(self):
+        import jax
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (
+            Bidirectional, LSTM)
+        x = np.random.RandomState(0).randn(4, 6, 5).astype(np.float32)
+        layer = Bidirectional(LSTM(7, return_sequences=True))
+        v = layer.init(jax.random.PRNGKey(0), (6, 5))
+        out, _ = layer.apply(v["params"], x, state=v["state"])
+        assert out.shape == (4, 6, 14)
+
+    def test_lstm_matches_manual_step(self):
+        # golden check: single timestep equals hand-rolled gate math
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.pipeline.api.keras.layers import LSTM
+        layer = LSTM(3, activation="tanh", inner_activation="sigmoid")
+        v = layer.init(jax.random.PRNGKey(1), (1, 4))
+        x = np.random.RandomState(0).randn(2, 1, 4).astype(np.float32)
+        out, _ = layer.apply(v["params"], x, state=v["state"])
+        W = np.asarray(v["params"]["kernel"])
+        b = np.asarray(v["params"]["bias"])
+        gates = x[:, 0, :] @ W + b  # h0 = 0 so recurrent term drops
+        i, f, g, o = np.split(gates, 4, axis=-1)
+        sig = lambda z: 1 / (1 + np.exp(-z))
+        c = sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        np.testing.assert_allclose(np.asarray(out), h, rtol=2e-2, atol=2e-2)
