@@ -1,0 +1,180 @@
+"""Image pipeline: ImageSet + per-image transforms.
+
+Reference: zoo/feature/image/ImageSet.scala:46-140 and the transform set
+(ImageResize, ImageChannelNormalize, ImageMatToTensor, ImageColorJitter,
+ImageSetToSample...) built on OpenCV mats.
+
+TPU design: transforms are host-side numpy/cv2 ops running in the input
+pipeline (the executor-side OpenCV role), producing channels-last f32
+arrays ready for device infeed.  An ImageSet is a thin container over
+file paths or ndarrays; ``transform`` chains Preprocessing stages, and
+``to_feature_set`` materialises a columnar FeatureSet for training.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:
+    import cv2
+    _HAS_CV2 = True
+except Exception:            # pragma: no cover
+    _HAS_CV2 = False
+
+from analytics_zoo_tpu.feature.common import Preprocessing
+from analytics_zoo_tpu.feature.feature_set import FeatureSet
+
+
+def read_image(path: str, to_rgb: bool = True) -> np.ndarray:
+    """Decode one image file to HWC uint8."""
+    if _HAS_CV2:
+        img = cv2.imread(path, cv2.IMREAD_COLOR)
+        if img is None:
+            raise IOError(f"cannot decode image {path}")
+        return cv2.cvtColor(img, cv2.COLOR_BGR2RGB) if to_rgb else img
+    from PIL import Image            # pragma: no cover
+    return np.asarray(Image.open(path).convert("RGB"))
+
+
+# ------------------------------------------------------------- transforms
+class ImageResize(Preprocessing):
+    """(ref ImageResize.scala)"""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.h, self.w = int(resize_h), int(resize_w)
+
+    def apply(self, img: np.ndarray) -> np.ndarray:
+        if _HAS_CV2:
+            return cv2.resize(img, (self.w, self.h),
+                              interpolation=cv2.INTER_LINEAR)
+        from PIL import Image        # pragma: no cover
+        return np.asarray(Image.fromarray(img).resize((self.w, self.h)))
+
+
+class ImageCenterCrop(Preprocessing):
+    def __init__(self, crop_h: int, crop_w: int):
+        self.h, self.w = int(crop_h), int(crop_w)
+
+    def apply(self, img):
+        H, W = img.shape[:2]
+        top = max((H - self.h) // 2, 0)
+        left = max((W - self.w) // 2, 0)
+        return img[top:top + self.h, left:left + self.w]
+
+
+class ImageRandomCrop(Preprocessing):
+    def __init__(self, crop_h: int, crop_w: int, seed: int = 0):
+        self.h, self.w = int(crop_h), int(crop_w)
+        self.rng = np.random.default_rng(seed)
+
+    def apply(self, img):
+        H, W = img.shape[:2]
+        top = int(self.rng.integers(0, max(H - self.h, 0) + 1))
+        left = int(self.rng.integers(0, max(W - self.w, 0) + 1))
+        return img[top:top + self.h, left:left + self.w]
+
+
+class ImageHFlip(Preprocessing):
+    def __init__(self, prob: float = 0.5, seed: int = 0):
+        self.prob = prob
+        self.rng = np.random.default_rng(seed)
+
+    def apply(self, img):
+        if self.rng.random() < self.prob:
+            return img[:, ::-1]
+        return img
+
+
+class ImageChannelNormalize(Preprocessing):
+    """Subtract per-channel mean / divide std (ImageChannelNormalize)."""
+
+    def __init__(self, mean_r, mean_g, mean_b, std_r=1.0, std_g=1.0,
+                 std_b=1.0):
+        self.mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self.std = np.array([std_r, std_g, std_b], np.float32)
+
+    def apply(self, img):
+        return (img.astype(np.float32) - self.mean) / self.std
+
+
+class ImageBrightness(Preprocessing):
+    """Additive brightness jitter (part of ImageColorJitter)."""
+
+    def __init__(self, delta: float = 32.0, seed: int = 0):
+        self.delta = delta
+        self.rng = np.random.default_rng(seed)
+
+    def apply(self, img):
+        shift = self.rng.uniform(-self.delta, self.delta)
+        return np.clip(img.astype(np.float32) + shift, 0, 255)
+
+
+class ImageMatToTensor(Preprocessing):
+    """HWC uint8/float -> float32, optional CHW (ImageMatToTensor)."""
+
+    def __init__(self, format: str = "NHWC"):
+        self.format = format
+
+    def apply(self, img):
+        arr = img.astype(np.float32)
+        if self.format == "NCHW":
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+# -------------------------------------------------------------- ImageSet
+class ImageSet:
+    """Container of images (+ optional labels) with chained transforms.
+
+    ``read`` mirrors ImageSet.read (ImageSet.scala:98): local dir or
+    file list; with ``with_label=True``, one sub-dir per class.
+    """
+
+    def __init__(self, images: List, labels: Optional[np.ndarray] = None,
+                 label_map: Optional[dict] = None):
+        self.images = images
+        self.labels = labels
+        self.label_map = label_map
+
+    @classmethod
+    def read(cls, path: str, with_label: bool = False,
+             pattern: str = "*.jpg") -> "ImageSet":
+        if with_label:
+            classes = sorted(
+                d for d in os.listdir(path)
+                if os.path.isdir(os.path.join(path, d)))
+            label_map = {c: i for i, c in enumerate(classes)}
+            files, labels = [], []
+            for c in classes:
+                for f in sorted(glob.glob(os.path.join(path, c, pattern))):
+                    files.append(f)
+                    labels.append(label_map[c])
+            images = [read_image(f) for f in files]
+            return cls(images, np.asarray(labels, np.int32), label_map)
+        files = sorted(glob.glob(os.path.join(path, pattern)))
+        return cls([read_image(f) for f in files])
+
+    @classmethod
+    def from_ndarrays(cls, images: np.ndarray,
+                      labels: Optional[np.ndarray] = None) -> "ImageSet":
+        return cls(list(images),
+                   None if labels is None else np.asarray(labels))
+
+    def transform(self, stage: Preprocessing) -> "ImageSet":
+        return ImageSet([stage.apply(im) for im in self.images],
+                        self.labels, self.label_map)
+
+    __rshift__ = transform
+
+    def to_feature_set(self, shuffle: bool = True) -> FeatureSet:
+        x = np.stack(self.images).astype(np.float32)
+        y = None if self.labels is None else \
+            self.labels.reshape(-1, 1)
+        return FeatureSet.from_ndarrays(x, y, shuffle=shuffle)
+
+    def __len__(self):
+        return len(self.images)

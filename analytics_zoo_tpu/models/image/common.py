@@ -1,0 +1,52 @@
+"""ImageModel base + per-model ImageConfigure.
+
+Reference: zoo/models/image/common/ImageModel.scala:47 (predictImageSet
+dispatching through a model-specific ``ImageConfigure``) and
+ImageConfigure.scala:88 (preprocessor, postprocessor, batch size, label
+map).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.common import Preprocessing
+from analytics_zoo_tpu.models.common import ZooModel
+
+
+@dataclasses.dataclass
+class ImageConfigure:
+    preprocessor: Optional[Preprocessing] = None
+    postprocessor: Optional[Callable] = None
+    batch_per_partition: int = 4
+    label_map: Optional[dict] = None
+
+
+class ImageModel(ZooModel):
+    """Base for image classification / detection models."""
+
+    def __init__(self, config: Optional[ImageConfigure] = None):
+        self.config = config or ImageConfigure()
+        super().__init__()
+
+    def predict_image_set(self, image_set, configure: Optional[
+            ImageConfigure] = None, batch_size: int = 32):
+        cfg = configure or self.config
+        if cfg.preprocessor is not None:
+            image_set = image_set.transform(cfg.preprocessor)
+        x = np.stack(image_set.images).astype(np.float32)
+        out = self.predict(x, batch_size=batch_size)
+        if cfg.postprocessor is not None:
+            out = cfg.postprocessor(out)
+        return out
+
+    def predict_image_classes(self, image_set, top_k: int = 1, **kwargs):
+        out = np.asarray(self.predict_image_set(image_set, **kwargs))
+        idx = np.argsort(-out, axis=-1)[:, :top_k]
+        if self.config.label_map:
+            inv = {v: k for k, v in self.config.label_map.items()}
+            return [[inv.get(int(i), int(i)) for i in row] for row in idx]
+        return idx

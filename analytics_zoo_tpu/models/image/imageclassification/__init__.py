@@ -1,0 +1,5 @@
+from analytics_zoo_tpu.models.image.imageclassification.nets import (
+    ImageClassifier, inception_v1, lenet, resnet,
+)
+
+__all__ = ["ImageClassifier", "inception_v1", "lenet", "resnet"]

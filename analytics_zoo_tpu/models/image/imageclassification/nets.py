@@ -1,0 +1,171 @@
+"""Image classification nets: LeNet, ResNet-18/34/50, Inception-v1.
+
+Reference: the ImageClassification model family
+(zoo/models/image/imageclassification/ImageClassificationConfig.scala:190
+loads published analytics-zoo models by name) and the two ImageNet
+training recipes (examples/inception/Train.scala:31,
+examples/resnet/TrainImageNet.scala).
+
+TPU design notes: NHWC layout throughout, BN+ReLU after each conv (XLA
+fuses both into the conv epilogue), residual adds via Merge("sum"),
+global-average-pool head.  bf16 conv compute with f32 accumulation comes
+from the layer implementations.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+from analytics_zoo_tpu.models.image.common import ImageConfigure, ImageModel
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
+    Dropout, Flatten, GlobalAveragePooling2D, MaxPooling2D, Merge,
+)
+
+
+def _conv_bn(x, filters, k, stride=1, act=True, border="same"):
+    x = Convolution2D(filters, k, k, subsample=(stride, stride),
+                      border_mode=border, bias=False)(x)
+    x = BatchNormalization()(x)
+    if act:
+        x = Activation("relu")(x)
+    return x
+
+
+# ------------------------------------------------------------------ LeNet
+def lenet(num_classes: int = 10,
+          input_shape: Tuple[int, int, int] = (28, 28, 1)) -> Model:
+    inp = Input(shape=input_shape)
+    x = Convolution2D(6, 5, 5, border_mode="same",
+                      activation="tanh")(inp)
+    x = MaxPooling2D()(x)
+    x = Convolution2D(12, 5, 5, activation="tanh")(x)
+    x = MaxPooling2D()(x)
+    x = Flatten()(x)
+    x = Dense(100, activation="tanh")(x)
+    out = Dense(num_classes)(x)
+    return Model(inp, out)
+
+
+# ----------------------------------------------------------------- ResNet
+def _basic_block(x, filters, stride):
+    shortcut = x
+    y = _conv_bn(x, filters, 3, stride)
+    y = _conv_bn(y, filters, 3, 1, act=False)
+    if stride != 1 or x.shape[-1] != filters:
+        shortcut = _conv_bn(x, filters, 1, stride, act=False)
+    out = Merge(mode="sum")([y, shortcut])
+    return Activation("relu")(out)
+
+
+def _bottleneck_block(x, filters, stride):
+    shortcut = x
+    y = _conv_bn(x, filters, 1, 1)
+    y = _conv_bn(y, filters, 3, stride)
+    y = _conv_bn(y, 4 * filters, 1, 1, act=False)
+    if stride != 1 or x.shape[-1] != 4 * filters:
+        shortcut = _conv_bn(x, 4 * filters, 1, stride, act=False)
+    out = Merge(mode="sum")([y, shortcut])
+    return Activation("relu")(out)
+
+
+_RESNET_SPECS = {
+    18: (_basic_block, (2, 2, 2, 2)),
+    34: (_basic_block, (3, 4, 6, 3)),
+    50: (_bottleneck_block, (3, 4, 6, 3)),
+    101: (_bottleneck_block, (3, 4, 23, 3)),
+    152: (_bottleneck_block, (3, 8, 36, 3)),
+}
+
+
+def resnet(depth: int = 50, num_classes: int = 1000,
+           input_shape: Tuple[int, int, int] = (224, 224, 3)) -> Model:
+    """ResNet for ImageNet-scale inputs (TrainImageNet.scala recipe)."""
+    block, reps = _RESNET_SPECS[depth]
+    inp = Input(shape=input_shape)
+    x = _conv_bn(inp, 64, 7, 2)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                     border_mode="same")(x)
+    filters = 64
+    for stage, n in enumerate(reps):
+        for i in range(n):
+            stride = 2 if (stage > 0 and i == 0) else 1
+            x = block(x, filters, stride)
+        filters *= 2
+    x = GlobalAveragePooling2D()(x)
+    out = Dense(num_classes)(x)
+    return Model(inp, out)
+
+
+# ------------------------------------------------------------ Inception-v1
+def _inception_module(x, f1, f3r, f3, f5r, f5, proj):
+    b1 = _conv_bn(x, f1, 1)
+    b3 = _conv_bn(_conv_bn(x, f3r, 1), f3, 3)
+    b5 = _conv_bn(_conv_bn(x, f5r, 1), f5, 5)
+    bp = MaxPooling2D(pool_size=(3, 3), strides=(1, 1),
+                      border_mode="same")(x)
+    bp = _conv_bn(bp, proj, 1)
+    return Merge(mode="concat", concat_axis=-1)([b1, b3, b5, bp])
+
+
+def inception_v1(num_classes: int = 1000,
+                 input_shape: Tuple[int, int, int] = (224, 224, 3)
+                 ) -> Model:
+    """GoogLeNet / Inception-v1 (examples/inception/Train.scala:31
+    workload)."""
+    inp = Input(shape=input_shape)
+    x = _conv_bn(inp, 64, 7, 2)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                     border_mode="same")(x)
+    x = _conv_bn(x, 64, 1)
+    x = _conv_bn(x, 192, 3)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                     border_mode="same")(x)
+    x = _inception_module(x, 64, 96, 128, 16, 32, 32)     # 3a
+    x = _inception_module(x, 128, 128, 192, 32, 96, 64)   # 3b
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                     border_mode="same")(x)
+    x = _inception_module(x, 192, 96, 208, 16, 48, 64)    # 4a
+    x = _inception_module(x, 160, 112, 224, 24, 64, 64)   # 4b
+    x = _inception_module(x, 128, 128, 256, 24, 64, 64)   # 4c
+    x = _inception_module(x, 112, 144, 288, 32, 64, 64)   # 4d
+    x = _inception_module(x, 256, 160, 320, 32, 128, 128)  # 4e
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                     border_mode="same")(x)
+    x = _inception_module(x, 256, 160, 320, 32, 128, 128)  # 5a
+    x = _inception_module(x, 384, 192, 384, 48, 128, 128)  # 5b
+    x = GlobalAveragePooling2D()(x)
+    x = Dropout(0.4)(x)
+    out = Dense(num_classes)(x)
+    return Model(inp, out)
+
+
+_BUILDERS = {
+    "lenet": lenet,
+    "resnet-18": lambda **kw: resnet(18, **kw),
+    "resnet-34": lambda **kw: resnet(34, **kw),
+    "resnet-50": lambda **kw: resnet(50, **kw),
+    "resnet-101": lambda **kw: resnet(101, **kw),
+    "inception-v1": inception_v1,
+}
+
+
+class ImageClassifier(ImageModel):
+    """Build a named classification net (the by-name loading surface of
+    ImageClassificationConfig.scala)."""
+
+    def __init__(self, model_name: str = "resnet-50",
+                 num_classes: int = 1000,
+                 input_shape: Tuple[int, int, int] = (224, 224, 3),
+                 config: ImageConfigure = None):
+        if model_name not in _BUILDERS:
+            raise ValueError(
+                f"unknown model {model_name!r}; "
+                f"available: {sorted(_BUILDERS)}")
+        self._builder = _BUILDERS[model_name]
+        self._kw = dict(num_classes=num_classes, input_shape=input_shape)
+        super().__init__(config)
+
+    def build_model(self):
+        return self._builder(**self._kw)

@@ -234,7 +234,13 @@ class DistributedTrainer:
             put, batch, is_leaf=lambda v: v is None)
 
     def replicate(self, tree):
-        return jax.device_put(tree, self._rep)
+        """Replicate a pytree across the mesh, always copying: the train
+        step donates its inputs, and ``device_put`` may alias an
+        already-device-resident array — donating an alias would delete
+        the caller's buffer."""
+        return jax.tree_util.tree_map(
+            lambda a: jax.device_put(jnp.array(a, copy=True), self._rep),
+            tree)
 
     def prefetch(self, batches, depth: Optional[int] = None):
         """Overlap host batch assembly + H2D transfer with device compute.

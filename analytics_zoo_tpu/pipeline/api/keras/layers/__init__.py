@@ -12,6 +12,33 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.normalization import (
 from analytics_zoo_tpu.pipeline.api.keras.layers.recurrent import (
     GRU, LSTM, Bidirectional, SimpleRNN,
 )
+from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (
+    AtrousConvolution2D, Convolution1D, Convolution2D, Convolution3D,
+    Cropping1D, Cropping2D, Cropping3D, Deconvolution2D,
+    SeparableConvolution2D, UpSampling1D, UpSampling2D, UpSampling3D,
+    ZeroPadding1D, ZeroPadding2D, ZeroPadding3D,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.pooling import (
+    AveragePooling1D, AveragePooling2D, AveragePooling3D,
+    GlobalAveragePooling1D, GlobalAveragePooling2D, GlobalAveragePooling3D,
+    GlobalMaxPooling1D, GlobalMaxPooling2D, GlobalMaxPooling3D,
+    MaxPooling1D, MaxPooling2D, MaxPooling3D,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.advanced_activations import (
+    ELU, LeakyReLU, PReLU, Softmax, SReLU, ThresholdedReLU,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.noise import (
+    GaussianDropout, GaussianNoise, SpatialDropout1D, SpatialDropout2D,
+    SpatialDropout3D,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.wrappers import (
+    KerasLayerWrapper, TimeDistributed,
+)
+
+# Keras-2 style aliases
+Conv1D = Convolution1D
+Conv2D = Convolution2D
+Conv3D = Convolution3D
 
 __all__ = [
     "Activation", "Dense", "Dropout", "Flatten", "Highway", "Lambda",
@@ -19,4 +46,17 @@ __all__ = [
     "SparseDense", "Embedding", "WordEmbedding", "Merge", "merge",
     "BatchNormalization", "L2Normalization", "LayerNorm",
     "GRU", "LSTM", "Bidirectional", "SimpleRNN",
+    "AtrousConvolution2D", "Convolution1D", "Convolution2D",
+    "Convolution3D", "Conv1D", "Conv2D", "Conv3D",
+    "Cropping1D", "Cropping2D", "Cropping3D", "Deconvolution2D",
+    "SeparableConvolution2D", "UpSampling1D", "UpSampling2D",
+    "UpSampling3D", "ZeroPadding1D", "ZeroPadding2D", "ZeroPadding3D",
+    "AveragePooling1D", "AveragePooling2D", "AveragePooling3D",
+    "GlobalAveragePooling1D", "GlobalAveragePooling2D",
+    "GlobalAveragePooling3D", "GlobalMaxPooling1D", "GlobalMaxPooling2D",
+    "GlobalMaxPooling3D", "MaxPooling1D", "MaxPooling2D", "MaxPooling3D",
+    "ELU", "LeakyReLU", "PReLU", "Softmax", "SReLU", "ThresholdedReLU",
+    "GaussianDropout", "GaussianNoise", "SpatialDropout1D",
+    "SpatialDropout2D", "SpatialDropout3D",
+    "KerasLayerWrapper", "TimeDistributed",
 ]

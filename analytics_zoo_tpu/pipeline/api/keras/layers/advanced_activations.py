@@ -1,0 +1,76 @@
+"""Parametric / advanced activation layers (ref:
+zoo/pipeline/api/keras/layers/AdvancedActivation.scala — LeakyReLU, ELU,
+PReLU, SReLU, ThresholdedReLU)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params
+
+
+class LeakyReLU(Layer):
+    def __init__(self, alpha: float = 0.3, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = float(alpha)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.where(x >= 0, x, self.alpha * x)
+
+
+class ELU(Layer):
+    def __init__(self, alpha: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = float(alpha)
+
+    def call(self, params, x, training=False, rng=None):
+        return jax.nn.elu(x, self.alpha)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, theta: float = 1.0, **kwargs):
+        super().__init__(**kwargs)
+        self.theta = float(theta)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.where(x > self.theta, x, 0.0).astype(x.dtype)
+
+
+class PReLU(Layer):
+    """Per-channel learnable negative slope."""
+
+    def build(self, rng, input_shape) -> Params:
+        params: Params = {}
+        self.add_weight(params, rng, "alpha", (input_shape[-1],),
+                        init="zero")
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.where(x >= 0, x, params["alpha"] * x)
+
+
+class SReLU(Layer):
+    """S-shaped ReLU with four learnable per-channel params
+    (AdvancedActivation.scala SReLU)."""
+
+    def build(self, rng, input_shape) -> Params:
+        d = (input_shape[-1],)
+        params: Params = {}
+        self.add_weight(params, rng, "t_left", d, init="zero")
+        self.add_weight(params, rng, "a_left", d, init="glorot_uniform")
+        self.add_weight(params, rng, "t_right", d, init="glorot_uniform")
+        self.add_weight(params, rng, "a_right", d, init="one")
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        tl, al = params["t_left"], params["a_left"]
+        tr, ar = params["t_right"], params["a_right"]
+        y_left = tl + al * (x - tl)
+        y_right = tr + ar * (x - tr)
+        return jnp.where(x <= tl, y_left, jnp.where(x >= tr, y_right, x))
+
+
+class Softmax(Layer):
+    def call(self, params, x, training=False, rng=None):
+        return jax.nn.softmax(x, axis=-1)

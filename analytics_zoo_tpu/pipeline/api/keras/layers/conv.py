@@ -1,0 +1,417 @@
+"""Convolution layers.
+
+Reference: zoo/pipeline/api/keras/layers/Convolutional.scala —
+Convolution1D/2D/3D, AtrousConvolution2D, SeparableConvolution2D,
+Deconvolution2D, Cropping/ZeroPadding/UpSampling 1/2/3D.
+
+TPU design: all convs lower to ``lax.conv_general_dilated`` in
+channels-last layouts (NWC/NHWC/NDHWC) — the layout XLA:TPU tiles best
+onto the MXU — with bf16 inputs and f32 accumulation.  The reference's
+default "th" (channels-first) ordering is accepted via ``dim_ordering``
+and handled by transposition at the boundary, but "tf" is the default
+and the fast path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops import activations as acts
+from analytics_zoo_tpu.ops.dtypes import get_policy
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params
+
+
+def _conv_dims(spatial: int):
+    if spatial == 1:
+        return ("NWC", "WIO", "NWC")
+    if spatial == 2:
+        return ("NHWC", "HWIO", "NHWC")
+    return ("NDHWC", "DHWIO", "NDHWC")
+
+
+def _same_or_valid(border_mode: str) -> str:
+    if border_mode not in ("same", "valid"):
+        raise ValueError(f"border_mode must be same|valid, got {border_mode}")
+    return border_mode.upper()
+
+
+def _out_len(n, k, stride, mode, dilation=1):
+    if n is None:
+        return None
+    eff = (k - 1) * dilation + 1
+    if mode == "same":
+        return -(-n // stride)
+    return -(-(n - eff + 1) // stride)
+
+
+class _ConvND(Layer):
+    spatial = 2
+
+    def __init__(self, nb_filter: int, kernel_size: Sequence[int],
+                 strides: Sequence[int] = None, border_mode: str = "valid",
+                 activation=None, dilation: Sequence[int] = None,
+                 init="glorot_uniform", bias: bool = True,
+                 dim_ordering: str = "tf", W_regularizer=None,
+                 b_regularizer=None, groups: int = 1, **kwargs):
+        super().__init__(**kwargs)
+        s = self.spatial
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = tuple(int(k) for k in kernel_size)
+        assert len(self.kernel_size) == s
+        self.strides = tuple(int(v) for v in (strides or (1,) * s))
+        self.dilation = tuple(int(v) for v in (dilation or (1,) * s))
+        self.border_mode = border_mode
+        _same_or_valid(border_mode)
+        self.activation = acts.get(activation)
+        self.kernel_init = init
+        self.use_bias = bias
+        self.dim_ordering = dim_ordering
+        self.groups = int(groups)
+        self.W_regularizer = W_regularizer
+        self.b_regularizer = b_regularizer
+
+    def _to_tf(self, shape):
+        """Normalise a batch-incl. shape to channels-last ordering."""
+        if self.dim_ordering == "th":
+            return (shape[0],) + tuple(shape[2:]) + (shape[1],)
+        return tuple(shape)
+
+    def _from_tf(self, shape):
+        if self.dim_ordering == "th":
+            return (shape[0], shape[-1]) + tuple(shape[1:-1])
+        return tuple(shape)
+
+    def build(self, rng, input_shape) -> Params:
+        shape_tf = self._to_tf(input_shape)
+        in_ch = shape_tf[-1]
+        params: Params = {}
+        kshape = self.kernel_size + (in_ch // self.groups, self.nb_filter)
+        self.add_weight(params, rng, "kernel", kshape,
+                        init=self.kernel_init,
+                        regularizer=self.W_regularizer)
+        if self.use_bias:
+            self.add_weight(params, rng, "bias", (self.nb_filter,),
+                            init="zero", regularizer=self.b_regularizer)
+        return params
+
+    def _convolve(self, x, kernel):
+        policy = get_policy()
+        return jax.lax.conv_general_dilated(
+            policy.cast_compute(x), policy.cast_compute(kernel),
+            window_strides=self.strides,
+            padding=_same_or_valid(self.border_mode),
+            rhs_dilation=self.dilation,
+            dimension_numbers=_conv_dims(self.spatial),
+            feature_group_count=self.groups)
+
+    def call(self, params, x, training=False, rng=None):
+        if self.dim_ordering == "th":
+            perm = (0,) + tuple(range(2, 2 + self.spatial)) + (1,)
+            x = jnp.transpose(x, perm)
+        y = self._convolve(x, params["kernel"])
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        if self.dim_ordering == "th":
+            back = (0, 1 + self.spatial) + tuple(range(1, 1 + self.spatial))
+            y = jnp.transpose(y, back)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        tf_shape = self._to_tf(input_shape)
+        spatial = [
+            _out_len(tf_shape[1 + i], self.kernel_size[i], self.strides[i],
+                     self.border_mode, self.dilation[i])
+            for i in range(self.spatial)
+        ]
+        out_tf = (tf_shape[0],) + tuple(spatial) + (self.nb_filter,)
+        return self._from_tf(out_tf)
+
+
+class Convolution1D(_ConvND):
+    spatial = 1
+
+    def __init__(self, nb_filter, filter_length, **kwargs):
+        super().__init__(nb_filter, (filter_length,), **kwargs)
+
+
+class Convolution2D(_ConvND):
+    spatial = 2
+
+    def __init__(self, nb_filter, nb_row, nb_col, subsample=(1, 1),
+                 **kwargs):
+        super().__init__(nb_filter, (nb_row, nb_col), strides=subsample,
+                         **kwargs)
+
+
+class Convolution3D(_ConvND):
+    spatial = 3
+
+    def __init__(self, nb_filter, kernel_dim1, kernel_dim2, kernel_dim3,
+                 subsample=(1, 1, 1), **kwargs):
+        super().__init__(nb_filter, (kernel_dim1, kernel_dim2, kernel_dim3),
+                         strides=subsample, **kwargs)
+
+
+class AtrousConvolution2D(_ConvND):
+    """Dilated conv (Convolutional.scala AtrousConvolution2D)."""
+    spatial = 2
+
+    def __init__(self, nb_filter, nb_row, nb_col, subsample=(1, 1),
+                 atrous_rate=(1, 1), **kwargs):
+        super().__init__(nb_filter, (nb_row, nb_col), strides=subsample,
+                         dilation=atrous_rate, **kwargs)
+
+
+class SeparableConvolution2D(Layer):
+    """Depthwise conv + pointwise 1x1 (Convolutional.scala Separable...)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 subsample=(1, 1), border_mode: str = "valid",
+                 depth_multiplier: int = 1, activation=None,
+                 init="glorot_uniform", bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.strides = tuple(subsample)
+        self.border_mode = border_mode
+        self.depth_multiplier = int(depth_multiplier)
+        self.activation = acts.get(activation)
+        self.kernel_init = init
+        self.use_bias = bias
+
+    def build(self, rng, input_shape) -> Params:
+        in_ch = input_shape[-1]
+        params: Params = {}
+        self.add_weight(params, rng, "depthwise_kernel",
+                        self.kernel_size + (1,
+                                            in_ch * self.depth_multiplier),
+                        init=self.kernel_init)
+        self.add_weight(params, rng, "pointwise_kernel",
+                        (1, 1, in_ch * self.depth_multiplier,
+                         self.nb_filter), init=self.kernel_init)
+        if self.use_bias:
+            self.add_weight(params, rng, "bias", (self.nb_filter,),
+                            init="zero")
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        policy = get_policy()
+        in_ch = x.shape[-1]
+        y = jax.lax.conv_general_dilated(
+            policy.cast_compute(x),
+            policy.cast_compute(params["depthwise_kernel"]),
+            window_strides=self.strides,
+            padding=_same_or_valid(self.border_mode),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=in_ch)
+        y = jax.lax.conv_general_dilated(
+            policy.cast_compute(y),
+            policy.cast_compute(params["pointwise_kernel"]),
+            window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        h = _out_len(input_shape[1], self.kernel_size[0], self.strides[0],
+                     self.border_mode)
+        w = _out_len(input_shape[2], self.kernel_size[1], self.strides[1],
+                     self.border_mode)
+        return (input_shape[0], h, w, self.nb_filter)
+
+
+class Deconvolution2D(Layer):
+    """Transposed conv (Convolutional.scala Deconvolution2D)."""
+
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 subsample=(1, 1), border_mode: str = "valid",
+                 activation=None, init="glorot_uniform", bias: bool = True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kernel_size = (int(nb_row), int(nb_col))
+        self.strides = tuple(subsample)
+        self.border_mode = border_mode
+        self.activation = acts.get(activation)
+        self.kernel_init = init
+        self.use_bias = bias
+
+    def build(self, rng, input_shape) -> Params:
+        in_ch = input_shape[-1]
+        params: Params = {}
+        self.add_weight(params, rng, "kernel",
+                        self.kernel_size + (self.nb_filter, in_ch),
+                        init=self.kernel_init)
+        if self.use_bias:
+            self.add_weight(params, rng, "bias", (self.nb_filter,),
+                            init="zero")
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        policy = get_policy()
+        y = jax.lax.conv_transpose(
+            policy.cast_compute(x), policy.cast_compute(params["kernel"]),
+            strides=self.strides,
+            padding=_same_or_valid(self.border_mode),
+            dimension_numbers=("NHWC", "HWOI", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, input_shape):
+        def up(n, k, s):
+            if n is None:
+                return None
+            if self.border_mode == "same":
+                return n * s
+            return n * s + max(k - s, 0)
+        h = up(input_shape[1], self.kernel_size[0], self.strides[0])
+        w = up(input_shape[2], self.kernel_size[1], self.strides[1])
+        return (input_shape[0], h, w, self.nb_filter)
+
+
+# ------------------------------------------------------ shape-change layers
+class ZeroPadding1D(Layer):
+    def __init__(self, padding=1, **kwargs):
+        super().__init__(**kwargs)
+        self.padding = (padding, padding) if np.isscalar(padding) \
+            else tuple(padding)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.pad(x, ((0, 0), self.padding, (0, 0)))
+
+    def compute_output_shape(self, s):
+        n = None if s[1] is None else s[1] + sum(self.padding)
+        return (s[0], n, s[2])
+
+
+class ZeroPadding2D(Layer):
+    def __init__(self, padding=(1, 1), **kwargs):
+        super().__init__(**kwargs)
+        p = padding
+        if len(p) == 2:
+            self.padding = ((p[0], p[0]), (p[1], p[1]))
+        else:
+            self.padding = ((p[0], p[1]), (p[2], p[3]))
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.pad(x, ((0, 0),) + self.padding + ((0, 0),))
+
+    def compute_output_shape(self, s):
+        h = None if s[1] is None else s[1] + sum(self.padding[0])
+        w = None if s[2] is None else s[2] + sum(self.padding[1])
+        return (s[0], h, w, s[3])
+
+
+class ZeroPadding3D(Layer):
+    def __init__(self, padding=(1, 1, 1), **kwargs):
+        super().__init__(**kwargs)
+        self.padding = tuple((p, p) for p in padding)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.pad(x, ((0, 0),) + self.padding + ((0, 0),))
+
+    def compute_output_shape(self, s):
+        dims = tuple(None if s[i + 1] is None
+                     else s[i + 1] + sum(self.padding[i]) for i in range(3))
+        return (s[0],) + dims + (s[4],)
+
+
+class Cropping1D(Layer):
+    def __init__(self, cropping=(1, 1), **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = tuple(cropping)
+
+    def call(self, params, x, training=False, rng=None):
+        a, b = self.cropping
+        return x[:, a:x.shape[1] - b]
+
+    def compute_output_shape(self, s):
+        n = None if s[1] is None else s[1] - sum(self.cropping)
+        return (s[0], n, s[2])
+
+
+class Cropping2D(Layer):
+    def __init__(self, cropping=((0, 0), (0, 0)), **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def call(self, params, x, training=False, rng=None):
+        (t, b), (l, r) = self.cropping
+        return x[:, t:x.shape[1] - b, l:x.shape[2] - r]
+
+    def compute_output_shape(self, s):
+        h = None if s[1] is None else s[1] - sum(self.cropping[0])
+        w = None if s[2] is None else s[2] - sum(self.cropping[1])
+        return (s[0], h, w, s[3])
+
+
+class Cropping3D(Layer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)), **kwargs):
+        super().__init__(**kwargs)
+        self.cropping = tuple(tuple(c) for c in cropping)
+
+    def call(self, params, x, training=False, rng=None):
+        (a1, b1), (a2, b2), (a3, b3) = self.cropping
+        return x[:, a1:x.shape[1] - b1, a2:x.shape[2] - b2,
+                 a3:x.shape[3] - b3]
+
+    def compute_output_shape(self, s):
+        dims = tuple(None if s[i + 1] is None
+                     else s[i + 1] - sum(self.cropping[i]) for i in range(3))
+        return (s[0],) + dims + (s[4],)
+
+
+class UpSampling1D(Layer):
+    def __init__(self, length=2, **kwargs):
+        super().__init__(**kwargs)
+        self.length = int(length)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.repeat(x, self.length, axis=1)
+
+    def compute_output_shape(self, s):
+        n = None if s[1] is None else s[1] * self.length
+        return (s[0], n, s[2])
+
+
+class UpSampling2D(Layer):
+    def __init__(self, size=(2, 2), **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(size)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.repeat(jnp.repeat(x, self.size[0], axis=1),
+                          self.size[1], axis=2)
+
+    def compute_output_shape(self, s):
+        h = None if s[1] is None else s[1] * self.size[0]
+        w = None if s[2] is None else s[2] * self.size[1]
+        return (s[0], h, w, s[3])
+
+
+class UpSampling3D(Layer):
+    def __init__(self, size=(2, 2, 2), **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(size)
+
+    def call(self, params, x, training=False, rng=None):
+        for i, r in enumerate(self.size):
+            x = jnp.repeat(x, r, axis=1 + i)
+        return x
+
+    def compute_output_shape(self, s):
+        dims = tuple(None if s[i + 1] is None else s[i + 1] * self.size[i]
+                     for i in range(3))
+        return (s[0],) + dims + (s[4],)

@@ -1,0 +1,71 @@
+"""Noise / structured-dropout layers (ref:
+zoo/pipeline/api/keras/layers/Noise.scala — GaussianNoise,
+GaussianDropout; Dropout.scala SpatialDropout1D/2D/3D)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+
+def _need_rng(layer, rng):
+    if rng is None:
+        raise ValueError(f"layer {layer.name} needs an rng when training")
+    return rng
+
+
+class GaussianNoise(Layer):
+    def __init__(self, sigma: float, **kwargs):
+        super().__init__(**kwargs)
+        self.sigma = float(sigma)
+
+    def call(self, params, x, training=False, rng=None):
+        if not training:
+            return x
+        rng = _need_rng(self, rng)
+        return x + self.sigma * jax.random.normal(rng, x.shape, x.dtype)
+
+
+class GaussianDropout(Layer):
+    def __init__(self, p: float, **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+
+    def call(self, params, x, training=False, rng=None):
+        if not training or self.p <= 0:
+            return x
+        rng = _need_rng(self, rng)
+        stddev = (self.p / (1.0 - self.p)) ** 0.5
+        return x * (1.0 + stddev * jax.random.normal(rng, x.shape, x.dtype))
+
+
+class _SpatialDropout(Layer):
+    spatial = 1
+
+    def __init__(self, p: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.p = float(p)
+
+    def call(self, params, x, training=False, rng=None):
+        if not training or self.p <= 0:
+            return x
+        rng = _need_rng(self, rng)
+        # drop whole channels: mask shape (B, 1...1, C)
+        mshape = (x.shape[0],) + (1,) * self.spatial + (x.shape[-1],)
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, mshape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class SpatialDropout1D(_SpatialDropout):
+    spatial = 1
+
+
+class SpatialDropout2D(_SpatialDropout):
+    spatial = 2
+
+
+class SpatialDropout3D(_SpatialDropout):
+    spatial = 3

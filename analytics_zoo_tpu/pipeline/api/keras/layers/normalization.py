@@ -54,8 +54,10 @@ class BatchNormalization(Layer):
         bshape[ax] = x.shape[ax]
 
         if training:
-            mean = jnp.mean(x, axis=reduce_axes)
-            var = jnp.var(x, axis=reduce_axes)
+            # statistics in f32 regardless of the (possibly bf16) input
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            var = jnp.var(xf, axis=reduce_axes)
             m = self.momentum
             new_state = {
                 "moving_mean": m * state["moving_mean"] + (1 - m) * mean,

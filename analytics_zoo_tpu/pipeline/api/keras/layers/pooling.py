@@ -1,0 +1,131 @@
+"""Pooling layers (ref: zoo/pipeline/api/keras/layers/Pooling.scala —
+Max/Average 1/2/3D local + Global variants).
+
+Channels-last layouts; lowered to ``lax.reduce_window`` which XLA:TPU
+fuses with surrounding elementwise ops.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (
+    _out_len, _same_or_valid,
+)
+
+
+class _PoolND(Layer):
+    spatial = 2
+    op = "max"
+
+    def __init__(self, pool_size=None, strides=None, border_mode="valid",
+                 **kwargs):
+        super().__init__(**kwargs)
+        s = self.spatial
+        if pool_size is None:
+            pool_size = (2,) * s
+        if np.isscalar(pool_size):
+            pool_size = (int(pool_size),) * s
+        self.pool_size = tuple(int(p) for p in pool_size)
+        self.strides = tuple(int(v) for v in (strides or self.pool_size))
+        self.border_mode = border_mode
+        _same_or_valid(border_mode)
+
+    def call(self, params, x, training=False, rng=None):
+        window = (1,) + self.pool_size + (1,)
+        strides = (1,) + self.strides + (1,)
+        pad = _same_or_valid(self.border_mode)
+        if self.op == "max":
+            return jax.lax.reduce_window(
+                x, -jnp.inf, jax.lax.max, window, strides, pad)
+        total = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, window, strides, pad)
+        if self.border_mode == "valid":
+            return total / float(np.prod(self.pool_size))
+        # SAME average pooling: divide by the true window size per cell
+        ones = jnp.ones(x.shape[:1] + x.shape[1:], x.dtype)
+        counts = jax.lax.reduce_window(
+            ones, 0.0, jax.lax.add, window, strides, pad)
+        return total / counts
+
+    def compute_output_shape(self, s):
+        spatial = tuple(
+            _out_len(s[1 + i], self.pool_size[i], self.strides[i],
+                     self.border_mode)
+            for i in range(self.spatial))
+        return (s[0],) + spatial + (s[-1],)
+
+
+class MaxPooling1D(_PoolND):
+    spatial, op = 1, "max"
+
+    def __init__(self, pool_length=2, stride=None, **kwargs):
+        super().__init__((pool_length,),
+                         None if stride is None else (stride,), **kwargs)
+
+
+class MaxPooling2D(_PoolND):
+    spatial, op = 2, "max"
+
+
+class MaxPooling3D(_PoolND):
+    spatial, op = 3, "max"
+
+
+class AveragePooling1D(_PoolND):
+    spatial, op = 1, "avg"
+
+    def __init__(self, pool_length=2, stride=None, **kwargs):
+        super().__init__((pool_length,),
+                         None if stride is None else (stride,), **kwargs)
+
+
+class AveragePooling2D(_PoolND):
+    spatial, op = 2, "avg"
+
+
+class AveragePooling3D(_PoolND):
+    spatial, op = 3, "avg"
+
+
+class _GlobalPoolND(Layer):
+    spatial = 2
+    op = "max"
+
+    def call(self, params, x, training=False, rng=None):
+        axes = tuple(range(1, 1 + self.spatial))
+        if self.op == "max":
+            return jnp.max(x, axis=axes)
+        return jnp.mean(x, axis=axes)
+
+    def compute_output_shape(self, s):
+        return (s[0], s[-1])
+
+
+class GlobalMaxPooling1D(_GlobalPoolND):
+    spatial, op = 1, "max"
+
+
+class GlobalAveragePooling1D(_GlobalPoolND):
+    spatial, op = 1, "avg"
+
+
+class GlobalMaxPooling2D(_GlobalPoolND):
+    spatial, op = 2, "max"
+
+
+class GlobalAveragePooling2D(_GlobalPoolND):
+    spatial, op = 2, "avg"
+
+
+class GlobalMaxPooling3D(_GlobalPoolND):
+    spatial, op = 3, "max"
+
+
+class GlobalAveragePooling3D(_GlobalPoolND):
+    spatial, op = 3, "avg"

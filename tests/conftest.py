@@ -25,7 +25,10 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _fresh_context():
-    """Reset the global ZooContext between tests."""
+    """Reset global state between tests: context and layer naming (so
+    param init rng streams don't depend on test execution order)."""
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+    Layer.reset_name_counters()
     yield
     from analytics_zoo_tpu.common.zoo_context import reset_zoo_context
     reset_zoo_context()

@@ -1,0 +1,174 @@
+"""Convolution/pooling layer tests.
+
+Golden strategy (SURVEY.md §4.1): the reference checks each Keras layer
+against a real Keras subprocess (KerasRunner.scala:30).  Here torch-CPU
+plays the golden role: forward outputs must match F.conv2d / F.pool
+results on identical weights.
+"""
+
+import jax
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    AveragePooling2D, Convolution1D, Convolution2D, Cropping2D,
+    Deconvolution2D, GlobalAveragePooling2D, GlobalMaxPooling2D,
+    LeakyReLU, MaxPooling1D, MaxPooling2D, PReLU, SeparableConvolution2D,
+    SpatialDropout2D, SReLU, TimeDistributed, UpSampling2D, ZeroPadding2D,
+    Dense,
+)
+
+RNG = jax.random.PRNGKey(0)
+
+
+def run(layer, x, input_shape=None, training=False, rng=None):
+    v = layer.init(RNG, input_shape or x.shape[1:])
+    out, _ = layer.apply(v["params"], x, state=v["state"],
+                         training=training, rng=rng)
+    return v, np.asarray(out)
+
+
+class TestConv2D:
+    @pytest.mark.parametrize("border,stride", [("valid", (1, 1)),
+                                               ("same", (1, 1)),
+                                               ("valid", (2, 2)),
+                                               ("same", (2, 2))])
+    def test_matches_torch(self, border, stride):
+        x = np.random.RandomState(0).randn(2, 9, 9, 3).astype(np.float32)
+        layer = Convolution2D(5, 3, 3, subsample=stride, border_mode=border)
+        v, out = run(layer, x)
+        w = np.asarray(v["params"]["kernel"])   # (kh, kw, cin, cout)
+        b = np.asarray(v["params"]["bias"])
+        tx = torch.from_numpy(x.transpose(0, 3, 1, 2))
+        tw = torch.from_numpy(w.transpose(3, 2, 0, 1))
+        if border == "same":
+            # torch 'same' only supports stride 1; emulate with pad
+            kh = kw = 3
+            ih, iw = 9, 9
+            oh = -(-ih // stride[0])
+            ow = -(-iw // stride[1])
+            ph = max((oh - 1) * stride[0] + kh - ih, 0)
+            pw = max((ow - 1) * stride[1] + kw - iw, 0)
+            tx = F.pad(tx, (pw // 2, pw - pw // 2, ph // 2, ph - ph // 2))
+            ref = F.conv2d(tx, tw, torch.from_numpy(b), stride=stride)
+        else:
+            ref = F.conv2d(tx, tw, torch.from_numpy(b), stride=stride)
+        ref = ref.numpy().transpose(0, 2, 3, 1)
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, rtol=5e-2, atol=5e-2)
+        assert layer.compute_output_shape((None,) + x.shape[1:]) == \
+            (None,) + out.shape[1:]
+
+    def test_channels_first_ordering(self):
+        x = np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32)
+        layer = Convolution2D(4, 3, 3, dim_ordering="th")
+        v, out = run(layer, x)
+        assert out.shape == (2, 4, 6, 6)
+        assert layer.compute_output_shape((None, 3, 8, 8)) == (None, 4, 6, 6)
+
+    def test_conv1d(self):
+        x = np.random.RandomState(0).randn(2, 10, 4).astype(np.float32)
+        layer = Convolution1D(6, 3)
+        v, out = run(layer, x)
+        w = np.asarray(v["params"]["kernel"])  # (k, cin, cout)
+        ref = F.conv1d(torch.from_numpy(x.transpose(0, 2, 1)),
+                       torch.from_numpy(w.transpose(2, 1, 0)),
+                       torch.from_numpy(np.asarray(v["params"]["bias"])))
+        np.testing.assert_allclose(out, ref.numpy().transpose(0, 2, 1),
+                                   rtol=5e-2, atol=5e-2)
+
+    def test_dilated(self):
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (
+            AtrousConvolution2D)
+        x = np.random.RandomState(0).randn(1, 12, 12, 2).astype(np.float32)
+        layer = AtrousConvolution2D(3, 3, 3, atrous_rate=(2, 2))
+        v, out = run(layer, x)
+        assert out.shape == (1, 8, 8, 3)
+
+    def test_separable_and_deconv_shapes(self):
+        x = np.random.RandomState(0).randn(2, 8, 8, 4).astype(np.float32)
+        _, out = run(SeparableConvolution2D(6, 3, 3), x)
+        assert out.shape == (2, 6, 6, 6)
+        _, out = run(Deconvolution2D(3, 3, 3, subsample=(2, 2)), x)
+        assert out.shape == (2, 17, 17, 3)
+
+
+class TestPooling:
+    def test_maxpool_matches_torch(self):
+        x = np.random.RandomState(0).randn(2, 8, 8, 3).astype(np.float32)
+        _, out = run(MaxPooling2D(), x)
+        ref = F.max_pool2d(torch.from_numpy(x.transpose(0, 3, 1, 2)), 2)
+        np.testing.assert_allclose(out, ref.numpy().transpose(0, 2, 3, 1))
+
+    def test_avgpool_matches_torch(self):
+        x = np.random.RandomState(0).randn(2, 8, 8, 3).astype(np.float32)
+        _, out = run(AveragePooling2D(), x)
+        ref = F.avg_pool2d(torch.from_numpy(x.transpose(0, 3, 1, 2)), 2)
+        np.testing.assert_allclose(out, ref.numpy().transpose(0, 2, 3, 1),
+                                   rtol=1e-5)
+
+    def test_same_avgpool_edge_counts(self):
+        x = np.ones((1, 5, 5, 1), np.float32)
+        _, out = run(AveragePooling2D(border_mode="same"), x)
+        # with true-window counts, averaging ones gives exactly ones
+        np.testing.assert_allclose(out, np.ones_like(out), rtol=1e-6)
+
+    def test_global_and_1d(self):
+        x = np.random.RandomState(0).randn(2, 6, 6, 3).astype(np.float32)
+        _, out = run(GlobalMaxPooling2D(), x)
+        np.testing.assert_allclose(out, x.max(axis=(1, 2)), rtol=1e-6)
+        _, out = run(GlobalAveragePooling2D(), x)
+        np.testing.assert_allclose(out, x.mean(axis=(1, 2)), rtol=1e-5)
+        x1 = np.random.RandomState(0).randn(2, 10, 3).astype(np.float32)
+        _, out = run(MaxPooling1D(pool_length=2), x1)
+        assert out.shape == (2, 5, 3)
+
+
+class TestShapeLayers:
+    def test_pad_crop_upsample(self):
+        x = np.random.RandomState(0).randn(1, 4, 4, 2).astype(np.float32)
+        _, out = run(ZeroPadding2D((1, 2)), x)
+        assert out.shape == (1, 6, 8, 2)
+        _, out = run(Cropping2D(((1, 1), (0, 2))), x)
+        assert out.shape == (1, 2, 2, 2)
+        _, out = run(UpSampling2D((2, 3)), x)
+        assert out.shape == (1, 8, 12, 2)
+
+
+class TestAdvancedActivations:
+    def test_leaky_prelu_srelu(self):
+        x = np.array([[-2.0, -0.5, 0.5, 2.0]], np.float32)
+        _, out = run(LeakyReLU(0.1), x)
+        np.testing.assert_allclose(out, [[-0.2, -0.05, 0.5, 2.0]],
+                                   rtol=1e-6)
+        _, out = run(PReLU(), x)   # alpha init 0 -> relu
+        np.testing.assert_allclose(out, [[0.0, 0.0, 0.5, 2.0]])
+        _, out = run(SReLU(), x)
+        assert out.shape == x.shape
+
+    def test_spatial_dropout_drops_channels(self):
+        x = np.ones((4, 6, 6, 8), np.float32)
+        _, out = run(SpatialDropout2D(0.5), x, training=True,
+                     rng=jax.random.PRNGKey(3))
+        # each channel is either fully zero or fully scaled
+        per_channel = out.reshape(4, -1, 8)
+        for b in range(4):
+            for c in range(8):
+                vals = np.unique(per_channel[b, :, c])
+                assert len(vals) == 1
+
+
+class TestWrappers:
+    def test_time_distributed_dense(self):
+        x = np.random.RandomState(0).randn(3, 5, 7).astype(np.float32)
+        layer = TimeDistributed(Dense(4))
+        v, out = run(layer, x)
+        assert out.shape == (3, 5, 4)
+        # equals applying the dense per timestep
+        w = np.asarray(v["params"]["kernel"])
+        b = np.asarray(v["params"]["bias"])
+        ref = x @ w + b
+        np.testing.assert_allclose(out, ref, rtol=5e-2, atol=5e-2)
+        assert layer.compute_output_shape((None, 5, 7)) == (None, 5, 4)

@@ -1,0 +1,112 @@
+"""Image pipeline + classification model tests (mirrors reference test
+dirs: test/zoo/feature/image, test/zoo/models/image)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.image import (
+    ImageCenterCrop, ImageChannelNormalize, ImageHFlip, ImageResize,
+    ImageSet,
+)
+from analytics_zoo_tpu.models.image.imageclassification import (
+    ImageClassifier, inception_v1, lenet, resnet,
+)
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+
+def fake_images(n=8, h=32, w=32, c=3, seed=0):
+    rs = np.random.RandomState(seed)
+    return rs.randint(0, 255, (n, h, w, c)).astype(np.uint8)
+
+
+class TestImagePipeline:
+    def test_transform_chain(self):
+        imgs = ImageSet.from_ndarrays(fake_images(4, 40, 40))
+        out = (imgs >> ImageResize(36, 36)
+                    >> ImageCenterCrop(32, 32)
+                    >> ImageChannelNormalize(127.5, 127.5, 127.5,
+                                             127.5, 127.5, 127.5))
+        arr = np.stack(out.images)
+        assert arr.shape == (4, 32, 32, 3)
+        assert abs(float(arr.mean())) < 0.2
+        fs = out.to_feature_set()
+        assert fs.size == 4
+
+    def test_read_labeled_dir(self, tmp_path):
+        import cv2
+        for cls_name in ("cats", "dogs"):
+            d = tmp_path / cls_name
+            d.mkdir()
+            for i in range(3):
+                cv2.imwrite(str(d / f"{i}.jpg"),
+                            fake_images(1, 16, 16)[0])
+        s = ImageSet.read(str(tmp_path), with_label=True)
+        assert len(s) == 6
+        assert s.label_map == {"cats": 0, "dogs": 1}
+        assert sorted(np.unique(s.labels)) == [0, 1]
+
+    def test_hflip(self):
+        img = np.arange(12, dtype=np.uint8).reshape(1, 2, 2, 3)[0]
+        flipped = ImageHFlip(prob=1.0).apply(img)
+        np.testing.assert_array_equal(flipped, img[:, ::-1])
+
+
+class TestNets:
+    def test_lenet_trains_on_fake_mnist(self):
+        rs = np.random.RandomState(0)
+        # learnable toy: class = quadrant with most mass
+        x = rs.rand(256, 28, 28, 1).astype(np.float32)
+        y = (x[:, :14, :14, 0].sum((1, 2)) >
+             x[:, 14:, 14:, 0].sum((1, 2))).astype(np.int32)
+        from analytics_zoo_tpu.models.image.imageclassification import lenet
+        m = lenet(num_classes=2)
+        m.compile(optimizer=Adam(lr=0.01),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+        m.fit(x, y, batch_size=64, nb_epoch=5, validation_data=(x, y))
+        scores = m.evaluate(x, y, batch_size=64)
+        assert scores["sparse_categorical_accuracy"] > 0.75
+
+    def test_resnet18_forward_small(self):
+        m = resnet(18, num_classes=10, input_shape=(32, 32, 3))
+        out = m.predict(fake_images(8).astype(np.float32), batch_size=8)
+        assert out.shape == (8, 10)
+
+    def test_resnet50_builds_and_shapes(self):
+        m = resnet(50, num_classes=7, input_shape=(64, 64, 3))
+        assert m.get_output_shape() == (None, 7)
+        v = m.get_variables()
+        n_params = sum(int(np.prod(p.shape))
+                       for p in __import__("jax").tree_util.tree_leaves(
+                           v["params"]))
+        # ~23.5M backbone params at 64x64/7-class head
+        assert 20e6 < n_params < 30e6
+
+    def test_inception_v1_forward(self):
+        m = inception_v1(num_classes=5, input_shape=(64, 64, 3))
+        out = m.predict(fake_images(4, 64, 64).astype(np.float32),
+                        batch_size=4)
+        assert out.shape == (4, 5)
+
+    def test_image_classifier_by_name(self):
+        clf = ImageClassifier("lenet", num_classes=3,
+                              input_shape=(28, 28, 1))
+        imgs = ImageSet.from_ndarrays(fake_images(4, 28, 28, 1))
+        classes = clf.predict_image_classes(imgs, top_k=2, batch_size=4)
+        assert np.asarray(classes).shape == (4, 2)
+        with pytest.raises(ValueError, match="unknown model"):
+            ImageClassifier("resnet-999")
+
+    def test_batchnorm_state_updates_in_training(self):
+        import jax
+        m = resnet(18, num_classes=4, input_shape=(16, 16, 3))
+        m.compile(optimizer="sgd",
+                  loss="sparse_categorical_crossentropy_with_logits")
+        x = fake_images(16, 16, 16).astype(np.float32)
+        y = np.zeros(16, np.int32)
+        before = jax.tree_util.tree_leaves(m.get_variables()["state"])
+        m.fit(x, y, batch_size=16, nb_epoch=1)
+        after = jax.tree_util.tree_leaves(m.get_variables()["state"])
+        changed = any(not np.allclose(a, b)
+                      for a, b in zip(before, after))
+        assert changed, "BN moving stats should update during fit"
