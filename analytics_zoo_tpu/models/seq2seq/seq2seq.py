@@ -1,0 +1,153 @@
+"""Seq2seq: RNN encoder/decoder with a state bridge and greedy infer.
+
+Reference: zoo/models/seq2seq/Seq2seq.scala:50, RNNEncoder/RNNDecoder,
+Bridge.scala:156 ("pass" forwards encoder states; "dense" maps them
+through a learned projection), and the token-by-token ``infer`` loop.
+
+TPU design: teacher-forced training runs both stacks as lax.scans in a
+single XLA program; greedy decoding is ALSO one program — a lax.scan
+over decode steps feeding the argmax back, instead of the reference's
+per-token forward calls from the driver.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    Params, State, fold_name,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense, Embedding
+from analytics_zoo_tpu.pipeline.api.keras.layers.recurrent import LSTM
+from analytics_zoo_tpu.pipeline.api.keras.topology import KerasNet
+
+
+class Seq2seq(KerasNet):
+    """Token seq2seq over a shared vocab (chatbot example workload)."""
+
+    def __init__(self, vocab_size: int, embed_dim: int = 128,
+                 hidden_sizes: Sequence[int] = (128,),
+                 bridge: str = "pass", name: Optional[str] = None):
+        super().__init__(name=name)
+        self.vocab_size = int(vocab_size)
+        self.embed_dim = int(embed_dim)
+        self.hidden_sizes = list(hidden_sizes)
+        assert bridge in ("pass", "dense")
+        if bridge == "pass":
+            # encoder carry feeds the decoder unchanged: sizes must align
+            assert len(set(self.hidden_sizes)) == 1
+        self.bridge = bridge
+
+        self.embedding = Embedding(self.vocab_size, self.embed_dim,
+                                   init="uniform")
+        self.encoder_rnns = [LSTM(h, return_sequences=True)
+                             for h in self.hidden_sizes]
+        self.decoder_rnns = [LSTM(h, return_sequences=True)
+                             for h in self.hidden_sizes]
+        self.bridge_layers = (
+            [Dense(2 * h) for h in self.hidden_sizes]
+            if bridge == "dense" else [])
+        self.generator = Dense(self.vocab_size)
+        self.layers = ([self.embedding] + self.encoder_rnns +
+                       self.decoder_rnns + self.bridge_layers +
+                       [self.generator])
+        self.batch_input_shape = [(None, None), (None, None)]
+
+    # ------------------------------------------------------------ building
+    def build(self, rng, input_shape) -> Params:
+        params: Params = {}
+        params[self.embedding.name] = self.embedding.init(
+            fold_name(rng, self.embedding.name), (None, 1))["params"]
+        shape = (None, None, self.embed_dim)
+        for enc, dec in zip(self.encoder_rnns, self.decoder_rnns):
+            params[enc.name] = enc.init(
+                fold_name(rng, enc.name), shape)["params"]
+            params[dec.name] = dec.init(
+                fold_name(rng, dec.name), shape)["params"]
+            shape = (None, None, enc.output_dim)
+        for i, bl in enumerate(self.bridge_layers):
+            h = self.hidden_sizes[i]
+            params[bl.name] = bl.init(
+                fold_name(rng, bl.name), (None, 2 * h))["params"]
+        params[self.generator.name] = self.generator.init(
+            fold_name(rng, self.generator.name),
+            (None, self.hidden_sizes[-1]))["params"]
+        return params
+
+    def init_state(self, input_shape) -> State:
+        return {}
+
+    def compute_output_shape(self, input_shape):
+        dec_shape = input_shape[1]
+        return (dec_shape[0], dec_shape[1], self.vocab_size)
+
+    # ------------------------------------------------------------- forward
+    def _encode(self, params, enc_ids):
+        x = self.embedding.call(params[self.embedding.name], enc_ids)
+        carries = []
+        for enc in self.encoder_rnns:
+            x, carry = enc.run(params[enc.name], x)
+            carries.append(carry)
+        return carries
+
+    def _bridge(self, params, carries):
+        if self.bridge == "pass":
+            return carries
+        out = []
+        for bl, (h, c) in zip(self.bridge_layers, carries):
+            joined = jnp.concatenate([h, c], axis=-1)
+            mapped = bl.call(params[bl.name], joined)
+            nh, nc = jnp.split(mapped, 2, axis=-1)
+            out.append((nh, nc))
+        return out
+
+    def apply(self, params, inputs, state=None, training=False, rng=None):
+        enc_ids, dec_ids = inputs
+        carries = self._bridge(params, self._encode(params, enc_ids))
+        x = self.embedding.call(params[self.embedding.name], dec_ids)
+        for dec, carry in zip(self.decoder_rnns, carries):
+            x, _ = dec.run(params[dec.name], x, initial_carry=carry)
+        logits = self.generator.call(params[self.generator.name], x)
+        return logits, state
+
+    # --------------------------------------------------------------- infer
+    def infer(self, enc_ids: np.ndarray, start_sign: int,
+              max_seq_len: int = 30, stop_sign: Optional[int] = None
+              ) -> np.ndarray:
+        """Greedy decode as ONE jitted lax.scan program."""
+        params = self.get_variables()["params"]
+        enc_ids = jnp.asarray(enc_ids, jnp.int32)
+
+        def decode(params, enc_ids):
+            carries = self._bridge(params, self._encode(params, enc_ids))
+            batch = enc_ids.shape[0]
+            tok0 = jnp.full((batch,), start_sign, jnp.int32)
+
+            def step(carry_state, _):
+                tok, carries = carry_state
+                x = self.embedding.call(
+                    params[self.embedding.name], tok[:, None])
+                new_carries = []
+                for dec, carry in zip(self.decoder_rnns, carries):
+                    x, nc = dec.run(params[dec.name], x,
+                                    initial_carry=carry)
+                    new_carries.append(nc)
+                logits = self.generator.call(
+                    params[self.generator.name], x[:, 0])
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return (nxt, tuple(new_carries)), nxt
+
+            _, toks = jax.lax.scan(step, (tok0, tuple(carries)), None,
+                                   length=max_seq_len)
+            return jnp.swapaxes(toks, 0, 1)
+
+        out = np.asarray(jax.jit(decode)(params, enc_ids))
+        if stop_sign is not None:
+            # mask everything after the first stop token
+            stopped = np.cumsum(out == stop_sign, axis=1) > 0
+            out = np.where(stopped, stop_sign, out)
+        return out

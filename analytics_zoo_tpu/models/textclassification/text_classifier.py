@@ -1,0 +1,58 @@
+"""Text classifier (ref:
+zoo/models/textclassification/TextClassifier.scala:34-192): embedding →
+encoder (CNN / LSTM / GRU) → dense head."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.models.common import ZooModel
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Convolution1D, Dense, Dropout, Embedding, Flatten,
+    GlobalMaxPooling1D, GRU, LSTM, WordEmbedding,
+)
+
+
+class TextClassifier(ZooModel):
+    """encoder: "cnn" | "lstm" | "gru" (TextClassifier.scala encoder
+    arg); with optional pretrained glove embeddings."""
+
+    def __init__(self, class_num: int, token_length: int = 200,
+                 sequence_length: int = 500, encoder: str = "cnn",
+                 encoder_output_dim: int = 256,
+                 max_words_num: int = 5000,
+                 embedding_matrix: Optional[np.ndarray] = None):
+        self.class_num = int(class_num)
+        self.token_length = int(token_length)
+        self.sequence_length = int(sequence_length)
+        self.encoder = encoder.lower()
+        self.encoder_output_dim = int(encoder_output_dim)
+        self.max_words_num = int(max_words_num)
+        self.embedding_matrix = embedding_matrix
+        super().__init__()
+
+    def build_model(self):
+        inp = Input(shape=(self.sequence_length,))
+        if self.embedding_matrix is not None:
+            x = WordEmbedding(self.embedding_matrix, trainable=False)(inp)
+        else:
+            x = Embedding(self.max_words_num + 1, self.token_length,
+                          init="uniform")(inp)
+        if self.encoder == "cnn":
+            x = Convolution1D(self.encoder_output_dim, 5,
+                              activation="relu")(x)
+            x = GlobalMaxPooling1D()(x)
+        elif self.encoder == "lstm":
+            x = LSTM(self.encoder_output_dim)(x)
+        elif self.encoder == "gru":
+            x = GRU(self.encoder_output_dim)(x)
+        else:
+            raise ValueError(f"unknown encoder {self.encoder!r}; "
+                             "use cnn|lstm|gru")
+        x = Dropout(0.2)(x)
+        x = Dense(128, activation="relu")(x)
+        out = Dense(self.class_num)(x)
+        return Model(inp, out)

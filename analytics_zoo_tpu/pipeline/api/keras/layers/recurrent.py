@@ -72,8 +72,12 @@ class _RNNBase(Layer):
         """One timestep: carry, pre-projected input slice -> carry, out."""
         raise NotImplementedError
 
-    def call(self, params, x, training=False, rng=None):
-        # x: (B, T, D); all-timestep input projection in one matmul
+    def run(self, params, x, initial_carry=None, collect_outputs=True):
+        """Scan the full sequence; returns (outputs or None, final_carry).
+
+        Exposed for encoder/decoder wiring (Seq2seq bridges the encoder's
+        final carry into the decoder's initial carry).
+        """
         x_proj = _mm(x, params["kernel"]) + params["bias"]
         seq = jnp.swapaxes(x_proj, 0, 1)          # (T, B, G*H)
         if self.go_backwards:
@@ -81,13 +85,23 @@ class _RNNBase(Layer):
 
         def scan_fn(carry, xt):
             new_carry, out = self.step(params, carry, xt)
-            return new_carry, out if self.return_sequences else None
+            return new_carry, out if collect_outputs else None
 
-        carry = self.initial_carry(x.shape[0])
+        carry = self.initial_carry(x.shape[0]) if initial_carry is None \
+            else initial_carry
         last_carry, outs = jax.lax.scan(scan_fn, carry, seq)
-        if self.return_sequences:
+        if collect_outputs:
             outs = jnp.swapaxes(outs, 0, 1)       # (B, T, H)
-            return outs[:, ::-1] if self.go_backwards else outs
+            if self.go_backwards:
+                outs = outs[:, ::-1]
+        return outs, last_carry
+
+    def call(self, params, x, training=False, rng=None):
+        # x: (B, T, D); all-timestep input projection in one matmul
+        outs, last_carry = self.run(
+            params, x, collect_outputs=self.return_sequences)
+        if self.return_sequences:
+            return outs
         h = last_carry[0] if isinstance(last_carry, tuple) else last_carry
         return h
 

@@ -1,0 +1,171 @@
+"""Text pipeline + text/seq model-zoo tests (mirrors reference dirs
+test/zoo/feature/text, test/zoo/models/{textclassification,textmatching,
+seq2seq,anomalydetection})."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.feature.text import TextSet
+from analytics_zoo_tpu.models.anomalydetection import (
+    AnomalyDetector, detect_anomalies, unroll,
+)
+from analytics_zoo_tpu.models.common_ranker import evaluate_map, evaluate_ndcg
+from analytics_zoo_tpu.models.seq2seq import Seq2seq
+from analytics_zoo_tpu.models.textclassification import TextClassifier
+from analytics_zoo_tpu.models.textmatching import KNRM
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+
+class TestTextSet:
+    TEXTS = ["The quick brown fox jumps over the lazy dog",
+             "JAX compiles to XLA for the TPU",
+             "the dog sleeps"]
+
+    def test_full_pipeline(self):
+        ts = (TextSet.from_texts(self.TEXTS, [0, 1, 0])
+              .tokenize().normalize().word2idx().shape_sequence(6))
+        x, y = ts.to_arrays()
+        assert x.shape == (3, 6)
+        assert y.shape == (3, 1)
+        assert x.min() >= 0
+        # "the" is the most frequent token -> index 1
+        assert ts.word_index["the"] == 1
+
+    def test_word_index_roundtrip(self, tmp_path):
+        ts = TextSet.from_texts(self.TEXTS).tokenize().normalize().word2idx()
+        p = str(tmp_path / "wi.json")
+        ts.save_word_index(p)
+        ts2 = (TextSet.from_texts(["a new dog"]).tokenize().normalize()
+               .load_word_index(p))
+        ts2.word2idx(existing_map=ts2.word_index)
+        assert ts2.features[0].indices[-1] == ts.word_index["dog"]
+
+    def test_truncation_modes(self):
+        ts = TextSet.from_texts(["a b c d e"]).tokenize().normalize()
+        ts.word2idx()
+        pre = [f.indices.copy() for f in
+               ts.shape_sequence(3, trunc_mode="pre").features][0]
+        assert len(pre) == 3
+
+    def test_relation_pairs_interleave(self):
+        relations = [("q1", "d1", 1), ("q1", "d2", 0), ("q1", "d3", 0)]
+        corpus1 = {"q1": "what is tpu"}
+        corpus2 = {"d1": "tensor processing unit", "d2": "a fruit",
+                   "d3": "a fish"}
+        ts = TextSet.from_relation_pairs(relations, corpus1, corpus2)
+        labels = [f.label for f in ts.features]
+        assert labels == [1, 0, 1, 0]  # (pos, neg) interleaved
+
+
+class TestTextClassifier:
+    def test_cnn_trains(self):
+        rs = np.random.RandomState(0)
+        # class = whether token "7" appears early
+        x = rs.randint(1, 50, (256, 20)).astype(np.int32)
+        y = (x[:, :5] % 2 == 0).sum(1).astype(np.int32) % 2
+        m = TextClassifier(class_num=2, token_length=16,
+                           sequence_length=20, encoder="cnn",
+                           encoder_output_dim=32, max_words_num=50)
+        m.compile(optimizer=Adam(lr=0.01),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+        m.fit(x, y, batch_size=64, nb_epoch=3)
+        out = m.predict(x, batch_size=64)
+        assert out.shape == (256, 2)
+
+    @pytest.mark.parametrize("encoder", ["lstm", "gru"])
+    def test_rnn_encoders_forward(self, encoder):
+        m = TextClassifier(class_num=3, token_length=8, sequence_length=10,
+                           encoder=encoder, encoder_output_dim=16,
+                           max_words_num=30)
+        x = np.random.RandomState(0).randint(0, 31, (8, 10))
+        assert m.predict(x, batch_size=8).shape == (8, 3)
+
+    def test_unknown_encoder(self):
+        with pytest.raises(ValueError, match="unknown encoder"):
+            TextClassifier(class_num=2, encoder="transformerx")
+
+
+class TestKNRM:
+    def test_forward_and_ranking_loss(self):
+        m = KNRM(text1_length=5, text2_length=8, vocab_size=100,
+                 embed_size=16, kernel_num=11)
+        q = np.random.RandomState(0).randint(1, 100, (16, 5))
+        d = np.random.RandomState(1).randint(1, 100, (16, 8))
+        scores = m.score_pairs(q, d)
+        assert scores.shape == (16,)
+        m.compile(optimizer=Adam(lr=0.01), loss="rank_hinge")
+        y = np.tile([1.0, 0.0], 8).reshape(-1, 1).astype(np.float32)
+        hist = m.fit([q, d], y, batch_size=16, nb_epoch=2)
+        assert np.isfinite(hist[-1]["loss"])
+
+    def test_ranker_metrics(self):
+        relations = [("q1", "a", 1), ("q1", "b", 0),
+                     ("q2", "c", 0), ("q2", "d", 1)]
+        perfect = np.array([0.9, 0.1, 0.2, 0.8])
+        assert evaluate_map(relations, perfect) == 1.0
+        assert evaluate_ndcg(relations, perfect, k=3) == 1.0
+        inverted = np.array([0.1, 0.9, 0.8, 0.2])
+        assert evaluate_map(relations, inverted) == 0.5
+
+
+class TestSeq2seq:
+    def test_copy_task_learns(self):
+        rs = np.random.RandomState(0)
+        V, T = 12, 5
+        n = 512
+        src = rs.randint(2, V, (n, T)).astype(np.int32)
+        # decoder input: <start>=1 + shifted target; target = src (copy)
+        dec_in = np.concatenate(
+            [np.ones((n, 1), np.int32), src[:, :-1]], axis=1)
+        m = Seq2seq(vocab_size=V, embed_dim=24, hidden_sizes=(48,))
+        m.compile(optimizer=Adam(lr=0.01),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        hist = m.fit([src, dec_in], src[..., None], batch_size=64,
+                     nb_epoch=10)
+        assert hist[-1]["loss"] < hist[0]["loss"]
+
+    def test_infer_shapes_and_stop(self):
+        m = Seq2seq(vocab_size=10, embed_dim=8, hidden_sizes=(16,))
+        m.init()
+        src = np.random.RandomState(0).randint(2, 10, (4, 6))
+        out = m.infer(src, start_sign=1, max_seq_len=7)
+        assert out.shape == (4, 7)
+        out2 = m.infer(src, start_sign=1, max_seq_len=7, stop_sign=2)
+        assert out2.shape == (4, 7)
+
+    def test_dense_bridge(self):
+        m = Seq2seq(vocab_size=10, embed_dim=8, hidden_sizes=(16,),
+                    bridge="dense")
+        m.init()
+        src = np.random.RandomState(0).randint(2, 10, (2, 4))
+        dec = np.ones((2, 4), np.int32)
+        v = m.get_variables()
+        logits, _ = m.apply(v["params"], [src, dec])
+        assert logits.shape == (2, 4, 10)
+
+
+class TestAnomalyDetector:
+    def test_unroll(self):
+        series = np.arange(10, dtype=np.float32)
+        x, y = unroll(series, 3)
+        assert x.shape == (7, 3, 1)
+        np.testing.assert_array_equal(x[0].ravel(), [0, 1, 2])
+        assert y[0, 0] == 3
+
+    def test_detect_anomalies(self):
+        y_true = np.zeros(100)
+        y_pred = np.zeros(100)
+        y_pred[[7, 42, 77]] = 5.0
+        idx = detect_anomalies(y_true, y_pred, anomaly_size=3)
+        assert set(idx) == {7, 42, 77}
+
+    def test_trains_on_sine(self):
+        t = np.arange(400, dtype=np.float32)
+        series = np.sin(0.1 * t)
+        x, y = unroll(series, 10)
+        m = AnomalyDetector(feature_shape=(10, 1), hidden_layers=(16, 8),
+                            dropouts=(0.0, 0.0))
+        m.compile(optimizer=Adam(lr=0.01), loss="mse")
+        hist = m.fit(x, y, batch_size=64, nb_epoch=10)
+        assert hist[-1]["loss"] < 0.1
