@@ -1,0 +1,155 @@
+"""Autograd API tests (mirrors reference pyzoo/test/zoo/pipeline/autograd)."""
+
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api import autograd as A
+from analytics_zoo_tpu.pipeline.api.keras import Model, Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+RNG = jax.random.PRNGKey(0)
+
+
+def eval_var(out_var, in_vars, arrays):
+    model = Model([v.node for v in in_vars], out_var.node)
+    variables = model.init(RNG)
+    out, _ = model.apply(variables["params"],
+                         arrays if len(arrays) > 1 else arrays[0],
+                         state=variables["state"])
+    return np.asarray(out), model, variables
+
+
+class TestVariableOps:
+    def test_arithmetic_chain(self):
+        x = A.Variable(input_shape=(4,))
+        y = A.Variable(input_shape=(4,))
+        out = (x * 2.0 + y - 1.0) / 2.0
+        a = np.ones((3, 4), np.float32)
+        b = 3 * np.ones((3, 4), np.float32)
+        res, _, _ = eval_var(out, [x, y], [a, b])
+        np.testing.assert_allclose(res, (a * 2 + b - 1) / 2)
+
+    def test_unary_math(self):
+        x = A.Variable(input_shape=(5,))
+        out = A.sqrt(A.square(A.abs(x)) + 1.0)
+        arr = np.random.RandomState(0).randn(2, 5).astype(np.float32)
+        res, _, _ = eval_var(out, [x], [arr])
+        np.testing.assert_allclose(res, np.sqrt(arr ** 2 + 1), rtol=1e-5)
+
+    def test_reductions_and_clip(self):
+        x = A.Variable(input_shape=(6,))
+        out = A.mean(A.clip(x, 0.0, 1.0), axis=1, keep_dims=True)
+        arr = np.linspace(-1, 2, 12).reshape(2, 6).astype(np.float32)
+        res, _, _ = eval_var(out, [x], [arr])
+        np.testing.assert_allclose(
+            res, np.clip(arr, 0, 1).mean(1, keepdims=True), rtol=1e-6)
+
+    def test_matmul_and_dot(self):
+        x = A.Variable(input_shape=(3, 4))
+        y = A.Variable(input_shape=(4, 5))
+        out = A.mm(x, y)
+        a = np.random.RandomState(0).randn(2, 3, 4).astype(np.float32)
+        b = np.random.RandomState(1).randn(2, 4, 5).astype(np.float32)
+        res, _, _ = eval_var(out, [x, y], [a, b])
+        np.testing.assert_allclose(res, a @ b, rtol=1e-5)
+
+    def test_slicing(self):
+        x = A.Variable(input_shape=(6, 3))
+        out = x.slice(1, 2, 3)
+        arr = np.random.RandomState(0).randn(2, 6, 3).astype(np.float32)
+        res, _, _ = eval_var(out, [x], [arr])
+        np.testing.assert_allclose(res, arr[:, 2:5])
+
+    def test_stack_concat(self):
+        x = A.Variable(input_shape=(4,))
+        y = A.Variable(input_shape=(4,))
+        a = np.ones((2, 4), np.float32)
+        b = np.zeros((2, 4), np.float32)
+        res, _, _ = eval_var(A.stack([x, y], axis=1), [x, y], [a, b])
+        assert res.shape == (2, 2, 4)
+        res, _, _ = eval_var(A.concatenate([x, y]), [x, y], [a, b])
+        assert res.shape == (2, 8)
+
+
+class TestParameter:
+    def test_parameter_learns_linear_map(self):
+        # w*x + b as raw parameters, trained through the normal fit path
+        x = A.Variable(input_shape=(3,))
+        w = A.Parameter((3, 1), init="normal")
+        b = A.Parameter((1,), init="zero")
+        out = A.mm(x, w) + b
+        model = Model(x.node, out.node)
+        model.compile(optimizer=Adam(lr=0.05), loss="mse")
+        rs = np.random.RandomState(0)
+        xs = rs.randn(256, 3).astype(np.float32)
+        true_w = np.array([[1.0], [-2.0], [0.5]], np.float32)
+        ys = xs @ true_w + 0.3
+        hist = model.fit(xs, ys, batch_size=64, nb_epoch=20)
+        assert hist[-1]["loss"] < 0.01
+
+    def test_non_trainable_parameter_stays_fixed(self):
+        x = A.Variable(input_shape=(2,))
+        w = A.Parameter((2, 2), init="one", trainable=False)
+        out = A.mm(x, w)
+        model = Model(x.node, out.node)
+        model.compile(optimizer=Adam(lr=0.1), loss="mse")
+        xs = np.random.RandomState(0).randn(64, 2).astype(np.float32)
+        ys = np.zeros((64, 2), np.float32)
+        model.fit(xs, ys, batch_size=32, nb_epoch=3)
+        leaves = jax.tree_util.tree_leaves(model.get_variables()["params"])
+        np.testing.assert_allclose(np.asarray(leaves[0]),
+                                   np.ones((2, 2)), atol=1e-6)
+
+    def test_constant(self):
+        x = A.Variable(input_shape=(3,))
+        c = A.Constant(np.array([1.0, 2.0, 3.0], np.float32))
+        out = x * c
+        arr = np.ones((2, 3), np.float32)
+        res, _, _ = eval_var(out, [x], [arr])
+        np.testing.assert_allclose(res, [[1, 2, 3], [1, 2, 3]])
+
+    def test_parameter_only_expression_raises(self):
+        a = A.Parameter((2,))
+        b = A.Parameter((2,))
+        with pytest.raises(ValueError, match="no batch input"):
+            _ = a + b
+
+
+class TestCustomLoss:
+    def test_custom_mae_matches_builtin(self):
+        def mae(y_true, y_pred):
+            return A.mean(A.abs(y_true - y_pred), axis=1)
+
+        loss = A.CustomLoss(mae, y_pred_shape=(4,))
+        yt = np.random.RandomState(0).rand(8, 4).astype(np.float32)
+        yp = np.random.RandomState(1).rand(8, 4).astype(np.float32)
+        got = float(loss(yt, yp))
+        np.testing.assert_allclose(got, np.abs(yt - yp).mean(), rtol=1e-6)
+
+    def test_fit_with_custom_loss(self):
+        def loss_fn(y_true, y_pred):
+            return A.mean(A.square(y_true - y_pred), axis=1)
+
+        m = Sequential()
+        m.add(Dense(1, input_shape=(3,)))
+        m.compile(optimizer=Adam(lr=0.05),
+                  loss=A.CustomLoss(loss_fn, y_pred_shape=(1,)))
+        rs = np.random.RandomState(0)
+        x = rs.randn(128, 3).astype(np.float32)
+        y = x.sum(1, keepdims=True).astype(np.float32)
+        hist = m.fit(x, y, batch_size=64, nb_epoch=35)
+        assert hist[-1]["loss"] < 0.1
+
+
+class TestLambdaLayer:
+    def test_create_lambda_as_layer(self):
+        swish = A.create_lambda(lambda v: v * A.clip(v + 3.0, 0.0, 6.0)
+                                / 6.0, input_shapes=(5,))
+        arr = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        variables = swish.init(RNG)
+        out, _ = swish.apply(variables["params"], arr,
+                             state=variables["state"])
+        ref = arr * np.clip(arr + 3, 0, 6) / 6
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6)
