@@ -96,18 +96,60 @@ class DistributedTrainer:
         self._eval_step = None
         self._predict_step = None
         self._rep = mesh_lib.replicated(self.mesh)
+        self._param_shardings = None
+
+    # ------------------------------------------------------------ sharding
+    def param_shardings(self, params):
+        """TP/FSDP/replicated sharding pytree for the model's params."""
+        if self._param_shardings is None:
+            from analytics_zoo_tpu.parallel.sharding import (
+                collect_param_shardings)
+            self._param_shardings = collect_param_shardings(
+                self.model, params, self.mesh)
+        return self._param_shardings
+
+    def place_params(self, params):
+        """Copy params onto the mesh per their TP/FSDP shardings."""
+        sh = self.param_shardings(params)
+        return jax.tree_util.tree_map(
+            lambda a, s: jax.device_put(jnp.array(a, copy=True), s),
+            params, sh)
+
+    def place_like(self, host_tree, like_tree):
+        """Place host arrays with the shardings of a live device tree
+        (checkpoint restore of sharded optimizer state)."""
+        return jax.tree_util.tree_map(
+            lambda a, ref: jax.device_put(jnp.array(a, copy=True),
+                                          ref.sharding),
+            host_tree, like_tree)
 
     # ----------------------------------------------------------- optimizer
     def init_opt_state(self, params):
-        if self.optim_groups:
-            groups = _group_params(
-                params, {k: v[1] for k, v in self.optim_groups.items()})
-            return {
-                g: self.optim_groups[g][0].init(
-                    {k: params[k] for k in names})
-                for g, names in groups.items()
-            }
-        return self.optim.init(params)
+        """Jitted so optimizer-state leaves inherit the param shardings
+        (GSPMD propagation) — sharded optimizer update, ZeRO-style."""
+        def init(p):
+            if self.optim_groups:
+                groups = _group_params(
+                    p, {k: v[1] for k, v in self.optim_groups.items()})
+                return {
+                    g: self.optim_groups[g][0].init(
+                        {k: p[k] for k in names})
+                    for g, names in groups.items()
+                }
+            return self.optim.init(p)
+
+        out = jax.jit(init)(params)
+        # leaves unrelated to any param (e.g. the step counter) may land
+        # on a single device — normalize them onto the mesh
+        mesh_devices = set(np.asarray(self.mesh.devices).flat)
+
+        def fix(leaf):
+            if isinstance(leaf, jax.Array) and \
+                    set(leaf.sharding.device_set) != mesh_devices:
+                return jax.device_put(leaf, self._rep)
+            return leaf
+
+        return jax.tree_util.tree_map(fix, out)
 
     def _optimizer_update(self, grads, opt_state, params):
         if self.optim_groups:
@@ -156,7 +198,8 @@ class DistributedTrainer:
         donate = (0, 1, 2) if self.donate else ()
         return jax.jit(
             step,
-            out_shardings=(self._rep, self._rep, self._rep, self._rep),
+            out_shardings=(self._param_shardings, None, self._rep,
+                           self._rep),
             donate_argnums=donate)
 
     def train_step(self, params, opt_state, state, batch, rng):

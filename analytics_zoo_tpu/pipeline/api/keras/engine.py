@@ -121,6 +121,8 @@ class Layer:
         self._nodes: List[Node] = []
         # param_name -> (l1, l2) weight-decay coefficients
         self.param_regularizers: Dict[str, Tuple[float, float]] = {}
+        # param_name -> PartitionSpec for tensor-parallel placement
+        self.param_pspecs: Dict[str, Any] = {}
 
     # ---------------------------------------------------------------- numeric
     def build(self, rng, input_shape) -> Params:

@@ -1,0 +1,250 @@
+"""Attention layers + BERT.
+
+Reference: zoo/pipeline/api/keras/layers/BERT.scala:66 (embeddings +
+N transformer blocks + pooler) and pyzoo
+zoo/pipeline/api/keras/layers/self_attention.py (TransformerLayer).
+
+TPU design: QKV is one fused matmul; heads live in a reshaped axis (no
+per-head loops).  With a populated ``seq`` mesh axis the layer routes
+through ring attention (sequence parallelism over ICI, ppermute ring) —
+the long-context capability the reference lacks (SURVEY.md §5).  With a
+populated ``model`` axis, QKV/out projections shard Megatron-style
+(column then row parallel).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.ops import activations as acts
+from analytics_zoo_tpu.ops.attention import scaled_dot_product_attention
+from analytics_zoo_tpu.ops.dtypes import get_policy
+from analytics_zoo_tpu.pipeline.api.keras.engine import (
+    Input, Layer, Params, fold_name,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.core import Dense, Dropout
+from analytics_zoo_tpu.pipeline.api.keras.layers.embedding import Embedding
+from analytics_zoo_tpu.pipeline.api.keras.layers.normalization import (
+    LayerNorm,
+)
+from analytics_zoo_tpu.pipeline.api.keras.topology import Model
+from analytics_zoo_tpu.parallel.mesh import (
+    DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS,
+)
+
+
+def _mm(x, w):
+    policy = get_policy()
+    return jax.lax.dot_general(
+        policy.cast_compute(x), policy.cast_compute(w),
+        (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _mesh():
+    from analytics_zoo_tpu.common.zoo_context import get_zoo_context
+    return get_zoo_context().mesh
+
+
+class MultiHeadSelfAttention(Layer):
+    """Self-attention over (B, T, D); optional (B, T) 0/1 mask as a
+    second input.  ``sequence_parallel``/``tensor_parallel``: "auto"
+    routes by whether the mesh axis is populated."""
+
+    def __init__(self, hidden_size: int, n_head: int,
+                 attn_dropout: float = 0.0, causal: bool = False,
+                 sequence_parallel: str = "auto",
+                 tensor_parallel: str = "auto", **kwargs):
+        super().__init__(**kwargs)
+        assert hidden_size % n_head == 0
+        self.hidden_size = int(hidden_size)
+        self.n_head = int(n_head)
+        self.head_dim = self.hidden_size // self.n_head
+        self.attn_dropout = float(attn_dropout)
+        self.causal = causal
+        self.sequence_parallel = sequence_parallel
+        self.tensor_parallel = tensor_parallel
+
+    def _use_sp(self):
+        return (self.sequence_parallel == "auto" and
+                _mesh().shape[SEQ_AXIS] > 1) or \
+            self.sequence_parallel is True
+
+    def _use_tp(self):
+        return (self.tensor_parallel == "auto" and
+                _mesh().shape[MODEL_AXIS] > 1) or \
+            self.tensor_parallel is True
+
+    def build(self, rng, input_shape) -> Params:
+        if isinstance(input_shape, list):
+            input_shape = input_shape[0]
+        d = input_shape[-1]
+        params: Params = {}
+        self.add_weight(params, rng, "qkv_kernel",
+                        (d, 3 * self.hidden_size))
+        self.add_weight(params, rng, "qkv_bias", (3 * self.hidden_size,),
+                        init="zero")
+        self.add_weight(params, rng, "out_kernel",
+                        (self.hidden_size, d))
+        self.add_weight(params, rng, "out_bias", (d,), init="zero")
+        if self._use_tp():
+            self.param_pspecs["qkv_kernel"] = P(None, MODEL_AXIS)
+            self.param_pspecs["qkv_bias"] = P(MODEL_AXIS)
+            self.param_pspecs["out_kernel"] = P(MODEL_AXIS, None)
+            self.param_pspecs["out_bias"] = P()
+        return params
+
+    def call(self, params, inputs, training=False, rng=None):
+        if isinstance(inputs, (list, tuple)):
+            x, mask = inputs[0], inputs[1]
+        else:
+            x, mask = inputs, None
+        b, t, _ = x.shape
+        qkv = _mm(x, params["qkv_kernel"]) + params["qkv_bias"]
+        qkv = qkv.reshape(b, t, 3, self.n_head, self.head_dim)
+        q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
+
+        use_sp = self._use_sp() and mask is None
+        if use_sp:
+            from analytics_zoo_tpu.parallel.ring_attention import (
+                ring_attention)
+            mesh = _mesh()
+            spec = NamedSharding(
+                mesh, P((DATA_AXIS, FSDP_AXIS), None, SEQ_AXIS, None))
+            q = jax.lax.with_sharding_constraint(q, spec)
+            k = jax.lax.with_sharding_constraint(k, spec)
+            v = jax.lax.with_sharding_constraint(v, spec)
+            ctx = ring_attention(q, k, v, mesh, causal=self.causal)
+        else:
+            attn_mask = None
+            if mask is not None:
+                attn_mask = mask[:, None, None, :]   # (B,1,1,Tk)
+            ctx = scaled_dot_product_attention(
+                q, k, v, mask=attn_mask, causal=self.causal)
+
+        if training and self.attn_dropout > 0:
+            if rng is None:
+                raise ValueError(f"{self.name} needs rng when training")
+            keep = 1.0 - self.attn_dropout
+            ctx = ctx * jax.random.bernoulli(
+                rng, keep, ctx.shape) / keep
+
+        ctx = jnp.moveaxis(ctx, 1, 2).reshape(b, t, self.hidden_size)
+        return (_mm(ctx, params["out_kernel"]) +
+                params["out_bias"]).astype(x.dtype)
+
+    def compute_output_shape(self, input_shape):
+        if isinstance(input_shape, list):
+            return tuple(input_shape[0])
+        return tuple(input_shape)
+
+
+class PositionwiseFeedForward(Layer):
+    """Transformer FFN: up-proj (column-TP) → gelu → down-proj (row-TP)."""
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 activation="gelu", tensor_parallel: str = "auto",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.hidden_size = int(hidden_size)
+        self.intermediate_size = int(intermediate_size)
+        self.activation = acts.get(activation)
+        self.tensor_parallel = tensor_parallel
+
+    def _use_tp(self):
+        return (self.tensor_parallel == "auto" and
+                _mesh().shape[MODEL_AXIS] > 1) or \
+            self.tensor_parallel is True
+
+    def build(self, rng, input_shape) -> Params:
+        d = input_shape[-1]
+        params: Params = {}
+        self.add_weight(params, rng, "up_kernel",
+                        (d, self.intermediate_size))
+        self.add_weight(params, rng, "up_bias",
+                        (self.intermediate_size,), init="zero")
+        self.add_weight(params, rng, "down_kernel",
+                        (self.intermediate_size, self.hidden_size))
+        self.add_weight(params, rng, "down_bias",
+                        (self.hidden_size,), init="zero")
+        if self._use_tp():
+            self.param_pspecs["up_kernel"] = P(None, MODEL_AXIS)
+            self.param_pspecs["up_bias"] = P(MODEL_AXIS)
+            self.param_pspecs["down_kernel"] = P(MODEL_AXIS, None)
+            self.param_pspecs["down_bias"] = P()
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        h = self.activation(_mm(x, params["up_kernel"]) +
+                            params["up_bias"])
+        return (_mm(h, params["down_kernel"]) +
+                params["down_bias"]).astype(x.dtype)
+
+
+def transformer_block(x, mask, hidden_size: int, n_head: int,
+                      intermediate_size: int, dropout: float = 0.1,
+                      causal: bool = False):
+    """Post-LN transformer encoder block (BERT-style)."""
+    attn_in = [x, mask] if mask is not None else x
+    a = MultiHeadSelfAttention(hidden_size, n_head,
+                               attn_dropout=dropout,
+                               causal=causal)(attn_in)
+    a = Dropout(dropout)(a)
+    from analytics_zoo_tpu.pipeline.api.keras.layers.merge import Merge
+    x = Merge(mode="sum")([x, a])
+    x = LayerNorm()(x)
+    f = PositionwiseFeedForward(hidden_size, intermediate_size)(x)
+    f = Dropout(dropout)(f)
+    x = Merge(mode="sum")([x, f])
+    return LayerNorm()(x)
+
+
+class BERT:
+    """BERT encoder (BERT.scala:66 surface): builds a graph Model with
+    inputs [token_ids, token_type_ids, position_ids, attention_mask] and
+    outputs [sequence_output, pooled_output]."""
+
+    def __init__(self, vocab: int = 40990, hidden_size: int = 768,
+                 n_block: int = 12, n_head: int = 12,
+                 seq_len: int = 512, intermediate_size: int = 3072,
+                 max_position_len: int = 512, type_vocab_size: int = 2,
+                 hidden_drop: float = 0.1, attn_drop: float = 0.1):
+        self.cfg = dict(vocab=vocab, hidden_size=hidden_size,
+                        n_block=n_block, n_head=n_head, seq_len=seq_len,
+                        intermediate_size=intermediate_size,
+                        max_position_len=max_position_len,
+                        type_vocab_size=type_vocab_size,
+                        hidden_drop=hidden_drop, attn_drop=attn_drop)
+
+    def build(self) -> Model:
+        c = self.cfg
+        ids = Input(shape=(c["seq_len"],))
+        seg = Input(shape=(c["seq_len"],))
+        pos = Input(shape=(c["seq_len"],))
+        mask = Input(shape=(c["seq_len"],))
+
+        from analytics_zoo_tpu.pipeline.api.keras.layers.merge import Merge
+        tok_e = Embedding(c["vocab"], c["hidden_size"],
+                          init="normal")(ids)
+        seg_e = Embedding(c["type_vocab_size"], c["hidden_size"],
+                          init="normal")(seg)
+        pos_e = Embedding(c["max_position_len"], c["hidden_size"],
+                          init="normal")(pos)
+        x = Merge(mode="sum")([tok_e, seg_e, pos_e])
+        x = LayerNorm()(x)
+        x = Dropout(c["hidden_drop"])(x)
+        for _ in range(c["n_block"]):
+            x = transformer_block(x, mask, c["hidden_size"], c["n_head"],
+                                  c["intermediate_size"],
+                                  dropout=c["attn_drop"])
+        seq_output = x
+        from analytics_zoo_tpu.pipeline.api.keras.layers.core import Lambda
+        first_tok = Lambda(lambda t: t[:, 0],
+                           output_shape=(c["hidden_size"],))(x)
+        pooled = Dense(c["hidden_size"], activation="tanh")(first_tok)
+        return Model([ids, seg, pos, mask], [seq_output, pooled])

@@ -39,7 +39,12 @@ class Dense(Layer):
 
     def __init__(self, output_dim: int, init="glorot_uniform",
                  activation=None, W_regularizer=None, b_regularizer=None,
-                 bias: bool = True, **kwargs):
+                 bias: bool = True, parallel_mode: str = None, **kwargs):
+        """parallel_mode: None | "column" | "row" — Megatron-style tensor
+        parallelism over the mesh's ``model`` axis.  "column" shards the
+        output dim (use for the up-projection), "row" shards the input
+        dim (the down-projection; GSPMD inserts the psum).
+        """
         super().__init__(**kwargs)
         self.output_dim = int(output_dim)
         self.kernel_init = init
@@ -47,8 +52,13 @@ class Dense(Layer):
         self.use_bias = bias
         self.W_regularizer = W_regularizer
         self.b_regularizer = b_regularizer
+        if parallel_mode not in (None, "column", "row"):
+            raise ValueError("parallel_mode must be None|column|row")
+        self.parallel_mode = parallel_mode
 
     def build(self, rng, input_shape) -> Params:
+        from jax.sharding import PartitionSpec as P
+        from analytics_zoo_tpu.parallel.mesh import MODEL_AXIS
         in_dim = input_shape[-1]
         params: Params = {}
         self.add_weight(params, rng, "kernel", (in_dim, self.output_dim),
@@ -56,6 +66,14 @@ class Dense(Layer):
         if self.use_bias:
             self.add_weight(params, rng, "bias", (self.output_dim,),
                             init="zero", regularizer=self.b_regularizer)
+        if self.parallel_mode == "column":
+            self.param_pspecs["kernel"] = P(None, MODEL_AXIS)
+            if self.use_bias:
+                self.param_pspecs["bias"] = P(MODEL_AXIS)
+        elif self.parallel_mode == "row":
+            self.param_pspecs["kernel"] = P(MODEL_AXIS, None)
+            if self.use_bias:
+                self.param_pspecs["bias"] = P()
         return params
 
     def call(self, params, x, training=False, rng=None):

@@ -19,19 +19,30 @@ class Embedding(Layer):
     """Integer ids (B, T) -> vectors (B, T, D)."""
 
     def __init__(self, input_dim: int, output_dim: int, init="uniform",
-                 W_regularizer=None, mask_zero: bool = False, **kwargs):
+                 W_regularizer=None, mask_zero: bool = False,
+                 parallel_mode: str = None, **kwargs):
+        """parallel_mode: None | "dim" — "dim" shards the embedding dim
+        over the ``model`` axis (the gather stays local; downstream TP
+        layers consume the sharded activations directly)."""
         super().__init__(**kwargs)
         self.input_dim = int(input_dim)
         self.output_dim = int(output_dim)
         self.kernel_init = init
         self.mask_zero = mask_zero
         self.W_regularizer = W_regularizer
+        if parallel_mode not in (None, "dim"):
+            raise ValueError("parallel_mode must be None|dim")
+        self.parallel_mode = parallel_mode
 
     def build(self, rng, input_shape) -> Params:
+        from jax.sharding import PartitionSpec as P
+        from analytics_zoo_tpu.parallel.mesh import MODEL_AXIS
         params: Params = {}
         self.add_weight(params, rng, "embeddings",
                         (self.input_dim, self.output_dim), init=self.kernel_init,
                         regularizer=self.W_regularizer)
+        if self.parallel_mode == "dim":
+            self.param_pspecs["embeddings"] = P(None, MODEL_AXIS)
         return params
 
     def call(self, params, x, training=False, rng=None):

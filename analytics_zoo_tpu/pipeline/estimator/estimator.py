@@ -94,9 +94,9 @@ class Estimator:
         # --- init / restore -------------------------------------------------
         if self.variables is None:
             self.variables = self.model.get_variables()
-        params = trainer.replicate(self.variables["params"])
+        params = trainer.place_params(self.variables["params"])
         state = trainer.replicate(self.variables["state"])
-        opt_state = trainer.replicate(trainer.init_opt_state(params))
+        opt_state = trainer.init_opt_state(params)
 
         ckpt = Checkpoint(self.model_dir) if self.model_dir else None
         ts = self.train_state
@@ -105,9 +105,9 @@ class Estimator:
                 {"params": params, "state": state, "opt_state": opt_state,
                  "epoch": 0, "iteration": 0})
             if restored is not None:
-                params = trainer.replicate(restored["params"])
+                params = trainer.place_params(restored["params"])
                 state = trainer.replicate(restored["state"])
-                opt_state = trainer.replicate(restored["opt_state"])
+                opt_state = trainer.place_like(restored["opt_state"], opt_state)
                 ts.epoch = int(restored["epoch"])
                 ts.iteration = int(restored["iteration"])
                 log.info("resumed from checkpoint at epoch %d iter %d",
@@ -182,9 +182,9 @@ class Estimator:
                     {"params": params, "state": state,
                      "opt_state": opt_state, "epoch": 0, "iteration": 0})
                 if restored is not None:
-                    params = trainer.replicate(restored["params"])
+                    params = trainer.place_params(restored["params"])
                     state = trainer.replicate(restored["state"])
-                    opt_state = trainer.replicate(restored["opt_state"])
+                    opt_state = trainer.place_like(restored["opt_state"], opt_state)
                     ts.epoch = int(restored["epoch"])
                     ts.iteration = int(restored["iteration"])
                 continue
@@ -246,7 +246,7 @@ class Estimator:
             methods = [met.Loss(criterion)] + methods
         trainer = self._infer_trainer()
         variables = self.model.get_variables()
-        params = trainer.replicate(variables["params"])
+        params = trainer.place_params(variables["params"])
         state = trainer.replicate(variables["state"])
         key = tuple(id(m) for m in methods)
         runner = self._cached_eval_runners.get(key)
@@ -261,7 +261,7 @@ class Estimator:
         import math
         trainer = self._infer_trainer()
         variables = self.model.get_variables()
-        params = trainer.replicate(variables["params"])
+        params = trainer.place_params(variables["params"])
         state = trainer.replicate(variables["state"])
         fn = trainer.predict_fn()
 

@@ -47,9 +47,9 @@ def main():
     trainer = DistributedTrainer(model, loss_fn,
                                  optim_method=model.optim_method)
     variables = model.get_variables()
-    params = trainer.replicate(variables["params"])
+    params = trainer.place_params(variables["params"])
     state = trainer.replicate(variables["state"])
-    opt_state = trainer.replicate(trainer.init_opt_state(params))
+    opt_state = trainer.init_opt_state(params)
     rng = jax.random.PRNGKey(0)
 
     # warmup: compile + first steps

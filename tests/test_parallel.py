@@ -1,0 +1,219 @@
+"""Distributed-parallelism tests on the virtual 8-device mesh:
+DP equivalence, FSDP sharding, tensor parallelism, ring attention,
+and a combined dp+tp+sp transformer train step."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from analytics_zoo_tpu.parallel.mesh import create_mesh
+from analytics_zoo_tpu.parallel.ring_attention import ring_attention
+from analytics_zoo_tpu.parallel.trainer import DistributedTrainer
+from analytics_zoo_tpu.ops.attention import scaled_dot_product_attention
+
+
+def _train_some(mesh, parallel_mode=None, steps=5):
+    from analytics_zoo_tpu.pipeline.api.keras import (
+        Layer, Sequential, objectives)
+    Layer.reset_name_counters()   # identical init rng across meshes
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import SGD
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 16).astype(np.float32)
+    w = rs.randn(16, 1).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+
+    m = Sequential()
+    m.add(Dense(32, activation="relu", input_shape=(16,),
+                parallel_mode=("column" if parallel_mode else None)))
+    m.add(Dense(1, parallel_mode=("row" if parallel_mode else None)))
+    loss = objectives.get("mse")
+    trainer = DistributedTrainer(m, loss, optim_method=SGD(0.05),
+                                 mesh=mesh)
+    v = m.init(jax.random.PRNGKey(0))
+    params = trainer.place_params(v["params"])
+    state = trainer.replicate(v["state"])
+    opt_state = trainer.init_opt_state(params)
+    batch = trainer.put_batch((x, y))
+    for _ in range(steps):
+        params, opt_state, state, l = trainer.train_step(
+            params, opt_state, state, batch, jax.random.PRNGKey(1))
+    return jax.device_get(params), float(l)
+
+
+class TestShardingModes:
+    def test_dp_fsdp_tp_agree(self):
+        """The same model/data under pure-DP, FSDP and TP meshes must
+        produce (numerically close) identical updates."""
+        p_dp, l_dp = _train_some(create_mesh({"data": 8}))
+        p_fsdp, l_fsdp = _train_some(
+            create_mesh({"data": 4, "fsdp": 2}))
+        p_tp, l_tp = _train_some(
+            create_mesh({"data": 4, "model": 2}), parallel_mode="tp")
+        for a, b in zip(jax.tree_util.tree_leaves(p_dp),
+                        jax.tree_util.tree_leaves(p_fsdp)):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(p_dp),
+                        jax.tree_util.tree_leaves(p_tp)):
+            np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+        assert abs(l_dp - l_fsdp) < 1e-4
+
+    def test_fsdp_actually_shards(self):
+        """With fsdp=2, large param leaves must be split across devices."""
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras import objectives
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import SGD
+        mesh = create_mesh({"data": 4, "fsdp": 2})
+        m = Sequential()
+        m.add(Dense(256, input_shape=(256,)))   # 64k params > threshold
+        trainer = DistributedTrainer(m, objectives.get("mse"),
+                                     optim_method=SGD(0.1), mesh=mesh)
+        v = m.init(jax.random.PRNGKey(0))
+        params = trainer.place_params(v["params"])
+        kernel = params[m.layers[0].name]["kernel"]
+        shard_shapes = {s.data.shape for s in kernel.addressable_shards}
+        assert shard_shapes == {(128, 256)} or \
+            shard_shapes == {(256, 128)}
+
+    def test_tp_param_placement(self):
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras import objectives
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import SGD
+        mesh = create_mesh({"data": 2, "model": 4})
+        m = Sequential()
+        m.add(Dense(64, input_shape=(32,), parallel_mode="column"))
+        trainer = DistributedTrainer(m, objectives.get("mse"),
+                                     optim_method=SGD(0.1), mesh=mesh)
+        v = m.init(jax.random.PRNGKey(0))
+        params = trainer.place_params(v["params"])
+        kernel = params[m.layers[0].name]["kernel"]
+        # column-parallel: output dim sharded 4-way
+        assert {s.data.shape for s in kernel.addressable_shards} == \
+            {(32, 16)}
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_attention(self, causal):
+        mesh = create_mesh({"seq": 4, "data": 2})
+        rs = np.random.RandomState(0)
+        q, k, v = (rs.randn(2, 3, 16, 8).astype(np.float32)
+                   for _ in range(3))
+        ref = scaled_dot_product_attention(
+            jnp.array(q), jnp.array(k), jnp.array(v), causal=causal)
+        out = ring_attention(jnp.array(q), jnp.array(k), jnp.array(v),
+                             mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_seq_axis_1_falls_back(self):
+        mesh = create_mesh({"data": 8})
+        rs = np.random.RandomState(0)
+        q = jnp.array(rs.randn(1, 2, 8, 4).astype(np.float32))
+        out = ring_attention(q, q, q, mesh)
+        ref = scaled_dot_product_attention(q, q, q)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5)
+
+
+class TestTransformerDPTPSP:
+    def test_combined_mesh_train_step(self):
+        """A transformer block trains on a data=2 × model=2 × seq=2 mesh
+        — DP gradient sync, Megatron TP and ring-attention SP in ONE
+        jitted program."""
+        from analytics_zoo_tpu.common import zoo_context
+        zoo_context.reset_zoo_context()
+        ctx = zoo_context.init_zoo_context(
+            mesh_shape={"data": 2, "model": 2, "seq": 2})
+        from analytics_zoo_tpu.pipeline.api.keras import (
+            Input, Model, objectives)
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+        from analytics_zoo_tpu.pipeline.api.keras.layers.attention import (
+            transformer_block)
+        from analytics_zoo_tpu.pipeline.api.keras.layers.core import Lambda
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+        D, T = 32, 8
+        inp = Input(shape=(T, D))
+        x = transformer_block(inp, None, hidden_size=D, n_head=4,
+                              intermediate_size=64, dropout=0.0)
+        x = Lambda(lambda t: t.mean(axis=1), output_shape=(D,))(x)
+        out = Dense(2)(x)
+        m = Model(inp, out)
+
+        trainer = DistributedTrainer(
+            m, objectives.get(
+                "sparse_categorical_crossentropy_with_logits"),
+            optim_method=Adam(lr=1e-3), mesh=ctx.mesh)
+        v = m.init(jax.random.PRNGKey(0))
+        params = trainer.place_params(v["params"])
+        state = trainer.replicate(v["state"])
+        opt_state = trainer.init_opt_state(params)
+        rs = np.random.RandomState(0)
+        xb = rs.randn(16, T, D).astype(np.float32)
+        yb = rs.randint(0, 2, (16, 1)).astype(np.int32)
+        batch = trainer.put_batch((xb, yb))
+        for i in range(3):
+            params, opt_state, state, loss = trainer.train_step(
+                params, opt_state, state, batch, jax.random.PRNGKey(i))
+        assert np.isfinite(float(loss))
+        # TP placement really happened on qkv kernels
+        flat = jax.tree_util.tree_leaves_with_path(params)
+        qkv = [leaf for path, leaf in flat
+               if "qkv_kernel" in jax.tree_util.keystr(path)]
+        assert qkv and any(
+            s.data.shape != qkv[0].shape
+            for s in qkv[0].addressable_shards)
+
+
+class TestBERT:
+    def test_bert_tiny_forward(self):
+        from analytics_zoo_tpu.pipeline.api.keras.layers.attention import (
+            BERT)
+        m = BERT(vocab=100, hidden_size=32, n_block=2, n_head=4,
+                 seq_len=12, intermediate_size=64,
+                 max_position_len=12).build()
+        m.init(jax.random.PRNGKey(0))
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 100, (2, 12)).astype(np.int32)
+        seg = np.zeros((2, 12), np.int32)
+        pos = np.tile(np.arange(12), (2, 1)).astype(np.int32)
+        mask = np.ones((2, 12), np.float32)
+        variables = m.get_variables()
+        (seq_out, pooled), _ = m.apply(
+            variables["params"], [ids, seg, pos, mask],
+            state=variables["state"])
+        assert seq_out.shape == (2, 12, 32)
+        assert pooled.shape == (2, 32)
+
+    def test_bert_mask_effect(self):
+        """Masked positions must not influence other positions."""
+        from analytics_zoo_tpu.pipeline.api.keras.layers.attention import (
+            BERT)
+        m = BERT(vocab=50, hidden_size=16, n_block=1, n_head=2,
+                 seq_len=8, intermediate_size=32,
+                 max_position_len=8, hidden_drop=0.0,
+                 attn_drop=0.0).build()
+        m.init(jax.random.PRNGKey(0))
+        variables = m.get_variables()
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 50, (1, 8)).astype(np.int32)
+        seg = np.zeros((1, 8), np.int32)
+        pos = np.tile(np.arange(8), (1, 1)).astype(np.int32)
+        mask = np.ones((1, 8), np.float32)
+        mask[0, -2:] = 0.0
+        (out1, _), _ = m.apply(variables["params"], [ids, seg, pos, mask],
+                               state=variables["state"])
+        ids2 = ids.copy()
+        ids2[0, -2:] = 7   # change only masked positions
+        (out2, _), _ = m.apply(variables["params"],
+                               [ids2, seg, pos, mask],
+                               state=variables["state"])
+        np.testing.assert_allclose(np.asarray(out1[0, :6]),
+                                   np.asarray(out2[0, :6]),
+                                   rtol=1e-4, atol=1e-5)
