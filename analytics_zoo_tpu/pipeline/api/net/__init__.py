@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.pipeline.api.net.torch_net import TorchNet
+from analytics_zoo_tpu.pipeline.api.net.tf_net import TFNet
+
+__all__ = ["TorchNet", "TFNet"]

@@ -1,0 +1,84 @@
+"""TFNet: run a TensorFlow model as a forward-only framework layer.
+
+Reference: zoo/pipeline/api/net/TFNet.scala:56 — a frozen TF GraphDef
+wrapped as a BigDL module via the TF Java JNI (forward only: "Please use
+TFTrainingHelper to construct a trainable TFNet"), and
+TFNetForInference.scala:35 for SavedModels.
+
+TPU redesign: the TF function is staged into JAX via
+``jax2tf.call_tf`` — when the graph is XLA-compatible it compiles into
+the surrounding jitted program (true in-process execution, no session /
+JNI boundary).  Like the reference, TFNet is inference-only; for
+*training* TF Keras models use ``analytics_zoo_tpu.tfpark.KerasModel``,
+which converts the architecture to native layers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params
+
+
+class TFNet(Layer):
+    def __init__(self, tf_callable, output_shape=None, **kwargs):
+        """``tf_callable``: a tf.function / keras model / SavedModel
+        signature mapping input tensor(s) -> output tensor."""
+        super().__init__(**kwargs)
+        from jax.experimental import jax2tf
+        self._jax_fn = jax2tf.call_tf(tf_callable)
+        self._declared_output_shape = output_shape
+
+    # ------------------------------------------------------------ factories
+    @classmethod
+    def from_saved_model(cls, path: str,
+                         signature: str = "serving_default",
+                         **kwargs) -> "TFNet":
+        """(ref TFNetForInference.scala:35 SavedModel loading)"""
+        import tensorflow as tf
+        loaded = tf.saved_model.load(path)
+        fn = loaded.signatures[signature]
+
+        def single(x):
+            out = fn(x)
+            if isinstance(out, dict):
+                return list(out.values())[0]
+            return out
+
+        net = cls(single, **kwargs)
+        net._tf_loaded = loaded    # keep alive
+        return net
+
+    @classmethod
+    def from_keras(cls, keras_model, **kwargs) -> "TFNet":
+        import tensorflow as tf
+        fn = tf.function(lambda x: keras_model(x, training=False))
+        net = cls(fn, **kwargs)
+        net._tf_loaded = keras_model
+        return net
+
+    # -------------------------------------------------------------- numeric
+    def call(self, params, x, training=False, rng=None):
+        out = self._jax_fn(x)
+        return jax.lax.stop_gradient(out)   # forward-only, like TFNet
+
+    def compute_output_shape(self, input_shape):
+        if self._declared_output_shape is not None:
+            return (input_shape[0],) + tuple(self._declared_output_shape)
+        concrete = tuple(2 if d is None else d for d in input_shape)
+        out = jax.eval_shape(
+            self._jax_fn,
+            jax.ShapeDtypeStruct(concrete, np.float32))
+        return (None,) + tuple(out.shape[1:])
+
+    def predict(self, x, batch_size: int = 256):
+        """Convenience distributed prediction (TFNet.predict surface)."""
+        fn = jax.jit(self._jax_fn)
+        outs = []
+        n = len(x)
+        for lo in range(0, n, batch_size):
+            outs.append(np.asarray(fn(np.asarray(x[lo:lo + batch_size]))))
+        return np.concatenate(outs)

@@ -1,0 +1,297 @@
+"""TorchNet: run a PyTorch model as a native JAX/TPU layer.
+
+Reference: zoo/pipeline/api/net/TorchNet.scala:40-242 +
+PytorchModelWrapper.java — TorchScript executed in-process via libtorch
+JNI, weights copied JVM↔libtorch every step.
+
+TPU redesign: instead of embedding a foreign runtime, the torch module
+is *compiled out*: ``torch.fx`` traces the model into an op graph which
+is re-emitted as pure jnp code over an extracted parameter pytree.  The
+result is a first-class framework Layer — it jits, differentiates,
+shards and runs on the MXU like native layers (no per-step weight
+copies, no host round trips).
+
+Covered op set mirrors what the reference's examples feed TorchNet
+(convnets / MLPs / classifiers): conv2d, linear, batch norms, pooling,
+elementwise math, activations, reshape/flatten/cat, embedding,
+layer_norm, dropout, matmul, mean/sum.
+"""
+
+from __future__ import annotations
+
+import math
+import operator
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params
+
+
+def _to_jax(t) -> jnp.ndarray:
+    return jnp.asarray(t.detach().cpu().numpy())
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+class _Emitter:
+    """Evaluate an fx graph with jnp semantics (NCHW preserved: torch
+    convention kept inside the subgraph; XLA re-layouts for TPU)."""
+
+    def __init__(self, gm, params: Dict[str, jnp.ndarray]):
+        self.gm = gm
+        self.params = params
+
+    # ------------------------------------------------------ module calls
+    def call_module(self, mod, x, extra_args, training, rng):
+        import torch.nn as nn
+        p = self.params
+        name = self.current_target
+        if isinstance(mod, nn.Conv2d):
+            w = p[f"{name}.weight"]          # (O, I, kh, kw)
+            stride = _pair(mod.stride)
+            pad = mod.padding
+            if isinstance(pad, str):
+                padding = pad.upper()
+            else:
+                ph, pw = _pair(pad)
+                padding = [(ph, ph), (pw, pw)]
+            out = jax.lax.conv_general_dilated(
+                x, w, stride, padding,
+                rhs_dilation=_pair(mod.dilation),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                feature_group_count=mod.groups)
+            if mod.bias is not None:
+                out = out + p[f"{name}.bias"][None, :, None, None]
+            return out
+        if isinstance(mod, nn.Linear):
+            out = x @ p[f"{name}.weight"].T
+            if mod.bias is not None:
+                out = out + p[f"{name}.bias"]
+            return out
+        if isinstance(mod, (nn.BatchNorm1d, nn.BatchNorm2d)):
+            mean = p[f"{name}.running_mean"]
+            var = p[f"{name}.running_var"]
+            shape = [1, -1] + [1] * (x.ndim - 2)
+            out = (x - mean.reshape(shape)) / jnp.sqrt(
+                var.reshape(shape) + mod.eps)
+            if mod.affine:
+                out = out * p[f"{name}.weight"].reshape(shape) + \
+                    p[f"{name}.bias"].reshape(shape)
+            return out
+        if isinstance(mod, nn.LayerNorm):
+            mean = jnp.mean(x, axis=-1, keepdims=True)
+            var = jnp.var(x, axis=-1, keepdims=True)
+            out = (x - mean) / jnp.sqrt(var + mod.eps)
+            if mod.elementwise_affine:
+                out = out * p[f"{name}.weight"] + p[f"{name}.bias"]
+            return out
+        if isinstance(mod, nn.Embedding):
+            return jnp.take(p[f"{name}.weight"],
+                            x.astype(jnp.int32), axis=0)
+        if isinstance(mod, nn.MaxPool2d):
+            k = _pair(mod.kernel_size)
+            s = _pair(mod.stride or mod.kernel_size)
+            ph, pw = _pair(mod.padding)
+            pad = [(0, 0), (0, 0), (ph, ph), (pw, pw)]
+            neg = (-jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                   else jnp.iinfo(x.dtype).min)
+            xp = jnp.pad(x, pad, constant_values=neg)
+            return jax.lax.reduce_window(
+                xp, neg, jax.lax.max, (1, 1) + k, (1, 1) + s, "VALID")
+        if isinstance(mod, nn.AvgPool2d):
+            k = _pair(mod.kernel_size)
+            s = _pair(mod.stride or mod.kernel_size)
+            out = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, (1, 1) + k, (1, 1) + s, "VALID")
+            return out / float(np.prod(k))
+        if isinstance(mod, nn.AdaptiveAvgPool2d):
+            osz = mod.output_size
+            osz = (osz, osz) if isinstance(osz, int) else osz
+            if tuple(osz) == (1, 1):
+                return jnp.mean(x, axis=(2, 3), keepdims=True)
+            raise NotImplementedError("adaptive pool only to (1,1)")
+        if isinstance(mod, nn.ReLU):
+            return jax.nn.relu(x)
+        if isinstance(mod, nn.GELU):
+            return jax.nn.gelu(x)
+        if isinstance(mod, nn.Sigmoid):
+            return jax.nn.sigmoid(x)
+        if isinstance(mod, nn.Tanh):
+            return jnp.tanh(x)
+        if isinstance(mod, nn.Softmax):
+            return jax.nn.softmax(x, axis=mod.dim if mod.dim is not None
+                                  else -1)
+        if isinstance(mod, nn.Dropout):
+            if not training or mod.p == 0:
+                return x
+            if rng is None:
+                raise ValueError("TorchNet training needs rng")
+            keep = 1.0 - mod.p
+            mask = jax.random.bernoulli(self._rng_next(rng), keep, x.shape)
+            return jnp.where(mask, x / keep, 0.0)
+        if isinstance(mod, nn.Flatten):
+            return x.reshape(x.shape[:mod.start_dim] + (-1,))
+        if isinstance(mod, nn.Identity):
+            return x
+        raise NotImplementedError(
+            f"TorchNet: unsupported module {type(mod).__name__}; "
+            "extend _Emitter.call_module")
+
+    _FUNCTIONS: Dict[Any, Callable] = {}
+
+    def call_function(self, fn, args, kwargs):
+        import torch
+        import torch.nn.functional as F
+        table = {
+            operator.add: jnp.add, torch.add: jnp.add,
+            operator.sub: jnp.subtract, operator.mul: jnp.multiply,
+            operator.truediv: jnp.divide,
+            operator.getitem: lambda a, idx: a[idx],
+            torch.relu: jax.nn.relu, F.relu: jax.nn.relu,
+            F.gelu: jax.nn.gelu, torch.sigmoid: jax.nn.sigmoid,
+            torch.tanh: jnp.tanh,
+            torch.flatten: lambda a, start_dim=0, end_dim=-1:
+                a.reshape(a.shape[:start_dim] + (-1,)),
+            torch.cat: lambda ts, dim=0: jnp.concatenate(ts, axis=dim),
+            torch.matmul: jnp.matmul,
+            torch.mean: lambda a, dim=None, keepdim=False:
+                jnp.mean(a, axis=dim, keepdims=keepdim),
+            torch.sum: lambda a, dim=None, keepdim=False:
+                jnp.sum(a, axis=dim, keepdims=keepdim),
+            F.softmax: lambda a, dim=-1: jax.nn.softmax(a, axis=dim),
+            F.log_softmax: lambda a, dim=-1:
+                jax.nn.log_softmax(a, axis=dim),
+            F.avg_pool2d: None,  # routed below
+        }
+        if fn in table and table[fn] is not None:
+            return table[fn](*args, **kwargs)
+        import torch.nn.functional as F2
+        if fn is F2.avg_pool2d:
+            x, k = args[0], _pair(args[1])
+            out = jax.lax.reduce_window(
+                x, 0.0, jax.lax.add, (1, 1) + k, (1, 1) + k, "VALID")
+            return out / float(np.prod(k))
+        raise NotImplementedError(f"TorchNet: unsupported function {fn}")
+
+    def call_method(self, method, args, kwargs):
+        x = args[0]
+        rest = args[1:]
+        if method == "view" or method == "reshape":
+            shape = rest[0] if len(rest) == 1 and \
+                isinstance(rest[0], (list, tuple)) else rest
+            return x.reshape(tuple(int(s) for s in shape))
+        if method == "flatten":
+            start = rest[0] if rest else 0
+            return x.reshape(x.shape[:start] + (-1,))
+        if method == "mean":
+            return jnp.mean(x, axis=rest[0] if rest else None, **kwargs)
+        if method == "permute":
+            return jnp.transpose(x, rest)
+        if method == "transpose":
+            d0, d1 = rest
+            return jnp.swapaxes(x, d0, d1)
+        if method == "contiguous" or method == "clone":
+            return x
+        if method == "size":
+            return x.shape if not rest else x.shape[rest[0]]
+        if method == "unsqueeze":
+            return jnp.expand_dims(x, rest[0])
+        if method == "squeeze":
+            return jnp.squeeze(x, rest[0] if rest else None)
+        raise NotImplementedError(f"TorchNet: unsupported method {method}")
+
+    def _rng_next(self, rng):
+        self._rng_count += 1
+        return jax.random.fold_in(rng, self._rng_count)
+
+    def run(self, params, x, training=False, rng=None):
+        self.params = params
+        self._rng_count = 0
+        env: Dict[str, Any] = {}
+        inputs = x if isinstance(x, (list, tuple)) else [x]
+        in_i = 0
+        modules = dict(self.gm.named_modules())
+
+        def resolve(a):
+            import torch.fx
+            if isinstance(a, torch.fx.Node):
+                return env[a.name]
+            if isinstance(a, (list, tuple)):
+                return type(a)(resolve(v) for v in a)
+            return a
+        import torch.fx
+        result = None
+        for node in self.gm.graph.nodes:
+            if node.op == "placeholder":
+                env[node.name] = inputs[in_i]
+                in_i += 1
+            elif node.op == "get_attr":
+                env[node.name] = self.params[node.target]
+            elif node.op == "call_module":
+                self.current_target = node.target
+                args = [resolve(a) for a in node.args]
+                env[node.name] = self.call_module(
+                    modules[node.target], args[0],
+                    args[1:], training, rng)
+            elif node.op == "call_function":
+                env[node.name] = self.call_function(
+                    node.target, [resolve(a) for a in node.args],
+                    {k: resolve(v) for k, v in node.kwargs.items()})
+            elif node.op == "call_method":
+                env[node.name] = self.call_method(
+                    node.target, [resolve(a) for a in node.args],
+                    {k: resolve(v) for k, v in node.kwargs.items()})
+            elif node.op == "output":
+                result = resolve(node.args[0])
+        return result
+
+
+class TorchNet(Layer):
+    """A torch ``nn.Module`` compiled into a native framework layer.
+
+    ``TorchNet.from_pytorch(model, input_shape)`` mirrors the reference
+    Python surface (pyzoo torch_net.py): the module is fx-traced once;
+    weights become the layer's params (trainable end-to-end under the
+    zoo optimizer — the reference could only sync them through
+    AllReduceParameter between libtorch calls).
+    """
+
+    def __init__(self, torch_module, **kwargs):
+        super().__init__(**kwargs)
+        import torch.fx
+        self.gm = torch.fx.symbolic_trace(torch_module.eval())
+        self._initial_params = self._extract_params(torch_module)
+        self._emitter = _Emitter(self.gm, self._initial_params)
+
+    @classmethod
+    def from_pytorch(cls, model, input_shape=None, **kwargs) -> "TorchNet":
+        net = cls(model, **kwargs)
+        if input_shape is not None:
+            net.batch_input_shape = (None,) + tuple(input_shape)
+        return net
+
+    @staticmethod
+    def _extract_params(module) -> Dict[str, jnp.ndarray]:
+        params = {n: _to_jax(p) for n, p in module.named_parameters()}
+        params.update({n: _to_jax(b) for n, b in module.named_buffers()})
+        return params
+
+    def build(self, rng, input_shape) -> Params:
+        return dict(self._initial_params)
+
+    def call(self, params, x, training=False, rng=None):
+        return self._emitter.run(params, x, training=training, rng=rng)
+
+    def compute_output_shape(self, input_shape):
+        concrete = tuple(2 if d is None else d for d in input_shape)
+        out = jax.eval_shape(
+            lambda p, a: self._emitter.run(p, a),
+            {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+             for k, v in self._initial_params.items()},
+            jax.ShapeDtypeStruct(concrete, jnp.float32))
+        return (None,) + tuple(out.shape[1:])

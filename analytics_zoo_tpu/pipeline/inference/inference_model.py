@@ -1,0 +1,160 @@
+"""InferenceModel — multi-backend concurrent inference facade.
+
+Reference: zoo/pipeline/inference/InferenceModel.scala:30-500+ — a
+``LinkedBlockingQueue`` pool of model copies bounds concurrency;
+backends: BigDL/zoo FloatModel, Caffe, TF frozen/SavedModel,
+TF→OpenVINO (incl. int8 calibration, :400), OpenVINO IR, PyTorch.
+
+TPU redesign: one compiled XLA executable serves all threads (dispatch
+is thread-safe), so the "pool" is a semaphore bounding in-flight
+requests rather than N model clones.  Backends: native zoo models,
+PyTorch (via TorchNet fx→jnp), TF (via TFNet/call_tf).  The int8 path
+is weight-only quantization: kernels stored int8 + per-output-channel
+scales, dequantized *inside* the jitted program so HBM weight traffic
+drops 4x (the role OpenVINO int8 played on CPU).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def quantize_params(params, min_size: int = 1024):
+    """Per-tensor int8 weight quantization with per-last-axis scales.
+
+    Returns (quantized pytree, meta pytree) where quantized leaves are
+    int8 and meta holds f32 scales (or None for kept-f32 leaves).
+    """
+    def q(leaf):
+        arr = np.asarray(leaf)
+        if arr.dtype != np.float32 or arr.size < min_size or arr.ndim < 2:
+            return arr, None
+        scale = np.max(np.abs(arr), axis=tuple(range(arr.ndim - 1)),
+                       keepdims=True) / 127.0
+        scale = np.maximum(scale, 1e-12)
+        qv = np.clip(np.round(arr / scale), -127, 127).astype(np.int8)
+        return qv, scale.astype(np.float32)
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out = [q(l) for l in leaves]
+    qleaves = [o[0] for o in out]
+    scales = [o[1] for o in out]    # flat list, None = kept f32
+    return jax.tree_util.tree_unflatten(treedef, qleaves), scales
+
+
+def dequantize_params(qparams, scales):
+    """``scales`` is the flat list from ``quantize_params``."""
+    leaves, treedef = jax.tree_util.tree_flatten(qparams)
+    new = [l if s is None else l.astype(jnp.float32) * s
+           for l, s in zip(leaves, scales)]
+    return jax.tree_util.tree_unflatten(treedef, new)
+
+
+class InferenceModel:
+    """Concurrency-bounded predictor over a loaded model."""
+
+    def __init__(self, supported_concurrent_num: int = 1):
+        self.concurrency = int(supported_concurrent_num)
+        self._sem = threading.Semaphore(self.concurrency)
+        self._predict_fn = None
+        self._variables = None
+        self._quantized = False
+        self.model = None
+
+    # ------------------------------------------------------------- loaders
+    def load_zoo(self, model, quantize: bool = False) -> "InferenceModel":
+        """Load a native framework model (KerasNet/ZooModel);
+        ``quantize=True`` enables the int8 weight path
+        (doLoadTFAsCalibratedOpenVINO analogue)."""
+        from analytics_zoo_tpu.models.common import ZooModel
+        if isinstance(model, ZooModel):
+            model = model.model
+        self.model = model
+        variables = model.get_variables()
+        if quantize:
+            qp, scales = quantize_params(variables["params"])
+            self._variables = {"params": qp, "state": variables["state"]}
+            self._scales = scales
+            self._quantized = True
+
+            def fn(qparams, state, x):
+                params = dequantize_params(qparams, self._scales)
+                out, _ = model.apply(params, x, state=state,
+                                     training=False)
+                return out
+        else:
+            self._variables = variables
+
+            def fn(params, state, x):
+                out, _ = model.apply(params, x, state=state,
+                                     training=False)
+                return out
+        self._predict_fn = jax.jit(fn)
+        return self
+
+    def load_zoo_file(self, model, path: str,
+                      quantize: bool = False) -> "InferenceModel":
+        """Weights from a saved checkpoint into a built architecture."""
+        model.load_weights(path)
+        return self.load_zoo(model, quantize=quantize)
+
+    def load_torch(self, torch_module, input_shape,
+                   quantize: bool = False) -> "InferenceModel":
+        """(ref InferenceModel.doLoadPyTorch)"""
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.net import TorchNet
+        m = Sequential()
+        m.add(TorchNet.from_pytorch(torch_module,
+                                    input_shape=input_shape))
+        m.init()
+        return self.load_zoo(m, quantize=quantize)
+
+    def load_tf(self, source, **kwargs) -> "InferenceModel":
+        """SavedModel dir path or tf.keras model
+        (ref InferenceModel.doLoadTF)."""
+        from analytics_zoo_tpu.pipeline.api.net import TFNet
+        if isinstance(source, str):
+            net = TFNet.from_saved_model(source, **kwargs)
+        else:
+            net = TFNet.from_keras(source, **kwargs)
+        self.model = net
+        self._variables = {"params": {}, "state": {}}
+        jfn = jax.jit(net._jax_fn)
+        self._predict_fn = lambda p, s, x: jfn(x)
+        return self
+
+    # -------------------------------------------------------------- predict
+    def predict(self, x, batch_size: Optional[int] = None):
+        """Thread-safe batched prediction (doPredict)."""
+        if self._predict_fn is None:
+            raise RuntimeError("no model loaded")
+        with self._sem:
+            leaves = jax.tree_util.tree_leaves(x)
+            n = len(leaves[0])
+            bs = batch_size or n
+            outs = []
+            nb = math.ceil(n / bs)
+            for b in range(nb):
+                lo, hi = b * bs, min((b + 1) * bs, n)
+                xb = jax.tree_util.tree_map(lambda a: a[lo:hi], x)
+                real = hi - lo
+                if real < bs:   # keep one compiled shape
+                    xb = jax.tree_util.tree_map(
+                        lambda a: np.concatenate(
+                            [a, np.zeros((bs - real,) + a.shape[1:],
+                                         a.dtype)]), xb)
+                out = self._predict_fn(
+                    self._variables["params"],
+                    self._variables["state"], xb)
+                outs.append(np.asarray(out)[:real])
+            return np.concatenate(outs)
+
+    @property
+    def is_quantized(self) -> bool:
+        return self._quantized

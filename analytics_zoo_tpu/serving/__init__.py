@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.server import ClusterServing
+
+__all__ = ["InputQueue", "OutputQueue", "ClusterServing"]

@@ -1,0 +1,74 @@
+"""Serving client API.
+
+Reference: pyzoo/zoo/serving/client.py — ``InputQueue.enqueue_image``
+(:58, base64 → XADD) and ``OutputQueue.query``/``dequeue`` (:127).
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.redis_client import connect
+from analytics_zoo_tpu.serving.server import INPUT_STREAM, RESULT_PREFIX
+
+
+class InputQueue:
+    def __init__(self, redis_url: Optional[str] = None, broker=None):
+        self.broker = broker if broker is not None else connect(redis_url)
+
+    def enqueue_image(self, uri: str, image) -> None:
+        """image: ndarray (HWC uint8) or path or raw JPEG bytes."""
+        if isinstance(image, str):
+            with open(image, "rb") as f:
+                raw = f.read()
+        elif isinstance(image, (bytes, bytearray)):
+            raw = bytes(image)
+        else:
+            import cv2
+            ok, enc = cv2.imencode(".jpg", np.asarray(image))
+            if not ok:
+                raise ValueError("cannot encode image")
+            raw = enc.tobytes()
+        self.broker.xadd(INPUT_STREAM, {
+            "uri": uri, "image": base64.b64encode(raw)})
+
+    def enqueue(self, uri: str, data: np.ndarray) -> None:
+        """Arbitrary ndarray input (npy-serialized)."""
+        buf = io.BytesIO()
+        np.save(buf, np.ascontiguousarray(data), allow_pickle=False)
+        self.broker.xadd(INPUT_STREAM, {
+            "uri": uri, "data": base64.b64encode(buf.getvalue())})
+
+
+class OutputQueue:
+    def __init__(self, redis_url: Optional[str] = None, broker=None):
+        self.broker = broker if broker is not None else connect(redis_url)
+
+    def query(self, uri: str, timeout_s: float = 0.0):
+        """Result for one uri (list of [class, prob]), or None."""
+        deadline = time.time() + timeout_s
+        while True:
+            fields = self.broker.hgetall(RESULT_PREFIX + uri)
+            if fields:
+                raw = fields.get("value")
+                return json.loads(raw.decode()
+                                  if isinstance(raw, bytes) else raw)
+            if time.time() >= deadline:
+                return None
+            time.sleep(0.02)
+
+    def dequeue(self, uris) -> Dict[str, Any]:
+        """Fetch-and-delete results for many uris (client.py dequeue)."""
+        out = {}
+        for uri in uris:
+            res = self.query(uri)
+            if res is not None:
+                out[uri] = res
+                self.broker.delete(RESULT_PREFIX + uri)
+        return out

@@ -1,0 +1,229 @@
+"""Minimal Redis client (RESP protocol) + embedded in-process broker.
+
+The reference's Cluster Serving rides Redis streams
+(ClusterServing.scala:103-113 reads stream ``image_stream``, results
+land in the ``result`` table; client pyzoo/zoo/serving/client.py uses
+XADD/HGETALL).  No redis-py is vendored here: RESP is a tiny protocol,
+so ``RedisClient`` speaks it directly over a socket — zero external
+dependencies.  ``EmbeddedBroker`` implements the same command subset
+in-process for tests and single-node serving without a Redis server.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class RedisClient:
+    """Speaks RESP2 for the commands serving needs: XADD, XREAD, XLEN,
+    XTRIM, XDEL, HSET, HGETALL, DEL, PING, INFO."""
+
+    def __init__(self, host: str = "localhost", port: int = 6379,
+                 timeout: float = 5.0):
+        self.sock = socket.create_connection((host, port), timeout)
+        self.buf = b""
+
+    # ------------------------------------------------------------ protocol
+    def execute(self, *args) -> Any:
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            if isinstance(a, str):
+                a = a.encode()
+            elif not isinstance(a, bytes):
+                a = str(a).encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(a), a))
+        self.sock.sendall(b"".join(out))
+        return self._read_reply()
+
+    def _read_line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis closed")
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def _read_exact(self, n: int) -> bytes:
+        while len(self.buf) < n + 2:
+            chunk = self.sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("redis closed")
+            self.buf += chunk
+        data, self.buf = self.buf[:n], self.buf[n + 2:]
+        return data
+
+    def _read_reply(self) -> Any:
+        line = self._read_line()
+        t, rest = line[:1], line[1:]
+        if t == b"+":
+            return rest.decode()
+        if t == b"-":
+            raise RuntimeError(f"redis error: {rest.decode()}")
+        if t == b":":
+            return int(rest)
+        if t == b"$":
+            n = int(rest)
+            return None if n == -1 else self._read_exact(n)
+        if t == b"*":
+            n = int(rest)
+            return None if n == -1 else [self._read_reply()
+                                         for _ in range(n)]
+        raise RuntimeError(f"bad RESP type {t!r}")
+
+    # ------------------------------------------------------------ commands
+    def ping(self) -> bool:
+        return self.execute("PING") == "PONG"
+
+    def xadd(self, stream: str, fields: Dict[str, Any]) -> bytes:
+        args = ["XADD", stream, "*"]
+        for k, v in fields.items():
+            args += [k, v]
+        return self.execute(*args)
+
+    def xread(self, stream: str, last_id: str = "0-0",
+              count: int = 64, block_ms: Optional[int] = None):
+        args = ["XREAD", "COUNT", count]
+        if block_ms is not None:
+            args += ["BLOCK", block_ms]
+        args += ["STREAMS", stream, last_id]
+        reply = self.execute(*args)
+        return _parse_xread(reply)
+
+    def xlen(self, stream: str) -> int:
+        return self.execute("XLEN", stream)
+
+    def xtrim(self, stream: str, maxlen: int) -> int:
+        return self.execute("XTRIM", stream, "MAXLEN", maxlen)
+
+    def xdel(self, stream: str, *ids) -> int:
+        return self.execute("XDEL", stream, *ids)
+
+    def hset(self, key: str, fields: Dict[str, Any]) -> int:
+        args = ["HSET", key]
+        for k, v in fields.items():
+            args += [k, v]
+        return self.execute(*args)
+
+    def hgetall(self, key: str) -> Dict[str, bytes]:
+        reply = self.execute("HGETALL", key) or []
+        return {reply[i].decode(): reply[i + 1]
+                for i in range(0, len(reply), 2)}
+
+    def delete(self, *keys) -> int:
+        return self.execute("DEL", *keys)
+
+    def close(self):
+        self.sock.close()
+
+
+def _parse_xread(reply):
+    """[[stream, [[id, [k,v,...]], ...]]] -> list of (id, fields)"""
+    out: List[Tuple[str, Dict[str, bytes]]] = []
+    if not reply:
+        return out
+    for _stream, entries in reply:
+        for entry_id, kvs in entries:
+            fields = {kvs[i].decode(): kvs[i + 1]
+                      for i in range(0, len(kvs), 2)}
+            out.append((entry_id.decode()
+                        if isinstance(entry_id, bytes) else entry_id,
+                        fields))
+    return out
+
+
+class EmbeddedBroker:
+    """In-process stand-in with the same method surface."""
+
+    def __init__(self):
+        self._streams: Dict[str, List[Tuple[str, Dict]]] = {}
+        self._hashes: Dict[str, Dict[str, Any]] = {}
+        self._seq = itertools.count(1)
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def ping(self) -> bool:
+        return True
+
+    def xadd(self, stream: str, fields: Dict[str, Any]) -> str:
+        with self._cv:
+            entry_id = f"{int(time.time() * 1000)}-{next(self._seq)}"
+            enc = {k: (v.encode() if isinstance(v, str) else v)
+                   for k, v in fields.items()}
+            self._streams.setdefault(stream, []).append((entry_id, enc))
+            self._cv.notify_all()
+            return entry_id
+
+    def xread(self, stream: str, last_id: str = "0-0", count: int = 64,
+              block_ms: Optional[int] = None):
+        deadline = time.time() + (block_ms or 0) / 1000.0
+        while True:
+            with self._cv:
+                entries = self._streams.get(stream, [])
+                out = [(i, f) for i, f in entries
+                       if _id_gt(i, last_id)][:count]
+                if out or block_ms is None:
+                    return out
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    return out
+                self._cv.wait(min(remaining, 0.05))
+
+    def xlen(self, stream: str) -> int:
+        with self._lock:
+            return len(self._streams.get(stream, []))
+
+    def xtrim(self, stream: str, maxlen: int) -> int:
+        with self._lock:
+            s = self._streams.get(stream, [])
+            drop = max(len(s) - maxlen, 0)
+            self._streams[stream] = s[drop:]
+            return drop
+
+    def xdel(self, stream: str, *ids) -> int:
+        with self._lock:
+            s = self._streams.get(stream, [])
+            keep = [(i, f) for i, f in s if i not in ids]
+            self._streams[stream] = keep
+            return len(s) - len(keep)
+
+    def hset(self, key: str, fields: Dict[str, Any]) -> int:
+        with self._lock:
+            self._hashes.setdefault(key, {}).update(
+                {k: (v.encode() if isinstance(v, str) else v)
+                 for k, v in fields.items()})
+            return len(fields)
+
+    def hgetall(self, key: str) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._hashes.get(key, {}))
+
+    def delete(self, *keys) -> int:
+        with self._lock:
+            n = 0
+            for k in keys:
+                n += self._hashes.pop(k, None) is not None
+                n += self._streams.pop(k, None) is not None
+            return n
+
+    def close(self):
+        pass
+
+
+def _id_gt(a: str, b: str) -> bool:
+    def parse(x):
+        ms, _, seq = x.partition("-")
+        return (int(ms), int(seq or 0))
+    return parse(a) > parse(b)
+
+
+def connect(url: Optional[str] = None):
+    """'host:port' → RedisClient; None/'embedded' → EmbeddedBroker."""
+    if url in (None, "embedded"):
+        return EmbeddedBroker()
+    host, _, port = url.partition(":")
+    return RedisClient(host or "localhost", int(port or 6379))

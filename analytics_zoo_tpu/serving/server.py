@@ -1,0 +1,176 @@
+"""Cluster Serving engine.
+
+Reference: zoo/serving/ClusterServing.scala:33-342 — a streaming loop:
+Redis stream ``image_stream`` → base64 JPEG decode → batched
+InferenceModel predict → top-N postprocess → write to the ``result``
+table with backpressure retry; Redis OOM guard via XTRIM (:128-134);
+throughput scalars to the inference summary (:294-317).  Config comes
+from config.yaml (ClusterServingHelper).
+
+TPU version: the worker is a host process driving the one compiled XLA
+predict program; batching pads to a fixed shape so one executable
+serves all traffic.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.redis_client import connect
+from analytics_zoo_tpu.utils.summary import InferenceSummary
+
+log = logging.getLogger("analytics_zoo_tpu.serving")
+
+INPUT_STREAM = "serving_stream"
+RESULT_PREFIX = "result:"
+
+
+def decode_field(fields: Dict[str, bytes]):
+    """Decode one stream record: 'data' (b64 ndarray .npy bytes) or
+    'image' (b64 JPEG) + 'uri'."""
+    uri = fields["uri"].decode() if isinstance(fields["uri"], bytes) \
+        else fields["uri"]
+    if "image" in fields:
+        import cv2
+        raw = base64.b64decode(fields["image"])
+        img = cv2.imdecode(np.frombuffer(raw, np.uint8),
+                           cv2.IMREAD_COLOR)
+        return uri, img.astype(np.float32)
+    raw = base64.b64decode(fields["data"])
+    import io
+    arr = np.load(io.BytesIO(raw), allow_pickle=False)
+    return uri, arr
+
+
+class ServingConfig:
+    """config.yaml contract (scripts/cluster-serving/config.yaml)."""
+
+    def __init__(self, redis_url: Optional[str] = None,
+                 batch_size: int = 4, top_n: int = 1,
+                 max_stream_len: int = 100000,
+                 log_dir: Optional[str] = None):
+        self.redis_url = redis_url
+        self.batch_size = int(batch_size)
+        self.top_n = int(top_n)
+        self.max_stream_len = int(max_stream_len)
+        self.log_dir = log_dir
+
+    @classmethod
+    def from_yaml(cls, path: str) -> "ServingConfig":
+        cfg: Dict[str, Any] = {}
+        section = None
+        with open(path) as f:
+            for line in f:
+                raw = line.rstrip()
+                if not raw or raw.lstrip().startswith("#"):
+                    continue
+                if not raw.startswith(" "):
+                    section = raw.rstrip(":").strip()
+                    continue
+                k, _, v = raw.strip().partition(":")
+                cfg[f"{section}.{k.strip()}"] = v.strip()
+        return cls(
+            redis_url=cfg.get("data.src"),
+            batch_size=int(cfg.get("params.batch_size", 4) or 4),
+            top_n=int(cfg.get("params.top_n", 1) or 1),
+        )
+
+
+class ClusterServing:
+    """The serving worker loop."""
+
+    def __init__(self, inference_model, config: ServingConfig = None,
+                 broker=None):
+        self.model = inference_model
+        self.config = config or ServingConfig()
+        self.broker = broker if broker is not None else connect(
+            self.config.redis_url)
+        self.summary = (InferenceSummary(self.config.log_dir, "serving")
+                        if self.config.log_dir else None)
+        self._stop = threading.Event()
+        self._last_id = "0-0"
+        self.total_records = 0
+
+    # ------------------------------------------------------------ main loop
+    def run_once(self, block_ms: int = 100) -> int:
+        """One poll/predict/write cycle; returns #records served."""
+        entries = self.broker.xread(INPUT_STREAM, self._last_id,
+                                    count=self.config.batch_size,
+                                    block_ms=block_ms)
+        if not entries:
+            return 0
+        t0 = time.time()
+        uris, arrays = [], []
+        for entry_id, fields in entries:
+            self._last_id = entry_id
+            try:
+                uri, arr = decode_field(fields)
+            except Exception:
+                log.exception("undecodable record %s", entry_id)
+                continue
+            uris.append(uri)
+            arrays.append(arr)
+        if not arrays:
+            return 0
+        # fixed-shape batch: pad to batch_size so ONE executable serves
+        # all traffic (the reference's non-BLAS batched path, :186-237)
+        bs = self.config.batch_size
+        x = np.stack(arrays)
+        real = len(arrays)
+        if real < bs:
+            x = np.concatenate(
+                [x, np.zeros((bs - real,) + x.shape[1:], x.dtype)])
+        out = np.asarray(self.model.predict(x))[:real]
+        # top-N postprocess (PostProcessing.scala)
+        exp = np.exp(out - out.max(axis=-1, keepdims=True))
+        probs = exp / exp.sum(axis=-1, keepdims=True)
+        top = np.argsort(-probs, axis=-1)[:, :self.config.top_n]
+        for uri, t, p in zip(uris, top, probs):
+            value = json.dumps([[int(i), float(p[i])] for i in t])
+            self._write_result(uri, value)
+        self.total_records += real
+        wall = time.time() - t0
+        if self.summary is not None:
+            self.summary.add_scalar("Serving Throughput",
+                                    real / max(wall, 1e-9),
+                                    self.total_records)
+            self.summary.add_scalar("Total Records Number",
+                                    self.total_records,
+                                    self.total_records)
+        # OOM guard (ClusterServing.scala:128-134)
+        if self.broker.xlen(INPUT_STREAM) > self.config.max_stream_len:
+            self.broker.xtrim(INPUT_STREAM, self.config.max_stream_len)
+        return real
+
+    def _write_result(self, uri: str, value: str,
+                      retries: int = 100) -> None:
+        # infinite-ish retry backpressure (:254-289)
+        for attempt in range(retries):
+            try:
+                self.broker.hset(RESULT_PREFIX + uri, {"value": value})
+                return
+            except Exception:
+                time.sleep(min(0.1 * (attempt + 1), 2.0))
+        raise RuntimeError(f"could not write result for {uri}")
+
+    def run(self, poll_ms: int = 100) -> None:
+        log.info("cluster serving started (batch=%d)",
+                 self.config.batch_size)
+        while not self._stop.is_set():
+            self.run_once(block_ms=poll_ms)
+
+    def start_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        """(ref ClusterServingManager.listenTermination :335)"""
+        self._stop.set()

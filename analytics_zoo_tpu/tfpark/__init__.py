@@ -1,0 +1,4 @@
+from analytics_zoo_tpu.tfpark.model import KerasModel
+from analytics_zoo_tpu.tfpark.tf_dataset import TFDataset
+
+__all__ = ["KerasModel", "TFDataset"]

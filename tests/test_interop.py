@@ -1,0 +1,165 @@
+"""Foreign-framework interop tests: TorchNet (fx→jnp), TFNet (call_tf),
+TFPark KerasModel / TFDataset — the reference's §2.5 surface."""
+
+import numpy as np
+import pytest
+
+import jax
+
+
+class TestTorchNet:
+    def _mlp(self):
+        import torch.nn as nn
+        return nn.Sequential(
+            nn.Linear(8, 16), nn.ReLU(), nn.Dropout(0.1), nn.Linear(16, 3))
+
+    def test_mlp_matches_torch(self):
+        import torch
+        from analytics_zoo_tpu.pipeline.api.net import TorchNet
+        tm = self._mlp()
+        net = TorchNet.from_pytorch(tm, input_shape=(8,))
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        v = net.init(jax.random.PRNGKey(0), (8,))
+        out, _ = net.apply(v["params"], x, state=v["state"])
+        with torch.no_grad():
+            ref = tm(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_convnet_matches_torch(self):
+        import torch
+        import torch.nn as nn
+        from analytics_zoo_tpu.pipeline.api.net import TorchNet
+
+        class Net(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.conv1 = nn.Conv2d(3, 8, 3, padding=1)
+                self.bn = nn.BatchNorm2d(8)
+                self.pool = nn.MaxPool2d(2)
+                self.fc = nn.Linear(8 * 4 * 4, 5)
+
+            def forward(self, x):
+                x = self.pool(torch.relu(self.bn(self.conv1(x))))
+                x = torch.flatten(x, 1)
+                return self.fc(x)
+
+        tm = Net().eval()
+        net = TorchNet.from_pytorch(tm, input_shape=(3, 8, 8))
+        x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+        v = net.init(jax.random.PRNGKey(0), (3, 8, 8))
+        out, _ = net.apply(v["params"], x, state=v["state"])
+        with torch.no_grad():
+            ref = tm(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_torchnet_trains_in_zoo_engine(self):
+        """The converted torch model is trainable end-to-end under the
+        zoo optimizer (beyond the reference, which only synced weights
+        around libtorch calls)."""
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+        from analytics_zoo_tpu.pipeline.api.net import TorchNet
+        tm = self._mlp()
+        model = Sequential()
+        model.add(TorchNet.from_pytorch(tm, input_shape=(8,)))
+        model.compile(optimizer=Adam(lr=0.02),
+                      loss="sparse_categorical_crossentropy_with_logits",
+                      metrics=["accuracy"])
+        rs = np.random.RandomState(0)
+        x = rs.randn(256, 8).astype(np.float32)
+        w = rs.randn(8, 3).astype(np.float32)
+        y = np.argmax(x @ w, -1).astype(np.int32)
+        m = model.fit(x, y, batch_size=64, nb_epoch=10,
+                      validation_data=(x, y))
+        assert m[-1]["val"]["sparse_categorical_accuracy"] > 0.8
+
+    def test_unsupported_module_reports_name(self):
+        import torch.nn as nn
+        from analytics_zoo_tpu.pipeline.api.net import TorchNet
+        tm = nn.Sequential(nn.Linear(4, 4), nn.PixelShuffle(2))
+        net = TorchNet.from_pytorch(tm, input_shape=(4,))
+        with pytest.raises(NotImplementedError, match="PixelShuffle"):
+            net.init(jax.random.PRNGKey(0), (4,))
+
+
+class TestTFNet:
+    def test_keras_inference_matches_tf(self):
+        import tensorflow as tf
+        from analytics_zoo_tpu.pipeline.api.net import TFNet
+        tfm = tf.keras.Sequential([
+            tf.keras.layers.Input((6,)),
+            tf.keras.layers.Dense(10, activation="relu"),
+            tf.keras.layers.Dense(2),
+        ])
+        net = TFNet.from_keras(tfm)
+        x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+        out = net.predict(x)
+        ref = tfm(x).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_saved_model_roundtrip(self, tmp_path):
+        import tensorflow as tf
+        from analytics_zoo_tpu.pipeline.api.net import TFNet
+        tfm = tf.keras.Sequential([
+            tf.keras.layers.Input((4,)),
+            tf.keras.layers.Dense(3, activation="softmax"),
+        ])
+        path = str(tmp_path / "sm")
+        tf.saved_model.save(tfm, path)
+        net = TFNet.from_saved_model(path)
+        x = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+        out = net.predict(x)
+        np.testing.assert_allclose(out, tfm(x).numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+
+class TestTFPark:
+    def _tf_model(self):
+        import tensorflow as tf
+        m = tf.keras.Sequential([
+            tf.keras.layers.Input((10,)),
+            tf.keras.layers.Dense(32, activation="relu"),
+            tf.keras.layers.Dropout(0.1),
+            tf.keras.layers.Dense(3, activation="softmax"),
+        ])
+        m.compile(optimizer=tf.keras.optimizers.Adam(0.01),
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+        return m
+
+    def test_converted_weights_match_forward(self):
+        from analytics_zoo_tpu.tfpark import KerasModel
+        tfm = self._tf_model()
+        km = KerasModel(tfm)
+        x = np.random.RandomState(0).randn(8, 10).astype(np.float32)
+        ref = tfm(x, training=False).numpy()
+        out = km.predict(x)
+        # bf16 compute policy vs TF f32 → loose tolerance
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-2,
+                                   atol=2e-2)
+
+    def test_distributed_fit(self):
+        from analytics_zoo_tpu.tfpark import KerasModel
+        tfm = self._tf_model()
+        km = KerasModel(tfm)
+        rs = np.random.RandomState(0)
+        x = rs.randn(512, 10).astype(np.float32)
+        w = rs.randn(10, 3).astype(np.float32)
+        y = np.argmax(x @ w, -1).astype(np.int32)
+        km.fit(x, y, batch_size=64, epochs=8)
+        scores = km.evaluate(x, y, batch_size=64)
+        assert scores["sparse_categorical_accuracy"] > 0.8
+
+    def test_tf_dataset_source(self):
+        import tensorflow as tf
+        from analytics_zoo_tpu.tfpark import TFDataset
+        x = np.random.RandomState(0).randn(64, 5).astype(np.float32)
+        y = np.zeros(64, np.int32)
+        ds = tf.data.Dataset.from_tensor_slices((x, y))
+        tfd = TFDataset.from_tf_data_dataset(ds, batch_size=16)
+        assert tfd.feature_set.size == 64
+        assert tfd.get_training_batch_size() == 16
+        batches = list(tfd.feature_set.epoch_batches(0, 16))
+        assert len(batches) == 4
