@@ -1,0 +1,9 @@
+from analytics_zoo_tpu.pipeline.nnframes.nn_estimator import (
+    NNClassifier, NNClassifierModel, NNEstimator, NNModel,
+)
+from analytics_zoo_tpu.pipeline.nnframes.nn_image_reader import (
+    NNImageReader,
+)
+
+__all__ = ["NNEstimator", "NNModel", "NNClassifier", "NNClassifierModel",
+           "NNImageReader"]

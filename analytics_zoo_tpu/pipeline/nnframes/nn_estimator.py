@@ -1,0 +1,231 @@
+"""NNFrames: ML-pipeline estimators over DataFrames.
+
+Reference: zoo/pipeline/nnframes/NNEstimator.scala:198 — a Spark ML
+``Estimator`` whose ``fit`` runs the distributed optimizer on
+DataFrame columns through ``Preprocessing`` converters, returning an
+``NNModel`` transformer that appends a prediction column; NNClassifier
+(NNClassifier.scala) is the classification sugar.
+
+TPU version: the DataFrame engine is pandas (the driver-side tabular
+layer of this stack; arrow-backed columns move zero-copy into numpy),
+and fit lowers to the same Estimator/DistributedTrainer path as
+everything else.  The param-setter surface (setBatchSize, setMaxEpoch,
+setLearningRate, setCachingSample...) is preserved.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.common.triggers import EveryEpoch, MaxEpoch
+from analytics_zoo_tpu.feature.common import Preprocessing
+from analytics_zoo_tpu.feature.feature_set import FeatureSet
+from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+
+def _col_to_array(series) -> np.ndarray:
+    first = series.iloc[0]
+    if isinstance(first, (list, tuple, np.ndarray)):
+        return np.stack([np.asarray(v, np.float32) for v in series])
+    return series.to_numpy()
+
+
+class NNEstimator:
+    def __init__(self, model, criterion,
+                 feature_preprocessing: Optional[Preprocessing] = None,
+                 label_preprocessing: Optional[Preprocessing] = None):
+        self.model = model
+        self.criterion = criterion
+        self.feature_preprocessing = feature_preprocessing
+        self.label_preprocessing = label_preprocessing
+        self.features_col = "features"
+        self.label_col = "label"
+        self.batch_size = 32
+        self.max_epoch = 10
+        self.optim_method = None
+        self.learning_rate = 1e-3
+        self.caching_sample = True
+        self.checkpoint_path = None
+        self.validation = None          # (trigger, df, methods, batch)
+        self._clip = None
+        self._tb = None
+
+    # ----------------------------------------------- Spark-ML-style setters
+    def set_features_col(self, name):
+        self.features_col = name
+        return self
+
+    setFeaturesCol = set_features_col
+
+    def set_label_col(self, name):
+        self.label_col = name
+        return self
+
+    setLabelCol = set_label_col
+
+    def set_batch_size(self, bs):
+        self.batch_size = int(bs)
+        return self
+
+    setBatchSize = set_batch_size
+
+    def set_max_epoch(self, n):
+        self.max_epoch = int(n)
+        return self
+
+    setMaxEpoch = set_max_epoch
+
+    def set_learning_rate(self, lr):
+        self.learning_rate = float(lr)
+        return self
+
+    setLearningRate = set_learning_rate
+
+    def set_optim_method(self, method):
+        self.optim_method = method
+        return self
+
+    setOptimMethod = set_optim_method
+
+    def set_caching_sample(self, flag):
+        self.caching_sample = bool(flag)
+        return self
+
+    setCachingSample = set_caching_sample
+
+    def set_checkpoint(self, path):
+        self.checkpoint_path = path
+        return self
+
+    def set_validation(self, trigger, df, methods, batch_size):
+        self.validation = (trigger, df, methods, batch_size)
+        return self
+
+    setValidation = set_validation
+
+    def set_constant_gradient_clipping(self, lo, hi):
+        self._clip = ("const", lo, hi)
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, v):
+        self._clip = ("l2", v)
+        return self
+
+    def set_tensorboard(self, log_dir, app_name):
+        self._tb = (log_dir, app_name)
+        return self
+
+    # ------------------------------------------------------------------ fit
+    def _extract(self, df, with_label: bool = True):
+        x = _col_to_array(df[self.features_col])
+        if self.feature_preprocessing is not None:
+            x = self.feature_preprocessing(x)
+        x = np.asarray(x, np.float32)
+        y = None
+        if with_label and self.label_col in df.columns:
+            y = _col_to_array(df[self.label_col])
+            if self.label_preprocessing is not None:
+                y = self.label_preprocessing(y)
+            y = np.asarray(y)
+            if y.ndim == 1:
+                y = y[:, None]
+        return x, y
+
+    def fit(self, df) -> "NNModel":
+        from analytics_zoo_tpu.pipeline.api.keras import optimizers as O
+        x, y = self._extract(df)
+        train = FeatureSet.from_ndarrays(x, y)
+        optim = self.optim_method or O.Adam(lr=self.learning_rate)
+        est = Estimator(self.model, optim_method=optim,
+                        model_dir=self.checkpoint_path)
+        if self._clip is not None:
+            if self._clip[0] == "const":
+                est.set_constant_gradient_clipping(*self._clip[1:])
+            else:
+                est.set_l2_norm_gradient_clipping(self._clip[1])
+        if self._tb is not None:
+            est.set_tensorboard(*self._tb)
+        val_set = val_methods = None
+        if self.validation is not None:
+            _, vdf, val_methods, _vb = self.validation
+            vx, vy = self._extract(vdf)
+            val_set = FeatureSet.from_ndarrays(vx, vy, shuffle=False)
+        est.train(train, self.criterion,
+                  end_trigger=MaxEpoch(self.max_epoch),
+                  checkpoint_trigger=EveryEpoch(),
+                  validation_set=val_set, validation_method=val_methods,
+                  batch_size=self.batch_size)
+        return self._make_model()
+
+    def _make_model(self) -> "NNModel":
+        return NNModel(self.model,
+                       feature_preprocessing=self.feature_preprocessing) \
+            .set_features_col(self.features_col) \
+            .set_batch_size(self.batch_size)
+
+
+class NNModel:
+    """Transformer: append a ``prediction`` column
+    (NNEstimator.scala:635)."""
+
+    def __init__(self, model, feature_preprocessing=None):
+        self.model = model
+        self.feature_preprocessing = feature_preprocessing
+        self.features_col = "features"
+        self.prediction_col = "prediction"
+        self.batch_size = 256
+
+    def set_features_col(self, name):
+        self.features_col = name
+        return self
+
+    setFeaturesCol = set_features_col
+
+    def set_prediction_col(self, name):
+        self.prediction_col = name
+        return self
+
+    setPredictionCol = set_prediction_col
+
+    def set_batch_size(self, bs):
+        self.batch_size = int(bs)
+        return self
+
+    setBatchSize = set_batch_size
+
+    def transform(self, df):
+        x = _col_to_array(df[self.features_col])
+        if self.feature_preprocessing is not None:
+            x = self.feature_preprocessing(x)
+        out = np.asarray(self.model.predict(
+            np.asarray(x, np.float32), batch_size=self.batch_size))
+        result = df.copy()
+        result[self.prediction_col] = list(out)
+        return result
+
+
+class NNClassifier(NNEstimator):
+    """Label column is a class index; prediction is argmax
+    (NNClassifier.scala)."""
+
+    def fit(self, df) -> "NNClassifierModel":
+        base = super().fit(df)
+        return NNClassifierModel(
+            base.model, feature_preprocessing=self.feature_preprocessing
+        ).set_features_col(self.features_col) \
+            .set_batch_size(self.batch_size)
+
+
+class NNClassifierModel(NNModel):
+    def transform(self, df):
+        x = _col_to_array(df[self.features_col])
+        if self.feature_preprocessing is not None:
+            x = self.feature_preprocessing(x)
+        out = np.asarray(self.model.predict(
+            np.asarray(x, np.float32), batch_size=self.batch_size))
+        result = df.copy()
+        result[self.prediction_col] = np.argmax(out, axis=-1).astype(
+            np.int64)
+        return result

@@ -18,16 +18,20 @@ from typing import Dict, List, Optional, Tuple
 
 class _ScalarWriter:
     def __init__(self, log_dir: str, app_name: str, kind: str):
+        from analytics_zoo_tpu.utils.tb_writer import TBEventWriter
         self.dir = os.path.join(log_dir, app_name, kind)
         os.makedirs(self.dir, exist_ok=True)
         self.path = os.path.join(self.dir, "events.jsonl")
         self._f = open(self.path, "a")
+        # real tfevents alongside the JSONL, loadable by TensorBoard
+        self._tb = TBEventWriter(self.dir)
 
     def add_scalar(self, tag: str, value: float, step: int) -> None:
         rec = {"tag": tag, "value": float(value), "step": int(step),
                "wall_time": time.time()}
         self._f.write(json.dumps(rec) + "\n")
         self._f.flush()
+        self._tb.add_scalar(tag, value, step)
 
     def read_scalar(self, tag: str) -> List[Tuple[int, float]]:
         out = []
@@ -45,6 +49,7 @@ class _ScalarWriter:
 
     def close(self) -> None:
         self._f.close()
+        self._tb.close()
 
 
 class TrainSummary(_ScalarWriter):

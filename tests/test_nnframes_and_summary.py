@@ -1,0 +1,113 @@
+"""NNFrames DataFrame estimator tests + TensorBoard event-writer
+validation against TF's own reader."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+from analytics_zoo_tpu.pipeline.nnframes import (
+    NNClassifier, NNEstimator, NNModel,
+)
+
+
+def make_df(n=256, d=6, classes=3, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, d).astype(np.float32)
+    w = rs.randn(d, classes).astype(np.float32)
+    y = np.argmax(x @ w, -1).astype(np.int64)
+    return pd.DataFrame({"features": list(x), "label": y}), x, y
+
+
+class TestNNFrames:
+    def test_estimator_fit_transform(self):
+        df, x, y = make_df()
+        model = Sequential()
+        model.add(Dense(16, activation="relu", input_shape=(6,)))
+        model.add(Dense(3))
+        est = (NNEstimator(model,
+                           "sparse_categorical_crossentropy_with_logits")
+               .set_batch_size(64).set_max_epoch(8)
+               .set_optim_method(Adam(lr=0.02)))
+        nn_model = est.fit(df)
+        assert isinstance(nn_model, NNModel)
+        out = nn_model.transform(df)
+        assert "prediction" in out.columns
+        assert len(out.iloc[0]["prediction"]) == 3
+
+    def test_classifier_argmax_labels(self):
+        df, x, y = make_df()
+        model = Sequential()
+        model.add(Dense(32, activation="relu", input_shape=(6,)))
+        model.add(Dense(3))
+        clf = (NNClassifier(model,
+                            "sparse_categorical_crossentropy_with_logits")
+               .set_batch_size(64).set_max_epoch(10)
+               .set_optim_method(Adam(lr=0.02)))
+        m = clf.fit(df)
+        out = m.transform(df)
+        acc = float(np.mean(out["prediction"].to_numpy() == y))
+        assert acc > 0.85
+
+    def test_custom_column_names(self):
+        df, x, y = make_df(n=64)
+        df = df.rename(columns={"features": "f", "label": "l"})
+        model = Sequential()
+        model.add(Dense(3, input_shape=(6,)))
+        est = (NNEstimator(model,
+                           "sparse_categorical_crossentropy_with_logits")
+               .set_features_col("f").set_label_col("l")
+               .set_batch_size(32).set_max_epoch(1))
+        m = est.fit(df)
+        out = m.set_features_col("f").transform(df)
+        assert "prediction" in out.columns
+
+    def test_image_reader(self, tmp_path):
+        import cv2
+        for i in range(3):
+            cv2.imwrite(str(tmp_path / f"{i}.jpg"),
+                        np.full((10, 12, 3), i * 40, np.uint8))
+        from analytics_zoo_tpu.pipeline.nnframes import NNImageReader
+        df = NNImageReader.read_images(str(tmp_path))
+        assert len(df) == 3
+        assert df.iloc[0]["height"] == 10
+        assert df.iloc[0]["width"] == 12
+        assert df.iloc[0]["data"].shape == (10, 12, 3)
+
+
+class TestTBWriter:
+    def test_tf_can_read_our_events(self, tmp_path):
+        from analytics_zoo_tpu.utils.tb_writer import TBEventWriter
+        w = TBEventWriter(str(tmp_path))
+        w.add_scalar("Loss", 1.5, 1)
+        w.add_scalar("Loss", 0.75, 2)
+        w.add_scalar("Throughput", 1e6, 2)
+        w.close()
+
+        import tensorflow as tf
+        events = list(tf.compat.v1.train.summary_iterator(w.path))
+        assert events[0].file_version == "brain.Event:2"
+        scalars = [(v.tag, e.step, v.simple_value)
+                   for e in events[1:] for v in e.summary.value]
+        assert ("Loss", 1, 1.5) in scalars
+        assert ("Loss", 2, 0.75) in scalars
+        assert any(t == "Throughput" and s == 2 for t, s, _ in scalars)
+
+    def test_crc32c_known_vectors(self):
+        from analytics_zoo_tpu.utils.tb_writer import crc32c
+        # RFC 3720 test vector: 32 bytes of zeros
+        assert crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert crc32c(b"123456789") == 0xE3069283
+
+    def test_train_summary_writes_both_formats(self, tmp_path):
+        from analytics_zoo_tpu.utils.summary import TrainSummary
+        ts = TrainSummary(str(tmp_path), "app")
+        ts.add_scalar("Loss", 2.0, 10)
+        assert ts.read_scalar("Loss") == [(10, 2.0)]
+        import glob
+        import os
+        assert glob.glob(os.path.join(str(tmp_path), "app", "train",
+                                      "events.out.tfevents.*"))
+        ts.close()
