@@ -1,0 +1,22 @@
+from analytics_zoo_tpu.models.image.objectdetection.bbox import (
+    decode_boxes, encode_boxes, iou_matrix,
+)
+from analytics_zoo_tpu.models.image.objectdetection.nms import nms
+from analytics_zoo_tpu.models.image.objectdetection.prior_box import (
+    ssd_priors,
+)
+from analytics_zoo_tpu.models.image.objectdetection.multibox_loss import (
+    MultiBoxLoss, match_priors,
+)
+from analytics_zoo_tpu.models.image.objectdetection.ssd import (
+    SSDDetector, ssd_lite, ssd_vgg300,
+)
+from analytics_zoo_tpu.models.image.objectdetection.evaluation import (
+    MeanAveragePrecision,
+)
+
+__all__ = [
+    "decode_boxes", "encode_boxes", "iou_matrix", "nms", "ssd_priors",
+    "MultiBoxLoss", "match_priors", "SSDDetector", "ssd_lite",
+    "ssd_vgg300", "MeanAveragePrecision",
+]

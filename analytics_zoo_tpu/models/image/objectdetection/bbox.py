@@ -1,0 +1,59 @@
+"""Box utilities (ref: objectdetection/common/BboxUtil.scala, 1033 LoC
+of per-box Scala loops — redesigned as fixed-shape vectorized jnp so
+everything jits and runs on the VPU).
+
+Boxes are (x1, y1, x2, y2) in [0, 1]; priors are center-form encoded
+with SSD variances.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+VARIANCES = (0.1, 0.1, 0.2, 0.2)
+
+
+def corner_to_center(boxes):
+    wh = boxes[..., 2:] - boxes[..., :2]
+    c = boxes[..., :2] + wh / 2
+    return jnp.concatenate([c, wh], axis=-1)
+
+
+def center_to_corner(boxes):
+    c, wh = boxes[..., :2], boxes[..., 2:]
+    return jnp.concatenate([c - wh / 2, c + wh / 2], axis=-1)
+
+
+def iou_matrix(a, b):
+    """a: (N,4), b: (M,4) corner boxes -> (N,M) IoU."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.clip(a[:, 2] - a[:, 0], 0, None) * \
+        jnp.clip(a[:, 3] - a[:, 1], 0, None)
+    area_b = jnp.clip(b[:, 2] - b[:, 0], 0, None) * \
+        jnp.clip(b[:, 3] - b[:, 1], 0, None)
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def encode_boxes(matched, priors, variances=VARIANCES):
+    """Encode matched gt corner boxes against center-form priors
+    (BboxUtil.encodeBoxes)."""
+    m = corner_to_center(matched)
+    p = corner_to_center(priors)
+    g_c = (m[..., :2] - p[..., :2]) / (p[..., 2:] * variances[0])
+    g_wh = jnp.log(jnp.maximum(m[..., 2:] / jnp.maximum(p[..., 2:], 1e-10),
+                               1e-10)) / variances[2]
+    return jnp.concatenate([g_c, g_wh], axis=-1)
+
+
+def decode_boxes(loc, priors, variances=VARIANCES):
+    """Inverse of encode (BboxUtil.decodeBoxes)."""
+    p = corner_to_center(priors)
+    c = p[..., :2] + loc[..., :2] * variances[0] * p[..., 2:]
+    wh = p[..., 2:] * jnp.exp(loc[..., 2:] * variances[2])
+    return jnp.clip(center_to_corner(
+        jnp.concatenate([c, wh], axis=-1)), 0.0, 1.0)

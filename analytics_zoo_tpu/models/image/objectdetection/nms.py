@@ -1,0 +1,41 @@
+"""Non-maximum suppression, jit-friendly (ref: the Nms class in
+objectdetection/common — scalar loops there; here a fixed-iteration
+select-and-suppress loop with static output size)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.image.objectdetection.bbox import iou_matrix
+
+
+def nms(boxes, scores, iou_threshold: float = 0.45,
+        max_output: int = 100, score_threshold: float = 0.0):
+    """boxes (N,4), scores (N,) -> (idx (max_output,), valid mask).
+
+    Greedy NMS as a lax.fori_loop with static shapes: each step picks
+    the best remaining score and suppresses overlaps.  Padded slots
+    return index -1.
+    """
+    n = boxes.shape[0]
+    iou = iou_matrix(boxes, boxes)
+    alive = scores > score_threshold
+
+    def body(i, carry):
+        alive, out_idx, out_valid = carry
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        ok = masked[best] > -jnp.inf
+        out_idx = out_idx.at[i].set(jnp.where(ok, best, -1))
+        out_valid = out_valid.at[i].set(ok)
+        suppress = iou[best] >= iou_threshold
+        alive = alive & ~suppress & ~(jnp.arange(n) == best)
+        alive = jnp.where(ok, alive, jnp.zeros_like(alive))
+        return alive, out_idx, out_valid
+
+    out_idx = jnp.full((max_output,), -1, jnp.int32)
+    out_valid = jnp.zeros((max_output,), bool)
+    _, out_idx, out_valid = jax.lax.fori_loop(
+        0, max_output, body, (alive, out_idx, out_valid))
+    return out_idx, out_valid

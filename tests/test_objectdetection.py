@@ -1,0 +1,168 @@
+"""Object detection tests: bbox codec, NMS, prior matching, MultiBox
+loss, SSD end-to-end on a synthetic shapes dataset, mAP."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.models.image.objectdetection import (
+    MeanAveragePrecision, MultiBoxLoss, SSDDetector, decode_boxes,
+    encode_boxes, iou_matrix, match_priors, nms, ssd_lite, ssd_priors,
+)
+
+
+class TestBbox:
+    def test_iou_known_values(self):
+        a = np.array([[0, 0, 1, 1]], np.float32)
+        b = np.array([[0, 0, 1, 1], [0.5, 0.5, 1.5, 1.5],
+                      [2, 2, 3, 3]], np.float32)
+        iou = np.asarray(iou_matrix(jnp.array(a), jnp.array(b)))
+        np.testing.assert_allclose(iou[0], [1.0, 0.25 / 1.75, 0.0],
+                                   rtol=1e-5)
+
+    def test_encode_decode_roundtrip(self):
+        rs = np.random.RandomState(0)
+        priors = np.clip(rs.rand(20, 4) * 0.5 +
+                         np.array([0.2, 0.2, 0.45, 0.45]), 0, 1)
+        priors[:, 2:] = np.maximum(priors[:, 2:],
+                                   priors[:, :2] + 0.05)
+        boxes = priors + rs.randn(20, 4) * 0.01
+        enc = encode_boxes(jnp.array(boxes, jnp.float32),
+                           jnp.array(priors, jnp.float32))
+        dec = decode_boxes(enc, jnp.array(priors, jnp.float32))
+        np.testing.assert_allclose(np.asarray(dec),
+                                   np.clip(boxes, 0, 1),
+                                   rtol=1e-3, atol=1e-4)
+
+
+class TestNMS:
+    def test_suppresses_overlaps(self):
+        boxes = jnp.array([[0, 0, 1, 1],
+                           [0.05, 0.05, 1.05, 1.05],   # overlaps #0
+                           [2, 2, 3, 3]], jnp.float32)
+        scores = jnp.array([0.9, 0.8, 0.7])
+        idx, valid = nms(boxes, scores, iou_threshold=0.5, max_output=3)
+        kept = np.asarray(idx)[np.asarray(valid)]
+        assert list(kept) == [0, 2]
+
+    def test_score_threshold(self):
+        boxes = jnp.array([[0, 0, 1, 1], [2, 2, 3, 3]], jnp.float32)
+        scores = jnp.array([0.9, 0.1])
+        idx, valid = nms(boxes, scores, max_output=2,
+                         score_threshold=0.5)
+        kept = np.asarray(idx)[np.asarray(valid)]
+        assert list(kept) == [0]
+
+
+class TestMatching:
+    def test_forced_match_and_threshold(self):
+        priors = jnp.array([[0, 0, 0.5, 0.5], [0.5, 0.5, 1, 1],
+                            [0, 0.5, 0.5, 1]], jnp.float32)
+        gt = jnp.array([[0.45, 0.45, 0.95, 0.95],
+                        [0, 0, 0, 0]], jnp.float32)
+        labels = jnp.array([2, 0], jnp.int32)
+        mask = jnp.array([True, False])
+        loc_t, cls_t = match_priors(gt, labels, mask, priors)
+        assert int(cls_t[1]) == 2       # overlapping prior matched
+        assert int(cls_t[0]) == 0       # far prior is background
+
+    def test_multibox_loss_decreases_on_perfect_pred(self):
+        priors = np.asarray(ssd_priors(32, (4,), (12.0,), (20.0,),
+                                       ((2.0,),)), np.float32)
+        loss_fn = MultiBoxLoss(priors)
+        G, P, C = 3, priors.shape[0], 4
+        rs = np.random.RandomState(0)
+        gt_boxes = np.array([[[0.1, 0.1, 0.4, 0.4],
+                              [0.5, 0.5, 0.9, 0.9],
+                              [0, 0, 0, 0]]], np.float32)
+        gt_labels = np.array([[1, 2, 0]], np.int32)
+        gt_mask = np.array([[1, 1, 0]], np.float32)
+        y_true = (jnp.array(gt_boxes), jnp.array(gt_labels),
+                  jnp.array(gt_mask))
+        # perfect prediction: encode gt onto matched priors
+        loc_t, cls_t = match_priors(
+            jnp.array(gt_boxes[0]), jnp.array(gt_labels[0]),
+            jnp.array(gt_mask[0], bool), jnp.array(priors))
+        conf_perfect = jax.nn.one_hot(cls_t, C) * 20.0
+        perfect = loss_fn(y_true, (loc_t[None], conf_perfect[None]))
+        random = loss_fn(
+            y_true, (jnp.array(rs.randn(1, P, 4), jnp.float32),
+                     jnp.array(rs.randn(1, P, C), jnp.float32)))
+        assert float(perfect) < float(random)
+        assert float(perfect) < 0.1
+
+
+def synthetic_shapes(n=64, size=64, seed=0):
+    """Images with one bright square; label 1, box = square bounds."""
+    rs = np.random.RandomState(seed)
+    imgs = rs.rand(n, size, size, 3).astype(np.float32) * 0.2
+    boxes = np.zeros((n, 2, 4), np.float32)
+    labels = np.zeros((n, 2), np.int32)
+    masks = np.zeros((n, 2), np.float32)
+    for i in range(n):
+        w = rs.randint(size // 4, size // 2)
+        x0 = rs.randint(0, size - w)
+        y0 = rs.randint(0, size - w)
+        imgs[i, y0:y0 + w, x0:x0 + w] = 1.0
+        boxes[i, 0] = [x0 / size, y0 / size, (x0 + w) / size,
+                       (y0 + w) / size]
+        labels[i, 0] = 1
+        masks[i, 0] = 1
+    return imgs, boxes, labels, masks
+
+
+class TestSSDEndToEnd:
+    def test_ssd_lite_trains_and_detects(self):
+        from analytics_zoo_tpu.parallel.trainer import DistributedTrainer
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+        model, priors = ssd_lite(num_classes=2, image_size=64)
+        model.init(jax.random.PRNGKey(0))
+        loss_fn = MultiBoxLoss(priors)
+        imgs, boxes, labels, masks = synthetic_shapes(n=64)
+
+        trainer = DistributedTrainer(model, loss_fn,
+                                     optim_method=Adam(lr=3e-3))
+        v = model.get_variables()
+        params = trainer.place_params(v["params"])
+        state = trainer.replicate(v["state"])
+        opt_state = trainer.init_opt_state(params)
+        losses = []
+        for step in range(30):
+            lo = (step * 16) % 64
+            batch = trainer.put_batch(
+                (imgs[lo:lo + 16],
+                 (boxes[lo:lo + 16], labels[lo:lo + 16],
+                  masks[lo:lo + 16])))
+            params, opt_state, state, loss = trainer.train_step(
+                params, opt_state, state, batch,
+                jax.random.PRNGKey(step))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7
+
+        model.set_variables({"params": jax.device_get(params),
+                             "state": jax.device_get(state)})
+        det = SSDDetector(model, priors, num_classes=2,
+                          score_threshold=0.25)
+        results = det.detect(imgs[:8])
+        assert len(results) == 8
+        # evaluate mAP on train images — should beat chance after
+        # 30 steps on this trivial dataset
+        m = MeanAveragePrecision(num_classes=2)
+        for i, (db, ds, dl) in enumerate(results):
+            m.add(db, ds, dl, [boxes[i, 0]], [1])
+        res = m.result()
+        assert "mAP" in res
+
+    def test_map_perfect_and_empty(self):
+        m = MeanAveragePrecision(num_classes=3)
+        m.add([np.array([0.1, 0.1, 0.4, 0.4])], [0.9], [1],
+              [np.array([0.1, 0.1, 0.4, 0.4])], [1])
+        m.add([np.array([0.5, 0.5, 0.9, 0.9])], [0.8], [2],
+              [np.array([0.5, 0.5, 0.9, 0.9])], [2])
+        res = m.result()
+        assert res["mAP"] == 1.0
+        empty = MeanAveragePrecision(num_classes=3).result()
+        assert empty["mAP"] == 0.0
