@@ -33,7 +33,13 @@ def _tree_len(tree) -> int:
 
 
 def _tree_take(tree, idx):
-    return jax.tree_util.tree_map(lambda a: a[idx], tree)
+    from analytics_zoo_tpu import native
+
+    def take(a):
+        if isinstance(a, np.ndarray) and a.ndim >= 1:
+            return native.gather_rows(a, idx)
+        return a[idx]
+    return jax.tree_util.tree_map(take, tree)
 
 
 class FeatureSet:

@@ -1,0 +1,92 @@
+"""ctypes bindings for the native data-path library (zoodata.cpp).
+
+Compiled lazily with g++ on first use and cached next to the source;
+all callers fall back to numpy when the toolchain or binary is
+unavailable, so the native path is an accelerator, never a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("analytics_zoo_tpu.native")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "zoodata.cpp")
+_LIB_PATH = os.path.join(_HERE, "libzoodata.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", _SRC,
+           "-o", _LIB_PATH, "-lpthread"]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except Exception as e:      # noqa: BLE001
+        log.info("native build skipped (%s); using numpy fallback", e)
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) or \
+                os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.gather_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_int]
+            lib.shuffle_indices.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint64]
+            lib.u8_to_f32_scaled.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_float, ctypes.c_float, ctypes.c_int]
+            _lib = lib
+        except OSError as e:
+            log.info("native lib load failed (%s)", e)
+        return _lib
+
+
+_N_THREADS = max(os.cpu_count() or 1, 1)
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray,
+                threads: Optional[int] = None) -> np.ndarray:
+    """out[i] = src[idx[i]] — threaded memcpy when the native lib is
+    available and the copy is big enough to amortise threads."""
+    lib = get_lib()
+    nbytes = src[0].nbytes * len(idx) if len(src) else 0
+    if lib is None or not src.flags["C_CONTIGUOUS"] or nbytes < (1 << 20):
+        return src[idx]
+    idx64 = np.ascontiguousarray(idx, np.int64)
+    out = np.empty((len(idx64),) + src.shape[1:], src.dtype)
+    row_bytes = src[0].nbytes
+    lib.gather_rows(
+        src.ctypes.data, idx64.ctypes.data, len(idx64), row_bytes,
+        out.ctypes.data, threads or _N_THREADS)
+    return out
+
+
+def shuffle_indices(n: int, seed: int) -> np.ndarray:
+    lib = get_lib()
+    if lib is None:
+        return np.random.default_rng(seed).permutation(n)
+    out = np.empty(n, np.int64)
+    lib.shuffle_indices(out.ctypes.data, n, seed & 0xFFFFFFFFFFFFFFFF)
+    return out

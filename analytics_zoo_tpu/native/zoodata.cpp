@@ -1,0 +1,73 @@
+// Native data-path kernels for the host input pipeline.
+//
+// Role parity (SURVEY.md §2.9): the reference's native data plumbing —
+// the PMem/DRAM sample cache (PersistentMemoryAllocator.java natives)
+// and the multi-threaded MTSampleToMiniBatch batcher — re-imagined for
+// the TPU host: the hot operation is gathering a shuffled set of sample
+// rows out of a big contiguous cache into a batch buffer that feeds
+// device infeed. numpy's fancy indexing is single-threaded; this is the
+// same memcpy fan-out across threads.
+//
+// Build: g++ -O3 -march=native -shared -fPIC zoodata.cpp -o libzoodata.so -lpthread
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+#include <algorithm>
+#include <random>
+
+extern "C" {
+
+// Gather rows: out[i] = src[idx[i]] for row_bytes-sized rows.
+void gather_rows(const uint8_t* src, const int64_t* idx, int64_t n_idx,
+                 int64_t row_bytes, uint8_t* out, int n_threads) {
+    if (n_threads < 1) n_threads = 1;
+    int64_t per = (n_idx + n_threads - 1) / n_threads;
+    std::vector<std::thread> threads;
+    for (int t = 0; t < n_threads; ++t) {
+        int64_t lo = t * per;
+        int64_t hi = std::min(lo + per, n_idx);
+        if (lo >= hi) break;
+        threads.emplace_back([=]() {
+            for (int64_t i = lo; i < hi; ++i) {
+                std::memcpy(out + i * row_bytes,
+                            src + idx[i] * row_bytes,
+                            (size_t)row_bytes);
+            }
+        });
+    }
+    for (auto& th : threads) th.join();
+}
+
+// Deterministic Fisher-Yates permutation (the per-epoch shuffled index
+// array of CachedDistributedFeatureSet, FeatureSet.scala:247-308).
+void shuffle_indices(int64_t* idx, int64_t n, uint64_t seed) {
+    for (int64_t i = 0; i < n; ++i) idx[i] = i;
+    std::mt19937_64 rng(seed);
+    for (int64_t i = n - 1; i > 0; --i) {
+        int64_t j = (int64_t)(rng() % (uint64_t)(i + 1));
+        std::swap(idx[i], idx[j]);
+    }
+}
+
+// Cast-and-scale uint8 image rows to float32 (decode postprocessing),
+// threaded: out = (in - mean) * inv_std per channel-agnostic scalar.
+void u8_to_f32_scaled(const uint8_t* src, float* out, int64_t n,
+                      float mean, float inv_std, int n_threads) {
+    if (n_threads < 1) n_threads = 1;
+    int64_t per = (n + n_threads - 1) / n_threads;
+    std::vector<std::thread> threads;
+    for (int t = 0; t < n_threads; ++t) {
+        int64_t lo = t * per;
+        int64_t hi = std::min(lo + per, n);
+        if (lo >= hi) break;
+        threads.emplace_back([=]() {
+            for (int64_t i = lo; i < hi; ++i)
+                out[i] = ((float)src[i] - mean) * inv_std;
+        });
+    }
+    for (auto& th : threads) th.join();
+}
+
+}  // extern "C"

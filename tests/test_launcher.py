@@ -1,0 +1,36 @@
+"""Launcher tests: env injection, exit-code collection, guard cleanup."""
+
+import os
+import sys
+import textwrap
+import time
+
+from analytics_zoo_tpu.parallel.launcher import ProcessMonitor, ZooCluster
+
+
+def test_cluster_env_and_exit_codes(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        pid = os.environ["ZOO_TPU_PROCESS_ID"]
+        n = os.environ["ZOO_TPU_NUM_PROCESSES"]
+        coord = os.environ["ZOO_TPU_COORDINATOR"]
+        assert ":" in coord
+        print(f"worker {pid}/{n}")
+        sys.exit(int(pid))
+    """))
+    cluster = ZooCluster(num_processes=3)
+    cluster.start(str(script))
+    codes = cluster.wait(timeout=30)
+    assert sorted(codes) == [0, 1, 2]
+
+
+def test_monitor_kills_stragglers(tmp_path):
+    script = tmp_path / "sleeper.py"
+    script.write_text("import time; time.sleep(600)")
+    cluster = ZooCluster(num_processes=2)
+    cluster.start(str(script))
+    time.sleep(0.5)
+    assert cluster.monitor.alive() == 2
+    cluster.stop()
+    assert cluster.monitor.alive() == 0
