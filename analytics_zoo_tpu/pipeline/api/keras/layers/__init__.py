@@ -34,6 +34,14 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.noise import (
 from analytics_zoo_tpu.pipeline.api.keras.layers.wrappers import (
     KerasLayerWrapper, TimeDistributed,
 )
+from analytics_zoo_tpu.pipeline.api.keras.layers.convlstm import ConvLSTM2D
+from analytics_zoo_tpu.pipeline.api.keras.layers.local import (
+    LocallyConnected1D, LocallyConnected2D,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.attention import (
+    BERT, MultiHeadSelfAttention, PositionwiseFeedForward,
+    transformer_block,
+)
 
 # Keras-2 style aliases
 Conv1D = Convolution1D
@@ -59,4 +67,7 @@ __all__ = [
     "GaussianDropout", "GaussianNoise", "SpatialDropout1D",
     "SpatialDropout2D", "SpatialDropout3D",
     "KerasLayerWrapper", "TimeDistributed",
+    "ConvLSTM2D", "LocallyConnected1D", "LocallyConnected2D",
+    "BERT", "MultiHeadSelfAttention", "PositionwiseFeedForward",
+    "transformer_block",
 ]

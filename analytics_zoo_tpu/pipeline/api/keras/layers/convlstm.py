@@ -1,0 +1,91 @@
+"""ConvLSTM2D (ref: keras/layers/ConvLSTM2D.scala / ConvLSTM3D) —
+convolutional LSTM over (B, T, H, W, C) sequences.
+
+Same scan structure as the dense RNNs: the input convolution for all
+timesteps is hoisted into one big batched conv (fold T into the batch
+dim → MXU-friendly); only the recurrent conv runs inside the scan.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops import activations as acts
+from analytics_zoo_tpu.ops.dtypes import get_policy
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params
+
+
+def _conv(x, w, stride=(1, 1), padding="SAME"):
+    policy = get_policy()
+    return jax.lax.conv_general_dilated(
+        policy.cast_compute(x), policy.cast_compute(w), stride, padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC")).astype(jnp.float32)
+
+
+class ConvLSTM2D(Layer):
+    def __init__(self, nb_filter: int, nb_kernel: int,
+                 activation="tanh", inner_activation="sigmoid",
+                 border_mode: str = "same", subsample=(1, 1),
+                 return_sequences: bool = False, go_backwards: bool = False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.k = int(nb_kernel)
+        self.activation = acts.get(activation) or (lambda v: v)
+        self.inner_activation = acts.get(inner_activation) or (lambda v: v)
+        assert border_mode == "same", \
+            "ConvLSTM2D supports border_mode='same' (state shapes)"
+        self.subsample = tuple(subsample)
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def build(self, rng, input_shape) -> Params:
+        c = input_shape[-1]
+        f = self.nb_filter
+        params: Params = {}
+        self.add_weight(params, rng, "kernel",
+                        (self.k, self.k, c, 4 * f))
+        self.add_weight(params, rng, "recurrent_kernel",
+                        (self.k, self.k, f, 4 * f), init="orthogonal")
+        self.add_weight(params, rng, "bias", (4 * f,), init="zero")
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        b, t, h, w, c = x.shape
+        f = self.nb_filter
+        # all-timestep input conv: fold T into batch
+        flat = x.reshape(b * t, h, w, c)
+        xp = _conv(flat, params["kernel"], self.subsample) + params["bias"]
+        oh, ow = xp.shape[1], xp.shape[2]
+        xp = xp.reshape(b, t, oh, ow, 4 * f)
+        seq = jnp.swapaxes(xp, 0, 1)
+        if self.go_backwards:
+            seq = seq[::-1]
+
+        def step(carry, xt):
+            h_prev, c_prev = carry
+            gates = xt + _conv(h_prev, params["recurrent_kernel"])
+            i, fg, g, o = jnp.split(gates, 4, axis=-1)
+            i = self.inner_activation(i)
+            fg = self.inner_activation(fg)
+            g = self.activation(g)
+            o = self.inner_activation(o)
+            c_new = fg * c_prev + i * g
+            h_new = o * self.activation(c_new)
+            return (h_new, c_new), \
+                h_new if self.return_sequences else None
+
+        z = jnp.zeros((b, oh, ow, f), jnp.float32)
+        (h_last, _), outs = jax.lax.scan(step, (z, z), seq)
+        if self.return_sequences:
+            outs = jnp.swapaxes(outs, 0, 1)
+            return outs[:, ::-1] if self.go_backwards else outs
+        return h_last
+
+    def compute_output_shape(self, s):
+        sh = None if s[2] is None else -(-s[2] // self.subsample[0])
+        sw = None if s[3] is None else -(-s[3] // self.subsample[1])
+        if self.return_sequences:
+            return (s[0], s[1], sh, sw, self.nb_filter)
+        return (s[0], sh, sw, self.nb_filter)

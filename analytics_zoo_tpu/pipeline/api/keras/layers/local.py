@@ -1,0 +1,108 @@
+"""Locally-connected layers (ref: keras/layers/LocallyConnected1D/2D
+.scala) — unshared conv: every spatial position has its own kernel.
+
+TPU note: lowered to one batched matmul over unfolded patches
+(extract_patches → einsum), which tiles onto the MXU far better than a
+per-position loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.ops import activations as acts
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params
+
+
+class LocallyConnected1D(Layer):
+    def __init__(self, nb_filter: int, filter_length: int,
+                 activation=None, subsample_length: int = 1,
+                 bias: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.k = int(filter_length)
+        self.stride = int(subsample_length)
+        self.activation = acts.get(activation)
+        self.use_bias = bias
+
+    def _out_len(self, n):
+        return None if n is None else (n - self.k) // self.stride + 1
+
+    def build(self, rng, input_shape) -> Params:
+        t, c = input_shape[1], input_shape[2]
+        ot = self._out_len(t)
+        params: Params = {}
+        self.add_weight(params, rng, "kernel",
+                        (ot, self.k * c, self.nb_filter))
+        if self.use_bias:
+            self.add_weight(params, rng, "bias", (ot, self.nb_filter),
+                            init="zero")
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        b, t, c = x.shape
+        ot = (t - self.k) // self.stride + 1
+        idx = (np.arange(ot)[:, None] * self.stride +
+               np.arange(self.k)[None, :])
+        patches = x[:, idx]                    # (B, OT, K, C)
+        patches = patches.reshape(b, ot, self.k * c)
+        y = jnp.einsum("bok,okf->bof", patches, params["kernel"])
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
+
+    def compute_output_shape(self, s):
+        return (s[0], self._out_len(s[1]), self.nb_filter)
+
+
+class LocallyConnected2D(Layer):
+    def __init__(self, nb_filter: int, nb_row: int, nb_col: int,
+                 activation=None, subsample=(1, 1), bias: bool = True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.nb_filter = int(nb_filter)
+        self.kh, self.kw = int(nb_row), int(nb_col)
+        self.stride = tuple(subsample)
+        self.activation = acts.get(activation)
+        self.use_bias = bias
+
+    def _out_hw(self, h, w):
+        oh = None if h is None else (h - self.kh) // self.stride[0] + 1
+        ow = None if w is None else (w - self.kw) // self.stride[1] + 1
+        return oh, ow
+
+    def build(self, rng, input_shape) -> Params:
+        h, w, c = input_shape[1:4]
+        oh, ow = self._out_hw(h, w)
+        params: Params = {}
+        self.add_weight(params, rng, "kernel",
+                        (oh * ow, self.kh * self.kw * c, self.nb_filter))
+        if self.use_bias:
+            self.add_weight(params, rng, "bias",
+                            (oh * ow, self.nb_filter), init="zero")
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        b, h, w, c = x.shape
+        oh, ow = self._out_hw(h, w)
+        ri = np.arange(oh)[:, None] * self.stride[0] + \
+            np.arange(self.kh)[None, :]
+        ci = np.arange(ow)[:, None] * self.stride[1] + \
+            np.arange(self.kw)[None, :]
+        patches = x[:, ri][:, :, :, ci]        # (B, OH, KH, OW, KW, C)
+        patches = jnp.moveaxis(patches, 2, 3)  # (B, OH, OW, KH, KW, C)
+        patches = patches.reshape(b, oh * ow, self.kh * self.kw * c)
+        y = jnp.einsum("bok,okf->bof", patches, params["kernel"])
+        if self.use_bias:
+            y = y + params["bias"]
+        if self.activation is not None:
+            y = self.activation(y)
+        return y.reshape(b, oh, ow, self.nb_filter)
+
+    def compute_output_shape(self, s):
+        oh, ow = self._out_hw(s[1], s[2])
+        return (s[0], oh, ow, self.nb_filter)

@@ -1,0 +1,23 @@
+"""keras2 — Keras-2-style argument names for the core layer set.
+
+Reference: zoo/pipeline/api/keras2/layers/ (partial Keras-2 API: Dense,
+Conv1D/2D, pooling, merge functions, Softmax... with `units`/`filters`/
+`kernel_size`-style args instead of Keras-1 `output_dim`/`nb_filter`).
+Thin adapters over the keras-1 layer set.
+"""
+
+from analytics_zoo_tpu.pipeline.api.keras2.layers import (
+    Activation, AveragePooling1D, AveragePooling2D, Conv1D, Conv2D,
+    Dense, Dropout, Flatten, GlobalAveragePooling1D,
+    GlobalAveragePooling2D, GlobalMaxPooling1D, GlobalMaxPooling2D,
+    MaxPooling1D, MaxPooling2D, Softmax, add, average, concatenate,
+    maximum, minimum, multiply, subtract,
+)
+
+__all__ = [
+    "Activation", "AveragePooling1D", "AveragePooling2D", "Conv1D",
+    "Conv2D", "Dense", "Dropout", "Flatten", "GlobalAveragePooling1D",
+    "GlobalAveragePooling2D", "GlobalMaxPooling1D", "GlobalMaxPooling2D",
+    "MaxPooling1D", "MaxPooling2D", "Softmax", "add", "average",
+    "concatenate", "maximum", "minimum", "multiply", "subtract",
+]

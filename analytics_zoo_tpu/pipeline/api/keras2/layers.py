@@ -1,0 +1,101 @@
+"""Keras-2 argument-name adapters (ref: zoo/pipeline/api/keras2/layers)."""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as k1
+from analytics_zoo_tpu.pipeline.api.keras.layers import (  # re-exports
+    Activation, Dropout, Flatten, GlobalAveragePooling1D,
+    GlobalAveragePooling2D, GlobalMaxPooling1D, GlobalMaxPooling2D,
+    Softmax,
+)
+
+
+def Dense(units, activation=None, use_bias=True,
+          kernel_initializer="glorot_uniform", kernel_regularizer=None,
+          bias_regularizer=None, **kwargs):
+    return k1.Dense(units, init=kernel_initializer, activation=activation,
+                    W_regularizer=kernel_regularizer,
+                    b_regularizer=bias_regularizer, bias=use_bias,
+                    **kwargs)
+
+
+def Conv2D(filters, kernel_size, strides=(1, 1), padding="valid",
+           activation=None, use_bias=True,
+           kernel_initializer="glorot_uniform", **kwargs):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    if isinstance(strides, int):
+        strides = (strides, strides)
+    return k1.Convolution2D(filters, kernel_size[0], kernel_size[1],
+                            subsample=tuple(strides), border_mode=padding,
+                            activation=activation, bias=use_bias,
+                            init=kernel_initializer, **kwargs)
+
+
+def Conv1D(filters, kernel_size, strides=1, padding="valid",
+           activation=None, use_bias=True, **kwargs):
+    if isinstance(kernel_size, (tuple, list)):
+        kernel_size = kernel_size[0]
+    if isinstance(strides, (tuple, list)):
+        strides = strides[0]
+    return k1.Convolution1D(filters, kernel_size, strides=(strides,),
+                            border_mode=padding, activation=activation,
+                            bias=use_bias, **kwargs)
+
+
+def MaxPooling2D(pool_size=(2, 2), strides=None, padding="valid",
+                 **kwargs):
+    return k1.MaxPooling2D(pool_size=pool_size, strides=strides,
+                           border_mode=padding, **kwargs)
+
+
+def AveragePooling2D(pool_size=(2, 2), strides=None, padding="valid",
+                     **kwargs):
+    return k1.AveragePooling2D(pool_size=pool_size, strides=strides,
+                               border_mode=padding, **kwargs)
+
+
+def MaxPooling1D(pool_size=2, strides=None, padding="valid", **kwargs):
+    return k1.MaxPooling1D(pool_length=pool_size, stride=strides,
+                           border_mode=padding, **kwargs)
+
+
+def AveragePooling1D(pool_size=2, strides=None, padding="valid",
+                     **kwargs):
+    return k1.AveragePooling1D(pool_length=pool_size, stride=strides,
+                               border_mode=padding, **kwargs)
+
+
+# ------------------------------------------------------- merge functions
+def _merge(mode, inputs, **kwargs):
+    return k1.Merge(mode=mode, **kwargs)(inputs)
+
+
+def add(inputs, **kw):
+    return _merge("sum", inputs, **kw)
+
+
+def multiply(inputs, **kw):
+    return _merge("mul", inputs, **kw)
+
+
+def average(inputs, **kw):
+    return _merge("ave", inputs, **kw)
+
+
+def maximum(inputs, **kw):
+    return _merge("max", inputs, **kw)
+
+
+def minimum(inputs, **kw):
+    return _merge("min", inputs, **kw)
+
+
+def concatenate(inputs, axis=-1, **kw):
+    return _merge("concat", inputs, concat_axis=axis, **kw)
+
+
+def subtract(inputs, **kw):
+    from analytics_zoo_tpu.pipeline.api.keras.layers.core import Lambda
+    assert len(inputs) == 2
+    return Lambda(lambda xs: xs[0] - xs[1])(list(inputs))

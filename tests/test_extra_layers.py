@@ -1,0 +1,121 @@
+"""Tests for ConvLSTM2D, LocallyConnected, keras2 API, image3d."""
+
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    ConvLSTM2D, LocallyConnected1D, LocallyConnected2D,
+)
+
+RNG = jax.random.PRNGKey(0)
+
+
+def run(layer, x, input_shape=None):
+    v = layer.init(RNG, input_shape or x.shape[1:])
+    out, _ = layer.apply(v["params"], x, state=v["state"])
+    return v, np.asarray(out)
+
+
+class TestConvLSTM2D:
+    def test_shapes(self):
+        x = np.random.RandomState(0).randn(2, 4, 8, 8, 3).astype(
+            np.float32)
+        layer = ConvLSTM2D(6, 3)
+        _, out = run(layer, x)
+        assert out.shape == (2, 8, 8, 6)
+        layer2 = ConvLSTM2D(6, 3, return_sequences=True)
+        _, out2 = run(layer2, x)
+        assert out2.shape == (2, 4, 8, 8, 6)
+        assert layer2.compute_output_shape((None, 4, 8, 8, 3)) == \
+            (None, 4, 8, 8, 6)
+
+    def test_temporal_dependence(self):
+        # output depends on earlier frames (recurrence actually wired)
+        rs = np.random.RandomState(0)
+        x1 = rs.randn(1, 3, 4, 4, 2).astype(np.float32)
+        x2 = x1.copy()
+        x2[:, 0] += 1.0     # change only the FIRST frame
+        layer = ConvLSTM2D(4, 3)
+        v = layer.init(RNG, (3, 4, 4, 2))
+        o1, _ = layer.apply(v["params"], x1, state=v["state"])
+        o2, _ = layer.apply(v["params"], x2, state=v["state"])
+        assert not np.allclose(np.asarray(o1), np.asarray(o2))
+
+
+class TestLocallyConnected:
+    def test_1d_shapes_and_unshared(self):
+        x = np.random.RandomState(0).randn(2, 10, 4).astype(np.float32)
+        layer = LocallyConnected1D(6, 3)
+        v, out = run(layer, x)
+        assert out.shape == (2, 8, 6)
+        assert v["params"]["kernel"].shape == (8, 12, 6)
+
+    def test_2d_matches_manual(self):
+        x = np.random.RandomState(0).randn(1, 5, 5, 2).astype(np.float32)
+        layer = LocallyConnected2D(3, 2, 2)
+        v, out = run(layer, x)
+        assert out.shape == (1, 4, 4, 3)
+        # manual check at position (0,0)
+        w = np.asarray(v["params"]["kernel"])
+        b = np.asarray(v["params"]["bias"])
+        patch = x[0, :2, :2].reshape(-1)
+        np.testing.assert_allclose(out[0, 0, 0], patch @ w[0] + b[0],
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestKeras2:
+    def test_keras2_mnist_style_model(self):
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api import keras2 as K2
+        m = Sequential()
+        m.add(K2.Conv2D(8, 3, activation="relu", padding="same",
+                        input_shape=(12, 12, 1)))
+        m.add(K2.MaxPooling2D())
+        m.add(K2.Flatten())
+        m.add(K2.Dense(units=4))
+        assert m.get_output_shape() == (None, 4)
+        m.init()
+        out = m.predict(np.ones((2, 12, 12, 1), np.float32),
+                        batch_size=2)
+        assert out.shape == (2, 4)
+
+    def test_keras2_merge_functions(self):
+        from analytics_zoo_tpu.pipeline.api import keras2 as K2
+        from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+        a = Input(shape=(4,))
+        b = Input(shape=(4,))
+        out = K2.concatenate([K2.add([a, b]), K2.subtract([a, b])])
+        model = Model([a, b], out)
+        model.init()
+        xa = np.ones((2, 4), np.float32)
+        xb = 2 * np.ones((2, 4), np.float32)
+        v = model.get_variables()
+        y, _ = model.apply(v["params"], [xa, xb], state=v["state"])
+        np.testing.assert_allclose(np.asarray(y)[:, :4], 3.0)
+        np.testing.assert_allclose(np.asarray(y)[:, 4:], -1.0)
+
+
+class TestImage3D:
+    def test_crops(self):
+        from analytics_zoo_tpu.feature.image3d import (
+            CenterCrop3D, Crop3D, RandomCrop3D)
+        vol = np.arange(4 * 6 * 8, dtype=np.float32).reshape(4, 6, 8)
+        out = Crop3D((1, 2, 3), (2, 2, 2)).apply(vol)
+        np.testing.assert_array_equal(out, vol[1:3, 2:4, 3:5])
+        out = CenterCrop3D((2, 2, 2)).apply(vol)
+        assert out.shape == (2, 2, 2)
+        out = RandomCrop3D((2, 3, 4), seed=1).apply(vol)
+        assert out.shape == (2, 3, 4)
+
+    def test_rotate_and_affine(self):
+        from analytics_zoo_tpu.feature.image3d import (
+            AffineTransform3D, Rotate3D)
+        vol = np.zeros((8, 8, 8), np.float32)
+        vol[2:6, 2:6, 2:6] = 1.0
+        rot = Rotate3D(90, axes=(1, 2)).apply(vol)
+        assert rot.shape == vol.shape
+        # 90° rotation of a centered cube ≈ the same cube
+        np.testing.assert_allclose(rot, vol, atol=1e-3)
+        ident = AffineTransform3D(np.eye(3)).apply(vol)
+        np.testing.assert_allclose(ident, vol, atol=1e-5)
