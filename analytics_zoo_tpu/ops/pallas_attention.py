@@ -1,0 +1,106 @@
+"""Pallas flash-attention kernel for TPU.
+
+Single-chip long-context attention: O(T·Tb) VMEM instead of the O(T²)
+logits matrix XLA materialises for plain attention.  Pairs with
+parallel/ring_attention.py (across-chip SP): ring handles the
+inter-chip blocks, this kernel is what each chip should run on its
+local block.
+
+Grid: (batch·heads, T/block_q).  K/V for the (batch·head) live in VMEM
+(fine for T·D up to ~4k·128 at bf16/f32); the kernel streams q blocks
+and runs the online-softmax recurrence over k blocks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:           # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+                  causal: bool, scale: float, block_q: int):
+    t = k_ref.shape[0]
+    d = q_ref.shape[-1]
+    q = q_ref[:] * scale                       # (block_q, d)
+    q_idx = pl.program_id(1)
+
+    n_k = t // block_k
+
+    def body(i, carry):
+        acc, m, l = carry
+        k_blk = k_ref[pl.ds(i * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(i * block_k, block_k), :]
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            q_pos = q_idx * block_q + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            k_pos = i * block_k + \
+                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, -1e30)
+        m_blk = jnp.max(s, axis=1)
+        m_new = jnp.maximum(m, m_blk)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * corr + jnp.sum(p, axis=1)
+        acc_new = acc * corr[:, None] + jnp.dot(
+            p.astype(v_blk.dtype), v_blk,
+            preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), -1e30, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc, m0, l0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 256,
+                    block_k: int = 256, interpret: bool = False):
+    """q,k,v: (B, H, T, D) -> (B, H, T, D)."""
+    b, h, t, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    if not _HAS_PALLAS:
+        from analytics_zoo_tpu.ops.attention import (
+            scaled_dot_product_attention)
+        return scaled_dot_product_attention(q, k, v, causal=causal,
+                                            scale=scale)
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    assert t % block_q == 0 and t % block_k == 0, \
+        f"seq len {t} must divide block sizes ({block_q}, {block_k})"
+
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+
+    kernel = functools.partial(_flash_kernel, block_k=block_k,
+                               causal=causal, scale=scale,
+                               block_q=block_q)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d),
+                         lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d)

@@ -1,0 +1,79 @@
+"""Profiling / tracing utilities.
+
+Reference posture (SURVEY.md §5): coarse ``Utils.timeIt`` wall timing
+around session runs + per-iteration phase metrics in the driver log.
+TPU version: the same cheap step timers, plus first-class
+``jax.profiler`` trace capture viewable in TensorBoard / Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+import jax
+
+log = logging.getLogger("analytics_zoo_tpu.profiling")
+
+
+@contextlib.contextmanager
+def time_it(name: str, sync: bool = False, result=None):
+    """Wall-time a block (the Utils.timeIt role); ``sync`` blocks on a
+    jax value first so device work is included."""
+    t0 = time.time()
+    yield
+    if sync and result is not None:
+        jax.block_until_ready(result)
+    log.info("%s took %.3fs", name, time.time() - t0)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Capture a jax.profiler trace for the enclosed block."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Aggregate per-phase step timings (the BigDL Metrics table role:
+    driver-side phase breakdown printed per interval)."""
+
+    def __init__(self, report_every: int = 100):
+        self.report_every = report_every
+        self._acc: Dict[str, float] = defaultdict(float)
+        self._count = 0
+        self._open: Dict[str, float] = {}
+
+    def start(self, phase: str) -> None:
+        self._open[phase] = time.time()
+
+    def stop(self, phase: str) -> None:
+        t0 = self._open.pop(phase, None)
+        if t0 is not None:
+            self._acc[phase] += time.time() - t0
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        self.start(name)
+        try:
+            yield
+        finally:
+            self.stop(name)
+
+    def step(self) -> Optional[Dict[str, float]]:
+        """Mark one step done; returns (and logs) the averaged phase
+        table every ``report_every`` steps."""
+        self._count += 1
+        if self._count % self.report_every:
+            return None
+        avg = {k: v / self.report_every for k, v in self._acc.items()}
+        self._acc.clear()
+        log.info("step %d phase avg: %s", self._count,
+                 {k: f"{v * 1e3:.2f}ms" for k, v in avg.items()})
+        return avg

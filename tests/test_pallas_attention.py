@@ -1,0 +1,29 @@
+"""Pallas flash-attention kernel: interpret-mode correctness on the CPU
+mesh (real-TPU perf is exercised by bench/verification runs)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.ops.attention import scaled_dot_product_attention
+from analytics_zoo_tpu.ops.pallas_attention import flash_attention
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(causal):
+    rs = np.random.RandomState(0)
+    q, k, v = (jnp.array(rs.randn(2, 3, 128, 32), jnp.float32)
+               for _ in range(3))
+    ref = scaled_dot_product_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, block_q=64,
+                          block_k=64, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_block_divisibility_checked():
+    q = jnp.zeros((1, 1, 100, 32))
+    with pytest.raises(AssertionError, match="divide"):
+        flash_attention(q, q, q, block_q=64, block_k=64, interpret=True)
