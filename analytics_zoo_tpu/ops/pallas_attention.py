@@ -61,6 +61,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
     acc = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), -1e30, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
+    if causal:
+        # future k blocks are fully masked for every query row in this
+        # q block — skip them instead of computing masked-out matmuls
+        n_k = jnp.minimum(
+            n_k, ((q_idx + 1) * block_q + block_k - 1) // block_k)
     acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc, m0, l0))
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
 
@@ -79,8 +84,9 @@ def flash_attention(q, k, v, causal: bool = False,
                                             scale=scale)
     block_q = min(block_q, t)
     block_k = min(block_k, t)
-    assert t % block_q == 0 and t % block_k == 0, \
-        f"seq len {t} must divide block sizes ({block_q}, {block_k})"
+    if t % block_q != 0 or t % block_k != 0:
+        raise ValueError(
+            f"seq len {t} must divide block sizes ({block_q}, {block_k})")
 
     qf = q.reshape(b * h, t, d)
     kf = k.reshape(b * h, t, d)

@@ -110,9 +110,14 @@ class MultiHeadSelfAttention(Layer):
         q, k, v = (jnp.moveaxis(qkv[:, :, i], 1, 2) for i in range(3))
 
         use_sp = self._use_sp() and mask is None
+        # flash kernel constraints: pallas_call is not GSPMD-partitionable,
+        # so only auto-route on a trivial (single-device) mesh; K/V for one
+        # (batch, head) must fit VMEM (~4k·128 floats, see pallas_attention)
+        mesh_trivial = math.prod(_mesh().shape.values()) == 1
         use_flash = (not use_sp and mask is None and not training and
-                     jax.default_backend() == "tpu" and
-                     t % 256 == 0 and self.head_dim % 64 == 0)
+                     jax.default_backend() == "tpu" and mesh_trivial and
+                     t % 256 == 0 and self.head_dim % 64 == 0 and
+                     t * self.head_dim <= 4096 * 128)
         if use_flash:
             from analytics_zoo_tpu.ops.pallas_attention import (
                 flash_attention)

@@ -19,14 +19,34 @@ import jax
 log = logging.getLogger("analytics_zoo_tpu.profiling")
 
 
+class _TimedBlock:
+    """Handle yielded by :func:`time_it`; register the block's output
+    with ``set`` so the timer can block on it before reading the clock."""
+
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = None
+
+    def set(self, value):
+        self.value = value
+        return value
+
+
 @contextlib.contextmanager
-def time_it(name: str, sync: bool = False, result=None):
-    """Wall-time a block (the Utils.timeIt role); ``sync`` blocks on a
-    jax value first so device work is included."""
+def time_it(name: str, sync: bool = False):
+    """Wall-time a block (the Utils.timeIt role).  With ``sync=True``,
+    call ``handle.set(out)`` inside the block and the timer blocks on
+    that jax value so async device work is included::
+
+        with time_it("fwd", sync=True) as tb:
+            tb.set(model.apply(params, x))
+    """
+    handle = _TimedBlock()
     t0 = time.time()
-    yield
-    if sync and result is not None:
-        jax.block_until_ready(result)
+    yield handle
+    if sync and handle.value is not None:
+        jax.block_until_ready(handle.value)
     log.info("%s took %.3fs", name, time.time() - t0)
 
 

@@ -25,5 +25,5 @@ def test_flash_matches_dense(causal):
 
 def test_block_divisibility_checked():
     q = jnp.zeros((1, 1, 100, 32))
-    with pytest.raises(AssertionError, match="divide"):
+    with pytest.raises(ValueError, match="divide"):
         flash_attention(q, q, q, block_q=64, block_k=64, interpret=True)
