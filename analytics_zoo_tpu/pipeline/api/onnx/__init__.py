@@ -1,0 +1,6 @@
+"""ONNX import (ref: pyzoo/zoo/pipeline/api/onnx)."""
+
+from analytics_zoo_tpu.pipeline.api.onnx.onnx_loader import (  # noqa: F401
+    load, load_graph, load_model_proto)
+from analytics_zoo_tpu.pipeline.api.onnx.mapper import (  # noqa: F401
+    CONVERTERS, OnnxOp)

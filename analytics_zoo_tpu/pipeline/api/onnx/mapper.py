@@ -1,0 +1,893 @@
+"""ONNX op → TPU-native layer converters.
+
+Mirror of the reference's per-op mapper set
+(pyzoo/zoo/pipeline/api/onnx/mapper/*.py, ~43 op classes mapped onto zoo
+Keras layers).  Here each ONNX node becomes an :class:`OnnxOp` — a
+first-class framework ``Layer`` whose forward is the exact ONNX
+semantics written in jax.numpy/lax (NCHW layouts, ONNX broadcast
+rules), and whose weights (pulled from graph initializers) are real
+params: the imported ``Model`` jits, differentiates, and shards like
+any native graph.
+
+Output shapes are inferred with ``jax.eval_shape`` (batch dim probed
+with 2 and restored to ``None``), so every converter only has to state
+the math once.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+CONVERTERS: Dict[str, Callable] = {}
+
+
+def converts(*op_types):
+    def deco(fn):
+        for op in op_types:
+            CONVERTERS[op] = fn
+        return fn
+    return deco
+
+
+class OnnxOp(Layer):
+    """One ONNX node as a framework layer.
+
+    ``fn(params, inputs, training, rng) -> output`` where ``inputs`` is
+    always a list of arrays; ``weights`` become the layer's params.
+    """
+
+    def __init__(self, fn, weights: Optional[Dict[str, np.ndarray]] = None,
+                 n_outputs: int = 1, **kwargs):
+        super().__init__(**kwargs)
+        self.fn = fn
+        self.weights = {k: np.asarray(v) for k, v in (weights or {}).items()}
+        self.n_outputs = n_outputs
+
+    def build(self, rng, input_shape):
+        return {k: jnp.asarray(v) for k, v in self.weights.items()}
+
+    def call(self, params, inputs, training=False, rng=None):
+        ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        return self.fn(params, list(ins), training, rng)
+
+    def compute_output_shape(self, input_shape):
+        shapes = (input_shape if isinstance(input_shape, list)
+                  else [input_shape])
+        dynamic = [s[0] is None if len(s) else False for s in shapes]
+        probe = [jax.ShapeDtypeStruct(
+            tuple(2 if d is None else int(d) for d in s),
+            getattr(self, "_probe_dtypes", {}).get(i, jnp.float32))
+            for i, s in enumerate(shapes)]
+        pprobe = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                  for k, v in self.weights.items()}
+        out = jax.eval_shape(
+            lambda p, xs: self.fn(p, xs, False, None), pprobe, probe)
+        any_dyn = any(dynamic)
+
+        def restore(s):
+            s = tuple(int(d) for d in s.shape)
+            if any_dyn and len(s) and s[0] == 2:
+                return (None,) + s[1:]
+            return s
+        if isinstance(out, (list, tuple)):
+            return [restore(o) for o in out]
+        return restore(out)
+
+
+# --------------------------------------------------------------------------
+# helpers
+
+
+def _as_list(v, n, default):
+    if v is None:
+        return [default] * n
+    return [int(x) for x in v]
+
+
+def _pads_pairs(pads, nsp, auto_pad, in_shape=None, kernel=None,
+                strides=None, dilations=None):
+    """ONNX pads [b1..bn, e1..en] -> [(b, e), ...]; resolve auto_pad."""
+    if auto_pad in ("SAME_UPPER", "SAME_LOWER"):
+        out = []
+        for i in range(nsp):
+            k = kernel[i]
+            d = (dilations or [1] * nsp)[i]
+            s = (strides or [1] * nsp)[i]
+            eff = (k - 1) * d + 1
+            in_d = in_shape[i]
+            out_d = -(-in_d // s)  # ceil
+            total = max(0, (out_d - 1) * s + eff - in_d)
+            lo = total // 2 if auto_pad == "SAME_UPPER" else total - total // 2
+            out.append((lo, total - lo))
+        return out
+    if auto_pad == "VALID" or pads is None:
+        return [(0, 0)] * nsp
+    pads = [int(p) for p in pads]
+    return list(zip(pads[:nsp], pads[nsp:]))
+
+
+def _conv_dn(nsp):
+    sp = "DHW"[-nsp:] if nsp <= 3 else None
+    if sp is None:
+        raise ValueError(f"unsupported conv rank {nsp}")
+    return (f"NC{sp}", f"OI{sp}", f"NC{sp}")
+
+
+# --------------------------------------------------------------------------
+# compute ops with weights
+
+
+@converts("Conv")
+def _conv(ctx, node, attrs, ins):
+    x = ins[0]
+    w = np.asarray(ins[1])
+    b = np.asarray(ins[2]) if len(ins) > 2 and ins[2] is not None else None
+    nsp = w.ndim - 2
+    kernel = attrs.get("kernel_shape") or list(w.shape[2:])
+    strides = _as_list(attrs.get("strides"), nsp, 1)
+    dilations = _as_list(attrs.get("dilations"), nsp, 1)
+    group = int(attrs.get("group", 1))
+    auto_pad = attrs.get("auto_pad", "NOTSET")
+    pads_attr = attrs.get("pads")
+    dn = _conv_dn(nsp)
+    weights = {"kernel": w}
+    if b is not None:
+        weights["bias"] = b
+
+    def fn(p, xs, training, rng):
+        xx = xs[0]
+        pads = _pads_pairs(pads_attr, nsp, auto_pad,
+                           in_shape=xx.shape[2:], kernel=kernel,
+                           strides=strides, dilations=dilations)
+        out = jax.lax.conv_general_dilated(
+            xx, p["kernel"], window_strides=strides, padding=pads,
+            rhs_dilation=dilations, feature_group_count=group,
+            dimension_numbers=dn)
+        if "bias" in p:
+            out = out + p["bias"].reshape((1, -1) + (1,) * nsp)
+        return out
+
+    return ctx.emit(node, fn, [ins[0]], weights)
+
+
+@converts("ConvTranspose")
+def _conv_transpose(ctx, node, attrs, ins):
+    w = np.asarray(ins[1])  # (C_in, C_out/group, *k)
+    b = np.asarray(ins[2]) if len(ins) > 2 and ins[2] is not None else None
+    nsp = w.ndim - 2
+    kernel = list(w.shape[2:])
+    strides = _as_list(attrs.get("strides"), nsp, 1)
+    dilations = _as_list(attrs.get("dilations"), nsp, 1)
+    group = int(attrs.get("group", 1))
+    if group != 1:
+        raise NotImplementedError("ConvTranspose group>1")
+    out_pad = _as_list(attrs.get("output_padding"), nsp, 0)
+    pads_attr = attrs.get("pads")
+    pads = _pads_pairs(pads_attr, nsp, attrs.get("auto_pad", "NOTSET"))
+    dn = _conv_dn(nsp)
+    # fractional-stride conv with flipped, transposed kernel:
+    # (I, O, *k) -> (O, I, *k), spatial flip
+    wt = np.swapaxes(w, 0, 1)[(slice(None), slice(None))
+                              + (slice(None, None, -1),) * nsp]
+    weights = {"kernel": wt}
+    if b is not None:
+        weights["bias"] = b
+
+    def fn(p, xs, training, rng):
+        xx = xs[0]
+        conv_pads = []
+        for i in range(nsp):
+            eff = (kernel[i] - 1) * dilations[i]
+            conv_pads.append((eff - pads[i][0],
+                              eff - pads[i][1] + out_pad[i]))
+        out = jax.lax.conv_general_dilated(
+            xx, p["kernel"], window_strides=[1] * nsp, padding=conv_pads,
+            lhs_dilation=strides, rhs_dilation=dilations,
+            dimension_numbers=dn)
+        if "bias" in p:
+            out = out + p["bias"].reshape((1, -1) + (1,) * nsp)
+        return out
+
+    return ctx.emit(node, fn, [ins[0]], weights)
+
+
+@converts("Gemm")
+def _gemm(ctx, node, attrs, ins):
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    trans_a = int(attrs.get("transA", 0))
+    trans_b = int(attrs.get("transB", 0))
+    weights = {}
+    names = {}
+    graph_ins = [ins[0]]
+    for idx, key in ((1, "b"), (2, "c")):
+        if idx < len(ins) and ins[idx] is not None:
+            if isinstance(ins[idx], np.ndarray):
+                weights[key] = ins[idx]
+            else:
+                names[key] = len(graph_ins)
+                graph_ins.append(ins[idx])
+
+    def fn(p, xs, training, rng):
+        a = xs[0]
+        bm = p.get("b") if "b" in p else xs[names["b"]]
+        if trans_a:
+            a = a.T
+        if trans_b:
+            bm = bm.T
+        out = alpha * (a @ bm)
+        c = p.get("c") if "c" in p else (
+            xs[names["c"]] if "c" in names else None)
+        if c is not None:
+            out = out + beta * c
+        return out
+
+    return ctx.emit(node, fn, graph_ins, weights)
+
+
+@converts("MatMul")
+def _matmul(ctx, node, attrs, ins):
+    weights = {}
+    graph_ins = []
+    pattern = []
+    for i, v in enumerate(ins[:2]):
+        if isinstance(v, np.ndarray):
+            key = f"w{i}"
+            weights[key] = v
+            pattern.append(("p", key))
+        else:
+            pattern.append(("x", len(graph_ins)))
+            graph_ins.append(v)
+
+    def fn(p, xs, training, rng):
+        ops = [p[k] if kind == "p" else xs[k] for kind, k in pattern]
+        return jnp.matmul(ops[0], ops[1])
+
+    return ctx.emit(node, fn, graph_ins, weights)
+
+
+@converts("BatchNormalization")
+def _batchnorm(ctx, node, attrs, ins):
+    eps = float(attrs.get("epsilon", 1e-5))
+    weights = {"scale": ins[1], "bias": ins[2],
+               "mean": ins[3], "var": ins[4]}
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        inv = jax.lax.rsqrt(p["var"].reshape(shape) + eps)
+        return ((x - p["mean"].reshape(shape)) * inv
+                * p["scale"].reshape(shape) + p["bias"].reshape(shape))
+
+    return ctx.emit(node, fn, [ins[0]], weights)
+
+
+@converts("InstanceNormalization")
+def _instancenorm(ctx, node, attrs, ins):
+    eps = float(attrs.get("epsilon", 1e-5))
+    weights = {"scale": ins[1], "bias": ins[2]}
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        axes = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return ((x - mean) * jax.lax.rsqrt(var + eps)
+                * p["scale"].reshape(shape) + p["bias"].reshape(shape))
+
+    return ctx.emit(node, fn, [ins[0]], weights)
+
+
+@converts("PRelu")
+def _prelu(ctx, node, attrs, ins):
+    weights = {"slope": ins[1]}
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        slope = p["slope"]
+        if slope.ndim == 1 and x.ndim > 1:
+            slope = slope.reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x >= 0, x, slope * x)
+
+    return ctx.emit(node, fn, [ins[0]], weights)
+
+
+# --------------------------------------------------------------------------
+# elementwise / activations
+
+_UNARY = {
+    "Relu": lambda x: jax.nn.relu(x),
+    "Sigmoid": lambda x: jax.nn.sigmoid(x),
+    "Tanh": lambda x: jnp.tanh(x),
+    "Exp": lambda x: jnp.exp(x),
+    "Log": lambda x: jnp.log(x),
+    "Sqrt": lambda x: jnp.sqrt(x),
+    "Neg": lambda x: -x,
+    "Abs": lambda x: jnp.abs(x),
+    "Reciprocal": lambda x: 1.0 / x,
+    "Floor": lambda x: jnp.floor(x),
+    "Ceil": lambda x: jnp.ceil(x),
+    "Erf": lambda x: jax.lax.erf(x),
+    "Softplus": lambda x: jax.nn.softplus(x),
+    "Softsign": lambda x: x / (1 + jnp.abs(x)),
+    "Sin": lambda x: jnp.sin(x),
+    "Cos": lambda x: jnp.cos(x),
+    "Identity": lambda x: x,
+    "Sign": lambda x: jnp.sign(x),
+}
+
+
+@converts(*_UNARY.keys())
+def _unary(ctx, node, attrs, ins):
+    op = _UNARY[node.op_type]
+
+    def fn(p, xs, training, rng):
+        return op(xs[0])
+
+    if isinstance(ins[0], np.ndarray):  # constant fold
+        return [np.asarray(op(jnp.asarray(ins[0])))]
+    return ctx.emit(node, fn, [ins[0]], {})
+
+
+@converts("LeakyRelu")
+def _leaky(ctx, node, attrs, ins):
+    alpha = float(attrs.get("alpha", 0.01))
+    return ctx.emit(node,
+                    lambda p, xs, t, r: jnp.where(xs[0] >= 0, xs[0],
+                                                  alpha * xs[0]),
+                    [ins[0]], {})
+
+
+@converts("Elu")
+def _elu(ctx, node, attrs, ins):
+    alpha = float(attrs.get("alpha", 1.0))
+    return ctx.emit(node,
+                    lambda p, xs, t, r: jnp.where(
+                        xs[0] >= 0, xs[0], alpha * jnp.expm1(xs[0])),
+                    [ins[0]], {})
+
+
+@converts("Selu")
+def _selu(ctx, node, attrs, ins):
+    alpha = float(attrs.get("alpha", 1.6732632423543772))
+    gamma = float(attrs.get("gamma", 1.0507009873554805))
+    return ctx.emit(node,
+                    lambda p, xs, t, r: gamma * jnp.where(
+                        xs[0] >= 0, xs[0], alpha * jnp.expm1(xs[0])),
+                    [ins[0]], {})
+
+
+@converts("Clip")
+def _clip(ctx, node, attrs, ins):
+    lo = attrs.get("min")
+    hi = attrs.get("max")
+    if lo is None and len(ins) > 1 and ins[1] is not None:
+        lo = float(np.asarray(ins[1]))
+    if hi is None and len(ins) > 2 and ins[2] is not None:
+        hi = float(np.asarray(ins[2]))
+    return ctx.emit(node,
+                    lambda p, xs, t, r: jnp.clip(xs[0], lo, hi),
+                    [ins[0]], {})
+
+
+@converts("HardSigmoid")
+def _hardsigmoid(ctx, node, attrs, ins):
+    alpha = float(attrs.get("alpha", 0.2))
+    beta = float(attrs.get("beta", 0.5))
+    return ctx.emit(node,
+                    lambda p, xs, t, r: jnp.clip(alpha * xs[0] + beta, 0, 1),
+                    [ins[0]], {})
+
+
+_BINARY = {
+    "Add": jnp.add, "Sub": jnp.subtract, "Mul": jnp.multiply,
+    "Div": jnp.divide, "Pow": jnp.power,
+    "Min": jnp.minimum, "Max": jnp.maximum,
+}
+
+
+@converts("Add", "Sub", "Mul", "Div", "Pow")
+def _binary(ctx, node, attrs, ins):
+    op = _BINARY[node.op_type]
+    if all(isinstance(v, np.ndarray) for v in ins[:2]):
+        return [np.asarray(op(ins[0], ins[1]))]
+    weights = {}
+    graph_ins = []
+    pattern = []
+    for i, v in enumerate(ins[:2]):
+        if isinstance(v, np.ndarray):
+            weights[f"c{i}"] = v
+            pattern.append(("p", f"c{i}"))
+        else:
+            pattern.append(("x", len(graph_ins)))
+            graph_ins.append(v)
+
+    def fn(p, xs, training, rng):
+        ops = [p[k] if kind == "p" else xs[k] for kind, k in pattern]
+        return op(ops[0], ops[1])
+
+    return ctx.emit(node, fn, graph_ins, weights)
+
+
+@converts("Min", "Max", "Sum", "Mean")
+def _variadic(ctx, node, attrs, ins):
+    op_type = node.op_type
+    weights = {}
+    graph_ins = []
+    pattern = []
+    for i, v in enumerate(ins):
+        if isinstance(v, np.ndarray):
+            weights[f"c{i}"] = v
+            pattern.append(("p", f"c{i}"))
+        else:
+            pattern.append(("x", len(graph_ins)))
+            graph_ins.append(v)
+
+    def fn(p, xs, training, rng):
+        ops = [p[k] if kind == "p" else xs[k] for kind, k in pattern]
+        out = ops[0]
+        for o in ops[1:]:
+            if op_type == "Min":
+                out = jnp.minimum(out, o)
+            elif op_type == "Max":
+                out = jnp.maximum(out, o)
+            else:
+                out = out + o
+        if op_type == "Mean":
+            out = out / len(ops)
+        return out
+
+    return ctx.emit(node, fn, graph_ins, weights)
+
+
+@converts("Softmax", "LogSoftmax")
+def _softmax(ctx, node, attrs, ins):
+    axis = int(attrs.get("axis", 1))
+    log = node.op_type == "LogSoftmax"
+    opset = ctx.opset
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        if opset < 13:
+            # pre-13: softmax over the flattened trailing dims [axis:)
+            ax = axis if axis >= 0 else x.ndim + axis
+            shape = x.shape
+            flat = x.reshape(shape[:ax] + (-1,))
+            out = (jax.nn.log_softmax(flat, axis=-1) if log
+                   else jax.nn.softmax(flat, axis=-1))
+            return out.reshape(shape)
+        return (jax.nn.log_softmax(x, axis=axis) if log
+                else jax.nn.softmax(x, axis=axis))
+
+    return ctx.emit(node, fn, [ins[0]], {})
+
+
+# --------------------------------------------------------------------------
+# pooling
+
+
+def _pool(ctx, node, attrs, ins, reducer, init, average=False):
+    kernel = [int(k) for k in attrs["kernel_shape"]]
+    nsp = len(kernel)
+    strides = _as_list(attrs.get("strides"), nsp, 1)
+    pads_attr = attrs.get("pads")
+    auto_pad = attrs.get("auto_pad", "NOTSET")
+    count_include_pad = int(attrs.get("count_include_pad", 0))
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        pads = _pads_pairs(pads_attr, nsp, auto_pad, in_shape=x.shape[2:],
+                           kernel=kernel, strides=strides)
+        window = (1, 1) + tuple(kernel)
+        strd = (1, 1) + tuple(strides)
+        pad = ((0, 0), (0, 0)) + tuple(pads)
+        out = jax.lax.reduce_window(x, init, reducer, window, strd, pad)
+        if average:
+            if count_include_pad or all(p_ == (0, 0) for p_ in pads):
+                out = out / float(np.prod(kernel))
+            else:
+                ones = jnp.ones_like(x)
+                denom = jax.lax.reduce_window(
+                    ones, 0.0, jax.lax.add, window, strd, pad)
+                out = out / denom
+        return out
+
+    return ctx.emit(node, fn, [ins[0]], {})
+
+
+@converts("MaxPool")
+def _maxpool(ctx, node, attrs, ins):
+    return _pool(ctx, node, attrs, ins, jax.lax.max, -jnp.inf)
+
+
+@converts("AveragePool")
+def _avgpool(ctx, node, attrs, ins):
+    return _pool(ctx, node, attrs, ins, jax.lax.add, 0.0, average=True)
+
+
+@converts("GlobalAveragePool")
+def _gap(ctx, node, attrs, ins):
+    return ctx.emit(node,
+                    lambda p, xs, t, r: jnp.mean(
+                        xs[0], axis=tuple(range(2, xs[0].ndim)),
+                        keepdims=True),
+                    [ins[0]], {})
+
+
+@converts("GlobalMaxPool")
+def _gmp(ctx, node, attrs, ins):
+    return ctx.emit(node,
+                    lambda p, xs, t, r: jnp.max(
+                        xs[0], axis=tuple(range(2, xs[0].ndim)),
+                        keepdims=True),
+                    [ins[0]], {})
+
+
+@converts("LRN")
+def _lrn(ctx, node, attrs, ins):
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    bias = float(attrs.get("bias", 1.0))
+    size = int(attrs["size"])
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        sq = jnp.square(x)
+        lo = (size - 1) // 2
+        hi = size - 1 - lo
+        window = (1, size) + (1,) * (x.ndim - 2)
+        pad = ((0, 0), (lo, hi)) + ((0, 0),) * (x.ndim - 2)
+        ssum = jax.lax.reduce_window(sq, 0.0, jax.lax.add, window,
+                                     (1,) * x.ndim, pad)
+        return x / jnp.power(bias + alpha / size * ssum, beta)
+
+    return ctx.emit(node, fn, [ins[0]], {})
+
+
+# --------------------------------------------------------------------------
+# shape ops
+
+
+@converts("Flatten")
+def _flatten(ctx, node, attrs, ins):
+    axis = int(attrs.get("axis", 1))
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        ax = axis if axis >= 0 else x.ndim + axis
+        lead = 1
+        for d in x.shape[:ax]:
+            lead *= d
+        return x.reshape((lead, -1))
+
+    return ctx.emit(node, fn, [ins[0]], {})
+
+
+@converts("Reshape")
+def _reshape(ctx, node, attrs, ins):
+    shape = attrs.get("shape")
+    if shape is None:
+        if len(ins) < 2 or not isinstance(ins[1], np.ndarray):
+            raise NotImplementedError("Reshape with dynamic shape input")
+        shape = [int(v) for v in np.asarray(ins[1]).ravel()]
+    shape = [int(v) for v in shape]
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        tgt = [x.shape[i] if v == 0 else v for i, v in enumerate(shape)]
+        # dim 0 is the batch: exports bake the traced batch size into the
+        # shape constant, so re-derive it from the runtime input instead
+        if tgt and -1 not in tgt[1:]:
+            tgt[0] = -1
+        return x.reshape(tuple(tgt))
+
+    return ctx.emit(node, fn, [ins[0]], {})
+
+
+@converts("Transpose")
+def _transpose(ctx, node, attrs, ins):
+    perm = attrs.get("perm")
+    if isinstance(ins[0], np.ndarray):
+        return [np.transpose(ins[0], perm)]
+    return ctx.emit(node,
+                    lambda p, xs, t, r: jnp.transpose(xs[0], perm),
+                    [ins[0]], {})
+
+
+@converts("Squeeze")
+def _squeeze(ctx, node, attrs, ins):
+    axes = attrs.get("axes")
+    if axes is None and len(ins) > 1 and isinstance(ins[1], np.ndarray):
+        axes = [int(v) for v in np.asarray(ins[1]).ravel()]
+    axes = tuple(int(a) for a in axes) if axes else None
+    if isinstance(ins[0], np.ndarray):
+        return [np.squeeze(ins[0], axis=axes)]
+    return ctx.emit(node,
+                    lambda p, xs, t, r: jnp.squeeze(xs[0], axis=axes),
+                    [ins[0]], {})
+
+
+@converts("Unsqueeze")
+def _unsqueeze(ctx, node, attrs, ins):
+    axes = attrs.get("axes")
+    if axes is None and len(ins) > 1 and isinstance(ins[1], np.ndarray):
+        axes = [int(v) for v in np.asarray(ins[1]).ravel()]
+    axes = sorted(int(a) for a in axes)
+
+    def expand(x):
+        for a in axes:
+            x = jnp.expand_dims(x, a) if not isinstance(x, np.ndarray) \
+                else np.expand_dims(x, a)
+        return x
+
+    if isinstance(ins[0], np.ndarray):
+        return [expand(ins[0])]
+    return ctx.emit(node, lambda p, xs, t, r: expand(xs[0]), [ins[0]], {})
+
+
+@converts("Concat")
+def _concat(ctx, node, attrs, ins):
+    axis = int(attrs.get("axis", 0))
+    if all(isinstance(v, np.ndarray) for v in ins):
+        return [np.concatenate(ins, axis=axis)]
+    weights = {}
+    graph_ins = []
+    pattern = []
+    for i, v in enumerate(ins):
+        if isinstance(v, np.ndarray):
+            weights[f"c{i}"] = v
+            pattern.append(("p", f"c{i}"))
+        else:
+            pattern.append(("x", len(graph_ins)))
+            graph_ins.append(v)
+
+    def fn(p, xs, training, rng):
+        ops = [p[k] if kind == "p" else xs[k] for kind, k in pattern]
+        return jnp.concatenate(ops, axis=axis)
+
+    return ctx.emit(node, fn, graph_ins, weights)
+
+
+@converts("Split")
+def _split(ctx, node, attrs, ins):
+    axis = int(attrs.get("axis", 0))
+    split = attrs.get("split")
+    if split is None and len(ins) > 1 and isinstance(ins[1], np.ndarray):
+        split = [int(v) for v in np.asarray(ins[1]).ravel()]
+    n_out = len(node.output)
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        if split is None:
+            return list(jnp.split(x, n_out, axis=axis))
+        idx = np.cumsum(split)[:-1].tolist()
+        return list(jnp.split(x, idx, axis=axis))
+
+    return ctx.emit(node, fn, [ins[0]], {}, n_outputs=n_out)
+
+
+@converts("Slice")
+def _slice(ctx, node, attrs, ins):
+    starts = attrs.get("starts")
+    ends = attrs.get("ends")
+    axes = attrs.get("axes")
+    steps = None
+    if starts is None:  # opset >= 10: inputs
+        starts = [int(v) for v in np.asarray(ins[1]).ravel()]
+        ends = [int(v) for v in np.asarray(ins[2]).ravel()]
+        if len(ins) > 3 and ins[3] is not None:
+            axes = [int(v) for v in np.asarray(ins[3]).ravel()]
+        if len(ins) > 4 and ins[4] is not None:
+            steps = [int(v) for v in np.asarray(ins[4]).ravel()]
+    if axes is None:
+        axes = list(range(len(starts)))
+
+    def make_slices(ndim):
+        sl = [slice(None)] * ndim
+        for i, ax in enumerate(axes):
+            st = steps[i] if steps else 1
+            sl[ax] = slice(int(starts[i]), int(ends[i]), st)
+        return tuple(sl)
+
+    if isinstance(ins[0], np.ndarray):
+        return [ins[0][make_slices(ins[0].ndim)]]
+    return ctx.emit(node,
+                    lambda p, xs, t, r: xs[0][make_slices(xs[0].ndim)],
+                    [ins[0]], {})
+
+
+@converts("Gather")
+def _gather(ctx, node, attrs, ins):
+    axis = int(attrs.get("axis", 0))
+    if all(isinstance(v, np.ndarray) for v in ins[:2]):
+        return [np.take(ins[0], ins[1].astype(np.int64), axis=axis)]
+    if isinstance(ins[0], np.ndarray):
+        # embedding lookup: table is a param, indices flow in
+        def fn(p, xs, training, rng):
+            return jnp.take(p["table"], xs[0].astype(jnp.int32), axis=axis)
+        out = ctx.emit(node, fn, [ins[1]], {"table": ins[0]})
+        return out
+    idx = np.asarray(ins[1]).astype(np.int64) \
+        if isinstance(ins[1], np.ndarray) else None
+
+    def fn(p, xs, training, rng):
+        indices = idx if idx is not None else xs[1].astype(jnp.int32)
+        return jnp.take(xs[0], indices, axis=axis)
+
+    graph_ins = [ins[0]] if idx is not None else [ins[0], ins[1]]
+    return ctx.emit(node, fn, graph_ins, {})
+
+
+@converts("Shape")
+def _shape(ctx, node, attrs, ins):
+    x = ins[0]
+    if isinstance(x, np.ndarray):
+        return [np.asarray(x.shape, dtype=np.int64)]
+    shape = x.shape
+    if any(d is None for d in shape):
+        raise NotImplementedError("Shape of tensor with dynamic dims")
+    return [np.asarray(shape, dtype=np.int64)]
+
+
+@converts("Constant")
+def _constant(ctx, node, attrs, ins):
+    for key in ("value", "value_float", "value_int", "value_floats",
+                "value_ints"):
+        if key in attrs and attrs[key] is not None:
+            return [np.asarray(attrs[key])]
+    raise ValueError("Constant node without value")
+
+
+@converts("ConstantOfShape")
+def _constant_of_shape(ctx, node, attrs, ins):
+    shape = tuple(int(v) for v in np.asarray(ins[0]).ravel())
+    value = attrs.get("value")
+    fill = np.asarray(value).ravel()[0] if value is not None else 0.0
+    return [np.full(shape, fill)]
+
+
+@converts("Cast")
+def _cast(ctx, node, attrs, ins):
+    from analytics_zoo_tpu.pipeline.api.onnx.onnx_pb import _NP_BY_DTYPE
+    to = _NP_BY_DTYPE[int(attrs["to"])]
+    if isinstance(ins[0], np.ndarray):
+        return [ins[0].astype(to)]
+    return ctx.emit(node,
+                    lambda p, xs, t, r: xs[0].astype(to), [ins[0]], {})
+
+
+@converts("Pad")
+def _pad(ctx, node, attrs, ins):
+    mode = attrs.get("mode", "constant")
+    pads = attrs.get("pads")
+    cval = float(attrs.get("value", 0.0))
+    if pads is None and len(ins) > 1 and isinstance(ins[1], np.ndarray):
+        pads = [int(v) for v in np.asarray(ins[1]).ravel()]
+        if len(ins) > 2 and ins[2] is not None:
+            cval = float(np.asarray(ins[2]).ravel()[0])
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "edge": "edge"}[mode]
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        n = x.ndim
+        pw = list(zip(pads[:n], pads[n:]))
+        if jmode == "constant":
+            return jnp.pad(x, pw, mode="constant", constant_values=cval)
+        return jnp.pad(x, pw, mode=jmode)
+
+    return ctx.emit(node, fn, [ins[0]], {})
+
+
+@converts("ReduceMean", "ReduceSum", "ReduceMax", "ReduceMin", "ReduceProd")
+def _reduce(ctx, node, attrs, ins):
+    op = {"ReduceMean": jnp.mean, "ReduceSum": jnp.sum,
+          "ReduceMax": jnp.max, "ReduceMin": jnp.min,
+          "ReduceProd": jnp.prod}[node.op_type]
+    axes = attrs.get("axes")
+    if axes is None and len(ins) > 1 and isinstance(ins[1], np.ndarray):
+        axes = [int(v) for v in np.asarray(ins[1]).ravel()]
+    axes = tuple(axes) if axes is not None else None
+    keepdims = bool(attrs.get("keepdims", 1))
+    return ctx.emit(node,
+                    lambda p, xs, t, r: op(xs[0], axis=axes,
+                                           keepdims=keepdims),
+                    [ins[0]], {})
+
+
+@converts("ArgMax", "ArgMin")
+def _argminmax(ctx, node, attrs, ins):
+    op = jnp.argmax if node.op_type == "ArgMax" else jnp.argmin
+    axis = int(attrs.get("axis", 0))
+    keepdims = bool(attrs.get("keepdims", 1))
+
+    def fn(p, xs, training, rng):
+        out = op(xs[0], axis=axis)
+        if keepdims:
+            out = jnp.expand_dims(out, axis)
+        return out
+
+    return ctx.emit(node, fn, [ins[0]], {})
+
+
+@converts("Dropout")
+def _dropout(ctx, node, attrs, ins):
+    rate = float(attrs.get("ratio", 0.5))
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        if not training or rng is None or rate <= 0.0:
+            return x
+        keep = 1.0 - rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+    return ctx.emit(node, fn, [ins[0]], {})
+
+
+@converts("Upsample", "Resize")
+def _resize(ctx, node, attrs, ins):
+    mode = attrs.get("mode", "nearest")
+    scales = attrs.get("scales")
+    if scales is None:
+        # Resize: inputs are (X, roi, scales, sizes); Upsample: (X, scales)
+        for cand in ins[1:]:
+            if isinstance(cand, np.ndarray) and cand.size:
+                arr = np.asarray(cand).ravel()
+                if arr.dtype.kind == "f" and arr.size >= 1:
+                    scales = [float(v) for v in arr]
+                    break
+    sizes = None
+    if scales is None and len(ins) >= 4 and isinstance(ins[3], np.ndarray):
+        sizes = [int(v) for v in np.asarray(ins[3]).ravel()]
+    method = {"nearest": "nearest", "linear": "linear",
+              "cubic": "cubic"}[mode.split("_")[0] if mode else "nearest"]
+
+    def fn(p, xs, training, rng):
+        x = xs[0]
+        if sizes is not None:
+            new_shape = tuple(sizes)
+        else:
+            new_shape = tuple(int(round(d * s))
+                              for d, s in zip(x.shape, scales))
+        return jax.image.resize(x, new_shape, method=method)
+
+    return ctx.emit(node, fn, [ins[0]], {})
+
+
+@converts("Expand")
+def _expand(ctx, node, attrs, ins):
+    shape = tuple(int(v) for v in np.asarray(ins[1]).ravel())
+
+    def fn(p, xs, training, rng):
+        return jnp.broadcast_to(xs[0], jnp.broadcast_shapes(
+            xs[0].shape, shape))
+
+    return ctx.emit(node, fn, [ins[0]], {})
+
+
+@converts("Where")
+def _where(ctx, node, attrs, ins):
+    weights = {}
+    graph_ins = []
+    pattern = []
+    for i, v in enumerate(ins[:3]):
+        if isinstance(v, np.ndarray):
+            weights[f"c{i}"] = v
+            pattern.append(("p", f"c{i}"))
+        else:
+            pattern.append(("x", len(graph_ins)))
+            graph_ins.append(v)
+
+    def fn(p, xs, training, rng):
+        ops = [p[k] if kind == "p" else xs[k] for kind, k in pattern]
+        return jnp.where(ops[0].astype(bool), ops[1], ops[2])
+
+    return ctx.emit(node, fn, graph_ins, {k: v for k, v in weights.items()})
