@@ -1,0 +1,4 @@
+"""Caffe import (ref: zoo models/caffe/CaffeLoader.scala)."""
+
+from analytics_zoo_tpu.models.caffe.loader import (  # noqa: F401
+    CaffeLoader, load_caffe)

@@ -1,0 +1,358 @@
+"""Caffe protobuf schema (subset) over the in-repo wire codec.
+
+Field numbers follow the public BVLC ``caffe.proto``.  Covers the
+messages needed to read ``.prototxt`` net definitions (text format) and
+``.caffemodel`` weight blobs (binary): NetParameter with both V2
+``layer`` and legacy V1 ``layers`` lists, per-layer param messages, and
+BlobProto weights.  The reference's loader is
+zoo models/caffe/CaffeLoader.scala:718 (+ Converter.scala,
+V1LayerConverter.scala); this is its TPU-build equivalent schema.
+"""
+
+from __future__ import annotations
+
+from analytics_zoo_tpu.utils.pbwire import Field, Message
+
+
+class BlobShape(Message):
+    FIELDS = [Field(1, "dim", "int64", repeated=True)]
+
+
+class BlobProto(Message):
+    FIELDS = [
+        Field(1, "num", "int64"),
+        Field(2, "channels", "int64"),
+        Field(3, "height", "int64"),
+        Field(4, "width", "int64"),
+        Field(5, "data", "float", repeated=True),
+        Field(6, "diff", "float", repeated=True),
+        Field(7, "shape", "msg", msg_cls=BlobShape),
+    ]
+
+    def ndarray(self):
+        import numpy as np
+        arr = np.asarray(self.data, dtype=np.float32)
+        if self.shape is not None and self.shape.dim:
+            return arr.reshape([int(d) for d in self.shape.dim])
+        legacy = [int(self.num), int(self.channels), int(self.height),
+                  int(self.width)]
+        if any(legacy):
+            dims = [d if d else 1 for d in legacy]
+            return arr.reshape(dims)
+        return arr
+
+
+class FillerParameter(Message):
+    FIELDS = [
+        Field(1, "type", "string"),
+        Field(2, "value", "float"),
+        Field(5, "mean", "float"),
+        Field(6, "std", "float"),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("type", "constant")
+        kw.setdefault("std", 1.0)
+        super().__init__(**kw)
+
+
+class ConvolutionParameter(Message):
+    FIELDS = [
+        Field(1, "num_output", "uint64"),
+        Field(2, "bias_term", "bool"),
+        Field(3, "pad", "uint64", repeated=True),
+        Field(4, "kernel_size", "uint64", repeated=True),
+        Field(5, "group", "uint64"),
+        Field(6, "stride", "uint64", repeated=True),
+        Field(7, "weight_filler", "msg", msg_cls=FillerParameter),
+        Field(8, "bias_filler", "msg", msg_cls=FillerParameter),
+        Field(9, "pad_h", "uint64"),
+        Field(10, "pad_w", "uint64"),
+        Field(11, "kernel_h", "uint64"),
+        Field(12, "kernel_w", "uint64"),
+        Field(13, "stride_h", "uint64"),
+        Field(14, "stride_w", "uint64"),
+        Field(18, "dilation", "uint64", repeated=True),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("bias_term", True)
+        super().__init__(**kw)
+
+
+class PoolingParameter(Message):
+    MAX = 0
+    AVE = 1
+    STOCHASTIC = 2
+    FIELDS = [
+        Field(1, "pool", "enum"),
+        Field(2, "kernel_size", "uint64"),
+        Field(3, "stride", "uint64"),
+        Field(4, "pad", "uint64"),
+        Field(5, "kernel_h", "uint64"),
+        Field(6, "kernel_w", "uint64"),
+        Field(7, "stride_h", "uint64"),
+        Field(8, "stride_w", "uint64"),
+        Field(9, "pad_h", "uint64"),
+        Field(10, "pad_w", "uint64"),
+        Field(12, "global_pooling", "bool"),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("stride", 1)
+        super().__init__(**kw)
+
+
+class InnerProductParameter(Message):
+    FIELDS = [
+        Field(1, "num_output", "uint64"),
+        Field(2, "bias_term", "bool"),
+        Field(3, "weight_filler", "msg", msg_cls=FillerParameter),
+        Field(4, "bias_filler", "msg", msg_cls=FillerParameter),
+        Field(5, "axis", "int64"),
+        Field(6, "transpose", "bool"),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("bias_term", True)
+        kw.setdefault("axis", 1)
+        super().__init__(**kw)
+
+
+class LRNParameter(Message):
+    FIELDS = [
+        Field(1, "local_size", "uint64"),
+        Field(2, "alpha", "float"),
+        Field(3, "beta", "float"),
+        Field(4, "norm_region", "enum"),
+        Field(5, "k", "float"),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("local_size", 5)
+        kw.setdefault("alpha", 1.0)
+        kw.setdefault("beta", 0.75)
+        kw.setdefault("k", 1.0)
+        super().__init__(**kw)
+
+
+class BatchNormParameter(Message):
+    FIELDS = [
+        Field(1, "use_global_stats", "bool"),
+        Field(2, "moving_average_fraction", "float"),
+        Field(3, "eps", "float"),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("eps", 1e-5)
+        super().__init__(**kw)
+
+
+class ScaleParameter(Message):
+    FIELDS = [
+        Field(1, "axis", "int64"),
+        Field(2, "num_axes", "int64"),
+        Field(3, "filler", "msg", msg_cls=FillerParameter),
+        Field(4, "bias_term", "bool"),
+        Field(5, "bias_filler", "msg", msg_cls=FillerParameter),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("axis", 1)
+        super().__init__(**kw)
+
+
+class DropoutParameter(Message):
+    FIELDS = [Field(1, "dropout_ratio", "float")]
+
+    def __init__(self, **kw):
+        kw.setdefault("dropout_ratio", 0.5)
+        super().__init__(**kw)
+
+
+class ConcatParameter(Message):
+    FIELDS = [
+        Field(1, "concat_dim", "uint64"),
+        Field(2, "axis", "int64"),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("axis", 1)
+        kw.setdefault("concat_dim", 1)
+        super().__init__(**kw)
+
+
+class EltwiseParameter(Message):
+    PROD = 0
+    SUM = 1
+    MAX = 2
+    FIELDS = [
+        Field(1, "operation", "enum"),
+        Field(2, "coeff", "float", repeated=True),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("operation", 1)
+        super().__init__(**kw)
+
+
+class PowerParameter(Message):
+    FIELDS = [
+        Field(1, "power", "float"),
+        Field(2, "scale", "float"),
+        Field(3, "shift", "float"),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("power", 1.0)
+        kw.setdefault("scale", 1.0)
+        super().__init__(**kw)
+
+
+class ReLUParameter(Message):
+    FIELDS = [Field(1, "negative_slope", "float")]
+
+
+class ELUParameter(Message):
+    FIELDS = [Field(1, "alpha", "float")]
+
+    def __init__(self, **kw):
+        kw.setdefault("alpha", 1.0)
+        super().__init__(**kw)
+
+
+class PReLUParameter(Message):
+    FIELDS = [
+        Field(1, "filler", "msg", msg_cls=FillerParameter),
+        Field(2, "channel_shared", "bool"),
+    ]
+
+
+class SoftmaxParameter(Message):
+    FIELDS = [
+        Field(1, "engine", "enum"),
+        Field(2, "axis", "int64"),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("axis", 1)
+        super().__init__(**kw)
+
+
+class FlattenParameter(Message):
+    FIELDS = [
+        Field(1, "axis", "int64"),
+        Field(2, "end_axis", "int64"),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("axis", 1)
+        kw.setdefault("end_axis", -1)
+        super().__init__(**kw)
+
+
+class ReshapeParameter(Message):
+    FIELDS = [
+        Field(1, "shape", "msg", msg_cls=BlobShape),
+        Field(2, "axis", "int64"),
+        Field(3, "num_axes", "int64"),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("num_axes", -1)
+        super().__init__(**kw)
+
+
+class SliceParameter(Message):
+    FIELDS = [
+        Field(1, "slice_dim", "uint64"),
+        Field(2, "slice_point", "uint64", repeated=True),
+        Field(3, "axis", "int64"),
+    ]
+
+    def __init__(self, **kw):
+        kw.setdefault("axis", 1)
+        super().__init__(**kw)
+
+
+class InputParameter(Message):
+    FIELDS = [Field(1, "shape", "msg", repeated=True, msg_cls=BlobShape)]
+
+
+class LayerParameter(Message):
+    """Caffe V2 layer."""
+
+    FIELDS = [
+        Field(1, "name", "string"),
+        Field(2, "type", "string"),
+        Field(3, "bottom", "string", repeated=True),
+        Field(4, "top", "string", repeated=True),
+        Field(7, "blobs", "msg", repeated=True, msg_cls=BlobProto),
+        Field(10, "phase", "enum"),
+        Field(104, "concat_param", "msg", msg_cls=ConcatParameter),
+        Field(106, "convolution_param", "msg", msg_cls=ConvolutionParameter),
+        Field(108, "dropout_param", "msg", msg_cls=DropoutParameter),
+        Field(110, "eltwise_param", "msg", msg_cls=EltwiseParameter),
+        Field(117, "inner_product_param", "msg",
+              msg_cls=InnerProductParameter),
+        Field(118, "lrn_param", "msg", msg_cls=LRNParameter),
+        Field(121, "pooling_param", "msg", msg_cls=PoolingParameter),
+        Field(122, "power_param", "msg", msg_cls=PowerParameter),
+        Field(123, "relu_param", "msg", msg_cls=ReLUParameter),
+        Field(125, "softmax_param", "msg", msg_cls=SoftmaxParameter),
+        Field(126, "slice_param", "msg", msg_cls=SliceParameter),
+        Field(131, "prelu_param", "msg", msg_cls=PReLUParameter),
+        Field(133, "reshape_param", "msg", msg_cls=ReshapeParameter),
+        Field(135, "flatten_param", "msg", msg_cls=FlattenParameter),
+        Field(139, "batch_norm_param", "msg", msg_cls=BatchNormParameter),
+        Field(140, "elu_param", "msg", msg_cls=ELUParameter),
+        Field(142, "scale_param", "msg", msg_cls=ScaleParameter),
+        Field(143, "input_param", "msg", msg_cls=InputParameter),
+    ]
+
+
+# V1LayerParameter.LayerType enum values (caffe.proto)
+V1_TYPES = {
+    3: "Concat", 4: "Convolution", 5: "Data", 6: "Dropout",
+    8: "Flatten", 14: "InnerProduct", 15: "LRN", 17: "Pooling",
+    18: "ReLU", 19: "Sigmoid", 20: "Softmax", 21: "SoftmaxWithLoss",
+    22: "Split", 23: "TanH", 25: "Eltwise", 26: "Power", 33: "Slice",
+    35: "AbsVal", 39: "Deconvolution",
+}
+
+
+class V1LayerParameter(Message):
+    """Legacy caffe layer (enum-typed)."""
+
+    FIELDS = [
+        Field(2, "bottom", "string", repeated=True),
+        Field(3, "top", "string", repeated=True),
+        Field(4, "name", "string"),
+        Field(5, "type", "enum"),
+        Field(6, "blobs", "msg", repeated=True, msg_cls=BlobProto),
+        Field(9, "concat_param", "msg", msg_cls=ConcatParameter),
+        Field(10, "convolution_param", "msg", msg_cls=ConvolutionParameter),
+        Field(12, "dropout_param", "msg", msg_cls=DropoutParameter),
+        Field(17, "inner_product_param", "msg",
+              msg_cls=InnerProductParameter),
+        Field(18, "lrn_param", "msg", msg_cls=LRNParameter),
+        Field(19, "pooling_param", "msg", msg_cls=PoolingParameter),
+        Field(21, "power_param", "msg", msg_cls=PowerParameter),
+        Field(30, "relu_param", "msg", msg_cls=ReLUParameter),
+        Field(31, "slice_param", "msg", msg_cls=SliceParameter),
+        Field(39, "softmax_param", "msg", msg_cls=SoftmaxParameter),
+    ]
+
+    def type_name(self) -> str:
+        return V1_TYPES.get(int(self.type), f"V1_{self.type}")
+
+
+class NetParameter(Message):
+    FIELDS = [
+        Field(1, "name", "string"),
+        Field(2, "layers", "msg", repeated=True, msg_cls=V1LayerParameter),
+        Field(3, "input", "string", repeated=True),
+        Field(4, "input_dim", "int64", repeated=True),
+        Field(8, "input_shape", "msg", repeated=True, msg_cls=BlobShape),
+        Field(100, "layer", "msg", repeated=True, msg_cls=LayerParameter),
+    ]

@@ -1,0 +1,478 @@
+"""Caffe layer → TPU-native layer converters.
+
+The reference converts prototxt layers to BigDL modules in
+Converter.scala:698 / LayerConverter.scala:792 (V2) and
+V1LayerConverter.scala:690 (legacy).  Here each caffe layer becomes a
+:class:`FnLayer` (the same fn-layer machinery the ONNX importer uses)
+carrying exact Caffe semantics — NCHW layouts, ceil-mode pooling,
+pad-inclusive average-pool denominators, BatchNorm scale_factor blobs.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.caffe.caffe_pb import (
+    ConvolutionParameter, EltwiseParameter, PoolingParameter)
+from analytics_zoo_tpu.models.caffe.prototxt import resolve_enum
+from analytics_zoo_tpu.pipeline.api.onnx.mapper import OnnxOp as FnLayer
+
+CONVERTERS: Dict[str, "callable"] = {}
+
+
+def converts(*types):
+    def deco(fn):
+        for t in types:
+            CONVERTERS[t] = fn
+        return fn
+    return deco
+
+
+def _spatial(param, name: str, default: int, n: int = 2) -> List[int]:
+    """Resolve caffe's (repeated | _h/_w) spatial params."""
+    h = int(getattr(param, name + "_h", 0) or 0)
+    w = int(getattr(param, name + "_w", 0) or 0)
+    if h or w:
+        return [h or default, w or default]
+    rep = getattr(param, "kernel_size" if name == "kernel" else name, None)
+    if rep is None or rep == [] or rep == 0:
+        return [default] * n
+    if isinstance(rep, (int, float)):      # pooling params are scalar
+        return [int(rep)] * n
+    if len(rep) == 1:
+        return [int(rep[0])] * n
+    return [int(v) for v in rep]
+
+
+def _filler_init(filler, shape, rng: np.random.RandomState) -> np.ndarray:
+    """Materialise a caffe weight_filler/bias_filler when no trained
+    blob exists (definition-only loads)."""
+    ftype = (filler.type if filler is not None else "constant") or "constant"
+    if ftype == "constant":
+        return np.full(shape, float(filler.value) if filler else 0.0,
+                       dtype=np.float32)
+    if ftype == "gaussian":
+        return rng.normal(float(filler.mean), float(filler.std or 1.0),
+                          shape).astype(np.float32)
+    if ftype in ("xavier", "msra"):
+        fan_in = int(np.prod(shape[1:])) or 1
+        scale = np.sqrt((2.0 if ftype == "msra" else 3.0) / fan_in)
+        if ftype == "xavier":
+            return rng.uniform(-scale, scale, shape).astype(np.float32)
+        return rng.normal(0.0, scale, shape).astype(np.float32)
+    if ftype == "uniform":
+        return rng.uniform(-1, 1, shape).astype(np.float32)
+    return np.zeros(shape, dtype=np.float32)
+
+
+def _in_channels(t) -> int:
+    shape = t.shape
+    if len(shape) < 2 or shape[1] is None:
+        raise ValueError("cannot infer input channels for weight init")
+    return int(shape[1])
+
+
+@converts("Convolution", "Deconvolution")
+def _conv(ctx, layer, blobs, ins):
+    p = layer.convolution_param or ConvolutionParameter()
+    kernel = _spatial(p, "kernel", 1)
+    stride = _spatial(p, "stride", 1)
+    pad = _spatial(p, "pad", 0)
+    dil = [int(v) for v in (p.dilation or [1])]
+    if len(dil) == 1:
+        dil = dil * 2
+    group = int(p.group or 1)
+    deconv = layer.type == "Deconvolution"
+    if blobs:
+        w = blobs[0]
+        bias = blobs[1] if len(blobs) > 1 and p.bias_term else None
+    else:
+        rng = np.random.RandomState(0)
+        cin = _in_channels(ins[0])
+        n_out = int(p.num_output)
+        wshape = ((cin, n_out, kernel[0], kernel[1]) if deconv
+                  else (n_out, cin // group, kernel[0], kernel[1]))
+        w = _filler_init(p.weight_filler, wshape, rng)
+        bias = (_filler_init(p.bias_filler, (n_out,), rng)
+                if p.bias_term else None)
+    weights = {"kernel": w}
+    if bias is not None:
+        weights["bias"] = bias
+
+    if not deconv:
+        def fn(prm, xs, training, rng):
+            out = jax.lax.conv_general_dilated(
+                xs[0], prm["kernel"], window_strides=stride,
+                padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+                rhs_dilation=dil, feature_group_count=group,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            if "bias" in prm:
+                out = out + prm["bias"].reshape(1, -1, 1, 1)
+            return out
+    else:
+        if group != 1:
+            raise NotImplementedError("Deconvolution group>1")
+        # caffe deconv weight layout is (in, out, kh, kw)
+        weights["kernel"] = np.swapaxes(np.asarray(w), 0, 1)[
+            :, :, ::-1, ::-1].copy()
+
+        def fn(prm, xs, training, rng):
+            conv_pads = [(dil[i] * (kernel[i] - 1) - pad[i],
+                          dil[i] * (kernel[i] - 1) - pad[i])
+                         for i in range(2)]
+            out = jax.lax.conv_general_dilated(
+                xs[0], prm["kernel"], window_strides=[1, 1],
+                padding=conv_pads, lhs_dilation=stride, rhs_dilation=dil,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            if "bias" in prm:
+                out = out + prm["bias"].reshape(1, -1, 1, 1)
+            return out
+
+    return ctx.emit(layer, fn, [ins[0]], weights)
+
+
+@converts("Pooling")
+def _pooling(ctx, layer, blobs, ins):
+    p = layer.pooling_param or PoolingParameter()
+    mode = resolve_enum(PoolingParameter, p.pool, PoolingParameter.MAX)
+    if p.global_pooling:
+        if mode == PoolingParameter.AVE:
+            return ctx.emit(layer,
+                            lambda prm, xs, t, r: jnp.mean(
+                                xs[0], axis=(2, 3), keepdims=True),
+                            [ins[0]], {})
+        return ctx.emit(layer,
+                        lambda prm, xs, t, r: jnp.max(
+                            xs[0], axis=(2, 3), keepdims=True),
+                        [ins[0]], {})
+    kernel = _spatial(p, "kernel", 1)
+    stride = _spatial(p, "stride", 1)
+    pad = _spatial(p, "pad", 0)
+
+    def out_dim(h, i):
+        # caffe uses ceil mode; the last window must start inside the
+        # padded extent
+        o = int(math.ceil((h + 2 * pad[i] - kernel[i]) / stride[i])) + 1
+        if pad[i] > 0 and (o - 1) * stride[i] >= h + pad[i]:
+            o -= 1
+        return o
+
+    def fn(prm, xs, training, rng):
+        x = xs[0]
+        h, w = x.shape[2], x.shape[3]
+        oh, ow = out_dim(h, 0), out_dim(w, 1)
+        extra = [max(0, (oh - 1) * stride[0] + kernel[0] - h - 2 * pad[0]),
+                 max(0, (ow - 1) * stride[1] + kernel[1] - w - 2 * pad[1])]
+        window = (1, 1, kernel[0], kernel[1])
+        strd = (1, 1, stride[0], stride[1])
+        pads = ((0, 0), (0, 0), (pad[0], pad[0] + extra[0]),
+                (pad[1], pad[1] + extra[1]))
+        if mode == PoolingParameter.MAX:
+            return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max,
+                                         window, strd, pads)
+        total = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strd,
+                                      pads)
+        # denominator = overlap with the base-padded extent (caffe
+        # counts padding, but not the ceil-mode spill-over region)
+        ones = jnp.ones((1, 1, h + 2 * pad[0], w + 2 * pad[1]), x.dtype)
+        denom = jax.lax.reduce_window(
+            ones, 0.0, jax.lax.add, window, strd,
+            ((0, 0), (0, 0), (0, extra[0]), (0, extra[1])))
+        return total / denom
+
+    return ctx.emit(layer, fn, [ins[0]], {})
+
+
+@converts("InnerProduct")
+def _inner_product(ctx, layer, blobs, ins):
+    p = layer.inner_product_param
+    axis = int(p.axis if p is not None else 1)
+    if blobs:
+        w = np.asarray(blobs[0])
+        if w.ndim == 4:                   # legacy (1, 1, out, in)
+            w = w.reshape(w.shape[-2], w.shape[-1])
+        bias = blobs[1] if len(blobs) > 1 and (p is None or p.bias_term) \
+            else None
+    else:
+        rng = np.random.RandomState(0)
+        in_dim = 1
+        for d in ins[0].shape[axis:]:
+            if d is None:
+                raise ValueError("cannot infer InnerProduct input dim")
+            in_dim *= int(d)
+        w = _filler_init(p.weight_filler if p else None,
+                         (int(p.num_output), in_dim), rng)
+        bias = (_filler_init(p.bias_filler if p else None,
+                             (int(p.num_output),), rng)
+                if (p is None or p.bias_term) else None)
+    weights = {"kernel": w}
+    if bias is not None:
+        weights["bias"] = np.asarray(bias).reshape(-1)
+
+    def fn(prm, xs, training, rng):
+        x = xs[0]
+        lead = 1
+        for d in x.shape[:axis]:
+            lead *= d
+        out = x.reshape(lead, -1) @ prm["kernel"].T
+        if "bias" in prm:
+            out = out + prm["bias"]
+        return out
+
+    return ctx.emit(layer, fn, [ins[0]], weights)
+
+
+@converts("ReLU")
+def _relu(ctx, layer, blobs, ins):
+    slope = float(layer.relu_param.negative_slope) \
+        if layer.relu_param is not None else 0.0
+    if slope:
+        return ctx.emit(layer,
+                        lambda prm, xs, t, r: jnp.where(
+                            xs[0] >= 0, xs[0], slope * xs[0]),
+                        [ins[0]], {})
+    return ctx.emit(layer, lambda prm, xs, t, r: jax.nn.relu(xs[0]),
+                    [ins[0]], {})
+
+
+@converts("PReLU")
+def _prelu(ctx, layer, blobs, ins):
+    if blobs:
+        weights = {"slope": np.asarray(blobs[0]).reshape(-1)}
+    else:
+        weights = {"slope": np.full(_in_channels(ins[0]), 0.25,
+                                    np.float32)}
+
+    def fn(prm, xs, training, rng):
+        x = xs[0]
+        slope = prm["slope"].reshape((1, -1) + (1,) * (x.ndim - 2))
+        return jnp.where(x >= 0, x, slope * x)
+
+    return ctx.emit(layer, fn, [ins[0]], weights)
+
+
+@converts("Sigmoid")
+def _sigmoid(ctx, layer, blobs, ins):
+    return ctx.emit(layer, lambda prm, xs, t, r: jax.nn.sigmoid(xs[0]),
+                    [ins[0]], {})
+
+
+@converts("TanH")
+def _tanh(ctx, layer, blobs, ins):
+    return ctx.emit(layer, lambda prm, xs, t, r: jnp.tanh(xs[0]),
+                    [ins[0]], {})
+
+
+@converts("AbsVal")
+def _absval(ctx, layer, blobs, ins):
+    return ctx.emit(layer, lambda prm, xs, t, r: jnp.abs(xs[0]),
+                    [ins[0]], {})
+
+
+@converts("ELU")
+def _elu(ctx, layer, blobs, ins):
+    alpha = float(layer.elu_param.alpha) if layer.elu_param else 1.0
+    return ctx.emit(layer,
+                    lambda prm, xs, t, r: jnp.where(
+                        xs[0] >= 0, xs[0], alpha * jnp.expm1(xs[0])),
+                    [ins[0]], {})
+
+
+@converts("Power")
+def _power(ctx, layer, blobs, ins):
+    p = layer.power_param
+    power = float(p.power) if p else 1.0
+    scale = float(p.scale) if p else 1.0
+    shift = float(p.shift) if p else 0.0
+    return ctx.emit(layer,
+                    lambda prm, xs, t, r: jnp.power(
+                        shift + scale * xs[0], power),
+                    [ins[0]], {})
+
+
+@converts("LRN")
+def _lrn(ctx, layer, blobs, ins):
+    p = layer.lrn_param
+    size = int(p.local_size) if p else 5
+    alpha = float(p.alpha) if p else 1.0
+    beta = float(p.beta) if p else 0.75
+    k = float(p.k) if p else 1.0
+
+    def fn(prm, xs, training, rng):
+        x = xs[0]
+        sq = jnp.square(x)
+        lo = (size - 1) // 2
+        window = (1, size, 1, 1)
+        pad = ((0, 0), (lo, size - 1 - lo), (0, 0), (0, 0))
+        ssum = jax.lax.reduce_window(sq, 0.0, jax.lax.add, window,
+                                     (1, 1, 1, 1), pad)
+        return x / jnp.power(k + alpha / size * ssum, beta)
+
+    return ctx.emit(layer, fn, [ins[0]], {})
+
+
+@converts("BatchNorm")
+def _batchnorm(ctx, layer, blobs, ins):
+    eps = float(layer.batch_norm_param.eps) \
+        if layer.batch_norm_param is not None else 1e-5
+    if blobs:
+        sf = float(np.asarray(blobs[2]).ravel()[0]) if len(blobs) > 2 else 1.0
+        sf = 1.0 / sf if sf != 0 else 0.0
+        weights = {"mean": np.asarray(blobs[0]).reshape(-1) * sf,
+                   "var": np.asarray(blobs[1]).reshape(-1) * sf}
+    else:
+        c = _in_channels(ins[0])
+        weights = {"mean": np.zeros(c, np.float32),
+                   "var": np.ones(c, np.float32)}
+
+    def fn(prm, xs, training, rng):
+        x = xs[0]
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        return (x - prm["mean"].reshape(shape)) * jax.lax.rsqrt(
+            prm["var"].reshape(shape) + eps)
+
+    return ctx.emit(layer, fn, [ins[0]], weights)
+
+
+@converts("Scale")
+def _scale(ctx, layer, blobs, ins):
+    p = layer.scale_param
+    bias_term = bool(p.bias_term) if p is not None else False
+    if blobs:
+        weights = {"scale": np.asarray(blobs[0]).reshape(-1)}
+        if bias_term and len(blobs) > 1:
+            weights["bias"] = np.asarray(blobs[1]).reshape(-1)
+    else:
+        c = _in_channels(ins[0])
+        weights = {"scale": np.ones(c, np.float32)}
+        if bias_term:
+            weights["bias"] = np.zeros(c, np.float32)
+
+    def fn(prm, xs, training, rng):
+        x = xs[0]
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = x * prm["scale"].reshape(shape)
+        if "bias" in prm:
+            out = out + prm["bias"].reshape(shape)
+        return out
+
+    return ctx.emit(layer, fn, [ins[0]], weights)
+
+
+@converts("Softmax", "SoftmaxWithLoss")
+def _softmax(ctx, layer, blobs, ins):
+    axis = int(layer.softmax_param.axis) if layer.softmax_param else 1
+    return ctx.emit(layer,
+                    lambda prm, xs, t, r: jax.nn.softmax(xs[0], axis=axis),
+                    [ins[0]], {})
+
+
+@converts("Dropout")
+def _dropout(ctx, layer, blobs, ins):
+    ratio = float(layer.dropout_param.dropout_ratio) \
+        if layer.dropout_param else 0.5
+
+    def fn(prm, xs, training, rng):
+        x = xs[0]
+        if not training or rng is None or ratio <= 0:
+            return x
+        keep = 1.0 - ratio
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0)
+
+    return ctx.emit(layer, fn, [ins[0]], {})
+
+
+@converts("Concat")
+def _concat(ctx, layer, blobs, ins):
+    p = layer.concat_param
+    axis = int(p.axis) if p is not None else 1
+
+    def fn(prm, xs, training, rng):
+        return jnp.concatenate(xs, axis=axis)
+
+    return ctx.emit(layer, fn, list(ins), {})
+
+
+@converts("Eltwise")
+def _eltwise(ctx, layer, blobs, ins):
+    p = layer.eltwise_param or EltwiseParameter()
+    op = resolve_enum(EltwiseParameter, p.operation, EltwiseParameter.SUM)
+    coeff = [float(c) for c in (p.coeff or [])]
+
+    def fn(prm, xs, training, rng):
+        if op == EltwiseParameter.PROD:
+            out = xs[0]
+            for x in xs[1:]:
+                out = out * x
+            return out
+        if op == EltwiseParameter.MAX:
+            out = xs[0]
+            for x in xs[1:]:
+                out = jnp.maximum(out, x)
+            return out
+        cs = coeff or [1.0] * len(xs)
+        out = cs[0] * xs[0]
+        for c, x in zip(cs[1:], xs[1:]):
+            out = out + c * x
+        return out
+
+    return ctx.emit(layer, fn, list(ins), {})
+
+
+@converts("Flatten")
+def _flatten(ctx, layer, blobs, ins):
+    axis = int(layer.flatten_param.axis) \
+        if getattr(layer, "flatten_param", None) else 1
+
+    def fn(prm, xs, training, rng):
+        x = xs[0]
+        lead = 1
+        for d in x.shape[:axis]:
+            lead *= d
+        return x.reshape(lead, -1)
+
+    return ctx.emit(layer, fn, [ins[0]], {})
+
+
+@converts("Reshape")
+def _reshape(ctx, layer, blobs, ins):
+    shape = [int(d) for d in layer.reshape_param.shape.dim]
+
+    def fn(prm, xs, training, rng):
+        x = xs[0]
+        tgt = [x.shape[i] if v == 0 else v for i, v in enumerate(shape)]
+        return x.reshape(tuple(tgt))
+
+    return ctx.emit(layer, fn, [ins[0]], {})
+
+
+@converts("Slice")
+def _slice(ctx, layer, blobs, ins):
+    p = layer.slice_param
+    axis = int(p.axis) if p is not None else 1
+    points = [int(v) for v in (p.slice_point if p else [])]
+    n_out = len(layer.top)
+
+    def fn(prm, xs, training, rng):
+        x = xs[0]
+        if points:
+            return list(jnp.split(x, points, axis=axis))
+        return list(jnp.split(x, n_out, axis=axis))
+
+    return ctx.emit(layer, fn, [ins[0]], {}, n_outputs=n_out)
+
+
+@converts("Split")
+def _split(ctx, layer, blobs, ins):
+    n_out = len(layer.top)
+
+    def fn(prm, xs, training, rng):
+        return [xs[0] for _ in range(n_out)]
+
+    return ctx.emit(layer, fn, [ins[0]], {}, n_outputs=n_out)

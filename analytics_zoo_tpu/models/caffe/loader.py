@@ -1,0 +1,182 @@
+"""Caffe model loader: prototxt + caffemodel → native graph Model.
+
+Parity with ``Net.loadCaffe(defPath, modelPath)``
+(pipeline/api/Net.scala:51-190 → models/caffe/CaffeLoader.scala:718):
+reads the net definition in protobuf text format and the weights in
+binary, converts layers (V1 + V2), and assembles a trainable graph.
+Data layers are replaced by graph inputs, in-place layers (top ==
+bottom) are chained, and loss/accuracy layers are dropped the way the
+reference's ``topologicalSort`` path does.
+"""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.models.caffe import converter as conv_mod
+from analytics_zoo_tpu.models.caffe.caffe_pb import (
+    LayerParameter, NetParameter, V1LayerParameter)
+from analytics_zoo_tpu.models.caffe.prototxt import parse
+from analytics_zoo_tpu.pipeline.api.keras.engine import Input, KTensor
+from analytics_zoo_tpu.pipeline.api.keras.topology import Model
+from analytics_zoo_tpu.pipeline.api.onnx.mapper import OnnxOp as FnLayer
+
+_DATA_LAYERS = {"Data", "ImageData", "HDF5Data", "MemoryData",
+                "WindowData", "DummyData", "Input", "AnnotatedData"}
+_SKIP_LAYERS = {"Accuracy", "SilenceLayer", "Silence"}
+
+_PARAM_NAMES = [
+    "concat_param", "convolution_param", "dropout_param", "eltwise_param",
+    "inner_product_param", "lrn_param", "pooling_param", "power_param",
+    "relu_param", "softmax_param", "slice_param", "prelu_param",
+    "reshape_param", "flatten_param", "batch_norm_param", "elu_param",
+    "scale_param", "input_param",
+]
+
+
+def _normalize(layer) -> SimpleNamespace:
+    """Uniform view over V1 (enum-typed) and V2 (string-typed) layers."""
+    if isinstance(layer, V1LayerParameter):
+        type_name = layer.type_name()
+    else:
+        type_name = layer.type
+    ns = SimpleNamespace(
+        name=layer.name, type=type_name,
+        bottom=list(layer.bottom), top=list(layer.top),
+        blobs=list(layer.blobs))
+    for p in _PARAM_NAMES:
+        setattr(ns, p, getattr(layer, p, None))
+    return ns
+
+
+class _Ctx:
+    def __init__(self):
+        self._names: Dict[str, int] = {}
+
+    def emit(self, layer, fn, graph_ins: List[KTensor],
+             weights: Dict[str, np.ndarray], n_outputs: int = 1):
+        base = layer.name or layer.type.lower()
+        n = self._names.get(base, 0)
+        self._names[base] = n + 1
+        name = base if n == 0 else f"{base}_{n}"
+        out = FnLayer(fn, weights=weights, n_outputs=n_outputs,
+                      name=name)(graph_ins if len(graph_ins) > 1
+                                 else graph_ins[0])
+        return out if isinstance(out, list) else [out]
+
+
+class CaffeLoader:
+    """``CaffeLoader.load(def_path, model_path)`` → graph ``Model``."""
+
+    @staticmethod
+    def load(def_path: str, model_path: Optional[str] = None,
+             input_shapes: Optional[Dict[str, Sequence[int]]] = None,
+             outputs: Optional[Sequence[str]] = None) -> Model:
+        with open(def_path, "r") as f:
+            net_def = parse(f.read(), NetParameter)
+        weights_by_name: Dict[str, List[np.ndarray]] = {}
+        if model_path is not None:
+            with open(model_path, "rb") as f:
+                net_w = NetParameter.decode(f.read())
+            for lyr in list(net_w.layer) + list(net_w.layers):
+                if lyr.blobs:
+                    weights_by_name[lyr.name] = [b.ndarray()
+                                                 for b in lyr.blobs]
+        return _build(net_def, weights_by_name, input_shapes or {}, outputs)
+
+
+def _build(net_def: NetParameter, weights_by_name, input_shapes, outputs):
+    tensors: Dict[str, KTensor] = {}
+    model_inputs: List[KTensor] = []
+    ctx = _Ctx()
+
+    def add_input(name: str, dims: Sequence[int]):
+        # caffe shapes are (N, C, H, W); dim 0 is the batch
+        t = Input(shape=tuple(int(d) for d in dims[1:]), name=name)
+        tensors[name] = t
+        model_inputs.append(t)
+
+    # net-level inputs: `input:` + input_shape / legacy input_dim
+    if net_def.input:
+        for i, name in enumerate(net_def.input):
+            if i < len(net_def.input_shape):
+                dims = [int(d) for d in net_def.input_shape[i].dim]
+            elif net_def.input_dim:
+                dims = [int(d) for d in net_def.input_dim[4 * i:4 * i + 4]]
+            elif name in input_shapes:
+                dims = [0] + list(input_shapes[name])
+            else:
+                raise ValueError(f"no shape for net input {name!r}")
+            add_input(name, dims)
+
+    layers = [_normalize(l) for l in
+              (list(net_def.layer) or list(net_def.layers))]
+
+    last_top: Optional[str] = None
+    for layer in layers:
+        if layer.type in _SKIP_LAYERS:
+            continue
+        if layer.type in _DATA_LAYERS:
+            for top in layer.top:
+                if top in ("label",):
+                    continue
+                if layer.input_param is not None and layer.input_param.shape:
+                    dims = [int(d) for d in layer.input_param.shape[0].dim]
+                elif top in input_shapes:
+                    dims = [0] + list(input_shapes[top])
+                else:
+                    raise ValueError(
+                        f"data layer {layer.name!r}: pass input_shapes="
+                        f"{{{top!r}: (C, H, W)}} to define the graph input")
+                add_input(top, dims)
+            continue
+        conv = conv_mod.CONVERTERS.get(layer.type)
+        if conv is None:
+            raise NotImplementedError(
+                f"caffe layer type {layer.type!r} not supported")
+        ins = []
+        for b in layer.bottom:
+            if b == "label":
+                continue
+            if b not in tensors:
+                raise KeyError(f"layer {layer.name}: unknown bottom {b!r}")
+            ins.append(tensors[b])
+        blobs = weights_by_name.get(layer.name, [b.ndarray()
+                                                 for b in layer.blobs])
+        outs = conv(ctx, layer, blobs, ins)
+        tops = [t for t in layer.top if t != "label"]
+        if not tops:
+            tops = [layer.name]
+        for top, val in zip(tops, outs):
+            tensors[top] = val
+            last_top = top
+
+    if outputs:
+        out_tensors = [tensors[o] for o in outputs]
+    else:
+        consumed = set()
+        for layer in layers:
+            if layer.type in _DATA_LAYERS or layer.type in _SKIP_LAYERS:
+                continue
+            for b in layer.bottom:
+                if not (len(layer.top) == 1 and layer.top[0] == b):
+                    consumed.add(b)
+        leaves = [n for n, t in tensors.items()
+                  if n not in consumed and t.node is not None]
+        out_tensors = [tensors[n] for n in (leaves or [last_top])]
+
+    return Model(input=model_inputs if len(model_inputs) > 1
+                 else model_inputs[0],
+                 output=out_tensors if len(out_tensors) > 1
+                 else out_tensors[0],
+                 name=net_def.name or "caffe_model")
+
+
+def load_caffe(def_path: str, model_path: Optional[str] = None,
+               **kwargs) -> Model:
+    """Module-level sugar mirroring ``Net.loadCaffe``."""
+    return CaffeLoader.load(def_path, model_path, **kwargs)

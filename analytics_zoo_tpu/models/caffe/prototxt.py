@@ -1,0 +1,142 @@
+"""Protobuf text-format parser (the ``.prototxt`` side of the Caffe
+loader, ref CaffeLoader.scala:718 which reads the net definition with
+``TextFormat.merge``).
+
+Schema-driven against the same Message classes the binary codec uses:
+``parse(text, NetParameter)`` returns a populated message.  Supports
+the subset the format actually uses in net definitions: ``name: value``
+scalars, ``name { ... }`` sub-messages, repeated fields, quoted
+strings, bools, enum identifiers, and ``#`` comments.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Tuple
+
+from analytics_zoo_tpu.utils.pbwire import Field, Message
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+      (?P<comment>\#[^\n]*)
+    | (?P<brace>[{}])
+    | (?P<colon>:)
+    | (?P<string>"(?:[^"\\]|\\.)*")
+    | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+    | (?P<number>[-+]?(?:\d+\.\d*|\.\d+|\d+)(?:[eE][-+]?\d+)?)
+    )""", re.VERBOSE)
+
+
+def _tokenize(text: str) -> List[Tuple[str, str]]:
+    tokens = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            if text[pos:].strip() == "":
+                break
+            raise ValueError(f"prototxt parse error at: {text[pos:pos+40]!r}")
+        pos = m.end()
+        kind = m.lastgroup
+        if kind == "comment" or kind is None:
+            continue
+        tokens.append((kind, m.group(kind)))
+    return tokens
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str]]):
+        self.tokens = tokens
+        self.pos = 0
+
+    def peek(self):
+        return self.tokens[self.pos] if self.pos < len(self.tokens) else None
+
+    def next(self):
+        tok = self.peek()
+        if tok is None:
+            raise ValueError("unexpected end of prototxt")
+        self.pos += 1
+        return tok
+
+    def parse_message(self, cls, stop_at_brace: bool) -> Message:
+        msg = cls()
+        fields = {f.name: f for f in cls.FIELDS}
+        while True:
+            tok = self.peek()
+            if tok is None:
+                if stop_at_brace:
+                    raise ValueError("missing closing '}'")
+                return msg
+            if tok == ("brace", "}"):
+                if not stop_at_brace:
+                    raise ValueError("unmatched '}'")
+                self.next()
+                return msg
+            kind, val = self.next()
+            if kind != "ident":
+                raise ValueError(f"expected field name, got {val!r}")
+            f = fields.get(val)
+            nxt = self.peek()
+            if nxt == ("brace", "{"):
+                self.next()
+                if f is None:
+                    self._skip_block()
+                    continue
+                if f.kind != "msg":
+                    raise ValueError(f"field {val} is not a message")
+                sub = self.parse_message(f.msg_cls, stop_at_brace=True)
+                self._store(msg, f, sub)
+            else:
+                if nxt is not None and nxt[0] == "colon":
+                    self.next()
+                vk, vv = self.next()
+                if f is None:
+                    continue
+                self._store(msg, f, self._convert(f, vk, vv))
+
+    def _skip_block(self):
+        depth = 1
+        while depth:
+            kind, val = self.next()
+            if kind == "brace":
+                depth += 1 if val == "{" else -1
+
+    @staticmethod
+    def _convert(f: Field, kind: str, raw: str) -> Any:
+        if f.kind in ("string", "bytes"):
+            if kind == "string":
+                body = raw[1:-1]
+                return (body.encode().decode("unicode_escape")
+                        if f.kind == "string" else body.encode())
+            return raw
+        if f.kind == "bool":
+            return raw in ("true", "1", "True")
+        if f.kind in ("float", "double"):
+            return float(raw)
+        if f.kind == "enum":
+            if kind == "ident":
+                # resolve via class constants (e.g. PoolingParameter.MAX)
+                return raw
+            return int(raw)
+        return int(raw)
+
+    @staticmethod
+    def _store(msg: Message, f: Field, val: Any):
+        if f.repeated:
+            getattr(msg, f.name).append(val)
+        else:
+            setattr(msg, f.name, val)
+
+
+def parse(text: str, cls) -> Message:
+    """Parse protobuf text format into an instance of ``cls``."""
+    return _Parser(_tokenize(text)).parse_message(cls, stop_at_brace=False)
+
+
+def resolve_enum(owner_cls, value, default: int = 0) -> int:
+    """Normalise an enum field that may hold an int or an identifier
+    string (text format writes ``pool: MAX``)."""
+    if isinstance(value, str):
+        return int(getattr(owner_cls, value, default))
+    return int(value) if value is not None else default

@@ -1,4 +1,5 @@
 from analytics_zoo_tpu.pipeline.api.net.torch_net import TorchNet
 from analytics_zoo_tpu.pipeline.api.net.tf_net import TFNet
+from analytics_zoo_tpu.pipeline.api.net.net import Net
 
-__all__ = ["TorchNet", "TFNet"]
+__all__ = ["TorchNet", "TFNet", "Net"]

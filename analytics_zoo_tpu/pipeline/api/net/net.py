@@ -1,0 +1,59 @@
+"""``Net`` — unified model-loading facade.
+
+Parity with ``Net.load/loadBigDL/loadCaffe/loadTF/loadTorch``
+(pipeline/api/Net.scala:51-190): one entry point that dispatches to the
+framework's importers and returns a native, trainable model.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+
+class Net:
+    """Static loaders mirroring the reference's ``Net`` object."""
+
+    @staticmethod
+    def load(path: str, into):
+        """Restore weights saved with ``model.save_model`` into ``into``
+        (a freshly built model of the same architecture) and return it."""
+        return into.load_weights(path)
+
+    # the reference aliases loadBigDL to the engine-native format; here
+    # the engine-native format IS the zoo format
+    load_bigdl = load
+
+    @staticmethod
+    def load_caffe(def_path: str, model_path: Optional[str] = None,
+                   input_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                   outputs: Optional[Sequence[str]] = None):
+        """Caffe prototxt+caffemodel → graph Model
+        (ref Net.loadCaffe → CaffeLoader.scala)."""
+        from analytics_zoo_tpu.models.caffe import CaffeLoader
+        return CaffeLoader.load(def_path, model_path,
+                                input_shapes=input_shapes, outputs=outputs)
+
+    @staticmethod
+    def load_onnx(path: str):
+        """ONNX file → graph Model (ref pyzoo onnx loader)."""
+        from analytics_zoo_tpu.pipeline.api.onnx import load as _load
+        return _load(path)
+
+    @staticmethod
+    def load_tf(path: str, **kwargs):
+        """TF frozen graph / SavedModel dir → TFNet layer
+        (ref Net.loadTF → TFNet.scala)."""
+        from analytics_zoo_tpu.pipeline.api.net.tf_net import TFNet
+        return TFNet.from_saved_model(path, **kwargs)
+
+    @staticmethod
+    def load_torch(module_or_path, example_input=None):
+        """torch.nn.Module (or TorchScript file) → TorchNet layer
+        (ref Net.loadTorch → TorchNet.scala)."""
+        from analytics_zoo_tpu.pipeline.api.net.torch_net import TorchNet
+        if isinstance(module_or_path, str):
+            import torch
+            module = torch.jit.load(module_or_path)
+        else:
+            module = module_or_path
+        return TorchNet.from_pytorch(module, example_input)

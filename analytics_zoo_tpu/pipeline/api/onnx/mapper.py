@@ -419,6 +419,18 @@ def _binary(ctx, node, attrs, ins):
 @converts("Min", "Max", "Sum", "Mean")
 def _variadic(ctx, node, attrs, ins):
     op_type = node.op_type
+    if all(isinstance(v, np.ndarray) for v in ins):   # constant fold
+        out = ins[0]
+        for o in ins[1:]:
+            if op_type == "Min":
+                out = np.minimum(out, o)
+            elif op_type == "Max":
+                out = np.maximum(out, o)
+            else:
+                out = out + o
+        if op_type == "Mean":
+            out = out / len(ins)
+        return [np.asarray(out)]
     weights = {}
     graph_ins = []
     pattern = []
@@ -449,7 +461,8 @@ def _variadic(ctx, node, attrs, ins):
 
 @converts("Softmax", "LogSoftmax")
 def _softmax(ctx, node, attrs, ins):
-    axis = int(attrs.get("axis", 1))
+    # default axis changed from 1 (flatten semantics) to -1 in opset 13
+    axis = int(attrs.get("axis", 1 if ctx.opset < 13 else -1))
     log = node.op_type == "LogSoftmax"
     opset = ctx.opset
 
@@ -480,22 +493,44 @@ def _pool(ctx, node, attrs, ins, reducer, init, average=False):
     pads_attr = attrs.get("pads")
     auto_pad = attrs.get("auto_pad", "NOTSET")
     count_include_pad = int(attrs.get("count_include_pad", 0))
+    ceil_mode = int(attrs.get("ceil_mode", 0))
 
     def fn(p, xs, training, rng):
         x = xs[0]
-        pads = _pads_pairs(pads_attr, nsp, auto_pad, in_shape=x.shape[2:],
+        base = _pads_pairs(pads_attr, nsp, auto_pad, in_shape=x.shape[2:],
                            kernel=kernel, strides=strides)
+        pads = base
+        if ceil_mode:
+            # widen the end pad so the last partial window is emitted
+            pads = []
+            for i, (lo, hi) in enumerate(base):
+                span = x.shape[2 + i] + lo + hi - kernel[i]
+                out_d = -(-span // strides[i]) + 1
+                need = (out_d - 1) * strides[i] + kernel[i]
+                pads.append((lo, hi + need - (x.shape[2 + i] + lo + hi)))
         window = (1, 1) + tuple(kernel)
         strd = (1, 1) + tuple(strides)
-        pad = ((0, 0), (0, 0)) + tuple(pads)
-        out = jax.lax.reduce_window(x, init, reducer, window, strd, pad)
+        out = jax.lax.reduce_window(x, init, reducer, window, strd,
+                                    ((0, 0), (0, 0)) + tuple(pads))
         if average:
-            if count_include_pad or all(p_ == (0, 0) for p_ in pads):
+            if count_include_pad and not ceil_mode:
                 out = out / float(np.prod(kernel))
-            else:
-                ones = jnp.ones_like(x)
+            elif count_include_pad:
+                # count positions in the base-padded extent, not the
+                # ceil-mode spill-over
+                ones = jnp.pad(jnp.ones_like(x),
+                               ((0, 0), (0, 0)) + tuple(base),
+                               constant_values=1.0)
+                extra = tuple((0, pads[i][1] - base[i][1])
+                              for i in range(nsp))
                 denom = jax.lax.reduce_window(
-                    ones, 0.0, jax.lax.add, window, strd, pad)
+                    ones, 0.0, jax.lax.add, window, strd,
+                    ((0, 0), (0, 0)) + extra)
+                out = out / denom
+            else:
+                denom = jax.lax.reduce_window(
+                    jnp.ones_like(x), 0.0, jax.lax.add, window, strd,
+                    ((0, 0), (0, 0)) + tuple(pads))
                 out = out / denom
         return out
 
@@ -578,6 +613,11 @@ def _reshape(ctx, node, attrs, ins):
             raise NotImplementedError("Reshape with dynamic shape input")
         shape = [int(v) for v in np.asarray(ins[1]).ravel()]
     shape = [int(v) for v in shape]
+
+    if isinstance(ins[0], np.ndarray):   # constant fold
+        tgt = [ins[0].shape[i] if v == 0 else v
+               for i, v in enumerate(shape)]
+        return [ins[0].reshape(tuple(tgt))]
 
     def fn(p, xs, training, rng):
         x = xs[0]
@@ -836,17 +876,21 @@ def _dropout(ctx, node, attrs, ins):
 def _resize(ctx, node, attrs, ins):
     mode = attrs.get("mode", "nearest")
     scales = attrs.get("scales")
-    if scales is None:
-        # Resize: inputs are (X, roi, scales, sizes); Upsample: (X, scales)
-        for cand in ins[1:]:
-            if isinstance(cand, np.ndarray) and cand.size:
-                arr = np.asarray(cand).ravel()
-                if arr.dtype.kind == "f" and arr.size >= 1:
-                    scales = [float(v) for v in arr]
-                    break
     sizes = None
-    if scales is None and len(ins) >= 4 and isinstance(ins[3], np.ndarray):
-        sizes = [int(v) for v in np.asarray(ins[3]).ravel()]
+    if scales is None:
+        if node.op_type == "Upsample":        # inputs: (X, scales)
+            if len(ins) > 1 and isinstance(ins[1], np.ndarray):
+                scales = [float(v) for v in np.asarray(ins[1]).ravel()]
+        else:                                  # Resize: (X, roi, scales, sizes)
+            if len(ins) > 2 and isinstance(ins[2], np.ndarray) \
+                    and np.asarray(ins[2]).size:
+                scales = [float(v) for v in np.asarray(ins[2]).ravel()]
+            elif len(ins) > 3 and isinstance(ins[3], np.ndarray) \
+                    and np.asarray(ins[3]).size:
+                sizes = [int(v) for v in np.asarray(ins[3]).ravel()]
+    if scales is None and sizes is None:
+        raise NotImplementedError(
+            f"{node.op_type} node without static scales/sizes")
     method = {"nearest": "nearest", "linear": "linear",
               "cubic": "cubic"}[mode.split("_")[0] if mode else "nearest"]
 
@@ -875,6 +919,8 @@ def _expand(ctx, node, attrs, ins):
 
 @converts("Where")
 def _where(ctx, node, attrs, ins):
+    if all(isinstance(v, np.ndarray) for v in ins[:3]):   # constant fold
+        return [np.where(ins[0].astype(bool), ins[1], ins[2])]
     weights = {}
     graph_ins = []
     pattern = []

@@ -69,6 +69,10 @@ def tensor_to_ndarray(t: TensorProto) -> np.ndarray:
         raise ValueError(f"unsupported ONNX tensor dtype {t.data_type}")
     if t.raw_data:
         arr = np.frombuffer(t.raw_data, dtype=np_dtype)
+    elif t.data_type == TensorProto.FLOAT16 and t.int32_data:
+        # fp16 payloads without raw_data are uint16 bit patterns stored
+        # in int32_data — reinterpret, don't value-cast
+        arr = np.asarray(t.int32_data, dtype=np.uint16).view(np.float16)
     elif t.float_data:
         arr = np.asarray(t.float_data, dtype=np.float32).astype(np_dtype)
     elif t.int64_data:

@@ -328,6 +328,37 @@ class TestOps:
         leaves = jax.tree_util.tree_leaves(grads)
         assert leaves and all(float(np.abs(g).sum()) > 0 for g in leaves)
 
+    def test_maxpool_ceil_mode(self):
+        rng = np.random.RandomState(12)
+        x = rng.randn(1, 2, 7, 7).astype(np.float32)
+        node = NodeProto(input=["x"], output=["y"], op_type="MaxPool",
+                         attribute=[attr_ints("kernel_shape", [3, 3]),
+                                    attr_ints("strides", [2, 2]),
+                                    attr_i("ceil_mode", 1)])
+        data = make_model([node], [("x", [0, 2, 7, 7])], [("y", [0, 2, 4, 4])])
+        got = run(data, x)
+        t = F.max_pool2d(torch.from_numpy(x), 3, stride=2, ceil_mode=True)
+        assert got.shape == tuple(t.shape)
+        np.testing.assert_allclose(got, t.numpy(), rtol=1e-6)
+
+    def test_constant_reshape_and_sum_fold(self):
+        rng = np.random.RandomState(13)
+        x = rng.randn(2, 6).astype(np.float32)
+        w = rng.randn(3, 2).astype(np.float32)
+        shape = np.asarray([6, 1], dtype=np.int64)
+        nodes = [
+            NodeProto(input=["w", "shape"], output=["wr"],
+                      op_type="Reshape"),
+            NodeProto(input=["wr", "wr"], output=["w2"], op_type="Sum"),
+            NodeProto(input=["x", "w2"], output=["y"], op_type="MatMul"),
+        ]
+        data = make_model(nodes, [("x", [0, 6])], [("y", [0, 1])],
+                          [ndarray_to_tensor(w, "w"),
+                           ndarray_to_tensor(shape, "shape")])
+        got = run(data, x)
+        ref = x @ (2 * w.reshape(6, 1))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
     def test_unsupported_op_raises(self):
         node = NodeProto(input=["x"], output=["y"], op_type="NoSuchOp")
         data = make_model([node], [("x", [0, 3])], [("y", [0, 3])])
