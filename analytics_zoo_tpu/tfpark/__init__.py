@@ -1,4 +1,9 @@
 from analytics_zoo_tpu.tfpark.model import KerasModel
 from analytics_zoo_tpu.tfpark.tf_dataset import TFDataset
+from analytics_zoo_tpu.tfpark.tf_optimizer import TFOptimizer
+from analytics_zoo_tpu.tfpark.tf_predictor import TFPredictor
+from analytics_zoo_tpu.tfpark.estimator import (ModeKeys, TFEstimator,
+                                                TFEstimatorSpec)
 
-__all__ = ["KerasModel", "TFDataset"]
+__all__ = ["KerasModel", "TFDataset", "TFOptimizer", "TFPredictor",
+           "TFEstimator", "TFEstimatorSpec", "ModeKeys"]

@@ -1,0 +1,6 @@
+"""TFPark text models (ref: pyzoo/zoo/tfpark/text)."""
+
+from analytics_zoo_tpu.tfpark.text.estimator import (  # noqa: F401
+    BERTBaseEstimator, BERTClassifier, BERTNER, BERTSQuAD)
+from analytics_zoo_tpu.tfpark.text.keras_models import (  # noqa: F401
+    IntentEntity, NER, SequenceTagger, TextKerasModel)

@@ -1,0 +1,139 @@
+"""BERT estimators.
+
+Reference: pyzoo/zoo/tfpark/text/estimator/{bert_base.py,
+bert_classifier.py, bert_ner.py, bert_squad.py} — TFEstimator-based
+fine-tuning heads over the google-research BERT graph.
+
+TPU build: heads over the native BERT encoder
+(pipeline/api/keras/layers/attention.py:BERT) with the same
+train/evaluate/predict surface; inputs follow the reference's feature
+dict {input_ids, token_type_ids, position_ids?, attention_mask}.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as L
+from analytics_zoo_tpu.pipeline.api.keras.layers.attention import BERT
+from analytics_zoo_tpu.pipeline.api.keras.topology import Model
+
+
+def _bert_io(bert: BERT):
+    model = bert.build()
+    return model, bert.cfg
+
+
+class BERTBaseEstimator:
+    """Feature-extraction base (ref bert_base.py): exposes the pooled
+    and sequence outputs of the encoder plus the shared train surface."""
+
+    head_on_pooled = True
+
+    def __init__(self, bert: Optional[BERT] = None, **bert_kwargs):
+        self.bert = bert or BERT(**bert_kwargs)
+        self.encoder, self.cfg = _bert_io(self.bert)
+        self.model = self._build_model()
+
+    # subclasses attach a head; the base serves raw features
+    def _build_model(self) -> Model:
+        return self.encoder
+
+    @staticmethod
+    def _inputs(features: dict, seq_len: int):
+        ids = np.asarray(features["input_ids"])
+        seg = np.asarray(features.get("token_type_ids",
+                                      np.zeros_like(ids)))
+        pos = np.asarray(features.get(
+            "position_ids",
+            np.broadcast_to(np.arange(seq_len), ids.shape)))
+        mask = np.asarray(features.get("attention_mask",
+                                       np.ones_like(ids)))
+        return [ids, seg, pos, mask]
+
+    def train(self, features: dict, labels, loss: str,
+              optim_method=None, batch_size: int = 8, epochs: int = 1):
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+            AdamWeightDecay)
+        x = self._inputs(features, self.cfg["seq_len"])
+        self.model.compile(optim_method or AdamWeightDecay(lr=2e-5),
+                           loss)
+        self.model.fit(x, np.asarray(labels), batch_size=batch_size,
+                       nb_epoch=epochs)
+        return self
+
+    def evaluate(self, features: dict, labels, batch_size: int = 8):
+        x = self._inputs(features, self.cfg["seq_len"])
+        return self.model.evaluate(x, np.asarray(labels),
+                                   batch_size=batch_size)
+
+    def predict(self, features: dict, batch_size: int = 8):
+        x = self._inputs(features, self.cfg["seq_len"])
+        return self.model.predict(x, batch_size=batch_size)
+
+
+class BERTClassifier(BERTBaseEstimator):
+    """Sequence classification head on the pooled output
+    (ref bert_classifier.py: dense+softmax over pooled_output)."""
+
+    def __init__(self, num_classes: int, dropout: float = 0.1,
+                 **bert_kwargs):
+        self.num_classes = num_classes
+        self.dropout = dropout
+        super().__init__(**bert_kwargs)
+
+    def _build_model(self) -> Model:
+        pooled = self.encoder.outputs[1]
+        x = L.Dropout(self.dropout)(pooled)
+        logits = L.Dense(self.num_classes)(x)
+        return Model(self.encoder.inputs, logits)
+
+    def train(self, features, labels, optim_method=None,
+              batch_size: int = 8, epochs: int = 1):
+        return super().train(
+            features, labels,
+            loss="sparse_categorical_crossentropy_with_logits",
+            optim_method=optim_method, batch_size=batch_size,
+            epochs=epochs)
+
+
+class BERTNER(BERTBaseEstimator):
+    """Token-classification head on the sequence output
+    (ref bert_ner.py)."""
+
+    def __init__(self, num_entities: int, dropout: float = 0.1,
+                 **bert_kwargs):
+        self.num_entities = num_entities
+        self.dropout = dropout
+        super().__init__(**bert_kwargs)
+
+    def _build_model(self) -> Model:
+        seq_out = self.encoder.outputs[0]
+        x = L.Dropout(self.dropout)(seq_out)
+        logits = L.TimeDistributed(L.Dense(self.num_entities))(x)
+        return Model(self.encoder.inputs, logits)
+
+    def train(self, features, labels, optim_method=None,
+              batch_size: int = 8, epochs: int = 1):
+        return super().train(
+            features, labels,
+            loss="sparse_categorical_crossentropy_with_logits",
+            optim_method=optim_method, batch_size=batch_size,
+            epochs=epochs)
+
+
+class BERTSQuAD(BERTBaseEstimator):
+    """Span-extraction head (ref bert_squad.py): per-token start/end
+    logits over the sequence output."""
+
+    def _build_model(self) -> Model:
+        seq_out = self.encoder.outputs[0]
+        span = L.TimeDistributed(L.Dense(2))(seq_out)   # (B, T, 2)
+        return Model(self.encoder.inputs, span)
+
+    def predict_spans(self, features: dict, batch_size: int = 8):
+        """Return (start_logits, end_logits) arrays."""
+        out = np.asarray(self.predict(features, batch_size=batch_size))
+        return out[..., 0], out[..., 1]

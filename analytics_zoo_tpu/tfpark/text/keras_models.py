@@ -1,0 +1,140 @@
+"""TFPark Keras-style text models.
+
+Reference: pyzoo/zoo/tfpark/text/keras/{text_model.py, ner.py,
+pos_tagging.py, intent_extraction.py} — NLP-architect-derived tf.keras
+models (word+char BiLSTM taggers, joint intent/entity nets) wrapped in
+``TextKerasModel``.
+
+TPU build: the same architectures assembled from native layers; the
+``fit/evaluate/predict/save_model`` surface comes from the zoo engine
+directly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as L
+from analytics_zoo_tpu.pipeline.api.keras.engine import Input
+from analytics_zoo_tpu.pipeline.api.keras.topology import Model
+
+
+class TextKerasModel:
+    """Base wrapper (ref text_model.py:TextKerasModel): holds a native
+    graph model and forwards the training surface."""
+
+    def __init__(self, model: Model):
+        self.model = model
+
+    def compile(self, optimizer, loss, metrics=None):
+        self.model.compile(optimizer, loss, metrics)
+        return self
+
+    def fit(self, x, y, batch_size: int = 32, epochs: int = 1, **kwargs):
+        return self.model.fit(x, y, batch_size=batch_size,
+                              nb_epoch=epochs, **kwargs)
+
+    def evaluate(self, x, y, batch_size: int = 32):
+        return self.model.evaluate(x, y, batch_size=batch_size)
+
+    def predict(self, x, batch_size: int = 256, distributed: bool = False):
+        return self.model.predict(x, batch_size=batch_size)
+
+    def save_model(self, path: str, over_write: bool = True):
+        self.model.save_model(path, over_write=over_write)
+
+    def get_weights(self):
+        return self.model.get_weights()
+
+
+class NER(TextKerasModel):
+    """Named-entity recognizer (ref ner.py:21): word + char embeddings,
+    char BiLSTM summarised per word, stacked word BiLSTMs, softmax tag
+    head (the reference uses NLP-architect's NERCRF; the head here is a
+    per-token softmax — same inputs/outputs surface)."""
+
+    def __init__(self, num_entities: int, word_vocab_size: int,
+                 char_vocab_size: int, word_length: int = 12,
+                 seq_len: int = 50, word_emb_dim: int = 100,
+                 char_emb_dim: int = 30, tagger_lstm_dim: int = 100,
+                 dropout: float = 0.5):
+        words = Input(shape=(seq_len,))
+        chars = Input(shape=(seq_len, word_length))
+
+        w = L.Embedding(word_vocab_size, word_emb_dim)(words)
+        c = L.Embedding(char_vocab_size, char_emb_dim)(chars)
+        # summarize each word's characters with a time-distributed BiLSTM
+        c = L.TimeDistributed(
+            L.Bidirectional(L.LSTM(char_emb_dim, return_sequences=False))
+        )(c)
+        x = L.Merge(mode="concat", concat_axis=-1)([w, c])
+        x = L.Dropout(dropout)(x)
+        x = L.Bidirectional(L.LSTM(tagger_lstm_dim,
+                                   return_sequences=True))(x)
+        x = L.Bidirectional(L.LSTM(tagger_lstm_dim,
+                                   return_sequences=True))(x)
+        out = L.TimeDistributed(
+            L.Dense(num_entities, activation="softmax"))(x)
+        super().__init__(Model([words, chars], out))
+
+
+class SequenceTagger(TextKerasModel):
+    """Joint POS + chunk tagger (ref pos_tagging.py:48): shared word
+    embedding/BiLSTM trunk with two softmax heads."""
+
+    def __init__(self, num_pos_labels: int, num_chunk_labels: int,
+                 word_vocab_size: int, char_vocab_size: Optional[int] = None,
+                 word_length: int = 12, feature_size: int = 100,
+                 classifier: str = "softmax", seq_len: int = 50,
+                 dropout: float = 0.2):
+        words = Input(shape=(seq_len,))
+        inputs = [words]
+        w = L.Embedding(word_vocab_size, feature_size)(words)
+        feats = w
+        if char_vocab_size:
+            chars = Input(shape=(seq_len, word_length))
+            inputs.append(chars)
+            c = L.Embedding(char_vocab_size, feature_size // 4)(chars)
+            c = L.TimeDistributed(
+                L.Bidirectional(L.LSTM(feature_size // 4,
+                                       return_sequences=False)))(c)
+            feats = L.Merge(mode="concat", concat_axis=-1)([w, c])
+        x = L.Dropout(dropout)(feats)
+        x = L.Bidirectional(L.LSTM(feature_size, return_sequences=True))(x)
+        pos = L.TimeDistributed(
+            L.Dense(num_pos_labels, activation="softmax"))(x)
+        chunk = L.TimeDistributed(
+            L.Dense(num_chunk_labels, activation="softmax"))(x)
+        super().__init__(Model(inputs, [pos, chunk]))
+
+
+class IntentEntity(TextKerasModel):
+    """Joint intent classification + slot filling
+    (ref intent_extraction.py:46): char-enriched BiLSTM encoder, an
+    intent head off the final state and a per-token entity head."""
+
+    def __init__(self, num_intents: int, num_entities: int,
+                 word_vocab_size: int, char_vocab_size: int,
+                 word_length: int = 12, seq_len: int = 50,
+                 token_emb_size: int = 100, char_emb_size: int = 30,
+                 tagger_lstm_dim: int = 100, dropout: float = 0.2):
+        words = Input(shape=(seq_len,))
+        chars = Input(shape=(seq_len, word_length))
+        w = L.Embedding(word_vocab_size, token_emb_size)(words)
+        c = L.Embedding(char_vocab_size, char_emb_size)(chars)
+        c = L.TimeDistributed(
+            L.Bidirectional(L.LSTM(char_emb_size,
+                                   return_sequences=False)))(c)
+        x = L.Merge(mode="concat", concat_axis=-1)([w, c])
+        x = L.Dropout(dropout)(x)
+        enc = L.Bidirectional(L.LSTM(tagger_lstm_dim,
+                                     return_sequences=True))(x)
+        # intent head: pool over time
+        pooled = L.GlobalMaxPooling1D()(enc)
+        intent = L.Dense(num_intents, activation="softmax")(pooled)
+        # entity head: per-token tags
+        ents = L.Bidirectional(L.LSTM(tagger_lstm_dim,
+                                      return_sequences=True))(enc)
+        ents = L.TimeDistributed(
+            L.Dense(num_entities, activation="softmax"))(ents)
+        super().__init__(Model([words, chars], [intent, ents]))

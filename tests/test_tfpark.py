@@ -1,0 +1,172 @@
+"""TFPark training-surface tests (ref: pyzoo/test/zoo/tfpark/*)."""
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.triggers import MaxEpoch
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras import layers as L
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+from analytics_zoo_tpu.tfpark import (ModeKeys, TFEstimator,
+                                      TFEstimatorSpec, TFOptimizer,
+                                      TFPredictor, TFDataset)
+from analytics_zoo_tpu.tfpark.gan import (GANEstimator,
+                                          least_squares_generator_loss,
+                                          least_squares_discriminator_loss)
+
+
+def make_xor(n=256, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int32)
+    return x, y
+
+
+def mlp(out=2):
+    m = Sequential()
+    m.add(L.Dense(32, activation="relu", input_shape=(2,)))
+    m.add(L.Dense(out))
+    return m
+
+
+class TestTFOptimizer:
+    def test_from_loss_optimizes(self):
+        x, y = make_xor()
+        model = mlp()
+        ds = TFDataset.from_ndarrays((x, y), batch_size=64)
+        opt = TFOptimizer.from_loss(
+            model, "sparse_categorical_crossentropy_with_logits", ds,
+            optim_method=Adam(lr=1e-2))
+        hist = opt.optimize(end_trigger=MaxEpoch(8))
+        losses = [h["loss"] for h in hist]
+        assert losses[-1] < losses[0]
+
+    def test_gradient_clipping_setters(self):
+        x, y = make_xor(64)
+        ds = TFDataset.from_ndarrays((x, y), batch_size=32)
+        opt = TFOptimizer.from_loss(
+            mlp(), "sparse_categorical_crossentropy_with_logits", ds,
+            optim_method=Adam(lr=1e-2))
+        opt.set_gradient_clipping_by_l2_norm(1.0)
+        hist = opt.optimize(end_trigger=MaxEpoch(1))
+        assert np.isfinite(hist[-1]["loss"])
+
+
+class TestTFEstimator:
+    def test_model_fn_train_eval_predict(self):
+        x, y = make_xor()
+
+        def model_fn(features, labels, mode):
+            model = mlp()
+            if mode == ModeKeys.TRAIN:
+                return TFEstimatorSpec(
+                    mode, predictions=model,
+                    loss="sparse_categorical_crossentropy_with_logits",
+                    optim_method=Adam(lr=1e-2))
+            if mode == ModeKeys.EVAL:
+                from analytics_zoo_tpu.pipeline.api.keras.metrics import (
+                    SparseCategoricalAccuracy)
+                return TFEstimatorSpec(
+                    mode, predictions=model,
+                    loss="sparse_categorical_crossentropy_with_logits",
+                    metrics=[SparseCategoricalAccuracy()])
+            return TFEstimatorSpec(mode, predictions=model)
+
+        est = TFEstimator(model_fn)
+        est.train(lambda: TFDataset.from_ndarrays((x, y), batch_size=64),
+                  steps=40)
+        scores = est.evaluate(
+            TFDataset.from_ndarrays((x, y), batch_per_thread=128))
+        assert isinstance(scores, dict) and scores
+        preds = est.predict(
+            TFDataset.from_ndarrays((x, None), batch_per_thread=128))
+        assert np.asarray(preds).shape == (len(x), 2)
+
+
+class TestTFPredictor:
+    def test_predict(self):
+        x, y = make_xor(128)
+        model = mlp()
+        pred = TFPredictor.from_outputs(
+            model, TFDataset.from_ndarrays((x, None),
+                                           batch_per_thread=64))
+        out = pred.predict()
+        assert np.asarray(out).shape == (128, 2)
+
+
+class TestGANEstimator:
+    def test_alternating_training_improves_generator(self):
+        # toy 1D GAN: real data ~ N(3, 0.2); G: z -> scalar
+        rng = np.random.RandomState(0)
+        real = rng.normal(3.0, 0.2, size=(512, 1)).astype(np.float32)
+
+        gen = Sequential()
+        gen.add(L.Dense(16, activation="relu", input_shape=(4,)))
+        gen.add(L.Dense(1))
+        disc = Sequential()
+        disc.add(L.Dense(16, activation="relu", input_shape=(1,)))
+        disc.add(L.Dense(1))
+
+        est = GANEstimator(
+            gen, disc,
+            generator_loss_fn=least_squares_generator_loss,
+            discriminator_loss_fn=least_squares_discriminator_loss,
+            generator_optim_method=Adam(lr=5e-3),
+            discriminator_optim_method=Adam(lr=5e-3),
+            d_steps=1, g_steps=1)
+        est.train(real, noise_dim=4, batch_size=64, steps=200)
+        import jax
+        samples = est.generate(np.asarray(
+            jax.random.normal(jax.random.PRNGKey(1), (256, 4))))
+        # generator mean should move toward the real mean (3.0)
+        assert abs(float(samples.mean()) - 3.0) < 1.0
+
+    def test_d_g_step_counts(self):
+        real = np.random.RandomState(1).normal(
+            0, 1, size=(64, 1)).astype(np.float32)
+        gen = Sequential(); gen.add(L.Dense(1, input_shape=(2,)))
+        disc = Sequential(); disc.add(L.Dense(1, input_shape=(1,)))
+        est = GANEstimator(gen, disc, d_steps=3, g_steps=2)
+        hist = est.train(real, noise_dim=2, batch_size=16, steps=2)
+        assert len(hist) == 2
+        assert all(np.isfinite(h["d_loss"]) and np.isfinite(h["g_loss"])
+                   for h in hist)
+
+
+class TestTextModels:
+    def test_ner_shapes(self):
+        from analytics_zoo_tpu.tfpark.text import NER
+        ner = NER(num_entities=5, word_vocab_size=100, char_vocab_size=30,
+                  word_length=6, seq_len=10, word_emb_dim=16,
+                  char_emb_dim=8, tagger_lstm_dim=16)
+        words = np.random.randint(0, 100, (4, 10)).astype(np.int32)
+        chars = np.random.randint(0, 30, (4, 10, 6)).astype(np.int32)
+        out = ner.predict([words, chars], batch_size=4)
+        assert np.asarray(out).shape == (4, 10, 5)
+        np.testing.assert_allclose(np.asarray(out).sum(-1),
+                                   np.ones((4, 10)), rtol=1e-4)
+
+    def test_intent_entity_two_heads(self):
+        from analytics_zoo_tpu.tfpark.text import IntentEntity
+        m = IntentEntity(num_intents=3, num_entities=4,
+                         word_vocab_size=50, char_vocab_size=20,
+                         word_length=5, seq_len=8, token_emb_size=12,
+                         char_emb_size=6, tagger_lstm_dim=8)
+        words = np.random.randint(0, 50, (2, 8)).astype(np.int32)
+        chars = np.random.randint(0, 20, (2, 8, 5)).astype(np.int32)
+        intent, ents = m.predict([words, chars], batch_size=2)
+        assert np.asarray(intent).shape == (2, 3)
+        assert np.asarray(ents).shape == (2, 8, 4)
+
+    def test_bert_classifier_tiny(self):
+        from analytics_zoo_tpu.tfpark.text import BERTClassifier
+        clf = BERTClassifier(num_classes=2, vocab=50, hidden_size=16,
+                             n_block=1, n_head=2, seq_len=8,
+                             intermediate_size=32, max_position_len=8)
+        n = 8
+        feats = {"input_ids": np.random.randint(0, 50, (n, 8)),
+                 "attention_mask": np.ones((n, 8), np.int32)}
+        out = clf.predict(feats, batch_size=4)
+        assert np.asarray(out).shape == (n, 2)
+        labels = np.random.randint(0, 2, (n,))
+        clf.train(feats, labels, batch_size=8, epochs=1)
