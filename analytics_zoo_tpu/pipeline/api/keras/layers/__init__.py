@@ -3,7 +3,7 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.core import (
     MaxoutDense, Permute, RepeatVector, Reshape, SparseDense,
 )
 from analytics_zoo_tpu.pipeline.api.keras.layers.embedding import (
-    Embedding, WordEmbedding,
+    Embedding, SparseEmbedding, WordEmbedding,
 )
 from analytics_zoo_tpu.pipeline.api.keras.layers.merge import Merge, merge
 from analytics_zoo_tpu.pipeline.api.keras.layers.normalization import (
@@ -13,9 +13,10 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.recurrent import (
     GRU, LSTM, Bidirectional, SimpleRNN,
 )
 from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (
-    AtrousConvolution2D, Convolution1D, Convolution2D, Convolution3D,
-    Cropping1D, Cropping2D, Cropping3D, Deconvolution2D,
-    SeparableConvolution2D, UpSampling1D, UpSampling2D, UpSampling3D,
+    AtrousConvolution1D, AtrousConvolution2D, Convolution1D,
+    Convolution2D, Convolution3D, Cropping1D, Cropping2D, Cropping3D,
+    Deconvolution2D, SeparableConvolution2D, ShareConvolution2D,
+    UpSampling1D, UpSampling2D, UpSampling3D,
     ZeroPadding1D, ZeroPadding2D, ZeroPadding3D,
 )
 from analytics_zoo_tpu.pipeline.api.keras.layers.pooling import (
@@ -34,13 +35,25 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.noise import (
 from analytics_zoo_tpu.pipeline.api.keras.layers.wrappers import (
     KerasLayerWrapper, TimeDistributed,
 )
-from analytics_zoo_tpu.pipeline.api.keras.layers.convlstm import ConvLSTM2D
+from analytics_zoo_tpu.pipeline.api.keras.layers.convlstm import (
+    ConvLSTM2D, ConvLSTM3D,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.elementwise import (
+    AddConstant, BinaryThreshold, CAdd, CMul, Exp, GaussianSampler,
+    HardShrink, HardTanh, Identity, Log, LRN2D, Mul, MulConstant,
+    Negative, Power, ResizeBilinear, RReLU, Scale, SoftShrink, Sqrt,
+    Square, Threshold, WithinChannelLRN2D,
+)
+from analytics_zoo_tpu.pipeline.api.keras.layers.shape_ops import (
+    Expand, ExpandDim, GetShape, Max, Narrow, Select, SelectTable,
+    SplitTensor, Squeeze,
+)
 from analytics_zoo_tpu.pipeline.api.keras.layers.local import (
     LocallyConnected1D, LocallyConnected2D,
 )
 from analytics_zoo_tpu.pipeline.api.keras.layers.attention import (
     BERT, MultiHeadSelfAttention, PositionwiseFeedForward,
-    transformer_block,
+    TransformerLayer, transformer_block,
 )
 
 # Keras-2 style aliases
@@ -67,7 +80,16 @@ __all__ = [
     "GaussianDropout", "GaussianNoise", "SpatialDropout1D",
     "SpatialDropout2D", "SpatialDropout3D",
     "KerasLayerWrapper", "TimeDistributed",
-    "ConvLSTM2D", "LocallyConnected1D", "LocallyConnected2D",
+    "ConvLSTM2D", "ConvLSTM3D", "LocallyConnected1D",
+    "LocallyConnected2D",
     "BERT", "MultiHeadSelfAttention", "PositionwiseFeedForward",
-    "transformer_block",
+    "TransformerLayer", "transformer_block",
+    "SparseEmbedding", "AtrousConvolution1D", "ShareConvolution2D",
+    "AddConstant", "BinaryThreshold", "CAdd", "CMul", "Exp",
+    "GaussianSampler", "HardShrink", "HardTanh", "Identity", "Log",
+    "LRN2D", "Mul", "MulConstant", "Negative", "Power",
+    "ResizeBilinear", "RReLU", "Scale", "SoftShrink", "Sqrt", "Square",
+    "Threshold", "WithinChannelLRN2D",
+    "Expand", "ExpandDim", "GetShape", "Max", "Narrow", "Select",
+    "SelectTable", "SplitTensor", "Squeeze",
 ]

@@ -261,3 +261,61 @@ class BERT:
                            output_shape=(c["hidden_size"],))(x)
         pooled = Dense(c["hidden_size"], activation="tanh")(first_tok)
         return Model([ids, seg, pos, mask], [seq_output, pooled])
+
+
+class TransformerLayer:
+    """GPT-style decoder stack (pyzoo self_attention.py TransformerLayer
+    :46): inputs [token_ids, position_ids], outputs [last block states,
+    pooled first-token output].  ``bidirectional=False`` applies the
+    causal mask (the reference's tril mask constant).
+
+    As in the reference's default embedding, tokens and positions share
+    ONE ``vocab``-row table: position ids are offset ids in
+    ``[vocab - seq_len, vocab)`` (vocab = n_tokens + n_position_slots),
+    and both lookups go through the same Embedding instance."""
+
+    def __init__(self, n_block: int = 12, hidden_drop: float = 0.1,
+                 attn_drop: float = 0.1, n_head: int = 12,
+                 bidirectional: bool = False,
+                 vocab: int = 40990, seq_len: int = 77,
+                 hidden_size: int = 768, intermediate_size: int = 0):
+        self.cfg = dict(n_block=n_block, hidden_drop=hidden_drop,
+                        attn_drop=attn_drop, n_head=n_head,
+                        bidirectional=bidirectional, vocab=vocab,
+                        seq_len=seq_len, hidden_size=hidden_size,
+                        intermediate_size=intermediate_size or
+                        4 * hidden_size)
+
+    @classmethod
+    def init_with_default_embedding(cls, vocab: int = 40990,
+                                    seq_len: int = 77, n_block: int = 12,
+                                    hidden_drop: float = 0.1,
+                                    attn_drop: float = 0.1,
+                                    n_head: int = 12,
+                                    bidirectional: bool = False,
+                                    hidden_size: int = 768):
+        return cls(n_block=n_block, hidden_drop=hidden_drop,
+                   attn_drop=attn_drop, n_head=n_head,
+                   bidirectional=bidirectional, vocab=vocab,
+                   seq_len=seq_len, hidden_size=hidden_size)
+
+    def build(self) -> Model:
+        c = self.cfg
+        ids = Input(shape=(c["seq_len"],))
+        pos = Input(shape=(c["seq_len"],))
+        from analytics_zoo_tpu.pipeline.api.keras.layers.merge import Merge
+        shared = Embedding(c["vocab"], c["hidden_size"], init="normal")
+        tok_e = shared(ids)
+        pos_e = shared(pos)
+        x = Merge(mode="sum")([tok_e, pos_e])
+        x = Dropout(c["hidden_drop"])(x)
+        for _ in range(c["n_block"]):
+            x = transformer_block(x, None, c["hidden_size"], c["n_head"],
+                                  c["intermediate_size"],
+                                  dropout=c["attn_drop"],
+                                  causal=not c["bidirectional"])
+        from analytics_zoo_tpu.pipeline.api.keras.layers.core import Lambda
+        first_tok = Lambda(lambda t: t[:, 0],
+                           output_shape=(c["hidden_size"],))(x)
+        pooled = Dense(c["hidden_size"], activation="tanh")(first_tok)
+        return Model([ids, pos], [x, pooled])

@@ -415,3 +415,48 @@ class UpSampling3D(Layer):
         dims = tuple(None if s[i + 1] is None else s[i + 1] * self.size[i]
                      for i in range(3))
         return (s[0],) + dims + (s[4],)
+
+
+class AtrousConvolution1D(_ConvND):
+    """Dilated 1D conv (AtrousConvolution1D.scala)."""
+    spatial = 1
+
+    def __init__(self, nb_filter, filter_length, subsample_length=1,
+                 atrous_rate=1, **kwargs):
+        super().__init__(nb_filter, (filter_length,),
+                         strides=(subsample_length,),
+                         dilation=(atrous_rate,), **kwargs)
+
+
+class ShareConvolution2D(_ConvND):
+    """Weight-shared 2D conv (ShareConvolution2D.scala).  Weight sharing
+    across applications is implicit in the functional design (one params
+    pytree, arbitrary applies), so compute-wise this is Convolution2D
+    with explicit (pad_h, pad_w) zero-padding."""
+    spatial = 2
+
+    def __init__(self, nb_filter, nb_row, nb_col, subsample=(1, 1),
+                 pad_h: int = 0, pad_w: int = 0, **kwargs):
+        super().__init__(nb_filter, (nb_row, nb_col), strides=subsample,
+                         **kwargs)
+        self.pad_h = int(pad_h)
+        self.pad_w = int(pad_w)
+
+    def _pad(self, shape_or_x, symbolic):
+        if self.pad_h == 0 and self.pad_w == 0:
+            return shape_or_x
+        if symbolic:
+            b, h, w, c = shape_or_x
+            return (b, None if h is None else h + 2 * self.pad_h,
+                    None if w is None else w + 2 * self.pad_w, c)
+        return jnp.pad(shape_or_x, ((0, 0), (self.pad_h, self.pad_h),
+                                    (self.pad_w, self.pad_w), (0, 0)))
+
+    def _convolve(self, x, kernel):
+        # x arrives channels-last from _ConvND.call
+        return super()._convolve(self._pad(x, symbolic=False), kernel)
+
+    def compute_output_shape(self, input_shape):
+        padded = self._from_tf(
+            self._pad(self._to_tf(input_shape), symbolic=True))
+        return super().compute_output_shape(padded)

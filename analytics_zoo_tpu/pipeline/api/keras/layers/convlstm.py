@@ -1,5 +1,5 @@
-"""ConvLSTM2D (ref: keras/layers/ConvLSTM2D.scala / ConvLSTM3D) —
-convolutional LSTM over (B, T, H, W, C) sequences.
+"""Convolutional LSTMs (ref: keras/layers/ConvLSTM2D.scala,
+ConvLSTM3D.scala) — one shared cell over N-D spatial sequences.
 
 Same scan structure as the dense RNNs: the input convolution for all
 timesteps is hoisted into one big batched conv (fold T into the batch
@@ -15,57 +15,70 @@ from analytics_zoo_tpu.ops import activations as acts
 from analytics_zoo_tpu.ops.dtypes import get_policy
 from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params
 
-
-def _conv(x, w, stride=(1, 1), padding="SAME"):
-    policy = get_policy()
-    return jax.lax.conv_general_dilated(
-        policy.cast_compute(x), policy.cast_compute(w), stride, padding,
-        dimension_numbers=("NHWC", "HWIO", "NHWC")).astype(jnp.float32)
+_CONV_DIMS = {2: ("NHWC", "HWIO", "NHWC"), 3: ("NDHWC", "DHWIO", "NDHWC")}
 
 
-class ConvLSTM2D(Layer):
+class _ConvLSTMND(Layer):
+    """Shared ConvLSTM cell; subclasses set ``spatial`` = 2 or 3.
+    Input is (B, T, *spatial, C); output (B, *spatial, F) or the full
+    sequence with ``return_sequences``."""
+
+    spatial = 2
+
     def __init__(self, nb_filter: int, nb_kernel: int,
                  activation="tanh", inner_activation="sigmoid",
-                 border_mode: str = "same", subsample=(1, 1),
-                 return_sequences: bool = False, go_backwards: bool = False,
-                 **kwargs):
+                 border_mode: str = "same", subsample=1,
+                 return_sequences: bool = False,
+                 go_backwards: bool = False, **kwargs):
         super().__init__(**kwargs)
         self.nb_filter = int(nb_filter)
         self.k = int(nb_kernel)
         self.activation = acts.get(activation) or (lambda v: v)
         self.inner_activation = acts.get(inner_activation) or (lambda v: v)
         assert border_mode == "same", \
-            "ConvLSTM2D supports border_mode='same' (state shapes)"
-        self.subsample = tuple(subsample)
+            f"{type(self).__name__} supports border_mode='same' " \
+            "(state shapes)"
+        if isinstance(subsample, int):
+            subsample = (subsample,) * self.spatial
+        self.subsample = tuple(int(s) for s in subsample)
+        assert len(self.subsample) == self.spatial
         self.return_sequences = return_sequences
         self.go_backwards = go_backwards
+
+    def _conv(self, x, w, stride=None):
+        policy = get_policy()
+        return jax.lax.conv_general_dilated(
+            policy.cast_compute(x), policy.cast_compute(w),
+            stride or (1,) * self.spatial, "SAME",
+            dimension_numbers=_CONV_DIMS[self.spatial]).astype(jnp.float32)
 
     def build(self, rng, input_shape) -> Params:
         c = input_shape[-1]
         f = self.nb_filter
+        kshape = (self.k,) * self.spatial
         params: Params = {}
-        self.add_weight(params, rng, "kernel",
-                        (self.k, self.k, c, 4 * f))
+        self.add_weight(params, rng, "kernel", kshape + (c, 4 * f))
         self.add_weight(params, rng, "recurrent_kernel",
-                        (self.k, self.k, f, 4 * f), init="orthogonal")
+                        kshape + (f, 4 * f), init="orthogonal")
         self.add_weight(params, rng, "bias", (4 * f,), init="zero")
         return params
 
     def call(self, params, x, training=False, rng=None):
-        b, t, h, w, c = x.shape
+        b, t = x.shape[0], x.shape[1]
         f = self.nb_filter
         # all-timestep input conv: fold T into batch
-        flat = x.reshape(b * t, h, w, c)
-        xp = _conv(flat, params["kernel"], self.subsample) + params["bias"]
-        oh, ow = xp.shape[1], xp.shape[2]
-        xp = xp.reshape(b, t, oh, ow, 4 * f)
+        flat = x.reshape((b * t,) + x.shape[2:])
+        xp = self._conv(flat, params["kernel"], self.subsample) \
+            + params["bias"]
+        out_spatial = xp.shape[1:-1]
+        xp = xp.reshape((b, t) + out_spatial + (4 * f,))
         seq = jnp.swapaxes(xp, 0, 1)
         if self.go_backwards:
             seq = seq[::-1]
 
         def step(carry, xt):
             h_prev, c_prev = carry
-            gates = xt + _conv(h_prev, params["recurrent_kernel"])
+            gates = xt + self._conv(h_prev, params["recurrent_kernel"])
             i, fg, g, o = jnp.split(gates, 4, axis=-1)
             i = self.inner_activation(i)
             fg = self.inner_activation(fg)
@@ -76,7 +89,7 @@ class ConvLSTM2D(Layer):
             return (h_new, c_new), \
                 h_new if self.return_sequences else None
 
-        z = jnp.zeros((b, oh, ow, f), jnp.float32)
+        z = jnp.zeros((b,) + out_spatial + (f,), jnp.float32)
         (h_last, _), outs = jax.lax.scan(step, (z, z), seq)
         if self.return_sequences:
             outs = jnp.swapaxes(outs, 0, 1)
@@ -84,8 +97,19 @@ class ConvLSTM2D(Layer):
         return h_last
 
     def compute_output_shape(self, s):
-        sh = None if s[2] is None else -(-s[2] // self.subsample[0])
-        sw = None if s[3] is None else -(-s[3] // self.subsample[1])
+        dims = tuple(None if v is None else -(-v // st)
+                     for v, st in zip(s[2:2 + self.spatial],
+                                      self.subsample))
         if self.return_sequences:
-            return (s[0], s[1], sh, sw, self.nb_filter)
-        return (s[0], sh, sw, self.nb_filter)
+            return (s[0], s[1]) + dims + (self.nb_filter,)
+        return (s[0],) + dims + (self.nb_filter,)
+
+
+class ConvLSTM2D(_ConvLSTMND):
+    """ConvLSTM over (B, T, H, W, C) images (ConvLSTM2D.scala)."""
+    spatial = 2
+
+
+class ConvLSTM3D(_ConvLSTMND):
+    """ConvLSTM over (B, T, D, H, W, C) volumes (ConvLSTM3D.scala)."""
+    spatial = 3

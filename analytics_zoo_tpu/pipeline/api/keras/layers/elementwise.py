@@ -1,0 +1,402 @@
+"""Element-wise, threshold, learnable-scale and normalization layers.
+
+Reference surface: zoo/pipeline/api/keras/layers/{AddConstant, MulConstant,
+Exp, Log, Sqrt, Square, Power, Negative, Identity, Threshold,
+BinaryThreshold, HardShrink, SoftShrink, HardTanh, RReLU, CAdd, CMul, Mul,
+Scale, LRN2D, WithinChannelLRN2D, ResizeBilinear, GaussianSampler}.scala.
+
+TPU notes: every op here is a cheap elementwise/reduction that XLA fuses
+into neighbouring matmuls/convs — implementations stay scalar-free and
+static-shaped so fusion is never blocked.  ``RReLU`` and
+``GaussianSampler`` draw from the layer rng (pure: the key is threaded
+through ``apply``, never stored).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params
+
+
+class _Elementwise(Layer):
+    """Base for parameter-free identity-shaped layers."""
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+
+class AddConstant(_Elementwise):
+    """y = x + constant (AddConstant.scala)."""
+
+    def __init__(self, constant: float, **kwargs):
+        super().__init__(**kwargs)
+        self.constant = float(constant)
+
+    def call(self, params, x, training=False, rng=None):
+        return x + self.constant
+
+
+class MulConstant(_Elementwise):
+    """y = x * constant (MulConstant.scala)."""
+
+    def __init__(self, constant: float, **kwargs):
+        super().__init__(**kwargs)
+        self.constant = float(constant)
+
+    def call(self, params, x, training=False, rng=None):
+        return x * self.constant
+
+
+class Exp(_Elementwise):
+    """y = exp(x) (Exp.scala)."""
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.exp(x)
+
+
+class Log(_Elementwise):
+    """y = log(x) (Log.scala)."""
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.log(x)
+
+
+class Sqrt(_Elementwise):
+    """y = sqrt(x) (Sqrt.scala)."""
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.sqrt(x)
+
+
+class Square(_Elementwise):
+    """y = x^2 (Square.scala)."""
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.square(x)
+
+
+class Power(_Elementwise):
+    """y = (shift + scale * x) ** power (Power.scala)."""
+
+    def __init__(self, power: float, scale: float = 1.0,
+                 shift: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.power = float(power)
+        self.scale = float(scale)
+        self.shift = float(shift)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Negative(_Elementwise):
+    """y = -x (Negative.scala)."""
+
+    def call(self, params, x, training=False, rng=None):
+        return -x
+
+
+class Identity(_Elementwise):
+    """y = x (Identity.scala) — graph plumbing / debugging."""
+
+    def call(self, params, x, training=False, rng=None):
+        return x
+
+
+class Threshold(_Elementwise):
+    """y = x if x > th else v (Threshold.scala)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.th = float(th)
+        self.v = float(v)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.where(x > self.th, x, jnp.asarray(self.v, x.dtype))
+
+
+class BinaryThreshold(_Elementwise):
+    """y = 1 if x > value else 0 (BinaryThreshold.scala)."""
+
+    def __init__(self, value: float = 1e-6, **kwargs):
+        super().__init__(**kwargs)
+        self.value = float(value)
+
+    def call(self, params, x, training=False, rng=None):
+        return (x > self.value).astype(x.dtype)
+
+
+class HardShrink(_Elementwise):
+    """y = x if |x| > value else 0 (HardShrink.scala)."""
+
+    def __init__(self, value: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.value = float(value)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.where(jnp.abs(x) > self.value, x,
+                         jnp.zeros((), x.dtype))
+
+
+class SoftShrink(_Elementwise):
+    """y = sign(x) * max(|x| - value, 0) (SoftShrink.scala)."""
+
+    def __init__(self, value: float = 0.5, **kwargs):
+        super().__init__(**kwargs)
+        self.value = float(value)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.sign(x) * jnp.maximum(jnp.abs(x) - self.value, 0.0)
+
+
+class HardTanh(_Elementwise):
+    """y = clip(x, min_value, max_value) (HardTanh.scala)."""
+
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.min_value = float(min_value)
+        self.max_value = float(max_value)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class RReLU(_Elementwise):
+    """Randomized leaky ReLU (RReLU.scala): negative slopes drawn from
+    U(lower, upper) per element in training, fixed mean slope in eval."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.lower = float(lower)
+        self.upper = float(upper)
+
+    def call(self, params, x, training=False, rng=None):
+        if training and rng is not None:
+            slope = jax.random.uniform(
+                rng, x.shape, x.dtype, self.lower, self.upper)
+        else:
+            slope = jnp.asarray((self.lower + self.upper) / 2, x.dtype)
+        return jnp.where(x >= 0, x, slope * x)
+
+
+class CAdd(_Elementwise):
+    """Learnable per-element bias of broadcastable ``size`` (CAdd.scala).
+    ``size`` includes the batch dim in the reference; use 1 there."""
+
+    def __init__(self, size: Sequence[int], b_regularizer=None, **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(int(s) for s in size)
+        self.b_regularizer = b_regularizer
+
+    def build(self, rng, input_shape) -> Params:
+        params: Params = {}
+        self.add_weight(params, rng, "bias", self.size, init="zero",
+                        regularizer=self.b_regularizer)
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        return x + params["bias"]
+
+
+class CMul(_Elementwise):
+    """Learnable per-element scale of broadcastable ``size`` (CMul.scala)."""
+
+    def __init__(self, size: Sequence[int], W_regularizer=None, **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(int(s) for s in size)
+        self.W_regularizer = W_regularizer
+
+    def build(self, rng, input_shape) -> Params:
+        params: Params = {}
+        self.add_weight(params, rng, "weight", self.size, init="one",
+                        regularizer=self.W_regularizer)
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        return x * params["weight"]
+
+
+class Mul(_Elementwise):
+    """Single learnable scalar multiplier (Mul.scala)."""
+
+    def build(self, rng, input_shape) -> Params:
+        params: Params = {}
+        self.add_weight(params, rng, "weight", (1,), init="one")
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        return x * params["weight"][0]
+
+
+class Scale(_Elementwise):
+    """CMul followed by CAdd with the same ``size`` (Scale.scala)."""
+
+    def __init__(self, size: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.size = tuple(int(s) for s in size)
+
+    def build(self, rng, input_shape) -> Params:
+        params: Params = {}
+        self.add_weight(params, rng, "weight", self.size, init="one")
+        self.add_weight(params, rng, "bias", self.size, init="zero")
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        return x * params["weight"] + params["bias"]
+
+
+def _to_channels_last(x, dim_ordering):
+    if dim_ordering == "th":
+        perm = (0,) + tuple(range(2, x.ndim)) + (1,)
+        return jnp.transpose(x, perm)
+    return x
+
+
+def _from_channels_last(x, dim_ordering):
+    if dim_ordering == "th":
+        perm = (0, x.ndim - 1) + tuple(range(1, x.ndim - 1))
+        return jnp.transpose(x, perm)
+    return x
+
+
+class LRN2D(Layer):
+    """Cross-channel local response normalization (LRN2D.scala):
+    y = x / (k + alpha/n * sum_{local n channels} x^2) ** beta."""
+
+    def __init__(self, alpha: float = 1e-4, k: float = 1.0,
+                 beta: float = 0.75, n: int = 5,
+                 dim_ordering: str = "tf", **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = float(alpha)
+        self.k = float(k)
+        self.beta = float(beta)
+        self.n = int(n)
+        self.dim_ordering = dim_ordering
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def call(self, params, x, training=False, rng=None):
+        y = _to_channels_last(x, self.dim_ordering)
+        sq = jnp.square(y)
+        half = self.n // 2
+        # channel-window moving sum via static shifts (XLA-fusable)
+        acc = sq
+        c = y.shape[-1]
+        for off in range(1, half + 1):
+            pad_lo = [(0, 0)] * (y.ndim - 1) + [(off, 0)]
+            pad_hi = [(0, 0)] * (y.ndim - 1) + [(0, off)]
+            acc = acc + jnp.pad(sq[..., off:], pad_hi)
+            acc = acc + jnp.pad(sq[..., :c - off], pad_lo)
+        denom = jnp.power(self.k + self.alpha / self.n * acc, self.beta)
+        return _from_channels_last(y / denom, self.dim_ordering)
+
+
+class WithinChannelLRN2D(Layer):
+    """Within-channel LRN over a size×size spatial window
+    (WithinChannelLRN2D.scala)."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0,
+                 beta: float = 0.75, **kwargs):
+        super().__init__(**kwargs)
+        self.size = int(size)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape)
+
+    def call(self, params, x, training=False, rng=None):
+        # mean of x^2 over a same-padded spatial window (NHWC); the
+        # alpha/n^2 convention is absorbed by the window mean
+        sq = jnp.square(x)
+        window = (1, self.size, self.size, 1)
+        summed = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add, window, (1, 1, 1, 1), "SAME")
+        counts = jax.lax.reduce_window(
+            jnp.ones_like(sq), 0.0, jax.lax.add, window, (1, 1, 1, 1),
+            "SAME")
+        denom = jnp.power(1.0 + self.alpha * summed / counts, self.beta)
+        return x / denom
+
+
+class ResizeBilinear(Layer):
+    """Bilinear spatial resize to (output_height, output_width)
+    (ResizeBilinear.scala) via ``jax.image.resize``."""
+
+    def __init__(self, output_height: int, output_width: int,
+                 align_corners: bool = False, dim_ordering: str = "tf",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.output_height = int(output_height)
+        self.output_width = int(output_width)
+        self.align_corners = bool(align_corners)
+        self.dim_ordering = dim_ordering
+
+    def compute_output_shape(self, input_shape):
+        b, h, w, c = (input_shape if self.dim_ordering == "tf"
+                      else (input_shape[0], input_shape[2],
+                            input_shape[3], input_shape[1]))
+        out = (b, self.output_height, self.output_width, c)
+        if self.dim_ordering == "th":
+            out = (b, c, self.output_height, self.output_width)
+        return out
+
+    def call(self, params, x, training=False, rng=None):
+        y = _to_channels_last(x, self.dim_ordering)
+        if self.align_corners:
+            y = self._resize_align_corners(y)
+        else:
+            shape = (y.shape[0], self.output_height, self.output_width,
+                     y.shape[3])
+            y = jax.image.resize(y, shape, method="bilinear")
+        return _from_channels_last(y, self.dim_ordering)
+
+    def _resize_align_corners(self, y):
+        """Corner-aligned sampling grid: src = dst * (in-1)/(out-1)."""
+
+        def lerp_axis(arr, axis, out_len):
+            in_len = arr.shape[axis]
+            if out_len == 1 or in_len == 1:
+                idx = jnp.zeros((out_len,), jnp.int32)
+                return jnp.take(arr, idx, axis=axis)
+            src = jnp.linspace(0.0, in_len - 1.0, out_len)
+            lo = jnp.floor(src).astype(jnp.int32)
+            hi = jnp.minimum(lo + 1, in_len - 1)
+            frac = (src - lo).astype(arr.dtype)
+            shape = [1] * arr.ndim
+            shape[axis] = out_len
+            frac = frac.reshape(shape)
+            return (jnp.take(arr, lo, axis=axis) * (1 - frac)
+                    + jnp.take(arr, hi, axis=axis) * frac)
+
+        y = lerp_axis(y, 1, self.output_height)
+        return lerp_axis(y, 2, self.output_width)
+
+
+class GaussianSampler(Layer):
+    """VAE reparameterisation: inputs [mean, log_var] →
+    mean + exp(log_var / 2) * eps (GaussianSampler.scala).  Without an
+    rng the layer returns the mean in eval and refuses to train — a
+    silent fixed key would repeat the same noise every step."""
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[0])
+
+    def call(self, params, inputs, training=False, rng=None):
+        mean, log_var = inputs
+        if rng is None:
+            if training:
+                raise ValueError(
+                    "GaussianSampler needs an rng when training "
+                    "(pass rng= through apply/fit)")
+            return mean
+        eps = jax.random.normal(rng, mean.shape, mean.dtype)
+        return mean + jnp.exp(log_var * 0.5) * eps

@@ -76,3 +76,55 @@ class WordEmbedding(Embedding):
         if not self.trainable:
             emb = jax.lax.stop_gradient(emb)
         return jnp.take(emb, x.astype(jnp.int32), axis=0)
+
+
+class SparseEmbedding(Layer):
+    """Combiner embedding over variable-length id lists
+    (SparseEmbedding.scala, BigDL LookupTableSparse).  TPU-native shape
+    contract: ids are a dense (B, T) int array padded with -1; the
+    combiner ("sum" | "mean" | "sqrtn") reduces the valid rows to
+    (B, D).  The reference's SparseTensor input becomes this static
+    padded-dense form — dynamic shapes would block XLA tiling."""
+
+    def __init__(self, input_dim: int, output_dim: int,
+                 combiner: str = "sum", max_norm: float = -1.0,
+                 init="uniform", W_regularizer=None, **kwargs):
+        super().__init__(**kwargs)
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError("combiner must be sum|mean|sqrtn")
+        self.input_dim = int(input_dim)
+        self.output_dim = int(output_dim)
+        self.combiner = combiner
+        self.max_norm = float(max_norm)
+        self.kernel_init = init
+        self.W_regularizer = W_regularizer
+
+    def build(self, rng, input_shape) -> Params:
+        params: Params = {}
+        self.add_weight(params, rng, "embeddings",
+                        (self.input_dim, self.output_dim),
+                        init=self.kernel_init,
+                        regularizer=self.W_regularizer)
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        ids = x.astype(jnp.int32)
+        valid = (ids >= 0)
+        rows = jnp.take(params["embeddings"], jnp.maximum(ids, 0), axis=0)
+        if self.max_norm > 0:
+            # per looked-up row (TF embedding_lookup semantics) — never
+            # renormalise the whole table on the hot path
+            norms = jnp.linalg.norm(rows, axis=-1, keepdims=True)
+            rows = rows * jnp.minimum(1.0, self.max_norm /
+                                      jnp.maximum(norms, 1e-12))
+        rows = rows * valid[..., None].astype(rows.dtype)
+        out = jnp.sum(rows, axis=-2)
+        count = jnp.maximum(jnp.sum(valid, axis=-1, keepdims=True), 1)
+        if self.combiner == "mean":
+            out = out / count
+        elif self.combiner == "sqrtn":
+            out = out / jnp.sqrt(count.astype(out.dtype))
+        return out
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.output_dim,)

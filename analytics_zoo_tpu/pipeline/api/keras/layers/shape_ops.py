@@ -1,0 +1,219 @@
+"""Shape-manipulation layers.
+
+Reference surface: zoo/pipeline/api/keras/layers/{Select, Narrow, Squeeze,
+ExpandDim, Expand, SplitTensor, SelectTable, Max, GetShape}.scala.
+
+Dims follow the reference's Keras convention: non-negative ``dim``
+indexes exclude the batch dimension (dim 0 = first non-batch axis);
+negative dims count from the end.  All ops are static-shaped slices /
+reshapes — free under XLA fusion on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+
+
+def _axis(dim: int, ndim: int) -> int:
+    """Map a batch-excluded dim to an absolute axis (batch included)."""
+    return dim + 1 if dim >= 0 else dim + ndim
+
+
+class Select(Layer):
+    """Select index ``index`` along ``dim``, dropping that axis
+    (Select.scala)."""
+
+    def __init__(self, dim: int, index: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = int(dim)
+        self.index = int(index)
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        del shape[_axis(self.dim, len(shape))]
+        return tuple(shape)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.take(x, self.index, axis=_axis(self.dim, x.ndim))
+
+
+class Narrow(Layer):
+    """Slice ``[offset, offset+length)`` along ``dim`` (Narrow.scala)."""
+
+    def __init__(self, dim: int, offset: int, length: int = 1, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = int(dim)
+        self.offset = int(offset)
+        self.length = int(length)
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        ax = _axis(self.dim, len(shape))
+        length = self.length
+        if length < 0:  # reference: -1 means "to the end"
+            length = shape[ax] - self.offset + length + 1
+        shape[ax] = length
+        return tuple(shape)
+
+    def call(self, params, x, training=False, rng=None):
+        ax = _axis(self.dim, x.ndim)
+        length = self.length
+        if length < 0:
+            length = x.shape[ax] - self.offset + length + 1
+        idx = [slice(None)] * x.ndim
+        idx[ax] = slice(self.offset, self.offset + length)
+        return x[tuple(idx)]
+
+
+class Squeeze(Layer):
+    """Drop size-1 axes at ``dims`` (Squeeze.scala)."""
+
+    def __init__(self, dims=None, **kwargs):
+        super().__init__(**kwargs)
+        if dims is None:
+            self.dims = None
+        else:
+            if isinstance(dims, (int, np.integer)):
+                dims = [dims]
+            self.dims = tuple(int(d) for d in dims)
+
+    def _axes(self, ndim):
+        if self.dims is None:
+            return None
+        return tuple(sorted(_axis(d, ndim) for d in self.dims))
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        axes = self._axes(len(shape))
+        if axes is None:
+            axes = [i for i in range(1, len(shape)) if shape[i] == 1]
+        for ax in sorted(axes, reverse=True):
+            if shape[ax] != 1:
+                raise ValueError(
+                    f"cannot squeeze axis {ax} of size {shape[ax]}")
+            del shape[ax]
+        return tuple(shape)
+
+    def call(self, params, x, training=False, rng=None):
+        axes = self._axes(x.ndim)
+        if axes is None:
+            axes = tuple(i for i in range(1, x.ndim) if x.shape[i] == 1)
+        return jnp.squeeze(x, axis=axes)
+
+
+class ExpandDim(Layer):
+    """Insert a size-1 axis at ``dim`` (ExpandDim.scala)."""
+
+    def __init__(self, dim: int = 0, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = int(dim)
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        shape.insert(_axis(self.dim, len(shape) + 1), 1)
+        return tuple(shape)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.expand_dims(x, axis=_axis(self.dim, x.ndim + 1))
+
+
+class Expand(Layer):
+    """Broadcast size-1 axes to ``tgt_sizes`` (Expand.scala /
+    InternalExpand).  ``tgt_sizes`` excludes the batch dim; -1 keeps a
+    dim unchanged."""
+
+    def __init__(self, tgt_sizes: Sequence[int], **kwargs):
+        super().__init__(**kwargs)
+        self.tgt_sizes = tuple(int(s) for s in tgt_sizes)
+
+    def _target(self, input_shape):
+        shape = list(input_shape)
+        if len(self.tgt_sizes) != len(shape) - 1:
+            raise ValueError(
+                f"tgt_sizes {self.tgt_sizes} must cover the "
+                f"{len(shape) - 1} non-batch dims")
+        for i, s in enumerate(self.tgt_sizes):
+            if s != -1:
+                shape[i + 1] = s
+        return tuple(shape)
+
+    def compute_output_shape(self, input_shape):
+        return self._target(input_shape)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.broadcast_to(x, self._target(x.shape))
+
+
+class SplitTensor(Layer):
+    """Split into ``num`` equal chunks along ``dimension``, returning a
+    list of tensors (SplitTensor.scala)."""
+
+    def __init__(self, dimension: int, num: int, **kwargs):
+        super().__init__(**kwargs)
+        self.dimension = int(dimension)
+        self.num = int(num)
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        ax = _axis(self.dimension, len(shape))
+        if shape[ax] is not None:
+            if shape[ax] % self.num:
+                raise ValueError(
+                    f"axis size {shape[ax]} not divisible by {self.num}")
+            shape[ax] = shape[ax] // self.num
+        return [tuple(shape) for _ in range(self.num)]
+
+    def call(self, params, x, training=False, rng=None):
+        return list(jnp.split(x, self.num,
+                              axis=_axis(self.dimension, x.ndim)))
+
+
+class SelectTable(Layer):
+    """Pick element ``index`` from a list input (SelectTable.scala)."""
+
+    def __init__(self, index: int, **kwargs):
+        super().__init__(**kwargs)
+        self.index = int(index)
+
+    def compute_output_shape(self, input_shape):
+        return tuple(input_shape[self.index])
+
+    def call(self, params, inputs, training=False, rng=None):
+        return inputs[self.index]
+
+
+class Max(Layer):
+    """Max (or argmax when ``return_value=False``) along ``dim``, the
+    reduced axis kept with size 1 (Max.scala / InternalMax)."""
+
+    def __init__(self, dim: int, return_value: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = int(dim)
+        self.return_value = bool(return_value)
+
+    def compute_output_shape(self, input_shape):
+        shape = list(input_shape)
+        shape[_axis(self.dim, len(shape))] = 1
+        return tuple(shape)
+
+    def call(self, params, x, training=False, rng=None):
+        ax = _axis(self.dim, x.ndim)
+        if self.return_value:
+            return jnp.max(x, axis=ax, keepdims=True)
+        return jnp.argmax(x, axis=ax, keepdims=True).astype(jnp.float32)
+
+
+class GetShape(Layer):
+    """Return the (static) runtime shape as a 1-D tensor of length ndim
+    — batch dim included, no batch axis on the output (GetShape.scala)."""
+
+    def compute_output_shape(self, input_shape):
+        return (len(input_shape),)
+
+    def call(self, params, x, training=False, rng=None):
+        return jnp.asarray(x.shape, jnp.int32)

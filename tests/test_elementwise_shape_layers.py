@@ -1,0 +1,262 @@
+"""Tests for the element-wise / threshold / shape-op layer catalog
+(reference: keras/layers/{AddConstant,...,Squeeze}.scala) plus
+SparseEmbedding, AtrousConvolution1D, ShareConvolution2D, ConvLSTM3D and
+TransformerLayer."""
+
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as L
+
+RNG = jax.random.PRNGKey(0)
+
+
+def run(layer, x, input_shape=None, **kw):
+    shapes = ([a.shape[1:] for a in x] if isinstance(x, list)
+              else x.shape[1:])
+    v = layer.init(RNG, input_shape or shapes)
+    out, _ = layer.apply(v["params"], x, state=v["state"], **kw)
+    return v, out
+
+
+X = np.array([[-2.0, -0.3, 0.0, 0.4, 3.0]], np.float32)
+
+
+class TestElementwise:
+    @pytest.mark.parametrize("layer,fn", [
+        (L.AddConstant(2.5), lambda x: x + 2.5),
+        (L.MulConstant(-2.0), lambda x: x * -2.0),
+        (L.Exp(), np.exp),
+        (L.Square(), np.square),
+        (L.Negative(), lambda x: -x),
+        (L.Identity(), lambda x: x),
+        (L.Power(2.0, scale=3.0, shift=1.0),
+         lambda x: (1.0 + 3.0 * x) ** 2),
+        (L.Threshold(0.2, v=9.0), lambda x: np.where(x > 0.2, x, 9.0)),
+        (L.BinaryThreshold(0.2), lambda x: (x > 0.2).astype(np.float32)),
+        (L.HardShrink(0.35), lambda x: np.where(np.abs(x) > 0.35, x, 0)),
+        (L.SoftShrink(0.35),
+         lambda x: np.sign(x) * np.maximum(np.abs(x) - 0.35, 0)),
+        (L.HardTanh(-1.0, 2.0), lambda x: np.clip(x, -1.0, 2.0)),
+    ])
+    def test_pointwise_semantics(self, layer, fn):
+        _, out = run(layer, X)
+        np.testing.assert_allclose(np.asarray(out), fn(X), rtol=1e-5)
+        assert layer.compute_output_shape((None, 5)) == (None, 5)
+
+    def test_log_sqrt(self):
+        x = np.array([[0.5, 1.0, 4.0]], np.float32)
+        _, out = run(L.Log(), x)
+        np.testing.assert_allclose(np.asarray(out), np.log(x), rtol=1e-5)
+        _, out = run(L.Sqrt(), x)
+        np.testing.assert_allclose(np.asarray(out), np.sqrt(x),
+                                   rtol=1e-5)
+
+    def test_rrelu_eval_and_train(self):
+        layer = L.RReLU(0.1, 0.3)
+        _, out = run(layer, X)   # eval: fixed mean slope 0.2
+        np.testing.assert_allclose(
+            np.asarray(out), np.where(X >= 0, X, 0.2 * X), rtol=1e-5)
+        _, tr = run(layer, X, training=True, rng=jax.random.PRNGKey(1))
+        tr = np.asarray(tr)
+        neg = X < 0
+        slopes = tr[neg] / X[neg]
+        assert np.all(slopes >= 0.1 - 1e-6)
+        assert np.all(slopes <= 0.3 + 1e-6)
+        np.testing.assert_allclose(tr[~neg], X[~neg])
+
+    def test_learnable_scales(self):
+        v, out = run(L.CAdd((1, 5)), X)
+        np.testing.assert_allclose(np.asarray(out), X)  # zero-init bias
+        assert v["params"]["bias"].shape == (1, 5)
+        v, out = run(L.CMul((1, 5)), X)
+        np.testing.assert_allclose(np.asarray(out), X)  # one-init weight
+        v, out = run(L.Mul(), X)
+        np.testing.assert_allclose(np.asarray(out), X)
+        v, out = run(L.Scale((1, 5)), X)
+        np.testing.assert_allclose(np.asarray(out), X)
+        assert set(v["params"]) == {"weight", "bias"}
+
+    def test_lrn2d_matches_manual(self):
+        rs = np.random.RandomState(0)
+        x = rs.rand(2, 4, 4, 7).astype(np.float32)
+        alpha, k, beta, n = 1e-2, 1.5, 0.75, 5
+        _, out = run(L.LRN2D(alpha=alpha, k=k, beta=beta, n=n), x)
+        sq = np.square(x)
+        ref = np.empty_like(x)
+        for c in range(7):
+            lo, hi = max(0, c - n // 2), min(7, c + n // 2 + 1)
+            acc = sq[..., lo:hi].sum(-1)
+            ref[..., c] = x[..., c] / (k + alpha / n * acc) ** beta
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4)
+
+    def test_within_channel_lrn(self):
+        x = np.random.RandomState(0).rand(1, 6, 6, 2).astype(np.float32)
+        _, out = run(L.WithinChannelLRN2D(size=3, alpha=1.0), x)
+        assert out.shape == x.shape
+        assert np.all(np.abs(np.asarray(out)) <= np.abs(x) + 1e-6)
+
+    def test_resize_bilinear(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+        layer = L.ResizeBilinear(8, 2)
+        _, out = run(layer, x)
+        assert out.shape == (1, 8, 2, 1)
+        assert layer.compute_output_shape((None, 4, 4, 1)) == \
+            (None, 8, 2, 1)
+        # channels-first round trip
+        xt = x.transpose(0, 3, 1, 2)
+        layer_th = L.ResizeBilinear(8, 2, dim_ordering="th")
+        _, out_th = run(layer_th, xt)
+        np.testing.assert_allclose(
+            np.asarray(out_th), np.asarray(out).transpose(0, 3, 1, 2),
+            rtol=1e-5)
+
+    def test_resize_bilinear_align_corners(self):
+        x = np.arange(4, dtype=np.float32).reshape(1, 1, 4, 1)
+        _, out = run(L.ResizeBilinear(1, 7, align_corners=True),
+                     np.broadcast_to(x, (1, 1, 4, 1)).copy())
+        # corner-aligned: endpoints exact, midpoints linear
+        expected = np.linspace(0.0, 3.0, 7, dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(out)[0, 0, :, 0], expected,
+                                   rtol=1e-5)
+
+    def test_gaussian_sampler(self):
+        mean = np.full((4, 3), 2.0, np.float32)
+        log_var = np.full((4, 3), -20.0, np.float32)  # ~zero variance
+        layer = L.GaussianSampler()
+        out, _ = layer.apply({}, [mean, log_var],
+                             rng=jax.random.PRNGKey(3))
+        np.testing.assert_allclose(np.asarray(out), mean, atol=1e-3)
+        assert layer.compute_output_shape([(None, 3), (None, 3)]) == \
+            (None, 3)
+
+
+class TestShapeOps:
+    def test_select_narrow(self):
+        x = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        _, out = run(L.Select(0, 1), x)
+        np.testing.assert_allclose(np.asarray(out), x[:, 1])
+        _, out = run(L.Select(1, -1), x)
+        np.testing.assert_allclose(np.asarray(out), x[..., -1])
+        layer = L.Narrow(1, 1, 2)
+        _, out = run(layer, x)
+        np.testing.assert_allclose(np.asarray(out), x[:, :, 1:3])
+        assert layer.compute_output_shape((None, 3, 4)) == (None, 3, 2)
+        # length -1 → to the end
+        _, out = run(L.Narrow(1, 2, -1), x)
+        np.testing.assert_allclose(np.asarray(out), x[:, :, 2:])
+
+    def test_squeeze_expanddim_expand(self):
+        x = np.zeros((2, 1, 3, 1), np.float32)
+        assert run(L.Squeeze(0), x)[1].shape == (2, 3, 1)
+        assert run(L.Squeeze(), x)[1].shape == (2, 3)
+        assert L.Squeeze(0).compute_output_shape((None, 1, 3, 1)) == \
+            (None, 3, 1)
+        y = np.zeros((2, 3), np.float32)
+        assert run(L.ExpandDim(0), y)[1].shape == (2, 1, 3)
+        assert run(L.ExpandDim(1), y)[1].shape == (2, 3, 1)
+        z = np.ones((2, 1, 3), np.float32)
+        out = run(L.Expand((4, -1)), z)[1]
+        assert out.shape == (2, 4, 3)
+
+    def test_split_select_table_max_getshape(self):
+        x = np.arange(12, dtype=np.float32).reshape(1, 2, 6)
+        layer = L.SplitTensor(1, 3)
+        outs = run(layer, x)[1]
+        assert len(outs) == 3 and outs[0].shape == (1, 2, 2)
+        np.testing.assert_allclose(np.asarray(outs[2]), x[..., 4:])
+        assert layer.compute_output_shape((None, 2, 6)) == \
+            [(None, 2, 2)] * 3
+
+        a, b = np.zeros((2, 3), np.float32), np.ones((2, 5), np.float32)
+        sel = L.SelectTable(1)
+        out = sel.apply({}, [a, b])[0]
+        np.testing.assert_allclose(np.asarray(out), b)
+
+        m = L.Max(1)
+        _, out = run(m, x)
+        assert out.shape == (1, 2, 1)
+        np.testing.assert_allclose(np.asarray(out)[..., 0],
+                                   x.max(-1))
+        _, idx = run(L.Max(1, return_value=False), x)
+        np.testing.assert_allclose(np.asarray(idx)[..., 0],
+                                   x.argmax(-1))
+
+        _, shp = run(L.GetShape(), x)
+        np.testing.assert_array_equal(np.asarray(shp), [1, 2, 6])
+
+
+class TestNewParamLayers:
+    def test_sparse_embedding_combiners(self):
+        ids = np.array([[0, 2, -1, -1], [1, 1, 1, -1]], np.int32)
+        layer = L.SparseEmbedding(5, 4, combiner="mean")
+        v = layer.init(RNG, (4,))
+        out, _ = layer.apply(v["params"], ids, state=v["state"])
+        table = np.asarray(v["params"]["embeddings"])
+        np.testing.assert_allclose(
+            np.asarray(out)[0], (table[0] + table[2]) / 2, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(out)[1], table[1],
+                                   rtol=1e-5)
+        assert layer.compute_output_shape((None, 4)) == (None, 4)
+
+    def test_atrous_conv1d(self):
+        x = np.random.RandomState(0).randn(2, 12, 3).astype(np.float32)
+        layer = L.AtrousConvolution1D(5, 3, atrous_rate=2)
+        v, out = run(layer, x)
+        assert out.shape == (2, 8, 5)  # 12 - (3-1)*2 = 8
+        assert layer.compute_output_shape((None, 12, 3)) == (None, 8, 5)
+
+    def test_share_conv2d_padding(self):
+        x = np.random.RandomState(0).randn(1, 6, 6, 2).astype(np.float32)
+        layer = L.ShareConvolution2D(4, 3, 3, pad_h=1, pad_w=1)
+        v, out = run(layer, x)
+        assert out.shape == (1, 6, 6, 4)
+        assert layer.compute_output_shape((None, 6, 6, 2)) == \
+            (None, 6, 6, 4)
+
+    def test_convlstm3d(self):
+        x = np.random.RandomState(0).randn(1, 2, 4, 4, 4, 2).astype(
+            np.float32)
+        layer = L.ConvLSTM3D(3, 3)
+        v, out = run(layer, x)
+        assert out.shape == (1, 4, 4, 4, 3)
+        seq = L.ConvLSTM3D(3, 3, return_sequences=True)
+        _, out2 = run(seq, x)
+        assert out2.shape == (1, 2, 4, 4, 4, 3)
+
+
+class TestTransformerLayer:
+    def test_build_and_forward(self):
+        tl = L.TransformerLayer.init_with_default_embedding(
+            vocab=50, seq_len=8, n_block=2, n_head=2, hidden_size=16)
+        model = tl.build()
+        variables = model.init()
+        ids = np.ones((2, 8), np.int32)
+        # positions are offset ids into the shared table: [vocab-T, vocab)
+        pos = np.tile(np.arange(42, 50, dtype=np.int32), (2, 1))
+        outs, _ = model.apply(variables["params"], [ids, pos], state={},
+                              training=False)
+        states, pooled = outs
+        assert states.shape == (2, 8, 16)
+        assert pooled.shape == (2, 16)
+
+    def test_causal_mask_applied(self):
+        # unidirectional: changing a LATER token must not affect the
+        # first position's hidden state
+        tl = L.TransformerLayer(n_block=1, n_head=2, vocab=50,
+                                seq_len=6, hidden_size=8,
+                                bidirectional=False)
+        model = tl.build()
+        variables = model.init()
+        pos = np.tile(np.arange(44, 50, dtype=np.int32), (1, 1))
+        ids1 = np.array([[1, 2, 3, 4, 5, 6]], np.int32)
+        ids2 = np.array([[1, 2, 3, 4, 5, 7]], np.int32)
+        (s1, _), _ = model.apply(variables["params"], [ids1, pos],
+                                 state={}, training=False)
+        (s2, _), _ = model.apply(variables["params"], [ids2, pos],
+                                 state={}, training=False)
+        np.testing.assert_allclose(np.asarray(s1)[0, 0],
+                                   np.asarray(s2)[0, 0], atol=1e-5)
+        assert not np.allclose(np.asarray(s1)[0, -1],
+                               np.asarray(s2)[0, -1])
