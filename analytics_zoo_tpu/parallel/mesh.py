@@ -117,10 +117,21 @@ def fsdp_shardings(mesh: Mesh, params, min_size: int = 2 ** 12):
     return jax.tree_util.tree_map(leaf_spec, params)
 
 
-def local_batch_size(mesh: Mesh, global_batch: int) -> int:
+def local_batch_size(mesh: Mesh, batch_size: int) -> int:
+    """Per-device rows for ``batch_size``.
+
+    Single-host: ``batch_size`` is the global batch.  Multi-host:
+    ``batch_size`` is the PER-HOST batch (each process contributes its
+    own slice of the global batch), so it must tile this host's share
+    of the data-parallel degree.
+    """
+    import jax
     dp = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
-    if global_batch % dp != 0:
+    nproc = jax.process_count()
+    if nproc > 1 and dp % nproc == 0:
+        dp = dp // nproc
+    if batch_size % dp != 0:
         raise ValueError(
-            f"global batch {global_batch} not divisible by data-parallel "
-            f"degree {dp}")
-    return global_batch // dp
+            f"batch {batch_size} not divisible by data-parallel "
+            f"degree {dp}" + (" (per-host)" if nproc > 1 else ""))
+    return batch_size // dp

@@ -109,8 +109,17 @@ class DistributedTrainer:
         return self._param_shardings
 
     def place_params(self, params):
-        """Copy params onto the mesh per their TP/FSDP shardings."""
+        """Copy params onto the mesh per their TP/FSDP shardings.
+
+        Multi-host: every process holds the full host copy (identical
+        init / restored checkpoint), so each contributes its
+        addressable shards via ``make_array_from_process_local_data``.
+        """
         sh = self.param_shardings(params)
+        if jax.process_count() > 1:
+            return jax.tree_util.tree_map(
+                lambda a, s: jax.make_array_from_process_local_data(
+                    s, np.asarray(a), np.shape(a)), params, sh)
         return jax.tree_util.tree_map(
             lambda a, s: jax.device_put(jnp.array(a, copy=True), s),
             params, sh)
@@ -118,6 +127,11 @@ class DistributedTrainer:
     def place_like(self, host_tree, like_tree):
         """Place host arrays with the shardings of a live device tree
         (checkpoint restore of sharded optimizer state)."""
+        if jax.process_count() > 1:
+            return jax.tree_util.tree_map(
+                lambda a, ref: jax.make_array_from_process_local_data(
+                    ref.sharding, np.asarray(a), np.shape(a)),
+                host_tree, like_tree)
         return jax.tree_util.tree_map(
             lambda a, ref: jax.device_put(jnp.array(a, copy=True),
                                           ref.sharding),
@@ -139,6 +153,9 @@ class DistributedTrainer:
             return self.optim.init(p)
 
         out = jax.jit(init)(params)
+        if jax.process_count() > 1:
+            # multi-host jit outputs are already global arrays
+            return out
         # leaves unrelated to any param (e.g. the step counter) may land
         # on a single device — normalize them onto the mesh
         mesh_devices = set(np.asarray(self.mesh.devices).flat)
@@ -255,8 +272,9 @@ class DistributedTrainer:
         """Place a host batch onto the mesh, sharded on the data axis.
 
         Single-host path: ``device_put`` with NamedSharding.  Multi-host
-        path would use ``jax.make_array_from_process_local_data`` — the
-        per-host FeatureSet shard becomes this host's slice.
+        path: ``jax.make_array_from_process_local_data`` — the per-host
+        FeatureSet shard becomes this host's slice of the global batch
+        (so the effective global batch = per-host batch x processes).
 
         Leaves whose leading dim doesn't tile the data axis (e.g. a
         group-aligned ranking-eval batch) are replicated instead — same
@@ -264,10 +282,36 @@ class DistributedTrainer:
         """
         dp = self.mesh.shape[mesh_lib.DATA_AXIS] * \
             self.mesh.shape[mesh_lib.FSDP_AXIS]
+        nproc = jax.process_count()
+        # data axes spread across processes only when they divide evenly;
+        # otherwise (e.g. pure model-parallel, dp=1 over 2 hosts) every
+        # host must feed the IDENTICAL batch, which is replicated below.
+        data_split_across_hosts = nproc > 1 and dp % nproc == 0 and \
+            dp >= nproc
+        local_dp = dp // nproc if data_split_across_hosts else dp
 
         def put(a):
             if a is None:
                 return None
+            if nproc > 1:
+                a = np.asarray(a)
+                if a.ndim == 0 or not data_split_across_hosts:
+                    # replica semantics: hosts must pass identical data
+                    # (make_array_from_process_local_data requires it
+                    # when global_shape == local shape)
+                    return jax.make_array_from_process_local_data(
+                        self._rep, a, a.shape)
+                if a.shape[0] % local_dp != 0:
+                    # replicating per-host-DIFFERENT rows would silently
+                    # disagree across processes, and mixing global dims
+                    # within one batch breaks the jitted step — refuse.
+                    raise ValueError(
+                        f"multi-host batch dim {a.shape[0]} must tile "
+                        f"this host's data-parallel share {local_dp}")
+                # this process's rows are one slice of the global batch
+                return jax.make_array_from_process_local_data(
+                    mesh_lib.data_sharding(self.mesh, a.ndim), a,
+                    (a.shape[0] * nproc,) + a.shape[1:])
             if np.ndim(a) == 0 or np.shape(a)[0] % dp != 0:
                 return jax.device_put(a, self._rep)
             return jax.device_put(
@@ -281,6 +325,10 @@ class DistributedTrainer:
         step donates its inputs, and ``device_put`` may alias an
         already-device-resident array — donating an alias would delete
         the caller's buffer."""
+        if jax.process_count() > 1:
+            return jax.tree_util.tree_map(
+                lambda a: jax.make_array_from_process_local_data(
+                    self._rep, np.asarray(a), np.shape(a)), tree)
         return jax.tree_util.tree_map(
             lambda a: jax.device_put(jnp.array(a, copy=True), self._rep),
             tree)

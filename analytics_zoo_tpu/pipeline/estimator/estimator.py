@@ -32,6 +32,32 @@ from analytics_zoo_tpu.utils.summary import TrainSummary, ValidationSummary
 log = logging.getLogger("analytics_zoo_tpu.estimator")
 
 
+def predict_in_batches(run_batch, x, batch_size: int):
+    """Fixed-shape batched prediction: zero-pad the tail batch so one
+    compiled program serves every batch, slice the padding back off,
+    and concatenate on host.  Shared by Estimator and LocalEstimator."""
+    import math
+    leaves = jax.tree_util.tree_leaves(x)
+    n = len(leaves[0]) if leaves else 0
+    if n == 0:
+        raise ValueError("predict called with an empty input")
+    outs = []
+    for b in range(math.ceil(n / batch_size)):
+        lo, hi = b * batch_size, min((b + 1) * batch_size, n)
+        xb = jax.tree_util.tree_map(lambda a: a[lo:hi], x)
+        real = hi - lo
+        if real < batch_size:   # pad to keep one compiled shape
+            xb = jax.tree_util.tree_map(
+                lambda a: np.concatenate(
+                    [a, np.zeros((batch_size - real,) + a.shape[1:],
+                                 a.dtype)]), xb)
+        out = run_batch(xb)
+        out = jax.tree_util.tree_map(lambda o: o[:real], out)
+        outs.append(jax.device_get(out))
+    return jax.tree_util.tree_map(
+        lambda *parts: np.concatenate(parts), *outs)
+
+
 class Estimator:
     def __init__(self, model, optim_method=None,
                  optim_methods: Optional[Dict] = None,
@@ -258,29 +284,11 @@ class Estimator:
 
     # -------------------------------------------------------------- predict
     def predict(self, x, batch_size: int = 256):
-        import math
         trainer = self._infer_trainer()
         variables = self.model.get_variables()
         params = trainer.place_params(variables["params"])
         state = trainer.replicate(variables["state"])
         fn = trainer.predict_fn()
-
-        leaves = jax.tree_util.tree_leaves(x)
-        n = len(leaves[0])
-        outs = []
-        nb = math.ceil(n / batch_size)
-        for b in range(nb):
-            lo, hi = b * batch_size, min((b + 1) * batch_size, n)
-            xb = jax.tree_util.tree_map(lambda a: a[lo:hi], x)
-            real = hi - lo
-            if real < batch_size:   # pad to keep one compiled shape
-                xb = jax.tree_util.tree_map(
-                    lambda a: np.concatenate(
-                        [a, np.zeros((batch_size - real,) + a.shape[1:],
-                                     a.dtype)]), xb)
-            xb = trainer.put_batch(xb)
-            out = fn(params, state, xb)
-            out = jax.tree_util.tree_map(lambda o: o[:real], out)
-            outs.append(jax.device_get(out))
-        return jax.tree_util.tree_map(
-            lambda *parts: np.concatenate(parts), *outs)
+        return predict_in_batches(
+            lambda xb: fn(params, state, trainer.put_batch(xb)),
+            x, batch_size)

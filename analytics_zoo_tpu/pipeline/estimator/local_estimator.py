@@ -1,0 +1,154 @@
+"""LocalEstimator — pure-local trainer with no distributed machinery.
+
+Reference: ``LocalEstimator`` (zoo/pipeline/estimator/LocalEstimator.scala:39-71)
+trains on one node without Spark: per-thread model replicas, parallel
+gradient reduce, array-based ``fit(trainData, ..., batchSize, epochs)``.
+
+TPU version: the "per-core thread replicas" role is played by a single
+jit-compiled step on the local device — XLA already saturates the chip's
+compute units, so host-side replica threads would only add overhead.  No
+mesh, no triggers, no checkpoints: just epochs over shuffled batches,
+which makes this the lightest-weight entry point (the analogue of the
+reference's localEstimator examples, e.g. LenetLocalEstimator.scala).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+log = logging.getLogger("analytics_zoo_tpu.local_estimator")
+
+
+class LocalEstimator:
+    """Train/evaluate/predict a Keras-API model on the local device.
+
+    ``model`` may be compiled or not; ``criterion``/``optim_method``
+    accept the same string or object forms as ``KerasNet.compile``.
+    """
+
+    def __init__(self, model, criterion, optim_method,
+                 metrics: Optional[Sequence] = None):
+        from analytics_zoo_tpu.pipeline.api.keras import (
+            metrics as met, objectives, optimizers as opt)
+        self.model = model
+        self.loss_fn = objectives.get(criterion)
+        self.optim = opt.get(optim_method)
+        self.metrics = [met.get(m) for m in (metrics or [])]
+        self.history: List[Dict] = []
+        self._step = None
+        self._eval_step = None
+        self._predict_step = None
+
+    # ------------------------------------------------------------- compile
+    def _build_step(self):
+        model, loss_fn, optim = self.model, self.loss_fn, self.optim
+
+        def step(params, opt_state, state, x, y, rng):
+            def objective(p):
+                out, new_state = model.apply(p, x, state=state,
+                                             training=True, rng=rng)
+                loss = loss_fn(y, out)
+                return loss + model.regularization_loss(p), (new_state, loss)
+
+            grads, (new_state, loss) = jax.grad(
+                objective, has_aux=True)(params)
+            import optax
+            updates, new_opt_state = optim.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), new_opt_state,
+                    new_state, loss)
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    # ----------------------------------------------------------------- fit
+    def fit(self, x, y, validation_data=None, batch_size: int = 32,
+            epochs: int = 1, rng=None):
+        from analytics_zoo_tpu.feature.feature_set import FeatureSet
+        data = x if isinstance(x, FeatureSet) \
+            else FeatureSet.from_ndarrays(x, y)
+        if data.size < batch_size:
+            raise ValueError(
+                f"batch_size {batch_size} exceeds dataset size {data.size}")
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        variables = self.model.get_variables()
+        params = variables["params"]
+        state = variables["state"]
+        opt_state = jax.jit(self.optim.init)(params)
+        if self._step is None:
+            self._step = self._build_step()
+
+        it = 0
+        validate = validation_data is not None and self.metrics
+
+        def sync_to_host():
+            self.model.set_variables({"params": jax.device_get(params),
+                                      "state": jax.device_get(state)})
+
+        for epoch in range(epochs):
+            t0 = time.time()
+            seen = 0
+            loss = None
+            for bx, by in data.epoch_batches(epoch, batch_size, train=True):
+                params, opt_state, state, loss = self._step(
+                    params, opt_state, state, bx, by,
+                    jax.random.fold_in(rng, it))
+                it += 1
+                seen += batch_size
+            wall = time.time() - t0
+            record = {"epoch": epoch + 1, "loss": float(loss),
+                      "throughput": seen / max(wall, 1e-9)}
+            if validate:   # evaluate() reads the host-side variables
+                sync_to_host()
+                record["val"] = self.evaluate(
+                    *validation_data, batch_size=batch_size)
+            self.history.append(record)
+            log.info("epoch %d loss %.4f%s (%.1f samples/s)",
+                     epoch + 1, record["loss"],
+                     f" val {record['val']}" if "val" in record else "",
+                     record["throughput"])
+        if not validate:
+            sync_to_host()
+        return self
+
+    # ------------------------------------------------------------ evaluate
+    def evaluate(self, x, y, batch_size: int = 32) -> Dict[str, float]:
+        from analytics_zoo_tpu.feature.feature_set import FeatureSet
+        data = x if isinstance(x, FeatureSet) \
+            else FeatureSet.from_ndarrays(x, y)
+        model, metrics = self.model, self.metrics
+        if self._eval_step is None:
+            def step(params, state, bx, by, mask):
+                out, _ = model.apply(params, bx, state=state, training=False)
+                return tuple(m.batch_update(by, out, mask) for m in metrics)
+            self._eval_step = jax.jit(step)
+
+        variables = self.model.get_variables()
+        partials = None
+        for bx, by, mask in data.epoch_batches(0, batch_size, train=False):
+            upd = self._eval_step(variables["params"], variables["state"],
+                                  bx, by, mask)
+            partials = list(upd) if partials is None else [
+                m.merge(a, b) for m, a, b in zip(metrics, partials, upd)]
+        return {m.name: m.finalize(p)
+                for m, p in zip(metrics, partials or [])}
+
+    # ------------------------------------------------------------- predict
+    def predict(self, x, batch_size: int = 256):
+        from analytics_zoo_tpu.pipeline.estimator.estimator import (
+            predict_in_batches)
+        model = self.model
+        if self._predict_step is None:
+            def step(params, state, bx):
+                out, _ = model.apply(params, bx, state=state, training=False)
+                return out
+            self._predict_step = jax.jit(step)
+        variables = self.model.get_variables()
+        return predict_in_batches(
+            lambda xb: self._predict_step(variables["params"],
+                                          variables["state"], xb),
+            x, batch_size)

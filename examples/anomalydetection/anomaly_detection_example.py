@@ -1,0 +1,59 @@
+"""LSTM time-series anomaly detection (reference
+examples/anomalydetection + models/anomalydetection/
+AnomalyDetector.scala:40-222): train on a periodic signal with injected
+spikes, predict, flag the largest reconstruction errors."""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--length", type=int, default=4000)
+    p.add_argument("--unroll", type=int, default=24)
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.length, args.unroll, args.epochs = 600, 10, 2
+
+    from analytics_zoo_tpu.models.anomalydetection import (
+        AnomalyDetector, detect_anomalies, unroll)
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    rs = np.random.RandomState(0)
+    t = np.arange(args.length, dtype=np.float32)
+    series = np.sin(0.1 * t) + 0.05 * rs.randn(args.length)
+    true_anomalies = rs.choice(args.length, 5, replace=False)
+    series[true_anomalies] += 4.0   # injected spikes
+
+    x, y = unroll(series, args.unroll)
+    split = int(len(x) * 0.8)
+    model = AnomalyDetector(feature_shape=(args.unroll, 1),
+                            hidden_layers=(32, 16), dropouts=(0.1, 0.1))
+    model.compile(optimizer=Adam(lr=0.01), loss="mse")
+    model.fit(x[:split], y[:split], batch_size=128,
+              nb_epoch=args.epochs)
+
+    y_pred = model.predict(x, batch_size=512)
+    flagged = detect_anomalies(y, y_pred, anomaly_size=5)
+    # window i predicts series index i + unroll
+    flagged_series_idx = set(int(i) + args.unroll for i in flagged)
+    hits = flagged_series_idx & set(int(i) for i in true_anomalies)
+    print(f"flagged {sorted(flagged_series_idx)}; "
+          f"true {sorted(int(i) for i in true_anomalies)}; "
+          f"recovered {len(hits)}/5")
+    return flagged
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Transformer/BERT sequence classification (reference pyzoo
+examples/attention + keras/layers/BERT.scala:66): build a small BERT
+encoder, pool the [CLS] position, and train a classifier head."""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--seq-len", type=int, default=32)
+    p.add_argument("--vocab", type=int, default=500)
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    n = 256 if args.smoke else 2048
+    if args.smoke:
+        args.epochs, args.seq_len = 2, 12
+
+    from analytics_zoo_tpu.pipeline.api.keras import Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.layers.attention import BERT
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+        AdamWeightDecay)
+
+    # task: does token 7 appear in the first half of the sequence?
+    rs = np.random.RandomState(0)
+    ids = rs.randint(8, args.vocab, (n, args.seq_len)).astype(np.int32)
+    y = rs.randint(0, 2, n)
+    half = args.seq_len // 2
+    for i in range(n):
+        if y[i]:
+            ids[i, rs.randint(0, half)] = 7
+    seg = np.zeros_like(ids)
+    pos = np.tile(np.arange(args.seq_len), (n, 1)).astype(np.int32)
+    mask = np.ones((n, args.seq_len), np.float32)
+
+    # extend the BERT graph: classifier head on the pooled output
+    encoder = BERT(vocab=args.vocab, hidden_size=64, n_block=2, n_head=4,
+                   seq_len=args.seq_len, intermediate_size=128,
+                   max_position_len=args.seq_len).build()
+    pooled = encoder.outputs[1]
+    out = Dense(2)(pooled)
+    model = Model(encoder.inputs, out)
+
+    steps = (n // 64) * args.epochs
+    model.compile(
+        optimizer=AdamWeightDecay(lr=5e-4, warmup_portion=0.1,
+                                  total=steps),
+        loss="sparse_categorical_crossentropy_with_logits",
+        metrics=["accuracy"])
+    model.fit([ids, seg, pos, mask], y.reshape(-1, 1), batch_size=64,
+              nb_epoch=args.epochs)
+    scores = model.evaluate([ids, seg, pos, mask], y.reshape(-1, 1),
+                            batch_size=64)
+    print("eval:", scores)
+    return scores
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""Autograd Variable algebra + CustomLoss (reference pyzoo
+examples/autograd/custom.py + pipeline/api/autograd/math.scala:32-378):
+define a loss as a Variable expression and train with it."""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=30)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.epochs = 3
+
+    import analytics_zoo_tpu.pipeline.api.autograd as A
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    # huber-ish loss written as a Variable expression
+    def custom_loss(y_true, y_pred):
+        err = A.abs(y_true - y_pred)
+        return A.mean(A.minimum(A.square(err), err), axis=1)
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(512, 4).astype(np.float32)
+    y = (x @ rs.randn(4, 1)).astype(np.float32)
+
+    model = Sequential()
+    model.add(Dense(8, activation="relu", input_shape=(4,)))
+    model.add(Dense(1))
+    model.compile(optimizer=Adam(lr=0.02),
+                  loss=A.CustomLoss(custom_loss, y_pred_shape=(1,)))
+    hist = model.fit(x, y, batch_size=64, nb_epoch=args.epochs)
+    print(f"loss {hist[0]['loss']:.4f} -> {hist[-1]['loss']:.4f}")
+    return hist
+
+
+if __name__ == "__main__":
+    main()

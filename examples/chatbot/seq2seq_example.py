@@ -1,0 +1,65 @@
+"""Chatbot-style seq2seq training (reference examples/chatbot +
+models/seq2seq/Seq2seq.scala:50): encoder/decoder GRU over a toy
+reversal dialogue task, then greedy inference via the jitted
+``infer`` scan loop."""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+START, STOP = 1, 2
+
+
+def _dialogue_data(n, t, vocab, seed=0):
+    """'Reply' = reversed prompt — structured enough to learn, and
+    inference quality is directly checkable."""
+    rs = np.random.RandomState(seed)
+    src = rs.randint(3, vocab, (n, t)).astype(np.int32)
+    tgt = src[:, ::-1].copy()
+    dec_in = np.concatenate(
+        [np.full((n, 1), START, np.int32), tgt[:, :-1]], axis=1)
+    return src, dec_in, tgt
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--vocab", type=int, default=40)
+    p.add_argument("--seq-len", type=int, default=8)
+    p.add_argument("--epochs", type=int, default=30)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    n = 512 if args.smoke else 4096
+    if args.smoke:
+        args.epochs, args.seq_len = 3, 5
+
+    from analytics_zoo_tpu.models.seq2seq import Seq2seq
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    src, dec_in, tgt = _dialogue_data(n, args.seq_len, args.vocab)
+    model = Seq2seq(vocab_size=args.vocab, embed_dim=48,
+                    hidden_sizes=(96,), bridge="pass")
+    model.compile(optimizer=Adam(lr=0.01),
+                  loss="sparse_categorical_crossentropy_with_logits")
+    hist = model.fit([src, dec_in], tgt[..., None], batch_size=128,
+                     nb_epoch=args.epochs)
+
+    out = model.infer(src[:4], start_sign=START,
+                      max_seq_len=args.seq_len, stop_sign=STOP)
+    acc = float((out == tgt[:4]).mean())
+    print(f"final loss {hist[-1]['loss']:.3f}; "
+          f"greedy-decode token accuracy on 4 prompts: {acc:.2f}")
+    for i in range(2):
+        print(f"  prompt {src[i].tolist()} -> reply {out[i].tolist()}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

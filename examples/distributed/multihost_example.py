@@ -1,0 +1,82 @@
+"""Multi-host distributed training via the ZooCluster launcher
+(reference RayOnSpark raycontext.py:54 — there a Spark barrier stage
+bootstraps the cluster; here the launcher spawns jax.distributed
+workers and guards them with PDEATHSIG, the JVMGuard role).
+
+Run with no env: spawns ``--workers`` local processes that form a
+jax.distributed job (each simulating one host with CPU devices) and
+train data-parallel NCF.  On a real TPU pod, run this script once per
+host with ZOO_TPU_* env set (or under the pod runtime, which sets it).
+"""
+
+import argparse
+import os
+import sys
+
+# runnable both as `python -m examples...` and as a bare script in the
+# spawned workers, where sys.path[0] is this file's directory
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def worker():
+    """Executed in each spawned process."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from analytics_zoo_tpu.common import zoo_context
+    from analytics_zoo_tpu.feature.datasets import movielens
+    from analytics_zoo_tpu.feature.feature_set import FeatureSet
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    from analytics_zoo_tpu.pipeline.estimator import Estimator
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    ctx = zoo_context.init_zoo_context()
+    users, items = 500, 200
+    ratings = movielens.synthetic_ratings(users, items, 20000)
+    tx, ty, _, _ = movielens.build_ncf_samples(ratings, users, items)
+    # per-host shard (the per-partition FeatureSet role)
+    pid = ctx.process_index
+    n = ctx.process_count
+    tx = [a[pid::n] for a in tx]
+    ty = ty[pid::n]
+
+    model = NeuralCF(user_count=users, item_count=items, class_num=2,
+                     user_embed=16, item_embed=16, mf_embed=16,
+                     hidden_layers=(32, 16))
+    model.compile(optimizer=Adam(lr=1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits")
+    est = Estimator(model.model, optim_method=model.model.optim_method)
+    est.train(FeatureSet.from_ndarrays(tx, ty),
+              "sparse_categorical_crossentropy_with_logits",
+              batch_size=512)
+    if pid == 0:
+        print(f"[worker 0] trained on {n} hosts; "
+              f"final loss {est.train_state.last_loss:.4f}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+
+    if os.environ.get("ZOO_TPU_NUM_PROCESSES"):
+        worker()
+        return 0
+
+    from analytics_zoo_tpu.parallel.launcher import ZooCluster
+    cluster = ZooCluster(num_processes=args.workers)
+    cluster.start(os.path.abspath(__file__))
+    codes = cluster.wait(timeout=600)
+    print("exit codes:", codes)
+    assert all(c == 0 for c in codes), codes
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

@@ -1,0 +1,79 @@
+"""Inception-v1 ImageNet training recipe (reference
+examples/inception/Train.scala:31,75-99): SGD momentum 0.9, linear
+warmup then polynomial (power 0.5) decay, label smoothing omitted as in
+the reference, checkpoint per epoch.
+
+Runs on a synthetic ImageNet-shaped dataset by default (``--data-dir``
+accepts a .npy directory laid out for FeatureSet.from_npy_dir).
+"""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--classes", type=int, default=1000)
+    p.add_argument("--batch-size", type=int, default=256)
+    p.add_argument("--max-iteration", type=int, default=62000)
+    p.add_argument("--warmup-iteration", type=int, default=200)
+    p.add_argument("--learning-rate", type=float, default=0.0898)
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.image_size, args.classes = 32, 10
+        args.batch_size, args.max_iteration = 32, 6
+        args.warmup_iteration = 2
+
+    import numpy as np
+
+    from analytics_zoo_tpu.common.triggers import EveryEpoch, MaxIteration
+    from analytics_zoo_tpu.feature.feature_set import FeatureSet
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        inception_v1)
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+        SGD, poly, warmup_then)
+    from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+    if args.data_dir:
+        train_set = FeatureSet.from_npy_dir(args.data_dir)
+    else:
+        n = max(args.batch_size * 4, 128)
+        rs = np.random.RandomState(0)
+        x = rs.rand(n, args.image_size, args.image_size, 3) \
+            .astype(np.float32)
+        y = rs.randint(0, args.classes, (n, 1))
+        train_set = FeatureSet.from_ndarrays(x, y)
+
+    model = inception_v1(num_classes=args.classes,
+                         input_shape=(args.image_size, args.image_size, 3))
+    # Train.scala:75-99 — warmup to lr, then poly(0.5) to maxIteration
+    schedule = warmup_then(
+        args.learning_rate, args.warmup_iteration,
+        poly(args.learning_rate, power=0.5,
+             max_iteration=args.max_iteration - args.warmup_iteration))
+    optim = SGD(momentum=0.9, schedule=schedule)
+
+    est = Estimator(model, optim_method=optim, model_dir=args.checkpoint)
+    est.train(train_set, "sparse_categorical_crossentropy_with_logits",
+              end_trigger=MaxIteration(args.max_iteration),
+              checkpoint_trigger=EveryEpoch(),
+              batch_size=args.batch_size)
+    print("history:", est.history[-1] if est.history
+          else {"iterations": est.train_state.iteration,
+                "loss": est.train_state.last_loss})
+    return est
+
+
+if __name__ == "__main__":
+    main()

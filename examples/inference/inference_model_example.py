@@ -1,0 +1,54 @@
+"""Concurrent multi-backend inference (reference
+pipeline/inference/InferenceModel.scala:30 + vnni int8 examples):
+load a zoo model into InferenceModel, run concurrent predicts, and
+compare the int8 weight-only-quantized path (the OpenVINO-int8 role)
+against float32."""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+import concurrent.futures
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu.models.image.imageclassification import lenet
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+
+    model = lenet(num_classes=10)
+    model.init()
+
+    im = InferenceModel(supported_concurrent_num=args.concurrency)
+    im.load_zoo(model)
+
+    rs = np.random.RandomState(0)
+    batches = [rs.rand(16, 28, 28, 1).astype(np.float32)
+               for _ in range(args.concurrency * 2)]
+    with concurrent.futures.ThreadPoolExecutor(args.concurrency) as ex:
+        outs = list(ex.map(lambda b: im.predict(b, batch_size=16),
+                           batches))
+    print(f"{len(outs)} concurrent batches -> {outs[0].shape}")
+
+    # int8 weight-only quantization (the OpenVINO calibration role)
+    q = InferenceModel().load_zoo(model, quantize=True)
+    f32 = im.predict(batches[0], batch_size=16)
+    i8 = q.predict(batches[0], batch_size=16)
+    rel = np.abs(i8 - f32).max() / (np.abs(f32).max() + 1e-9)
+    print(f"int8 vs f32 max relative error: {rel:.4f}")
+    return rel
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,51 @@
+"""LeNet on MNIST-shaped data via LocalEstimator (reference
+examples/localEstimator/LenetLocalEstimator.scala — pure-local training
+with no cluster machinery)."""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    n = 1024 if args.smoke else 8192
+    if args.smoke:
+        args.epochs = 1
+
+    from analytics_zoo_tpu.models.image.imageclassification import lenet
+    from analytics_zoo_tpu.pipeline.estimator import LocalEstimator
+
+    # synthetic MNIST: class = quadrant with the brightest blob
+    rs = np.random.RandomState(0)
+    x = rs.rand(n, 28, 28, 1).astype(np.float32) * 0.1
+    y = rs.randint(0, 4, n)
+    for i in range(n):
+        r, c = divmod(int(y[i]), 2)
+        x[i, r * 14:(r + 1) * 14, c * 14:(c + 1) * 14] += 0.8
+
+    model = lenet(num_classes=4)
+    est = LocalEstimator(model,
+                         "sparse_categorical_crossentropy_with_logits",
+                         "adam", metrics=["accuracy"])
+    est.fit(x, y.reshape(-1, 1), validation_data=(x, y.reshape(-1, 1)),
+            batch_size=args.batch_size, epochs=args.epochs)
+    scores = est.evaluate(x, y.reshape(-1, 1), batch_size=args.batch_size)
+    print("eval:", scores)
+    return scores
+
+
+if __name__ == "__main__":
+    main()

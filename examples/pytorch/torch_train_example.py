@@ -1,0 +1,53 @@
+"""PyTorch-model training inside the zoo engine (reference pyzoo
+examples/pytorch/train + TorchNet.scala:40): convert an nn.Module to a
+zoo layer with ``TorchNet.from_pytorch`` and train it on TPU —
+beyond the reference, the converted model is differentiable end-to-end
+(no JVM↔libtorch weight copies per step)."""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.epochs = 2
+
+    import torch.nn as nn
+
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.net import TorchNet
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    torch_model = nn.Sequential(
+        nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+
+    model = Sequential()
+    model.add(TorchNet.from_pytorch(torch_model, input_shape=(8,)))
+    model.compile(optimizer=Adam(lr=1e-2),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(1024, 8).astype(np.float32)
+    y = (x.sum(1) > 0).astype(np.int32).reshape(-1, 1)
+    model.fit(x, y, batch_size=128, nb_epoch=args.epochs)
+    scores = model.evaluate(x, y, batch_size=256)
+    print("eval:", scores)
+    return scores
+
+
+if __name__ == "__main__":
+    main()

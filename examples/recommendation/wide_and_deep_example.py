@@ -1,0 +1,63 @@
+"""Wide&Deep on a Census-style tabular dataset (reference
+examples/recommendation WideAndDeepExample + models/recommendation/
+WideAndDeep.scala:101, feature engineering Utils.scala:325)."""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=50000)
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=1024)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.rows, args.epochs, args.batch_size = 2000, 1, 256
+
+    from analytics_zoo_tpu.models.recommendation import (
+        ColumnFeatureInfo, WideAndDeep)
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    info = ColumnFeatureInfo(
+        wide_base_cols=["gender", "age_bucket"], wide_base_dims=[3, 10],
+        wide_cross_cols=["gender_age"], wide_cross_dims=[30],
+        embed_cols=["occupation"], embed_in_dims=[21], embed_out_dims=[8],
+        continuous_cols=["hours_per_week"])
+
+    rs = np.random.RandomState(0)
+    n = args.rows
+    gender = rs.randint(0, 3, n)
+    age = rs.randint(0, 10, n)
+    occupation = rs.randint(0, 21, n)
+    hours = rs.rand(n).astype(np.float32)
+    cols = {"gender": gender, "age_bucket": age,
+            "gender_age": gender * 10 + age, "occupation": occupation,
+            "hours_per_week": hours}
+    # synthetic target correlated with several columns
+    logit = (gender - 1) * 0.8 + (age - 5) * 0.2 + hours
+    y = (logit + 0.3 * rs.randn(n) > 0).astype(np.int32).reshape(-1, 1)
+
+    model = WideAndDeep(2, info, model_type="wide_n_deep")
+    x = model.features_from_columns(cols)
+    model.compile(optimizer=Adam(lr=1e-2),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=args.batch_size, nb_epoch=args.epochs)
+    scores = model.evaluate(x, y, batch_size=args.batch_size)
+    print("eval:", scores)
+    return scores
+
+
+if __name__ == "__main__":
+    main()

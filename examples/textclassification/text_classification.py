@@ -1,0 +1,97 @@
+"""Text classification through the TextSet pipeline (reference
+examples/textclassification + models/textclassification/
+TextClassifier.scala:34): tokenize → normalize → word2idx →
+shape_sequence → train a CNN classifier.
+
+Reads a news20-style directory (``--data-dir`` with one subdir per
+class, one file per doc) or synthesizes a 3-class corpus.
+"""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def _synthetic_corpus(n_per_class=300, seed=0):
+    rs = np.random.RandomState(seed)
+    themes = [["game", "team", "score", "season", "coach", "play"],
+              ["space", "orbit", "nasa", "launch", "moon", "rocket"],
+              ["disk", "driver", "windows", "memory", "video", "card"]]
+    common = ["the", "a", "of", "to", "and", "in", "it", "is"]
+    texts, labels = [], []
+    for label, theme in enumerate(themes):
+        for _ in range(n_per_class):
+            words = rs.choice(theme, 8).tolist() + \
+                rs.choice(common, 12).tolist()
+            rs.shuffle(words)
+            texts.append(" ".join(words))
+            labels.append(label)
+    return texts, labels
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--sequence-length", type=int, default=100)
+    p.add_argument("--max-words", type=int, default=5000)
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--encoder", default="cnn",
+                   choices=["cnn", "lstm", "gru"])
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.epochs, args.sequence_length = 2, 30
+
+    from analytics_zoo_tpu.feature.text import TextSet
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    if args.data_dir:
+        texts, labels = [], []
+        classes = sorted(os.listdir(args.data_dir))
+        for li, cls in enumerate(classes):
+            cdir = os.path.join(args.data_dir, cls)
+            for fname in sorted(os.listdir(cdir)):
+                with open(os.path.join(cdir, fname),
+                          errors="ignore") as f:
+                    texts.append(f.read())
+                labels.append(li)
+    else:
+        texts, labels = _synthetic_corpus(
+            60 if args.smoke else 300)
+    n_classes = len(set(labels))
+
+    ts = (TextSet.from_texts(texts, labels).tokenize().normalize()
+          .word2idx(max_words_num=args.max_words)
+          .shape_sequence(args.sequence_length))
+    x, y = ts.to_arrays()
+    perm = np.random.RandomState(1).permutation(len(x))
+    x, y = x[perm], y[perm]
+    split = int(len(x) * 0.8)
+
+    model = TextClassifier(
+        class_num=n_classes, token_length=64,
+        sequence_length=args.sequence_length, encoder=args.encoder,
+        encoder_output_dim=128,
+        max_words_num=len(ts.word_index) + 1)
+    model.compile(optimizer=Adam(lr=0.01),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+    model.fit(x[:split], y[:split], batch_size=128,
+              nb_epoch=args.epochs)
+    scores = model.evaluate(x[split:], y[split:],
+                            batch_size=min(128, len(x) - split))
+    print("eval:", scores)
+    return scores
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,82 @@
+"""TFPark-style training surface (reference pyzoo
+examples/tensorflow/tfpark): TFDataset + TFOptimizer.from_loss for
+distributed-style training, TFEstimator model_fn train/eval/predict."""
+
+import argparse
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.epochs = 2
+
+    from analytics_zoo_tpu.common.triggers import MaxEpoch
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras import layers as L
+    from analytics_zoo_tpu.pipeline.api.keras.metrics import (
+        SparseCategoricalAccuracy)
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+    from analytics_zoo_tpu.tfpark import (
+        ModeKeys, TFEstimator, TFEstimatorSpec, TFOptimizer, TFDataset)
+
+    rs = np.random.RandomState(0)
+    x = rs.rand(2048, 2).astype(np.float32)
+    y = ((x[:, 0] > 0.5) ^ (x[:, 1] > 0.5)).astype(np.int32)
+
+    def mlp():
+        m = Sequential()
+        m.add(L.Dense(32, activation="relu", input_shape=(2,)))
+        m.add(L.Dense(2))
+        return m
+
+    # --- TFOptimizer path (tf_optimizer.py:332 analogue) ----------------
+    ds = TFDataset.from_ndarrays((x, y), batch_size=256)
+    opt = TFOptimizer.from_loss(
+        mlp(), "sparse_categorical_crossentropy_with_logits", ds,
+        optim_method=Adam(lr=1e-2))
+    hist = opt.optimize(end_trigger=MaxEpoch(args.epochs))
+    print(f"TFOptimizer: loss {hist[0]['loss']:.3f} -> "
+          f"{hist[-1]['loss']:.3f}")
+
+    # --- TFEstimator path (estimator.py:30 analogue) --------------------
+    def model_fn(features, labels, mode):
+        model = mlp()
+        if mode == ModeKeys.TRAIN:
+            return TFEstimatorSpec(
+                mode, predictions=model,
+                loss="sparse_categorical_crossentropy_with_logits",
+                optim_method=Adam(lr=1e-2))
+        if mode == ModeKeys.EVAL:
+            return TFEstimatorSpec(
+                mode, predictions=model,
+                loss="sparse_categorical_crossentropy_with_logits",
+                metrics=[SparseCategoricalAccuracy()])
+        return TFEstimatorSpec(mode, predictions=model)
+
+    est = TFEstimator(model_fn)
+    est.train(lambda: TFDataset.from_ndarrays((x, y), batch_size=256),
+              steps=20 if args.smoke else 200)
+    scores = est.evaluate(
+        TFDataset.from_ndarrays((x, y), batch_per_thread=512))
+    preds = est.predict(
+        TFDataset.from_ndarrays((x, None), batch_per_thread=512))
+    print(f"TFEstimator eval: {scores}; preds shape "
+          f"{np.asarray(preds).shape}")
+    return scores
+
+
+if __name__ == "__main__":
+    main()

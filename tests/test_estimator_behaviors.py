@@ -101,3 +101,31 @@ def test_hit_ratio_batch_size_message():
     num, den = hr.batch_update(jnp.zeros((202, 1)), jnp.zeros((202, 1)),
                                jnp.ones((202,)))
     assert float(den) == 2
+
+
+# ---------------------------------------------------------- LocalEstimator
+
+def test_local_estimator_trains_evaluates_predicts():
+    from analytics_zoo_tpu.pipeline.estimator import LocalEstimator
+    rs = np.random.RandomState(1)
+    x = rs.randn(256, 8).astype(np.float32)
+    w = rs.randn(8, 1).astype(np.float32)
+    y = x @ w + 0.01 * rs.randn(256, 1).astype(np.float32)
+    m = Sequential()
+    m.add(Dense(1, input_shape=(8,)))
+    est = LocalEstimator(m, "mse", "adam", metrics=["mae"])
+    est.fit(x, y, validation_data=(x, y), batch_size=64, epochs=8)
+    losses = [h["loss"] for h in est.history]
+    assert losses[-1] < losses[0]
+    scores = est.evaluate(x, y, batch_size=64)
+    assert "mae" in scores
+    preds = est.predict(x[:100], batch_size=64)  # exercises tail padding
+    assert preds.shape == (100, 1)
+
+
+def test_local_estimator_rejects_oversized_batch():
+    from analytics_zoo_tpu.pipeline.estimator import LocalEstimator
+    x, y = small_data(n=16)
+    est = LocalEstimator(small_model(), "mse", "sgd")
+    with pytest.raises(ValueError):
+        est.fit(x, y, batch_size=64)

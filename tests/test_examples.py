@@ -1,0 +1,26 @@
+"""Example smoke tests — the analogue of the reference's
+run-example-tests*.sh CI scripts (SURVEY.md §4.2): each example runs
+end-to-end in --smoke mode."""
+
+import importlib
+
+import pytest
+
+EXAMPLES = [
+    "examples.recommendation.ncf_example",
+    "examples.recommendation.wide_and_deep_example",
+    "examples.anomalydetection.anomaly_detection_example",
+    "examples.localestimator.lenet_local_estimator",
+    "examples.autogradexamples.custom_loss_example",
+    "examples.qaranker.qa_ranker",
+    "examples.tfpark.tf_optimizer_example",
+    "examples.pytorch.torch_train_example",
+    "examples.inference.inference_model_example",
+    "examples.nnframes.nnframes_example",
+]
+
+
+@pytest.mark.parametrize("module", EXAMPLES)
+def test_example_smoke(module):
+    mod = importlib.import_module(module)
+    assert mod.main(["--smoke"]) is not None
