@@ -1,0 +1,44 @@
+"""``zoo-launch`` CLI: spawn an N-process jax.distributed job on this
+machine (the spark-submit launcher-script role, reference
+scripts/spark-submit-python-with-zoo.sh + RayOnSpark bootstrap).
+
+Usage: ``python -m analytics_zoo_tpu.parallel.launch_cli -n 4
+script.py [args...]``.  Each worker gets ZOO_TPU_COORDINATOR /
+NUM_PROCESSES / PROCESS_ID and should call ``init_zoo_context()``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="zoo-launch")
+    p.add_argument("-n", "--num-processes", type=int, default=1)
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of process 0 (default: local free port)")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="seconds to wait before killing stragglers")
+    p.add_argument("script")
+    p.add_argument("args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu.parallel.launcher import ZooCluster
+    cluster = ZooCluster(num_processes=args.num_processes,
+                         coordinator=args.coordinator)
+    cluster.start(args.script, args.args)
+    try:
+        codes = cluster.wait(timeout=args.timeout)
+    finally:
+        cluster.stop()
+    bad = [c for c in codes if c != 0]
+    if bad:
+        print(f"workers exited with codes {codes}", file=sys.stderr)
+        return 1
+    print(f"{args.num_processes} workers completed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

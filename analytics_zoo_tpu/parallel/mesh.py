@@ -125,7 +125,6 @@ def local_batch_size(mesh: Mesh, batch_size: int) -> int:
     own slice of the global batch), so it must tile this host's share
     of the data-parallel degree.
     """
-    import jax
     dp = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
     nproc = jax.process_count()
     if nproc > 1 and dp % nproc == 0:

@@ -238,23 +238,13 @@ class DistributedTrainer:
         return jax.jit(step, out_shardings=self._rep)
 
     def make_eval_runner(self, metrics):
+        from analytics_zoo_tpu.pipeline.api.keras.metrics import accumulate
         step = self._build_eval_step(metrics)
 
         def run(params, state, batches):
-            partials = None
-            for batch in self.prefetch(batches):
-                upd = step(params, state, batch)
-                if partials is None:
-                    partials = list(upd)
-                else:
-                    partials = [m.merge(a, b) for m, a, b in
-                                zip(metrics, partials, upd)]
-            return {
-                m.name: m.finalize(p)
-                for m, p in zip(metrics, partials or
-                                [None] * len(metrics))
-                if p is not None
-            }
+            return accumulate(
+                metrics, (step(params, state, batch)
+                          for batch in self.prefetch(batches)))
         return run
 
     # -------------------------------------------------------- predict step

@@ -39,6 +39,26 @@ class Metric:
         return float(num) / max(float(den), 1e-12)
 
 
+def accumulate(metrics, partial_batches):
+    """Fold per-batch partial tuples into final scores.
+
+    ``partial_batches`` yields one tuple of per-metric partials per
+    batch (each produced by ``Metric.batch_update``).  Shared by the
+    distributed eval runner and LocalEstimator so the accumulation
+    protocol has exactly one implementation.
+    """
+    partials = None
+    for upd in partial_batches:
+        if partials is None:
+            partials = list(upd)
+        else:
+            partials = [m.merge(a, b)
+                        for m, a, b in zip(metrics, partials, upd)]
+    return {m.name: m.finalize(p)
+            for m, p in zip(metrics, partials or [None] * len(metrics))
+            if p is not None}
+
+
 class SparseCategoricalAccuracy(Metric):
     """Integer labels vs class scores."""
     name = "sparse_categorical_accuracy"

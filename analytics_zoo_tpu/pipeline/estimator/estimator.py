@@ -289,6 +289,19 @@ class Estimator:
         params = trainer.place_params(variables["params"])
         state = trainer.replicate(variables["state"])
         fn = trainer.predict_fn()
-        return predict_in_batches(
-            lambda xb: fn(params, state, trainer.put_batch(xb)),
-            x, batch_size)
+        nproc = jax.process_count()
+
+        def run(xb):
+            out = fn(params, state, trainer.put_batch(xb))
+            if nproc > 1:
+                # the global batch concatenates per-host slices in
+                # process order — slice this host's own rows back out.
+                # (Every host must predict the same number of rows so
+                # the SPMD programs stay in step.)
+                pid = jax.process_index()
+                bs = len(jax.tree_util.tree_leaves(xb)[0])
+                out = jax.tree_util.tree_map(
+                    lambda o: o[pid * bs:(pid + 1) * bs], out)
+            return out
+
+        return predict_in_batches(run, x, batch_size)

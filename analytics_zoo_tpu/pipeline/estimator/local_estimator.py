@@ -118,6 +118,7 @@ class LocalEstimator:
     # ------------------------------------------------------------ evaluate
     def evaluate(self, x, y, batch_size: int = 32) -> Dict[str, float]:
         from analytics_zoo_tpu.feature.feature_set import FeatureSet
+        from analytics_zoo_tpu.pipeline.api.keras.metrics import accumulate
         data = x if isinstance(x, FeatureSet) \
             else FeatureSet.from_ndarrays(x, y)
         model, metrics = self.model, self.metrics
@@ -128,14 +129,12 @@ class LocalEstimator:
             self._eval_step = jax.jit(step)
 
         variables = self.model.get_variables()
-        partials = None
-        for bx, by, mask in data.epoch_batches(0, batch_size, train=False):
-            upd = self._eval_step(variables["params"], variables["state"],
-                                  bx, by, mask)
-            partials = list(upd) if partials is None else [
-                m.merge(a, b) for m, a, b in zip(metrics, partials, upd)]
-        return {m.name: m.finalize(p)
-                for m, p in zip(metrics, partials or [])}
+        return accumulate(
+            metrics,
+            (self._eval_step(variables["params"], variables["state"],
+                             bx, by, mask)
+             for bx, by, mask in data.epoch_batches(0, batch_size,
+                                                    train=False)))
 
     # ------------------------------------------------------------- predict
     def predict(self, x, batch_size: int = 256):

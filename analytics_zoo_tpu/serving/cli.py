@@ -1,0 +1,75 @@
+"""Cluster Serving CLI — the scripts/cluster-serving entry points
+(reference cluster-serving-start/stop shells + ClusterServing.main,
+serving/ClusterServing.scala:44).
+
+``start`` reads config.yaml, builds the model from ``model: builder:``
+(a "pkg.module:function" returning a built KerasNet), optionally loads
+``model: weights:`` (a save_model checkpoint), and runs the serving
+loop against Redis.  ``stop`` sets the cross-process stop key.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import sys
+
+
+def _build_model(spec: str, weights: str = None):
+    mod_name, _, fn_name = spec.partition(":")
+    if not fn_name:
+        raise SystemExit(
+            f"model builder {spec!r} must look like pkg.module:function")
+    fn = getattr(importlib.import_module(mod_name), fn_name)
+    model = fn()
+    if weights:
+        model.load_weights(weights)
+    else:
+        model.init()
+    return model
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="cluster-serving")
+    p.add_argument("command", choices=["start", "stop"])
+    p.add_argument("--config", "-c", default="config.yaml")
+    p.add_argument("--builder", default=None,
+                   help="pkg.module:function returning a built model "
+                        "(overrides config)")
+    p.add_argument("--weights", default=None)
+    p.add_argument("--redis", default=None, help="host:port")
+    p.add_argument("--quantize", action="store_true")
+    args = p.parse_args(argv)
+
+    import os
+    from analytics_zoo_tpu.serving.server import (
+        STOP_KEY, ClusterServing, ServingConfig)
+    from analytics_zoo_tpu.serving.redis_client import connect
+
+    cfg = ServingConfig.from_yaml(args.config) \
+        if os.path.exists(args.config) else ServingConfig()
+    if args.redis:
+        cfg.redis_url = args.redis
+
+    if args.command == "stop":
+        import time
+        broker = connect(cfg.redis_url)
+        broker.hset(STOP_KEY, {"stop": str(time.time())})
+        print("stop signal sent")
+        return 0
+
+    builder = args.builder or cfg.extra.get("model.builder")
+    if not builder:
+        raise SystemExit("start needs --builder or config model: builder:")
+    weights = args.weights or cfg.extra.get("model.weights")
+    model = _build_model(builder, weights)
+
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    im = InferenceModel().load_zoo(model, quantize=args.quantize)
+    serving = ClusterServing(im, cfg)
+    serving.run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

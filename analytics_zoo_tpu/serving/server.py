@@ -30,6 +30,8 @@ log = logging.getLogger("analytics_zoo_tpu.serving")
 
 INPUT_STREAM = "serving_stream"
 RESULT_PREFIX = "result:"
+STOP_KEY = "zoo-serving-stop"   # cross-process stop signal
+                                # (ClusterServingManager.listenTermination)
 
 
 def decode_field(fields: Dict[str, bytes]):
@@ -55,12 +57,14 @@ class ServingConfig:
     def __init__(self, redis_url: Optional[str] = None,
                  batch_size: int = 4, top_n: int = 1,
                  max_stream_len: int = 100000,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 extra: Optional[Dict[str, str]] = None):
         self.redis_url = redis_url
         self.batch_size = int(batch_size)
         self.top_n = int(top_n)
         self.max_stream_len = int(max_stream_len)
         self.log_dir = log_dir
+        self.extra = extra or {}   # raw section.key entries (model.* etc)
 
     @classmethod
     def from_yaml(cls, path: str) -> "ServingConfig":
@@ -80,6 +84,8 @@ class ServingConfig:
             redis_url=cfg.get("data.src"),
             batch_size=int(cfg.get("params.batch_size", 4) or 4),
             top_n=int(cfg.get("params.top_n", 1) or 1),
+            log_dir=cfg.get("params.log_dir") or None,
+            extra=cfg,
         )
 
 
@@ -163,8 +169,23 @@ class ClusterServing:
     def run(self, poll_ms: int = 100) -> None:
         log.info("cluster serving started (batch=%d)",
                  self.config.batch_size)
+        # honor only stop signals issued at/after startup so a stale
+        # key from a previous shutdown can't kill a fresh worker, and a
+        # signal sent while we were still booting isn't lost
+        started = time.time()
         while not self._stop.is_set():
             self.run_once(block_ms=poll_ms)
+            sig = self.broker.hgetall(STOP_KEY)
+            if sig:
+                raw = sig.get(b"stop", sig.get("stop", b"0"))
+                try:
+                    ts = float(raw)
+                except (TypeError, ValueError):
+                    ts = float("inf")   # unparseable → explicit stop
+                if ts >= started - 1.0:   # small clock-skew allowance
+                    log.info("stop signal received; shutting down")
+                    self.broker.delete(STOP_KEY)
+                    break
 
     def start_background(self) -> threading.Thread:
         t = threading.Thread(target=self.run, daemon=True)

@@ -83,7 +83,10 @@ def main(argv=None):
                  vocab_size=vocab_size, embed_size=32, kernel_num=21)
     model.compile(optimizer=Adam(lr=0.01), loss="rank_hinge")
     bs = 32   # must stay even: rank_hinge consumes (pos, neg) pairs
-    model.fit([q, a], y, batch_size=bs, nb_epoch=args.epochs)
+    # shuffle=False preserves the interleaved (pos, neg) adjacency that
+    # rank_hinge pairs up row-by-row
+    model.fit([q, a], y, batch_size=bs, nb_epoch=args.epochs,
+              shuffle=False)
 
     # rank every relation and score listwise
     rq = np.stack([_index(q_corpus[r[0]], wi, args.q_len)

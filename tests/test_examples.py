@@ -17,6 +17,12 @@ EXAMPLES = [
     "examples.pytorch.torch_train_example",
     "examples.inference.inference_model_example",
     "examples.nnframes.nnframes_example",
+    "examples.textclassification.text_classification",
+    "examples.chatbot.seq2seq_example",
+    "examples.attention.bert_classification",
+    "examples.imageclassification.image_classification_example",
+    "examples.objectdetection.ssd_example",
+    "examples.inception.train_inception",
 ]
 
 
@@ -24,3 +30,14 @@ EXAMPLES = [
 def test_example_smoke(module):
     mod = importlib.import_module(module)
     assert mod.main(["--smoke"]) is not None
+
+
+def test_multihost_example_runs():
+    """Spawns 2 real jax.distributed worker processes."""
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, "examples/distributed/multihost_example.py",
+         "--workers", "2"],
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr[-2000:]

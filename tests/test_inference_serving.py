@@ -174,3 +174,54 @@ class TestClusterServing:
         assert cfg.batch_size == 16
         assert cfg.top_n == 3
         assert cfg.redis_url == "localhost:6379"
+
+
+# -------------------------------------------------------------- serving CLI
+
+def _cli_builder():
+    m = Sequential()
+    m.add(Dense(4, input_shape=(8,)))
+    return m
+
+
+class TestServingCLI:
+    def test_stop_signal_roundtrip(self):
+        import time
+        from analytics_zoo_tpu.serving.server import STOP_KEY
+        broker = EmbeddedBroker()
+        model = _cli_builder()
+        model.init()
+        serving = ClusterServing(InferenceModel().load_zoo(model),
+                                 broker=broker)
+        t = serving.start_background()
+        broker.hset(STOP_KEY, {"stop": str(time.time())})
+        t.join(timeout=15)
+        assert not t.is_alive()
+        assert not broker.hgetall(STOP_KEY)
+
+    def test_stale_stop_signal_ignored(self):
+        import time
+        from analytics_zoo_tpu.serving.server import STOP_KEY
+        broker = EmbeddedBroker()
+        model = _cli_builder()
+        model.init()
+        serving = ClusterServing(InferenceModel().load_zoo(model),
+                                 broker=broker)
+        # signal from a long-dead previous run must not kill the worker
+        broker.hset(STOP_KEY, {"stop": str(time.time() - 3600)})
+        t = serving.start_background()
+        time.sleep(0.5)
+        assert t.is_alive()
+        broker.hset(STOP_KEY, {"stop": str(time.time())})
+        t.join(timeout=15)
+        assert not t.is_alive()
+
+    def test_build_model_from_spec(self):
+        from analytics_zoo_tpu.serving.cli import _build_model
+        m = _build_model("tests.test_inference_serving:_cli_builder")
+        assert m.get_variables()["params"]
+
+    def test_bad_spec_rejected(self):
+        from analytics_zoo_tpu.serving.cli import _build_model
+        with pytest.raises(SystemExit):
+            _build_model("no_colon_here")
