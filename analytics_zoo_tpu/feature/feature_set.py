@@ -81,6 +81,48 @@ class FeatureSet:
         return cls(stack(xs), stack(ys), shuffle=shuffle)
 
     @classmethod
+    def from_torch_dataloader(cls, dataloader, shuffle: bool = True,
+                              max_items: Optional[int] = None
+                              ) -> "FeatureSet":
+        """Drain a PyTorch DataLoader into columnar storage.
+
+        The PythonLoaderFeatureSet role (reference FeatureSet.scala:331
+        runs the cloudpickled loader inside Jep on each executor); here
+        the host IS the executor, so the loader runs in-process and the
+        resulting columns feed the device prefetcher.
+        """
+        xs, ys = [], []
+        n = 0
+        to_np = lambda t: jax.tree_util.tree_map(
+            lambda v: v.numpy() if hasattr(v, "numpy") else np.asarray(v),
+            t, is_leaf=lambda v: hasattr(v, "numpy"))
+        for item in dataloader:
+            if isinstance(item, (tuple, list)) and len(item) == 2:
+                bx, by = item
+                xs.append(to_np(bx))
+                ys.append(to_np(by))
+            else:
+                xs.append(to_np(item))
+            n += _tree_len(xs[-1])
+            if max_items is not None and n >= max_items:
+                break
+        if not xs:
+            raise ValueError("dataloader yielded no items")
+        cat = lambda seq: jax.tree_util.tree_map(
+            lambda *leaves: np.concatenate(leaves), *seq)
+        x = cat(xs)
+        y = cat(ys) if ys else None
+        if max_items is not None and n > max_items:
+            trim = lambda t: jax.tree_util.tree_map(
+                lambda a: a[:max_items], t)
+            x = trim(x)
+            y = trim(y) if y is not None else None
+        if y is not None:
+            y = jax.tree_util.tree_map(
+                lambda a: a[:, None] if a.ndim == 1 else a, y)
+        return cls(x, y, shuffle=shuffle)
+
+    @classmethod
     def from_npy_dir(cls, path: str, num_slices: int = 1,
                      shuffle: bool = True) -> "FeatureSet":
         """Disk-backed mode: memory-mapped ``x.npy``/``y.npy``; with

@@ -1,0 +1,195 @@
+"""Pure-Python TFRecord reader/writer + tf.train.Example codec.
+
+Reference: ``TFDataset.from_tfrecord_file`` (pyzoo tf_dataset.py:479)
+reads TFRecords through the tensorflow-hadoop input format; SURVEY.md
+§2.9 calls for a pure-Python reader here (no TF dependency).
+
+TFRecord framing (tensorflow/core/lib/io/record_writer.h):
+
+    uint64 length            (little-endian)
+    uint32 masked_crc32c(length bytes)
+    byte   data[length]
+    uint32 masked_crc32c(data)
+
+CRC is CRC-32C (Castagnoli), masked with the rot-15 + magic recipe.
+``Example`` parsing uses the in-house protobuf wire codec
+(utils/pbwire.py) — schema from tensorflow/core/example/{example,
+feature}.proto.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from analytics_zoo_tpu.utils.pbwire import Field, Message
+
+# ------------------------------------------------------------------ crc32c
+
+_CRC_TABLE = None
+
+
+def _crc_table() -> np.ndarray:
+    global _CRC_TABLE
+    if _CRC_TABLE is None:
+        poly = 0x82F63B78        # reversed Castagnoli polynomial
+        table = np.empty(256, np.uint32)
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
+            table[i] = crc
+        _CRC_TABLE = table
+    return _CRC_TABLE
+
+
+def crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = np.uint32(0xFFFFFFFF)
+    for b in np.frombuffer(data, np.uint8):
+        crc = table[(crc ^ b) & np.uint32(0xFF)] ^ (crc >> np.uint8(8))
+    return int(crc ^ np.uint32(0xFFFFFFFF))
+
+
+def masked_crc32c(data: bytes) -> int:
+    crc = crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ----------------------------------------------------------------- framing
+
+def read_tfrecord(path: str, check_crc: bool = True) -> Iterator[bytes]:
+    """Yield raw record payloads from one TFRecord file."""
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(12)
+            if not header:
+                return
+            if len(header) < 12:
+                raise IOError(f"{path}: truncated record header")
+            length, length_crc = struct.unpack("<QI", header)
+            if check_crc and \
+                    masked_crc32c(header[:8]) != length_crc:
+                raise IOError(f"{path}: corrupt length crc")
+            data = f.read(length)
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            if check_crc and masked_crc32c(data) != data_crc:
+                raise IOError(f"{path}: corrupt data crc")
+            yield data
+
+
+def write_tfrecord(path: str, records: Sequence[bytes]) -> None:
+    with open(path, "wb") as f:
+        for data in records:
+            header = struct.pack("<Q", len(data))
+            f.write(header)
+            f.write(struct.pack("<I", masked_crc32c(header)))
+            f.write(data)
+            f.write(struct.pack("<I", masked_crc32c(data)))
+
+
+# ----------------------------------------- tf.train.Example proto schema
+
+class BytesList(Message):
+    FIELDS = [Field(1, "value", "bytes", repeated=True)]
+
+
+class FloatList(Message):
+    FIELDS = [Field(1, "value", "float", repeated=True)]
+
+
+class Int64List(Message):
+    FIELDS = [Field(1, "value", "int64", repeated=True)]
+
+
+class Feature(Message):
+    FIELDS = [
+        Field(1, "bytes_list", "msg", msg_cls=BytesList),
+        Field(2, "float_list", "msg", msg_cls=FloatList),
+        Field(3, "int64_list", "msg", msg_cls=Int64List),
+    ]
+
+
+class FeatureEntry(Message):
+    """map<string, Feature> entry."""
+    FIELDS = [
+        Field(1, "key", "string"),
+        Field(2, "value", "msg", msg_cls=Feature),
+    ]
+
+
+class Features(Message):
+    FIELDS = [Field(1, "feature", "msg", repeated=True,
+                    msg_cls=FeatureEntry)]
+
+
+class Example(Message):
+    FIELDS = [Field(1, "features", "msg", msg_cls=Features)]
+
+
+def parse_example(data: bytes) -> Dict[str, np.ndarray]:
+    """Decode one serialized tf.train.Example into name → ndarray."""
+    ex = Example.decode(data)
+    out: Dict[str, np.ndarray] = {}
+    if ex.features is None:
+        return out
+    for entry in ex.features.feature:
+        feat = entry.value
+        if feat is None:
+            continue
+        if feat.int64_list is not None and feat.int64_list.value:
+            out[entry.key] = np.asarray(feat.int64_list.value, np.int64)
+        elif feat.float_list is not None and feat.float_list.value:
+            out[entry.key] = np.asarray(feat.float_list.value, np.float32)
+        elif feat.bytes_list is not None and feat.bytes_list.value:
+            out[entry.key] = np.asarray(feat.bytes_list.value, object)
+        else:
+            out[entry.key] = np.asarray([], np.float32)
+    return out
+
+
+def make_example(features: Dict[str, object]) -> bytes:
+    """Encode name → (ints | floats | bytes) into a tf.train.Example."""
+    entries = []
+    for name, value in features.items():
+        arr = np.asarray(value)
+        if arr.dtype.kind in "iu b".replace(" ", ""):
+            feat = Feature(int64_list=Int64List(
+                value=[int(v) for v in arr.ravel()]))
+        elif arr.dtype.kind == "f":
+            feat = Feature(float_list=FloatList(
+                value=[float(v) for v in arr.ravel()]))
+        else:
+            vals = [v if isinstance(v, bytes) else str(v).encode()
+                    for v in np.atleast_1d(arr)]
+            feat = Feature(bytes_list=BytesList(value=vals))
+        entries.append(FeatureEntry(key=name, value=feat))
+    return Example(features=Features(feature=entries)).encode()
+
+
+# -------------------------------------------------- dataset-level helpers
+
+def read_examples(paths, check_crc: bool = True
+                  ) -> Iterator[Dict[str, np.ndarray]]:
+    """Iterate parsed Examples over one path, a glob, or a list."""
+    import glob as _glob
+    if isinstance(paths, (str, os.PathLike)):
+        paths = sorted(_glob.glob(str(paths))) or [str(paths)]
+    for p in paths:
+        for rec in read_tfrecord(p, check_crc=check_crc):
+            yield parse_example(rec)
+
+
+def load_tfrecord_arrays(paths, feature_names: Optional[List[str]] = None
+                         ) -> Dict[str, np.ndarray]:
+    """Materialise TFRecord Examples into stacked arrays (fixed-shape
+    features only) — the eager path feeding FeatureSet."""
+    cols: Dict[str, List[np.ndarray]] = {}
+    for ex in read_examples(paths):
+        for k, v in ex.items():
+            if feature_names is None or k in feature_names:
+                cols.setdefault(k, []).append(v)
+    return {k: np.stack(vs) for k, vs in cols.items()}

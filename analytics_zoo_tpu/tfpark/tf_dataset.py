@@ -74,6 +74,68 @@ class TFDataset:
         return cls(fs, batch_size, batch_per_thread)
 
     @classmethod
+    def from_tfrecord_file(cls, paths, features, label: Optional[str] = None,
+                           batch_size: int = -1,
+                           batch_per_thread: int = -1) -> "TFDataset":
+        """Read TFRecord Examples with the pure-Python reader
+        (feature/tfrecord.py; reference tf_dataset.py:479 used the
+        tensorflow-hadoop input format).
+
+        ``features``: list of feature names forming x — a single array
+        when one name, else a list pytree in order (multi-input models);
+        ``label``: optional label feature name.
+        """
+        from analytics_zoo_tpu.feature.tfrecord import load_tfrecord_arrays
+        names = list(features) + ([label] if label else [])
+        cols = load_tfrecord_arrays(paths, feature_names=names)
+        missing = [n for n in names if n not in cols]
+        if missing:
+            raise ValueError(f"features {missing} not found in TFRecords "
+                             f"(have {sorted(cols)})")
+        xs = [cols[n] for n in features]
+        x = xs[0] if len(xs) == 1 else xs
+        y = cols[label] if label else None
+        return cls(FeatureSet.from_ndarrays(x, y),
+                   batch_size, batch_per_thread)
+
+    @classmethod
+    def from_image_set(cls, image_set, batch_size: int = -1,
+                       batch_per_thread: int = -1) -> "TFDataset":
+        """ImageSet → dataset (reference tf_dataset.py from_image_set)."""
+        return cls(image_set.to_feature_set(),
+                   batch_size, batch_per_thread)
+
+    @classmethod
+    def from_text_set(cls, text_set, batch_size: int = -1,
+                      batch_per_thread: int = -1) -> "TFDataset":
+        """TextSet (already word2idx + shaped) → dataset."""
+        return cls(text_set.to_feature_set(),
+                   batch_size, batch_per_thread)
+
+    @classmethod
+    def from_dataframe(cls, df, feature_cols, labels_cols=None,
+                       batch_size: int = -1,
+                       batch_per_thread: int = -1) -> "TFDataset":
+        """pandas DataFrame columns → dataset (reference from_dataframe
+        took a Spark DataFrame; the driver-side table here is pandas)."""
+        def col(c):
+            v = df[c].to_numpy()
+            if v.dtype == object:   # column of arrays
+                v = np.stack(v)
+            return v
+        xs = [col(c) for c in feature_cols]
+        x = xs[0] if len(xs) == 1 else xs
+        y = None
+        if labels_cols:
+            names = [labels_cols] if isinstance(labels_cols, str) \
+                else list(labels_cols)
+            ys = [y_[:, None] if y_.ndim == 1 else y_
+                  for y_ in (col(c) for c in names)]
+            y = ys[0] if len(ys) == 1 else ys
+        return cls(FeatureSet.from_ndarrays(x, y),
+                   batch_size, batch_per_thread)
+
+    @classmethod
     def from_string_rdd(cls, *a, **kw):
         raise NotImplementedError(
             "RDD sources require the Spark-bridge deployment; use "
