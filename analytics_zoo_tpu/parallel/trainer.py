@@ -187,34 +187,34 @@ class DistributedTrainer:
         return optax.apply_updates(params, updates), new_state
 
     # ---------------------------------------------------------- train step
-    def _build_train_step(self):
+    def _step_core(self, params, opt_state, state, batch, rng):
+        """One forward+backward+update — traced into both the per-step
+        jit and the whole-epoch scan."""
         model, loss_fn, clip = self.model, self.loss_fn, self.clip
-        sync_dtype = self.grad_sync_dtype
+        x, y = batch
 
-        def step(params, opt_state, state, batch, rng):
-            x, y = batch
+        def objective(p):
+            out, new_state = model.apply(p, x, state=state,
+                                         training=True, rng=rng)
+            loss = loss_fn(y, out)
+            reg = model.regularization_loss(p)
+            return loss + reg, (new_state, loss)
 
-            def objective(p):
-                out, new_state = model.apply(p, x, state=state,
-                                             training=True, rng=rng)
-                loss = loss_fn(y, out)
-                reg = model.regularization_loss(p)
-                return loss + reg, (new_state, loss)
+        grads, (new_state, loss) = jax.grad(
+            objective, has_aux=True)(params)
+        if self.grad_sync_dtype == "bfloat16":
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(jnp.bfloat16).astype(jnp.float32),
+                grads)
+        grads = _apply_clipping(grads, clip)
+        new_params, new_opt_state = self._optimizer_update(
+            grads, opt_state, params)
+        return new_params, new_opt_state, new_state, loss
 
-            grads, (new_state, loss) = jax.grad(
-                objective, has_aux=True)(params)
-            if sync_dtype == "bfloat16":
-                grads = jax.tree_util.tree_map(
-                    lambda g: g.astype(jnp.bfloat16).astype(jnp.float32),
-                    grads)
-            grads = _apply_clipping(grads, clip)
-            new_params, new_opt_state = self._optimizer_update(
-                grads, opt_state, params)
-            return new_params, new_opt_state, new_state, loss
-
+    def _build_train_step(self):
         donate = (0, 1, 2) if self.donate else ()
         return jax.jit(
-            step,
+            self._step_core,
             out_shardings=(self._param_shardings, None, self._rep,
                            self._rep),
             donate_argnums=donate)
@@ -225,6 +225,58 @@ class DistributedTrainer:
         if self._train_step is None:
             self._train_step = self._build_train_step()
         return self._train_step(params, opt_state, state, batch, rng)
+
+    # ------------------------------------------------- device-resident epoch
+    def epoch_scan_fn(self, num_batches: int, batch_size: int):
+        """Whole-epoch trainer over DEVICE-RESIDENT data — the HBM tier
+        of the FeatureSet cache hierarchy (the reference's DRAM cache,
+        FeatureSet.scala:229-329, moved all the way onto the chip).
+
+        One ``lax.scan`` runs ``num_batches`` steps with zero host
+        involvement: no per-step dispatch, no H2D transfers.  Batches
+        are contiguous slices of the (host-preshuffled) epoch arrays.
+        Returns ``f(params, opt_state, state, x, y, rng) ->
+        (params, opt_state, state, mean_loss)``.
+        """
+        local_bs = mesh_lib.local_batch_size(self.mesh, batch_size)
+        del local_bs   # validation only
+
+        def epoch(params, opt_state, state, x, y, rng):
+            def body(carry, i):
+                params, opt_state, state = carry
+                take = lambda a: jax.lax.dynamic_slice_in_dim(
+                    a, i * batch_size, batch_size, axis=0)
+                batch = (jax.tree_util.tree_map(take, x),
+                         jax.tree_util.tree_map(take, y))
+                params, opt_state, state, loss = self._step_core(
+                    params, opt_state, state, batch,
+                    jax.random.fold_in(rng, i))
+                return (params, opt_state, state), loss
+
+            (params, opt_state, state), losses = jax.lax.scan(
+                body, (params, opt_state, state),
+                jnp.arange(num_batches))
+            return params, opt_state, state, losses.mean()
+
+        donate = (0, 1, 2) if self.donate else ()
+        return jax.jit(
+            epoch,
+            out_shardings=(self._param_shardings, None, self._rep,
+                           self._rep),
+            donate_argnums=donate)
+
+    def put_epoch(self, x, y, epoch: int, feature_set=None):
+        """Device-place a whole epoch, sharded on the data axis.
+
+        If ``feature_set`` is given, its deterministic per-epoch
+        permutation is applied host-side first (one gather per epoch
+        instead of one per step)."""
+        if feature_set is not None and feature_set.shuffle:
+            perm = feature_set._epoch_perm(epoch)
+            take = lambda a: a[perm]
+            x = jax.tree_util.tree_map(take, x)
+            y = jax.tree_util.tree_map(take, y) if y is not None else None
+        return self.put_batch((x, y))
 
     # ----------------------------------------------------------- eval step
     def _build_eval_step(self, metrics):

@@ -217,3 +217,60 @@ class TestBERT:
         np.testing.assert_allclose(np.asarray(out1[0, :6]),
                                    np.asarray(out2[0, :6]),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_epoch_scan_matches_per_step_training():
+    """Device-resident whole-epoch scan == the per-step loop (HBM-tier
+    FeatureSet cache; runs on the CPU mesh here)."""
+    import jax
+    import numpy as np
+    from analytics_zoo_tpu.feature.feature_set import FeatureSet
+    from analytics_zoo_tpu.parallel.trainer import DistributedTrainer
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential, objectives
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import SGD
+
+    def build():
+        from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+        Layer.reset_name_counters()
+        m = Sequential()
+        m.add(Dense(1, input_shape=(4,)))
+        m.init(jax.random.PRNGKey(3))
+        return m
+
+    rs = np.random.RandomState(0)
+    n, bs = 64, 16
+    x = rs.randn(n, 4).astype(np.float32)
+    y = rs.randn(n, 1).astype(np.float32)
+    fs = FeatureSet.from_ndarrays(x, y, shuffle=True, seed=11)
+    loss_fn = objectives.get("mse")
+    rng = jax.random.PRNGKey(0)
+
+    # per-step path over the host-shuffled epoch-0 order
+    m1 = build()
+    t1 = DistributedTrainer(m1, loss_fn, optim_method=SGD(0.1))
+    p1 = t1.place_params(m1.get_variables()["params"])
+    s1 = t1.replicate(m1.get_variables()["state"])
+    o1 = t1.init_opt_state(p1)
+    perm = fs._epoch_perm(0)
+    for b in range(n // bs):
+        sel = perm[b * bs:(b + 1) * bs]
+        batch = t1.put_batch((x[sel], y[sel]))
+        p1, o1, s1, loss1 = t1.train_step(
+            p1, o1, s1, batch, jax.random.fold_in(rng, b))
+
+    # scan path with the same epoch-0 permutation
+    m2 = build()
+    t2 = DistributedTrainer(m2, loss_fn, optim_method=SGD(0.1))
+    p2 = t2.place_params(m2.get_variables()["params"])
+    s2 = t2.replicate(m2.get_variables()["state"])
+    o2 = t2.init_opt_state(p2)
+    fn = t2.epoch_scan_fn(n // bs, bs)
+    ex, ey = t2.put_epoch(x, y, 0, feature_set=fs)
+    p2, o2, s2, mean_loss = fn(p2, o2, s2, ex, ey, rng)
+
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        jax.device_get(p1), jax.device_get(p2))
+    assert np.isfinite(float(mean_loss))
