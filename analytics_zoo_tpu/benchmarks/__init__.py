@@ -1,0 +1,27 @@
+"""Benchmark harnesses behind ``bench.py`` (BASELINE.md configs)."""
+
+# bf16 peak FLOP/s per chip for known TPU generations (public specs);
+# used only for informational MFU estimates.
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def mfu_estimate(flops_per_step, step_time_s, device):
+    """Model FLOPs utilisation vs the chip's bf16 peak; None when the
+    chip generation (or the FLOP count) is unknown."""
+    peak = None
+    kind = getattr(device, "device_kind", "")
+    for name, val in PEAK_FLOPS.items():
+        if name.lower() in str(kind).lower():
+            peak = val
+            break
+    if peak is None or not flops_per_step or step_time_s <= 0:
+        return None
+    return round(flops_per_step / step_time_s / peak, 6)

@@ -1,0 +1,102 @@
+"""ResNet-50 synthetic-ImageNet training benchmark (BASELINE.md
+config 3; reference recipe examples/resnet/TrainImageNet.scala +
+examples/inception/Train.scala:75-99 — SGD momentum 0.9, poly(0.5) LR
+decay with warmup).
+
+TPU recipe: bf16 compute / f32 master weights (``dtype.compute``),
+donated buffers, a handful of synthetic batches cycled device-resident
+so the number measures the training step, not the synthetic-data
+generator."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
+                     num_classes: int = 1000, warmup_steps: int = 5,
+                     timed_steps: int = 30,
+                     compute_dtype: str = "bfloat16"):
+    import jax
+
+    from analytics_zoo_tpu.models.image.imageclassification import resnet
+    from analytics_zoo_tpu.ops import dtypes
+    from analytics_zoo_tpu.parallel.trainer import DistributedTrainer
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+        SGD, poly, warmup_then)
+
+    dtypes.set_policy(param_dtype="float32", compute_dtype=compute_dtype)
+
+    model = resnet(50, num_classes=num_classes,
+                   input_shape=(image_size, image_size, 3))
+    # reference ImageNet recipe: warmup into poly(0.5) decay
+    sched = warmup_then(0.1, warmup_steps,
+                        poly(0.1, 0.5, max_iteration=10_000))
+    optim = SGD(learning_rate=0.1, momentum=0.9, schedule=sched)
+    loss_fn = objectives.get("sparse_categorical_crossentropy_with_logits")
+    trainer = DistributedTrainer(model, loss_fn, optim_method=optim)
+
+    variables = model.init()
+    params = trainer.place_params(variables["params"])
+    state = trainer.replicate(variables["state"])
+    opt_state = trainer.init_opt_state(params)
+    rng = jax.random.PRNGKey(0)
+
+    # a few synthetic batches, placed once and cycled (device-resident)
+    rs = np.random.RandomState(0)
+    n_host_batches = 4
+    batches = [
+        trainer.put_batch((
+            rs.rand(batch_size, image_size, image_size, 3)
+            .astype(np.float32),
+            rs.randint(0, num_classes, size=(batch_size, 1)),
+        ))
+        for _ in range(n_host_batches)
+    ]
+
+    t_compile = time.time()
+    for i in range(warmup_steps):
+        params, opt_state, state, loss = trainer.train_step(
+            params, opt_state, state, batches[i % n_host_batches], rng)
+        if i == 0:
+            jax.block_until_ready(loss)
+            compile_s = time.time() - t_compile
+    jax.block_until_ready(loss)
+
+    t0 = time.time()
+    for i in range(timed_steps):
+        params, opt_state, state, loss = trainer.train_step(
+            params, opt_state, state, batches[i % n_host_batches], rng)
+    jax.block_until_ready(loss)
+    wall = time.time() - t0
+
+    imgs_per_sec = timed_steps * batch_size / wall
+    step_ms = wall / timed_steps * 1e3
+
+    # FLOP estimate: ResNet-50 fwd ≈ 4.1 GFLOPs/img @224 (standard
+    # published figure, scaled for image size), training ≈ 3x fwd.
+    fwd_flops = 4.1e9 * (image_size / 224.0) ** 2
+    train_flops = 3.0 * fwd_flops * batch_size
+    from analytics_zoo_tpu.benchmarks import mfu_estimate
+    mfu = mfu_estimate(train_flops, wall / timed_steps, device)
+
+    return {
+        "metric": "resnet50_imagenet_train_throughput",
+        "value": round(imgs_per_sec, 1),
+        "unit": "imgs/sec/chip",
+        "vs_baseline": None,
+        "workload": "resnet50",
+        "batch_size": batch_size,
+        "image_size": image_size,
+        "step_time_ms": round(step_ms, 2),
+        "timed_steps": timed_steps,
+        "compile_time_s": round(compile_s, 2),
+        "compute_dtype": compute_dtype,
+        "final_loss": float(loss),
+        "mfu_est": mfu,
+        "device": str(device),
+        "device_kind": getattr(device, "device_kind", "?"),
+    }
