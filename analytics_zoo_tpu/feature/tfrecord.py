@@ -75,7 +75,10 @@ def read_tfrecord(path: str, check_crc: bool = True) -> Iterator[bytes]:
                     masked_crc32c(header[:8]) != length_crc:
                 raise IOError(f"{path}: corrupt length crc")
             data = f.read(length)
-            (data_crc,) = struct.unpack("<I", f.read(4))
+            crc_bytes = f.read(4)
+            if len(data) < length or len(crc_bytes) < 4:
+                raise IOError(f"{path}: truncated record")
+            (data_crc,) = struct.unpack("<I", crc_bytes)
             if check_crc and masked_crc32c(data) != data_crc:
                 raise IOError(f"{path}: corrupt data crc")
             yield data

@@ -24,12 +24,18 @@ def main(argv=None):
     p.add_argument("args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
 
+    import subprocess
+
     from analytics_zoo_tpu.parallel.launcher import ZooCluster
     cluster = ZooCluster(num_processes=args.num_processes,
                          coordinator=args.coordinator)
     cluster.start(args.script, args.args)
     try:
         codes = cluster.wait(timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        print(f"workers still running after {args.timeout}s; "
+              "killing stragglers", file=sys.stderr)
+        return 1
     finally:
         cluster.stop()
     bad = [c for c in codes if c != 0]

@@ -55,6 +55,18 @@ def _apply_clipping(grads, clip: Optional[ClipSpec]):
     raise ValueError(clip.kind)
 
 
+def mask_frozen_params(model, params, new_params):
+    """Keep frozen layers' params bit-identical through an optimizer
+    update (transfer learning): restoring the old leaves masks weight
+    decay too, which plain gradient zeroing would not."""
+    frozen = (model.frozen_layer_names()
+              if hasattr(model, "frozen_layer_names") else set())
+    if not frozen:
+        return new_params
+    return {k: (params[k] if k in frozen else v)
+            for k, v in new_params.items()}
+
+
 def _group_params(params, groups: Dict[str, Sequence[str]]):
     """Split a top-level params dict into named disjoint groups.
 
@@ -189,7 +201,12 @@ class DistributedTrainer:
     # ---------------------------------------------------------- train step
     def _step_core(self, params, opt_state, state, batch, rng):
         """One forward+backward+update — traced into both the per-step
-        jit and the whole-epoch scan."""
+        jit and the whole-epoch scan.
+
+        Mixed precision is OP-LEVEL: the matmul/conv kernels cast their
+        operands per ``dtype.compute`` (ops/dtypes.py policy), so bf16
+        MXU compute with f32 master weights needs no whole-tree casting
+        here."""
         model, loss_fn, clip = self.model, self.loss_fn, self.clip
         x, y = batch
 
@@ -209,6 +226,7 @@ class DistributedTrainer:
         grads = _apply_clipping(grads, clip)
         new_params, new_opt_state = self._optimizer_update(
             grads, opt_state, params)
+        new_params = mask_frozen_params(model, params, new_params)
         return new_params, new_opt_state, new_state, loss
 
     def _build_train_step(self):
@@ -237,15 +255,32 @@ class DistributedTrainer:
         are contiguous slices of the (host-preshuffled) epoch arrays.
         Returns ``f(params, opt_state, state, x, y, rng) ->
         (params, opt_state, state, mean_loss)``.
+
+        ``batch_size`` is PER-HOST, matching the per-step
+        ``put_batch`` convention: when the data axes divide across
+        processes, ``put_epoch`` builds a global epoch array of
+        ``local_rows * process_count`` rows and each scan step slices
+        the GLOBAL batch of ``batch_size * process_count`` rows —
+        ``num_batches`` (= per-host rows // batch_size) steps then
+        consume exactly the whole epoch.  When ``put_batch`` falls back
+        to REPLICATING (dp doesn't divide across hosts), global rows ==
+        local rows and the slice stays ``batch_size``.
         """
         local_bs = mesh_lib.local_batch_size(self.mesh, batch_size)
         del local_bs   # validation only
+        # mirror put_batch's host-splitting condition exactly
+        dp = self.mesh.shape[mesh_lib.DATA_AXIS] * \
+            self.mesh.shape[mesh_lib.FSDP_AXIS]
+        nproc = jax.process_count()
+        data_split_across_hosts = nproc > 1 and dp % nproc == 0 and \
+            dp >= nproc
+        global_bs = batch_size * (nproc if data_split_across_hosts else 1)
 
         def epoch(params, opt_state, state, x, y, rng):
             def body(carry, i):
                 params, opt_state, state = carry
                 take = lambda a: jax.lax.dynamic_slice_in_dim(
-                    a, i * batch_size, batch_size, axis=0)
+                    a, i * global_bs, global_bs, axis=0)
                 batch = (jax.tree_util.tree_map(take, x),
                          jax.tree_util.tree_map(take, y))
                 params, opt_state, state, loss = self._step_core(

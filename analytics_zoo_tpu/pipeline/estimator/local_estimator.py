@@ -58,11 +58,25 @@ class LocalEstimator:
             grads, (new_state, loss) = jax.grad(
                 objective, has_aux=True)(params)
             import optax
+            from analytics_zoo_tpu.parallel.trainer import (
+                mask_frozen_params)
             updates, new_opt_state = optim.update(grads, opt_state, params)
-            return (optax.apply_updates(params, updates), new_opt_state,
-                    new_state, loss)
+            new_params = optax.apply_updates(params, updates)
+            new_params = mask_frozen_params(model, params, new_params)
+            return new_params, new_opt_state, new_state, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _current_step(self):
+        """The jitted step, rebuilt whenever the model's frozen-layer
+        set changes (it is baked in at trace time)."""
+        frozen = (self.model.frozen_layer_names()
+                  if hasattr(self.model, "frozen_layer_names") else set())
+        if self._step is None or \
+                getattr(self, "_step_frozen", None) != frozen:
+            self._step = self._build_step()
+            self._step_frozen = frozen
+        return self._step
 
     # ----------------------------------------------------------------- fit
     def fit(self, x, y, validation_data=None, batch_size: int = 32,
@@ -76,11 +90,16 @@ class LocalEstimator:
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         variables = self.model.get_variables()
-        params = variables["params"]
-        state = variables["state"]
+        # the jitted step donates (params, opt_state, state): copy the
+        # model's live variables first so donation can never delete the
+        # model's own buffers (e.g. after an exception mid-epoch)
+        import jax.numpy as jnp
+        copy = lambda t: jax.tree_util.tree_map(
+            lambda a: jnp.array(a, copy=True), t)
+        params = copy(variables["params"])
+        state = copy(variables["state"])
         opt_state = jax.jit(self.optim.init)(params)
-        if self._step is None:
-            self._step = self._build_step()
+        self._current_step()
 
         it = 0
         validate = validation_data is not None and self.metrics

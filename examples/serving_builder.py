@@ -1,0 +1,20 @@
+"""Default Cluster Serving model builder.
+
+Referenced by ``scripts/cluster-serving/config.yaml`` (``model:
+builder: examples.serving_builder:build``) so that
+``cluster-serving-start`` works out of the box — the reference ships a
+ready-to-run config.yaml the same way
+(scripts/cluster-serving/config.yaml).
+
+``build()`` returns a small LeNet image classifier (28x28 grayscale,
+10 classes); swap in your own ``pkg.module:function`` for real
+deployments.
+"""
+
+
+def build():
+    from analytics_zoo_tpu.models.image.imageclassification import lenet
+
+    model = lenet(num_classes=10, input_shape=(28, 28, 1))
+    model.init()
+    return model
