@@ -114,6 +114,10 @@ class Layer:
             name = f"{cls}_{Layer._counters[cls]}".lower()
         self.name = name
         self.built = False
+        # transfer-learning freeze flag (NetUtils.scala:267-276): a
+        # frozen layer's params get stop_gradient in the containers'
+        # apply, and the training engine masks its optimizer update
+        self.trainable = True
         self.batch_input_shape: Optional[Shape] = (
             to_batch_shape(input_shape) if input_shape is not None else None)
         self.input_dtype = input_dtype

@@ -17,6 +17,7 @@ EXAMPLES = [
     "examples.pytorch.torch_train_example",
     "examples.inference.inference_model_example",
     "examples.nnframes.nnframes_example",
+    "examples.finetune.finetune_example",
     "examples.textclassification.text_classification",
     "examples.chatbot.seq2seq_example",
     "examples.attention.bert_classification",

@@ -1,0 +1,135 @@
+"""Transfer-learning graph surgery: new_graph / freeze / freeze_up_to /
+unfreeze (reference NetUtils.scala:82,267,276 — GraphNet surgery behind
+the nnframes finetune example and the dogs-vs-cats app)."""
+
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model, Sequential
+from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+
+def _base_model():
+    inp = Input(shape=(8,))
+    x = Dense(16, activation="relu", name="backbone1")(inp)
+    feat = Dense(8, activation="relu", name="backbone2")(x)
+    out = Dense(2, name="old_head")(feat)
+    return Model(inp, out)
+
+
+def _data(n=64, rng=0):
+    r = np.random.default_rng(rng)
+    return (r.normal(size=(n, 8)).astype(np.float32),
+            r.integers(0, 2, size=(n, 1)))
+
+
+def test_new_graph_extracts_subgraph_with_weights():
+    m = _base_model()
+    m.init()
+    sub = m.new_graph("backbone2")
+    assert [l.name for l in sub.layers] == ["backbone1", "backbone2"]
+    assert sub.get_output_shape() == (None, 8)
+    # trained variables carry over (same arrays, not re-inits)
+    mv, sv = m.get_variables(), sub.get_variables()
+    for name in ("backbone1", "backbone2"):
+        for k in mv["params"][name]:
+            assert sv["params"][name][k] is mv["params"][name][k]
+
+
+def test_new_graph_unknown_layer_raises():
+    m = _base_model()
+    with pytest.raises(ValueError, match="no such layer"):
+        m.new_graph("nope")
+
+
+def test_freeze_up_to_stops_at_named_layer():
+    m = _base_model()
+    m.freeze_up_to("backbone2")
+    assert m.frozen_layer_names() == {"backbone1", "backbone2"}
+    m.unfreeze()
+    assert m.frozen_layer_names() == set()
+
+
+def test_finetune_frozen_backbone_bit_identical():
+    # 1. train the base model briefly
+    m = _base_model()
+    m.compile(optimizer="adam",
+              loss="sparse_categorical_crossentropy_with_logits")
+    x, y = _data()
+    m.fit(x, y, batch_size=16, nb_epoch=1)
+
+    # 2. cut at an intermediate layer, freeze the backbone
+    sub = m.new_graph("backbone2")
+    sub.freeze()
+
+    # 3. stack a fresh head, adopt the trained backbone weights
+    new_out = Dense(3, name="new_head")(sub.outputs[0])
+    ft = Model(sub.inputs[0], new_out)
+    ft.init_from(m)
+    frozen_before = jax.device_get(
+        {n: ft.get_variables()["params"][n]
+         for n in ("backbone1", "backbone2")})
+    head_before = jax.device_get(ft.get_variables()["params"]["new_head"])
+
+    # 4. fine-tune on a 3-class task
+    r = np.random.default_rng(1)
+    y3 = r.integers(0, 3, size=(64, 1))
+    ft.compile(optimizer="adam",
+               loss="sparse_categorical_crossentropy_with_logits")
+    ft.fit(x, y3, batch_size=16, nb_epoch=2)
+
+    after = jax.device_get(ft.get_variables()["params"])
+    # frozen backbone params bit-identical, new head actually moved
+    for name, tree in frozen_before.items():
+        for k, v in tree.items():
+            np.testing.assert_array_equal(v, after[name][k])
+    assert any(not np.array_equal(head_before[k], after["new_head"][k])
+               for k in head_before)
+
+
+def test_freeze_is_bit_identical_under_weight_decay():
+    # regularized layer: plain gradient masking would not be enough —
+    # weight decay moves params even with zero grads
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
+        AdamWeightDecay)
+    seq = Sequential()
+    seq.add(Dense(8, input_shape=(4,), name="frozen_d", activation="relu"))
+    seq.add(Dense(2, name="live_d"))
+    seq.compile(optimizer=AdamWeightDecay(lr=1e-2, weight_decay=0.1),
+                loss="sparse_categorical_crossentropy_with_logits")
+    seq.freeze("frozen_d")
+    r = np.random.default_rng(2)
+    x = r.normal(size=(32, 4)).astype(np.float32)
+    y = r.integers(0, 2, size=(32, 1))
+    before = jax.device_get(seq.get_variables()["params"]["frozen_d"])
+    seq.fit(x, y, batch_size=16, nb_epoch=2)
+    after = jax.device_get(seq.get_variables()["params"]["frozen_d"])
+    for k in before:
+        np.testing.assert_array_equal(before[k], after[k])
+
+
+def test_sequential_freeze_by_name_and_gradient_flow():
+    # gradient must still FLOW THROUGH a frozen middle layer to earlier
+    # trainable layers (stop_gradient is on params, not activations)
+    seq = Sequential()
+    seq.add(Dense(8, input_shape=(4,), name="early", activation="relu"))
+    seq.add(Dense(8, name="middle", activation="relu"))
+    seq.add(Dense(2, name="head"))
+    seq.freeze("middle")
+    variables = seq.init()
+
+    from analytics_zoo_tpu.pipeline.api.keras import objectives
+    loss_fn = objectives.get("sparse_categorical_crossentropy_with_logits")
+    x = np.ones((8, 4), np.float32)
+    y = np.zeros((8, 1), np.int64)
+
+    def loss(p):
+        out, _ = seq.apply(p, x, state=variables["state"], training=True)
+        return loss_fn(y, out)
+
+    g = jax.grad(loss)(variables["params"])
+    assert all(float(jax.numpy.abs(v).sum()) == 0.0
+               for v in jax.tree_util.tree_leaves(g["middle"]))
+    assert any(float(jax.numpy.abs(v).sum()) > 0.0
+               for v in jax.tree_util.tree_leaves(g["early"]))
