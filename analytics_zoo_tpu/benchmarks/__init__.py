@@ -25,3 +25,19 @@ def mfu_estimate(flops_per_step, step_time_s, device):
     if peak is None or not flops_per_step or step_time_s <= 0:
         return None
     return round(flops_per_step / step_time_s / peak, 6)
+
+
+def compiled_flops(jitted, *args):
+    """FLOPs of a compiled jit program via XLA cost analysis; None when
+    the backend doesn't expose it.
+
+    NOTE: XLA counts a while/scan BODY once, not multiplied by the trip
+    count — for a whole-epoch scan program this is (approximately) the
+    FLOPs of one step (times any ``unroll`` factor)."""
+    try:
+        cost = jitted.lower(*args).compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        return float(cost.get("flops", 0.0)) or None
+    except Exception:
+        return None

@@ -4,25 +4,39 @@ examples/inception/Train.scala:75-99 — SGD momentum 0.9, poly(0.5) LR
 decay with warmup).
 
 TPU recipe: bf16 compute / f32 master weights (``dtype.compute``),
-donated buffers, a handful of synthetic batches cycled device-resident
-so the number measures the training step, not the synthetic-data
-generator."""
+donated buffers, and the trainer's device-resident ``lax.scan`` epoch
+path — ``scan_steps`` training steps compile into ONE XLA program with
+zero per-step host involvement, so the number measures the chip, not
+the Python dispatch latency (which dominates over a tunneled backend).
+
+Timing discipline: every wall-clock measurement ends with a host read
+of the scalar loss (D2H transfer).  ``block_until_ready`` alone proved
+unreliable over the experimental tunneled backend (it intermittently
+returned before the dispatched chain completed, yielding physically
+impossible step times); a device→host copy of a value that depends on
+the final step cannot return early.
+
+MFU is computed from XLA's own cost analysis of the compiled epoch
+program (not an analytic estimate — the published "4.1 GFLOPs" ResNet
+figure counts multiply-adds once and underestimates FLOPs 2x).
+"""
 
 from __future__ import annotations
 
 import time
 
-import numpy as np
-
 
 def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
-                     num_classes: int = 1000, warmup_steps: int = 5,
-                     timed_steps: int = 30,
-                     compute_dtype: str = "bfloat16"):
+                     num_classes: int = 1000, scan_steps: int = 48,
+                     repeats: int = 3, compute_dtype: str = "bfloat16",
+                     stem: str = "space_to_depth", unroll: int = 1):
     import jax
+    import jax.numpy as jnp
 
+    from analytics_zoo_tpu.benchmarks import compiled_flops, mfu_estimate
     from analytics_zoo_tpu.models.image.imageclassification import resnet
     from analytics_zoo_tpu.ops import dtypes
+    from analytics_zoo_tpu.parallel import mesh as mesh_lib
     from analytics_zoo_tpu.parallel.trainer import DistributedTrainer
     from analytics_zoo_tpu.pipeline.api.keras import objectives
     from analytics_zoo_tpu.pipeline.api.keras.optimizers import (
@@ -31,10 +45,9 @@ def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
     dtypes.set_policy(param_dtype="float32", compute_dtype=compute_dtype)
 
     model = resnet(50, num_classes=num_classes,
-                   input_shape=(image_size, image_size, 3))
+                   input_shape=(image_size, image_size, 3), stem=stem)
     # reference ImageNet recipe: warmup into poly(0.5) decay
-    sched = warmup_then(0.1, warmup_steps,
-                        poly(0.1, 0.5, max_iteration=10_000))
+    sched = warmup_then(0.1, 5, poly(0.1, 0.5, max_iteration=10_000))
     optim = SGD(learning_rate=0.1, momentum=0.9, schedule=sched)
     loss_fn = objectives.get("sparse_categorical_crossentropy_with_logits")
     trainer = DistributedTrainer(model, loss_fn, optim_method=optim)
@@ -45,43 +58,62 @@ def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
     opt_state = trainer.init_opt_state(params)
     rng = jax.random.PRNGKey(0)
 
-    # a few synthetic batches, placed once and cycled (device-resident)
-    rs = np.random.RandomState(0)
-    n_host_batches = 4
-    batches = [
-        trainer.put_batch((
-            rs.rand(batch_size, image_size, image_size, 3)
-            .astype(np.float32),
-            rs.randint(0, num_classes, size=(batch_size, 1)),
-        ))
-        for _ in range(n_host_batches)
-    ]
+    # Synthetic epoch generated ON DEVICE (no 5 GB H2D over the tunnel),
+    # bf16 images sharded on the data axis — the HBM tier of the
+    # FeatureSet cache hierarchy holding `scan_steps` batches.
+    # epoch_scan_fn treats batch_size as PER-HOST: when the data axes
+    # divide across processes each step slices batch_size*nproc GLOBAL
+    # rows, so the epoch array must be sized accordingly (mirrors
+    # put_batch/put_epoch's host-splitting condition).
+    dp = trainer.mesh.shape[mesh_lib.DATA_AXIS] * \
+        trainer.mesh.shape[mesh_lib.FSDP_AXIS]
+    nproc = jax.process_count()
+    data_split = nproc > 1 and dp % nproc == 0 and dp >= nproc
+    n_rows = scan_steps * batch_size * (nproc if data_split else 1)
+    x_shard = mesh_lib.data_sharding(trainer.mesh, 4)
+    y_shard = mesh_lib.data_sharding(trainer.mesh, 2)
+    gen = jax.jit(
+        lambda k: (
+            jax.random.uniform(
+                k, (n_rows, image_size, image_size, 3), jnp.bfloat16),
+            jax.random.randint(
+                jax.random.fold_in(k, 1), (n_rows, 1), 0, num_classes),
+        ),
+        out_shardings=(x_shard, y_shard))
+    x_dev, y_dev = gen(jax.random.PRNGKey(1))
+    jax.block_until_ready((x_dev, y_dev))
 
+    epoch_fn = trainer.epoch_scan_fn(scan_steps, batch_size,
+                                     unroll=unroll)
+
+    # compile + first execution (donates params/opt_state/state)
     t_compile = time.time()
-    for i in range(warmup_steps):
-        params, opt_state, state, loss = trainer.train_step(
-            params, opt_state, state, batches[i % n_host_batches], rng)
-        if i == 0:
-            jax.block_until_ready(loss)
-            compile_s = time.time() - t_compile
-    jax.block_until_ready(loss)
+    params, opt_state, state, mloss = epoch_fn(
+        params, opt_state, state, x_dev, y_dev, rng)
+    float(mloss)                       # D2H sync — see module docstring
+    compile_s = time.time() - t_compile
 
-    t0 = time.time()
-    for i in range(timed_steps):
-        params, opt_state, state, loss = trainer.train_step(
-            params, opt_state, state, batches[i % n_host_batches], rng)
-    jax.block_until_ready(loss)
-    wall = time.time() - t0
+    walls = []
+    for r in range(repeats):
+        t0 = time.time()
+        params, opt_state, state, mloss = epoch_fn(
+            params, opt_state, state, x_dev, y_dev,
+            jax.random.fold_in(rng, r))
+        loss_val = float(mloss)        # D2H sync
+        walls.append(time.time() - t0)
+    wall = min(walls)
 
-    imgs_per_sec = timed_steps * batch_size / wall
-    step_ms = wall / timed_steps * 1e3
+    # cost analysis AFTER the timed loop: .lower().compile() goes
+    # through a separate AOT path that would recompile the epoch
+    # program, so it must not sit between jit-compile and timing.
+    flops = compiled_flops(epoch_fn, params, opt_state, state, x_dev,
+                           y_dev, rng)
+    if flops:
+        flops /= unroll        # unrolled scan body holds `unroll` steps
 
-    # FLOP estimate: ResNet-50 fwd ≈ 4.1 GFLOPs/img @224 (standard
-    # published figure, scaled for image size), training ≈ 3x fwd.
-    fwd_flops = 4.1e9 * (image_size / 224.0) ** 2
-    train_flops = 3.0 * fwd_flops * batch_size
-    from analytics_zoo_tpu.benchmarks import mfu_estimate
-    mfu = mfu_estimate(train_flops, wall / timed_steps, device)
+    imgs_per_sec = scan_steps * batch_size / wall
+    step_ms = wall / scan_steps * 1e3
+    mfu = mfu_estimate(flops, wall / scan_steps, device)
 
     return {
         "metric": "resnet50_imagenet_train_throughput",
@@ -92,10 +124,14 @@ def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
         "batch_size": batch_size,
         "image_size": image_size,
         "step_time_ms": round(step_ms, 2),
-        "timed_steps": timed_steps,
+        "scan_steps": scan_steps,
+        "repeats": repeats,
+        "wall_s_per_repeat": [round(w, 3) for w in walls],
         "compile_time_s": round(compile_s, 2),
         "compute_dtype": compute_dtype,
-        "final_loss": float(loss),
+        "stem": stem,
+        "final_loss": loss_val,
+        "xla_flops_per_step": flops,
         "mfu_est": mfu,
         "device": str(device),
         "device_kind": getattr(device, "device_kind", "?"),

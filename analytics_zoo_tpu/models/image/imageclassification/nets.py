@@ -21,6 +21,7 @@ from analytics_zoo_tpu.pipeline.api.keras import Input, Model
 from analytics_zoo_tpu.pipeline.api.keras.layers import (
     Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
     Dropout, Flatten, GlobalAveragePooling2D, MaxPooling2D, Merge,
+    SpaceToDepth2D,
 )
 
 
@@ -80,11 +81,26 @@ _RESNET_SPECS = {
 
 
 def resnet(depth: int = 50, num_classes: int = 1000,
-           input_shape: Tuple[int, int, int] = (224, 224, 3)) -> Model:
-    """ResNet for ImageNet-scale inputs (TrainImageNet.scala recipe)."""
+           input_shape: Tuple[int, int, int] = (224, 224, 3),
+           stem: str = "conv7") -> Model:
+    """ResNet for ImageNet-scale inputs (TrainImageNet.scala recipe).
+
+    ``stem="conv7"`` is the classic 7x7/stride-2 stem; ``"space_to_depth"``
+    is the MXU-efficient equivalent (2x2 pixel blocks packed into 12
+    channels, then a 4x4/stride-1 conv whose 8x8-pixel receptive field
+    covers the 7x7 original) — same output shape and capacity, ~4x the
+    stem's MXU utilisation on TPU.
+    """
     block, reps = _RESNET_SPECS[depth]
     inp = Input(shape=input_shape)
-    x = _conv_bn(inp, 64, 7, 2)
+    if stem == "space_to_depth":
+        x = SpaceToDepth2D(2)(inp)
+        x = _conv_bn(x, 64, 4, 1)
+    elif stem == "conv7":
+        x = _conv_bn(inp, 64, 7, 2)
+    else:
+        raise ValueError(f"unknown stem {stem!r}; "
+                         "expected 'conv7' or 'space_to_depth'")
     x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
                      border_mode="same")(x)
     filters = 64

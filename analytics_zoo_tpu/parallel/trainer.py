@@ -245,7 +245,8 @@ class DistributedTrainer:
         return self._train_step(params, opt_state, state, batch, rng)
 
     # ------------------------------------------------- device-resident epoch
-    def epoch_scan_fn(self, num_batches: int, batch_size: int):
+    def epoch_scan_fn(self, num_batches: int, batch_size: int,
+                      unroll: int = 1):
         """Whole-epoch trainer over DEVICE-RESIDENT data — the HBM tier
         of the FeatureSet cache hierarchy (the reference's DRAM cache,
         FeatureSet.scala:229-329, moved all the way onto the chip).
@@ -290,7 +291,7 @@ class DistributedTrainer:
 
             (params, opt_state, state), losses = jax.lax.scan(
                 body, (params, opt_state, state),
-                jnp.arange(num_batches))
+                jnp.arange(num_batches), unroll=unroll)
             return params, opt_state, state, losses.mean()
 
         donate = (0, 1, 2) if self.donate else ()

@@ -16,6 +16,7 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.conv import (
     AtrousConvolution1D, AtrousConvolution2D, Convolution1D,
     Convolution2D, Convolution3D, Cropping1D, Cropping2D, Cropping3D,
     Deconvolution2D, SeparableConvolution2D, ShareConvolution2D,
+    SpaceToDepth2D,
     UpSampling1D, UpSampling2D, UpSampling3D,
     ZeroPadding1D, ZeroPadding2D, ZeroPadding3D,
 )
@@ -85,6 +86,7 @@ __all__ = [
     "BERT", "MultiHeadSelfAttention", "PositionwiseFeedForward",
     "TransformerLayer", "transformer_block",
     "SparseEmbedding", "AtrousConvolution1D", "ShareConvolution2D",
+    "SpaceToDepth2D",
     "AddConstant", "BinaryThreshold", "CAdd", "CMul", "Exp",
     "GaussianSampler", "HardShrink", "HardTanh", "Identity", "Log",
     "LRN2D", "Mul", "MulConstant", "Negative", "Power",

@@ -460,3 +460,32 @@ class ShareConvolution2D(_ConvND):
         padded = self._from_tf(
             self._pad(self._to_tf(input_shape), symbolic=True))
         return super().compute_output_shape(padded)
+
+
+class SpaceToDepth2D(Layer):
+    """Pack ``block_size x block_size`` spatial blocks into channels:
+    (B, H, W, C) -> (B, H/bs, W/bs, bs*bs*C).
+
+    TPU-native addition (no reference analogue): the MXU contracts over
+    128 lanes, so a conv over a 3-channel image wastes >95% of the
+    contraction dimension.  Packing 2x2 pixel blocks first (12 channels)
+    lets an equivalent 4x4/stride-1 conv replace the classic 7x7/stride-2
+    ImageNet stem at ~4x the MXU utilisation — the standard public
+    MLPerf-ResNet formulation of the stem.
+    """
+
+    def __init__(self, block_size: int = 2, **kwargs):
+        super().__init__(**kwargs)
+        self.block_size = int(block_size)
+
+    def call(self, params, x, training=False, rng=None):
+        b, h, w, c = x.shape
+        s = self.block_size
+        x = x.reshape(b, h // s, s, w // s, s, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5)
+        return x.reshape(b, h // s, w // s, s * s * c)
+
+    def compute_output_shape(self, input_shape):
+        b, h, w, c = input_shape
+        s = self.block_size
+        return (b, h // s, w // s, s * s * c)

@@ -10,6 +10,7 @@ reference's per-replica BN behavior in BigDL.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from analytics_zoo_tpu.ops.dtypes import get_policy
@@ -54,10 +55,16 @@ class BatchNormalization(Layer):
         bshape[ax] = x.shape[ax]
 
         if training:
-            # statistics in f32 regardless of the (possibly bf16) input
+            # single-pass f32 statistics: mean and mean-of-squares share
+            # one read of the (bf16) activation — XLA multi-output-fuses
+            # the two reductions, where jnp.var's (x - mean)^2 form
+            # costs a second full pass.  var = E[x^2] - E[x]^2 in f32 is
+            # the standard mixed-precision BN formulation (flax does the
+            # same); clamp guards the tiny negative from cancellation.
             xf = x.astype(jnp.float32)
             mean = jnp.mean(xf, axis=reduce_axes)
-            var = jnp.var(xf, axis=reduce_axes)
+            m2 = jnp.mean(xf * xf, axis=reduce_axes)
+            var = jnp.maximum(m2 - mean * mean, 0.0)
             m = self.momentum
             new_state = {
                 "moving_mean": m * state["moving_mean"] + (1 - m) * mean,
@@ -68,13 +75,19 @@ class BatchNormalization(Layer):
             var = state["moving_var"]
             new_state = state
 
-        y = (x - mean.reshape(bshape)) / jnp.sqrt(
-            var.reshape(bshape) + self.epsilon)
+        # fold mean/var/gamma/beta into per-channel scale+bias (C cheap
+        # f32 scalars), then apply ONE fused multiply-add in the input's
+        # compute dtype — the per-element work is bf16 and fusable into
+        # the producing conv's epilogue.
+        inv = jax.lax.rsqrt(var + self.epsilon)
         if self.scale:
-            y = y * params["gamma"].reshape(bshape)
+            inv = inv * params["gamma"]
+        bias = -mean * inv
         if self.center:
-            y = y + params["beta"].reshape(bshape)
-        return y.astype(x.dtype), new_state
+            bias = bias + params["beta"]
+        y = x * inv.reshape(bshape).astype(x.dtype) \
+            + bias.reshape(bshape).astype(x.dtype)
+        return y, new_state
 
 
 class LayerNorm(Layer):
