@@ -134,3 +134,21 @@ def local_batch_size(mesh: Mesh, batch_size: int) -> int:
             f"batch {batch_size} not divisible by data-parallel "
             f"degree {dp}" + (" (per-host)" if nproc > 1 else ""))
     return batch_size // dp
+
+
+def data_split_across_hosts(mesh: Mesh) -> bool:
+    """True when the data axes divide across processes (each host feeds
+    its own slice of the global batch); False means every host must
+    feed IDENTICAL replicated batches.  The single source of truth for
+    the host-splitting rule used by put_batch / epoch_scan_fn /
+    benchmarks."""
+    dp = mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
+    nproc = jax.process_count()
+    return nproc > 1 and dp % nproc == 0 and dp >= nproc
+
+
+def global_batch_rows(mesh: Mesh, batch_size: int) -> int:
+    """Rows of the GLOBAL batch for a per-host ``batch_size`` (equal to
+    ``batch_size`` whenever hosts replicate instead of splitting)."""
+    return batch_size * (jax.process_count()
+                         if data_split_across_hosts(mesh) else 1)

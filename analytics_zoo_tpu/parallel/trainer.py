@@ -269,13 +269,7 @@ class DistributedTrainer:
         """
         local_bs = mesh_lib.local_batch_size(self.mesh, batch_size)
         del local_bs   # validation only
-        # mirror put_batch's host-splitting condition exactly
-        dp = self.mesh.shape[mesh_lib.DATA_AXIS] * \
-            self.mesh.shape[mesh_lib.FSDP_AXIS]
-        nproc = jax.process_count()
-        data_split_across_hosts = nproc > 1 and dp % nproc == 0 and \
-            dp >= nproc
-        global_bs = batch_size * (nproc if data_split_across_hosts else 1)
+        global_bs = mesh_lib.global_batch_rows(self.mesh, batch_size)
 
         def epoch(params, opt_state, state, x, y, rng):
             def body(carry, i):
@@ -364,8 +358,8 @@ class DistributedTrainer:
         # data axes spread across processes only when they divide evenly;
         # otherwise (e.g. pure model-parallel, dp=1 over 2 hosts) every
         # host must feed the IDENTICAL batch, which is replicated below.
-        data_split_across_hosts = nproc > 1 and dp % nproc == 0 and \
-            dp >= nproc
+        data_split_across_hosts = mesh_lib.data_split_across_hosts(
+            self.mesh)
         local_dp = dp // nproc if data_split_across_hosts else dp
 
         def put(a):

@@ -41,6 +41,12 @@ class Merge(Layer):
             for x in inputs[1:]:
                 out = jnp.minimum(out, x)
             return out
+        if mode == "sub":
+            # two-input subtraction (tf.keras Subtract; not in the
+            # reference's Merge.scala mode set but needed by the
+            # tfpark converter)
+            a, b = inputs
+            return a - b
         if mode == "ave":
             out = inputs[0]
             for x in inputs[1:]:

@@ -84,9 +84,23 @@ class TFOptimizer:
                    val_set=getattr(dataset, "val_set", None),
                    model_dir=model_dir, **kwargs)
 
-    # the reference's from_train_op couples the update to in-graph ops;
-    # the functional equivalent is from_loss with an explicit optimizer
-    from_train_op = from_loss
+    @classmethod
+    def from_train_op(cls, *args, **kwargs):
+        """NOT SUPPORTED — and deliberately not aliased to from_loss.
+
+        The reference's from_train_op (tf_optimizer.py:430) keeps the
+        user's own in-graph update semantics (TFTrainingHelperV2 +
+        FakeOptimMethod apply whatever ops the train_op runs); there is
+        no TF graph here, so silently substituting from_loss would
+        change WHAT update gets applied.  Raise with a migration path
+        instead of lying about semantics."""
+        raise NotImplementedError(
+            "from_train_op couples training to a TF1 in-graph update op, "
+            "which has no equivalent in this TPU-native runtime. Migrate "
+            "to TFOptimizer.from_loss(model, criterion, dataset, "
+            "optim_method=...) — the optimizer is explicit — or, for a "
+            "custom update rule, pass an optax.GradientTransformation "
+            "as optim_method.")
 
     # -------------------------------------------------------------- running
     def set_train_summary(self, log_dir: str, app_name: str):

@@ -290,6 +290,8 @@ def main(argv=None):
         }
 
     if args.child:
+        if args.workload == "all":
+            ap.error("--child requires a concrete --workload")
         try:
             _apply_platform_env()
             _emit(WORKLOADS[args.workload]())

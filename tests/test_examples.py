@@ -27,6 +27,9 @@ EXAMPLES = [
 ]
 
 
+pytestmark = pytest.mark.slow   # heavy jit compiles / end-to-end runs
+
+
 @pytest.mark.parametrize("module", EXAMPLES)
 def test_example_smoke(module):
     mod = importlib.import_module(module)

@@ -1,0 +1,335 @@
+"""Golden tests: recurrent/stateful layers vs real torch/TF oracles.
+
+The reference golden-tests its Keras layer set against actual Keras via
+KerasRunner (zoo/src/test/.../KerasRunner.scala:30 runs Keras in a
+subprocess and compares forward + gradients).  Equivalent here: copy
+weights into ``torch.nn`` / ``tf.keras`` layers and compare forward
+activations AND input gradients to <=1e-4 in f32.
+
+Conventions verified:
+- LSTM gate order i,f,c,o (matches both tf.keras and torch.nn.LSTM).
+- GRU gate order z,r,h with reset-before-matmul (Keras-1 convention ==
+  tf.keras ``reset_after=False``; torch's GRU applies reset AFTER the
+  recurrent matmul and orders gates r,z,n, so torch is deliberately NOT
+  an oracle for GRU).
+- BatchNorm momentum is the KEEP-OLD factor (Keras convention; torch's
+  ``momentum`` is 1 - ours) and moving_var stores the BIASED batch
+  variance (Keras; torch stores unbiased — corrected in the test).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+pytestmark = pytest.mark.slow   # oracle comparisons: TF/torch + many jit compiles
+
+
+@pytest.fixture(autouse=True)
+def _f32_policy():
+    """Golden comparisons need f32 end-to-end (default policy is bf16)."""
+    from analytics_zoo_tpu.ops import dtypes
+    old = dtypes.get_policy()
+    dtypes.set_policy(param_dtype="float32", compute_dtype="float32")
+    yield
+    dtypes._policy = old
+
+
+def _native_forward_and_grad(layer, params, x):
+    """(forward, d sum(forward) / dx) for a stateless native layer."""
+    def f(xx):
+        return layer.call(params, xx, training=False)
+    out = f(x)
+    gx = jax.grad(lambda xx: f(xx).sum())(x)
+    return np.asarray(out), np.asarray(gx)
+
+
+def _tf_forward_and_grad(tfl, x):
+    import tensorflow as tf
+    xt = tf.constant(x)
+    with tf.GradientTape() as tape:
+        tape.watch(xt)
+        out = tfl(xt, training=False)
+        s = tf.reduce_sum(out)
+    gx = tape.gradient(s, xt)
+    return out.numpy(), gx.numpy()
+
+
+def _assert_close(a, b, tol=1e-4):
+    np.testing.assert_allclose(a, b, rtol=tol, atol=tol)
+
+
+# ------------------------------------------------------------------ LSTM/TF
+class TestLSTMvsTF:
+    @pytest.mark.parametrize("return_sequences", [False, True])
+    def test_lstm_matches_tf(self, return_sequences):
+        import tensorflow as tf
+        from analytics_zoo_tpu.pipeline.api.keras.layers import LSTM
+        B, T, D, H = 3, 5, 4, 7
+        tfl = tf.keras.layers.LSTM(H, return_sequences=return_sequences)
+        x = np.random.RandomState(0).randn(B, T, D).astype(np.float32)
+        tfl.build((None, T, D))
+        k, rk, b = [np.asarray(w) for w in tfl.get_weights()]
+
+        nl = LSTM(H, return_sequences=return_sequences)
+        params = nl.init(jax.random.PRNGKey(0), (None, T, D))["params"]
+        params = dict(params, kernel=jnp.asarray(k),
+                      recurrent_kernel=jnp.asarray(rk),
+                      bias=jnp.asarray(b))
+        out, gx = _native_forward_and_grad(nl, params, x)
+        ref, gref = _tf_forward_and_grad(tfl, x)
+        _assert_close(out, ref)
+        _assert_close(gx, gref)
+
+    def test_lstm_matches_torch(self):
+        import torch
+        from analytics_zoo_tpu.pipeline.api.keras.layers import LSTM
+        B, T, D, H = 2, 6, 3, 5
+        tm = torch.nn.LSTM(D, H, batch_first=True)
+        # torch packs gates i,f,g,o as rows of (4H, D) — transpose to
+        # our (D, 4H); bias = b_ih + b_hh
+        k = tm.weight_ih_l0.detach().numpy().T
+        rk = tm.weight_hh_l0.detach().numpy().T
+        b = (tm.bias_ih_l0 + tm.bias_hh_l0).detach().numpy()
+        x = np.random.RandomState(1).randn(B, T, D).astype(np.float32)
+
+        nl = LSTM(H, return_sequences=True)
+        params = nl.init(jax.random.PRNGKey(0), (None, T, D))["params"]
+        params = dict(params, kernel=jnp.asarray(k),
+                      recurrent_kernel=jnp.asarray(rk),
+                      bias=jnp.asarray(b))
+        out, gx = _native_forward_and_grad(nl, params, x)
+
+        xt = torch.from_numpy(x).requires_grad_(True)
+        ref, _ = tm(xt)
+        ref.sum().backward()
+        _assert_close(out, ref.detach().numpy())
+        _assert_close(gx, xt.grad.numpy())
+
+
+# ------------------------------------------------------------------- GRU/TF
+class TestGRUvsTF:
+    @pytest.mark.parametrize("return_sequences", [False, True])
+    def test_gru_matches_tf(self, return_sequences):
+        import tensorflow as tf
+        from analytics_zoo_tpu.pipeline.api.keras.layers import GRU
+        B, T, D, H = 3, 5, 4, 6
+        # reset_after=False == the Keras-1 convention this framework
+        # implements (reset applied before the recurrent matmul)
+        tfl = tf.keras.layers.GRU(H, return_sequences=return_sequences,
+                                  reset_after=False)
+        x = np.random.RandomState(2).randn(B, T, D).astype(np.float32)
+        tfl.build((None, T, D))
+        k, rk, b = [np.asarray(w) for w in tfl.get_weights()]
+
+        nl = GRU(H, return_sequences=return_sequences)
+        params = nl.init(jax.random.PRNGKey(0), (None, T, D))["params"]
+        params = dict(params, kernel=jnp.asarray(k),
+                      recurrent_kernel=jnp.asarray(rk),
+                      bias=jnp.asarray(b))
+        out, gx = _native_forward_and_grad(nl, params, x)
+        ref, gref = _tf_forward_and_grad(tfl, x)
+        _assert_close(out, ref)
+        _assert_close(gx, gref)
+
+
+# ------------------------------------------------------------ SimpleRNN/TF
+class TestSimpleRNNvsTF:
+    def test_simple_rnn_matches_tf(self):
+        import tensorflow as tf
+        from analytics_zoo_tpu.pipeline.api.keras.layers import SimpleRNN
+        B, T, D, H = 2, 4, 3, 5
+        tfl = tf.keras.layers.SimpleRNN(H)
+        x = np.random.RandomState(3).randn(B, T, D).astype(np.float32)
+        tfl.build((None, T, D))
+        k, rk, b = [np.asarray(w) for w in tfl.get_weights()]
+        nl = SimpleRNN(H)
+        params = nl.init(jax.random.PRNGKey(0), (None, T, D))["params"]
+        params = dict(params, kernel=jnp.asarray(k),
+                      recurrent_kernel=jnp.asarray(rk),
+                      bias=jnp.asarray(b))
+        out, gx = _native_forward_and_grad(nl, params, x)
+        ref, gref = _tf_forward_and_grad(tfl, x)
+        _assert_close(out, ref)
+        _assert_close(gx, gref)
+
+
+# --------------------------------------------------------- Bidirectional/TF
+class TestBidirectionalvsTF:
+    def test_bidirectional_lstm_concat_matches_tf(self):
+        import tensorflow as tf
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (
+            LSTM, Bidirectional)
+        B, T, D, H = 2, 5, 3, 4
+        tfl = tf.keras.layers.Bidirectional(
+            tf.keras.layers.LSTM(H), merge_mode="concat")
+        x = np.random.RandomState(4).randn(B, T, D).astype(np.float32)
+        tfl.build((None, T, D))
+        fw = [np.asarray(w) for w in tfl.forward_layer.get_weights()]
+        bw = [np.asarray(w) for w in tfl.backward_layer.get_weights()]
+
+        nl = Bidirectional(LSTM(H), merge_mode="concat")
+        params = nl.init(jax.random.PRNGKey(0), (None, T, D))["params"]
+        params = {
+            "forward": dict(params["forward"],
+                            kernel=jnp.asarray(fw[0]),
+                            recurrent_kernel=jnp.asarray(fw[1]),
+                            bias=jnp.asarray(fw[2])),
+            "backward": dict(params["backward"],
+                             kernel=jnp.asarray(bw[0]),
+                             recurrent_kernel=jnp.asarray(bw[1]),
+                             bias=jnp.asarray(bw[2])),
+        }
+        out, gx = _native_forward_and_grad(nl, params, x)
+        ref, gref = _tf_forward_and_grad(tfl, x)
+        _assert_close(out, ref)
+        _assert_close(gx, gref)
+
+
+# ------------------------------------------------------------ ConvLSTM2D/TF
+class TestConvLSTM2DvsTF:
+    @pytest.mark.parametrize("return_sequences", [False, True])
+    def test_convlstm2d_matches_tf(self, return_sequences):
+        import tensorflow as tf
+        from analytics_zoo_tpu.pipeline.api.keras.layers import ConvLSTM2D
+        B, T, H, W, C, F, K = 2, 3, 6, 6, 2, 4, 3
+        tfl = tf.keras.layers.ConvLSTM2D(
+            F, K, padding="same", return_sequences=return_sequences)
+        x = np.random.RandomState(5).randn(B, T, H, W, C).astype(np.float32)
+        tfl.build((None, T, H, W, C))
+        k, rk, b = [np.asarray(w) for w in tfl.get_weights()]
+
+        nl = ConvLSTM2D(F, K, return_sequences=return_sequences)
+        params = nl.init(jax.random.PRNGKey(0),
+                         (None, T, H, W, C))["params"]
+        params = dict(params, kernel=jnp.asarray(k),
+                      recurrent_kernel=jnp.asarray(rk),
+                      bias=jnp.asarray(b))
+        out, gx = _native_forward_and_grad(nl, params, x)
+        ref, gref = _tf_forward_and_grad(tfl, x)
+        _assert_close(out, ref)
+        _assert_close(gx, gref)
+
+
+# ---------------------------------------------------------- BatchNorm/torch
+class TestBatchNormVsTorch:
+    def _native(self, momentum):
+        from analytics_zoo_tpu.pipeline.api.keras.layers import (
+            BatchNormalization)
+        return BatchNormalization(epsilon=1e-5, momentum=momentum)
+
+    def test_train_mode_matches_torch_1d(self):
+        import torch
+        B, C = 16, 6
+        x = np.random.RandomState(6).randn(B, C).astype(np.float32)
+        tm = torch.nn.BatchNorm1d(C, eps=1e-5, momentum=0.1)
+        with torch.no_grad():
+            tm.weight.copy_(torch.rand(C) + 0.5)
+            tm.bias.copy_(torch.randn(C))
+        nl = self._native(momentum=0.9)   # keep-old = 1 - torch momentum
+        v = nl.init(jax.random.PRNGKey(0), (None, C))
+        params = {"gamma": jnp.asarray(tm.weight.detach().numpy()),
+                  "beta": jnp.asarray(tm.bias.detach().numpy())}
+        state = v["state"]
+
+        def f(xx):
+            return nl.apply(params, xx, state=state, training=True)
+        out, new_state = f(jnp.asarray(x))
+        gx = jax.grad(lambda xx: f(xx)[0].sum())(jnp.asarray(x))
+
+        tm.train()
+        xt = torch.from_numpy(x).requires_grad_(True)
+        ref = tm(xt)
+        ref.sum().backward()
+        _assert_close(np.asarray(out), ref.detach().numpy())
+        _assert_close(np.asarray(gx), xt.grad.numpy())
+        # moving mean matches directly; torch stores UNBIASED running
+        # var where ours (Keras convention) stores biased — checked
+        # exactly against both conventions below
+        _assert_close(np.asarray(new_state["moving_mean"]),
+                      tm.running_mean.numpy())
+        batch_var_biased = x.var(0)
+        expected_ours = 0.9 * 1.0 + 0.1 * batch_var_biased
+        _assert_close(np.asarray(new_state["moving_var"]), expected_ours)
+        expected_torch = 0.9 * 1.0 + 0.1 * x.var(0, ddof=1)
+        _assert_close(tm.running_var.numpy(), expected_torch)
+
+    def test_infer_mode_matches_torch_1d(self):
+        import torch
+        B, C = 8, 5
+        x = np.random.RandomState(7).randn(B, C).astype(np.float32)
+        tm = torch.nn.BatchNorm1d(C, eps=1e-5, momentum=0.1)
+        with torch.no_grad():
+            tm.weight.copy_(torch.rand(C) + 0.5)
+            tm.bias.copy_(torch.randn(C))
+            tm.running_mean.copy_(torch.randn(C))
+            tm.running_var.copy_(torch.rand(C) + 0.5)
+        tm.eval()
+        nl = self._native(momentum=0.9)
+        nl.init(jax.random.PRNGKey(0), (None, C))
+        params = {"gamma": jnp.asarray(tm.weight.detach().numpy()),
+                  "beta": jnp.asarray(tm.bias.detach().numpy())}
+        state = {"moving_mean": jnp.asarray(tm.running_mean.numpy()),
+                 "moving_var": jnp.asarray(tm.running_var.numpy())}
+        out, _ = nl.apply(params, jnp.asarray(x), state=state,
+                          training=False)
+        with torch.no_grad():
+            ref = tm(torch.from_numpy(x)).numpy()
+        _assert_close(np.asarray(out), ref)
+
+    def test_train_mode_matches_torch_2d(self):
+        import torch
+        B, H, W, C = 4, 5, 5, 3
+        x = np.random.RandomState(8).randn(B, H, W, C).astype(np.float32)
+        tm = torch.nn.BatchNorm2d(C, eps=1e-5, momentum=0.1)
+        with torch.no_grad():
+            tm.weight.copy_(torch.rand(C) + 0.5)
+            tm.bias.copy_(torch.randn(C))
+        tm.train()
+        nl = self._native(momentum=0.9)
+        v = nl.init(jax.random.PRNGKey(0), (None, H, W, C))
+        params = {"gamma": jnp.asarray(tm.weight.detach().numpy()),
+                  "beta": jnp.asarray(tm.bias.detach().numpy())}
+        out, _ = nl.apply(params, jnp.asarray(x), state=v["state"],
+                          training=True)
+        gx = jax.grad(lambda xx: nl.apply(
+            params, xx, state=v["state"], training=True)[0].sum())(
+                jnp.asarray(x))
+        xt = torch.from_numpy(x.transpose(0, 3, 1, 2)).requires_grad_(True)
+        ref = tm(xt)
+        ref.sum().backward()
+        _assert_close(np.asarray(out),
+                      ref.detach().numpy().transpose(0, 2, 3, 1))
+        _assert_close(np.asarray(gx),
+                      xt.grad.numpy().transpose(0, 2, 3, 1))
+
+
+# ------------------------------------------------------------- Embedding/TF
+class TestEmbeddingvsTF:
+    def test_embedding_matches_tf(self):
+        import tensorflow as tf
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Embedding
+        V, E, B, T = 11, 6, 3, 4
+        tfl = tf.keras.layers.Embedding(V, E)
+        idx = np.random.RandomState(9).randint(0, V, size=(B, T))
+        tfl.build((None, T))
+        table = np.asarray(tfl.get_weights()[0])
+        nl = Embedding(V, E)
+        params = nl.init(jax.random.PRNGKey(0), (None, T))["params"]
+        params = dict(params, embeddings=jnp.asarray(table))
+        out = nl.call(params, jnp.asarray(idx))
+        ref = tfl(tf.constant(idx)).numpy()
+        _assert_close(np.asarray(out), ref)
+        # gradient wrt the table (input is integer — differentiate the
+        # parameter instead, the meaningful gradient for embeddings)
+        g = jax.grad(lambda p: nl.call(p, jnp.asarray(idx)).sum())(
+            params)["embeddings"]
+        import tensorflow as tf2
+        with tf.GradientTape() as tape:
+            o = tfl(tf.constant(idx))
+            s = tf.reduce_sum(o)
+        gref = tape.gradient(s, tfl.trainable_variables[0])
+        gref = tf.convert_to_tensor(gref).numpy() if not isinstance(
+            gref, np.ndarray) else gref
+        _assert_close(np.asarray(g), gref)

@@ -14,6 +14,9 @@ from analytics_zoo_tpu.models.image.imageclassification import (
 from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
 
 
+pytestmark = pytest.mark.slow   # heavy jit compiles / end-to-end runs
+
+
 def fake_images(n=8, h=32, w=32, c=3, seed=0):
     rs = np.random.RandomState(seed)
     return rs.randint(0, 255, (n, h, w, c)).astype(np.uint8)

@@ -140,6 +140,7 @@ class TestTFPark:
         np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-2,
                                    atol=2e-2)
 
+    @pytest.mark.slow
     def test_distributed_fit(self):
         from analytics_zoo_tpu.tfpark import KerasModel
         tfm = self._tf_model()
@@ -163,3 +164,130 @@ class TestTFPark:
         assert tfd.get_training_batch_size() == 16
         batches = list(tfd.feature_set.epoch_batches(0, 16))
         assert len(batches) == 4
+
+
+class TestFunctionalConversion:
+    """Functional-API tf.keras → native graph Model
+    (ref tf_optimizer.py:537 from_keras accepts arbitrary Models via
+    graph export; here the get_config() layer graph is walked)."""
+
+    @pytest.fixture(autouse=True)
+    def _f32_policy(self):
+        """f32 end-to-end so forward parity vs TF holds to 1e-4 (the
+        default policy computes in bf16); restored afterwards."""
+        from analytics_zoo_tpu.ops import dtypes
+        old = dtypes.get_policy()
+        dtypes.set_policy(param_dtype="float32", compute_dtype="float32")
+        yield
+        dtypes._policy = old
+
+    def _two_tower(self):
+        import tensorflow as tf
+        user = tf.keras.Input(shape=(8,), name="user_feat")
+        item = tf.keras.Input(shape=(8,), name="item_feat")
+        shared = tf.keras.layers.Dense(16, activation="relu",
+                                       name="shared_proj")
+        u, i = shared(user), shared(item)
+        both = tf.keras.layers.Concatenate(name="cat")([u, i])
+        h = tf.keras.layers.Dense(8, activation="relu", name="h")(both)
+        d = tf.keras.layers.Subtract(name="diff")([u, i])
+        merged = tf.keras.layers.Concatenate(name="cat2")([h, d])
+        out = tf.keras.layers.Dense(2, name="logits")(merged)
+        return tf.keras.Model([user, item], out)
+
+    def test_two_tower_forward_parity(self):
+        from analytics_zoo_tpu.tfpark.converter import convert_keras_model
+        tfm = self._two_tower()
+        native = convert_keras_model(tfm)
+        rs = np.random.RandomState(0)
+        xu = rs.randn(6, 8).astype(np.float32)
+        xi = rs.randn(6, 8).astype(np.float32)
+        ref = tfm([xu, xi], training=False).numpy()
+        out, _ = native.apply(native.get_variables()["params"],
+                              [xu, xi],
+                              state=native.get_variables()["state"],
+                              training=False)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_shared_layer_is_single_instance(self):
+        from analytics_zoo_tpu.tfpark.converter import convert_keras_model
+        tfm = self._two_tower()
+        native = convert_keras_model(tfm)
+        params = native.get_variables()["params"]
+        # one parameter entry for the shared tower despite two calls
+        assert "shared_proj" in params
+        names = [l.name for l in native.layers]
+        assert names.count("shared_proj") == 1
+
+    def test_residual_add_and_bn_forward_parity(self):
+        import tensorflow as tf
+        from analytics_zoo_tpu.tfpark.converter import convert_keras_model
+        inp = tf.keras.Input(shape=(12,))
+        h = tf.keras.layers.Dense(12, activation="relu")(inp)
+        h = tf.keras.layers.BatchNormalization()(h)
+        res = tf.keras.layers.Add()([inp, h])
+        out = tf.keras.layers.Dense(3)(res)
+        tfm = tf.keras.Model(inp, out)
+        # make BN stats non-trivial
+        tfm.layers[2].set_weights([
+            np.random.RandomState(1).rand(12).astype(np.float32) + 0.5,
+            np.random.RandomState(2).randn(12).astype(np.float32),
+            np.random.RandomState(3).randn(12).astype(np.float32),
+            np.random.RandomState(4).rand(12).astype(np.float32) + 0.5,
+        ])
+        native = convert_keras_model(tfm)
+        x = np.random.RandomState(0).randn(5, 12).astype(np.float32)
+        ref = tfm(x, training=False).numpy()
+        out_n, _ = native.apply(native.get_variables()["params"], x,
+                                state=native.get_variables()["state"],
+                                training=False)
+        np.testing.assert_allclose(np.asarray(out_n), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    @pytest.mark.slow
+    def test_two_tower_trains_via_tf_optimizer(self):
+        import tensorflow as tf
+        from analytics_zoo_tpu.tfpark import TFOptimizer
+        from analytics_zoo_tpu.common.triggers import MaxEpoch
+        tfm = self._two_tower()
+        tfm.compile(optimizer=tf.keras.optimizers.Adam(0.01),
+                    loss="sparse_categorical_crossentropy")
+        rs = np.random.RandomState(0)
+        xu = rs.randn(256, 8).astype(np.float32)
+        xi = rs.randn(256, 8).astype(np.float32)
+        y = (np.sum(xu * xi, -1) > 0).astype(np.int32)
+        opt = TFOptimizer.from_keras(tfm, ([xu, xi], y))
+        opt.batch_size = 64
+        history = opt.optimize(end_trigger=MaxEpoch(6))
+        losses = [h["loss"] for h in history]
+        assert float(losses[-1]) < float(losses[0])
+
+    def test_from_train_op_raises(self):
+        from analytics_zoo_tpu.tfpark import TFOptimizer
+        with pytest.raises(NotImplementedError, match="from_loss"):
+            TFOptimizer.from_train_op(None, None, None)
+
+    def test_dot_normalize_and_bn_no_scale(self):
+        import tensorflow as tf
+        from analytics_zoo_tpu.tfpark.converter import convert_keras_model
+        a = tf.keras.Input(shape=(6,), name="a")
+        b = tf.keras.Input(shape=(6,), name="b")
+        ha = tf.keras.layers.Dense(4, name="pa")(a)
+        hb = tf.keras.layers.Dense(4, name="pb")(b)
+        ha = tf.keras.layers.BatchNormalization(scale=False,
+                                                name="bn")(ha)
+        sim = tf.keras.layers.Dot(axes=1, normalize=True,
+                                  name="cos")([ha, hb])
+        tfm = tf.keras.Model([a, b], sim)
+        native = convert_keras_model(tfm)
+        rs = np.random.RandomState(3)
+        xa = rs.randn(5, 6).astype(np.float32)
+        xb = rs.randn(5, 6).astype(np.float32)
+        ref = tfm([xa, xb], training=False).numpy()
+        out, _ = native.apply(native.get_variables()["params"],
+                              [xa, xb],
+                              state=native.get_variables()["state"],
+                              training=False)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-5)

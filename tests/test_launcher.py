@@ -5,7 +5,11 @@ import sys
 import textwrap
 import time
 
+import pytest
+
 from analytics_zoo_tpu.parallel.launcher import ProcessMonitor, ZooCluster
+
+pytestmark = pytest.mark.slow   # subprocess spawns / straggler timeouts
 
 
 def test_cluster_env_and_exit_codes(tmp_path):

@@ -15,6 +15,7 @@ def _toy_ratings(users=50, items=40, n=2000, seed=0):
     return movielens.synthetic_ratings(users, items, n, seed=seed)
 
 
+@pytest.mark.slow
 class TestNeuralCF:
     def test_forward_shapes(self):
         m = NeuralCF(user_count=50, item_count=40, class_num=2)
@@ -106,6 +107,7 @@ class TestWideAndDeep:
         assert scores["sparse_categorical_accuracy"] > 0.9
 
 
+@pytest.mark.slow
 class TestSessionRecommender:
     def test_forward_and_recommend(self):
         m = SessionRecommender(item_count=30, item_embed=16,

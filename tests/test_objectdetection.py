@@ -13,6 +13,9 @@ from analytics_zoo_tpu.models.image.objectdetection import (
 )
 
 
+pytestmark = pytest.mark.slow   # heavy jit compiles / end-to-end runs
+
+
 class TestBbox:
     def test_iou_known_values(self):
         a = np.array([[0, 0, 1, 1]], np.float32)

@@ -15,6 +15,9 @@ from analytics_zoo_tpu.parallel.trainer import DistributedTrainer
 from analytics_zoo_tpu.ops.attention import scaled_dot_product_attention
 
 
+pytestmark = pytest.mark.slow   # heavy jit compiles / end-to-end runs
+
+
 def _train_some(mesh, parallel_mode=None, steps=5):
     from analytics_zoo_tpu.pipeline.api.keras import (
         Layer, Sequential, objectives)

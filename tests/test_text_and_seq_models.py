@@ -109,6 +109,7 @@ class TestKNRM:
         assert evaluate_map(relations, inverted) == 0.5
 
 
+@pytest.mark.slow
 class TestSeq2seq:
     def test_copy_task_learns(self):
         rs = np.random.RandomState(0)
@@ -145,6 +146,7 @@ class TestSeq2seq:
         assert logits.shape == (2, 4, 10)
 
 
+@pytest.mark.slow
 class TestAnomalyDetector:
     def test_unroll(self):
         series = np.arange(10, dtype=np.float32)

@@ -94,6 +94,7 @@ class TestTFPredictor:
         assert np.asarray(out).shape == (128, 2)
 
 
+@pytest.mark.slow
 class TestGANEstimator:
     def test_alternating_training_improves_generator(self):
         # toy 1D GAN: real data ~ N(3, 0.2); G: z -> scalar
@@ -133,6 +134,7 @@ class TestGANEstimator:
                    for h in hist)
 
 
+@pytest.mark.slow
 class TestTextModels:
     def test_ner_shapes(self):
         from analytics_zoo_tpu.tfpark.text import NER
