@@ -71,6 +71,9 @@ class ImageRandomCrop(Preprocessing):
         self.h, self.w = int(crop_h), int(crop_w)
         self.rng = np.random.default_rng(seed)
 
+    def reseed(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
+
     def apply(self, img):
         H, W = img.shape[:2]
         top = int(self.rng.integers(0, max(H - self.h, 0) + 1))
@@ -81,6 +84,9 @@ class ImageRandomCrop(Preprocessing):
 class ImageHFlip(Preprocessing):
     def __init__(self, prob: float = 0.5, seed: int = 0):
         self.prob = prob
+        self.rng = np.random.default_rng(seed)
+
+    def reseed(self, seed: int) -> None:
         self.rng = np.random.default_rng(seed)
 
     def apply(self, img):
@@ -108,9 +114,149 @@ class ImageBrightness(Preprocessing):
         self.delta = delta
         self.rng = np.random.default_rng(seed)
 
+    def reseed(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
+
     def apply(self, img):
         shift = self.rng.uniform(-self.delta, self.delta)
         return np.clip(img.astype(np.float32) + shift, 0, 255)
+
+
+class ImageContrast(Preprocessing):
+    """Multiplicative contrast jitter (part of ImageColorJitter)."""
+
+    def __init__(self, lower: float = 0.5, upper: float = 1.5,
+                 seed: int = 0):
+        self.lower, self.upper = float(lower), float(upper)
+        self.rng = np.random.default_rng(seed)
+
+    def reseed(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
+
+    def apply(self, img):
+        alpha = self.rng.uniform(self.lower, self.upper)
+        return np.clip(img.astype(np.float32) * alpha, 0, 255)
+
+
+class ImageSaturation(Preprocessing):
+    """Blend with per-pixel grayscale (part of ImageColorJitter)."""
+
+    def __init__(self, lower: float = 0.5, upper: float = 1.5,
+                 seed: int = 0):
+        self.lower, self.upper = float(lower), float(upper)
+        self.rng = np.random.default_rng(seed)
+
+    def reseed(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
+
+    def apply(self, img):
+        alpha = self.rng.uniform(self.lower, self.upper)
+        f = img.astype(np.float32)
+        gray = f @ np.array([0.299, 0.587, 0.114], np.float32)
+        return np.clip(alpha * f + (1 - alpha) * gray[..., None], 0, 255)
+
+
+class ImageHue(Preprocessing):
+    """Hue rotation in HSV space (part of ImageColorJitter)."""
+
+    def __init__(self, delta: float = 18.0, seed: int = 0):
+        self.delta = float(delta)
+        self.rng = np.random.default_rng(seed)
+
+    def reseed(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
+
+    def apply(self, img):
+        shift = self.rng.uniform(-self.delta, self.delta)
+        u8 = np.clip(img, 0, 255).astype(np.uint8)
+        if _HAS_CV2:
+            hsv = cv2.cvtColor(u8, cv2.COLOR_RGB2HSV)
+            h = hsv[..., 0].astype(np.int16)
+            hsv[..., 0] = ((h + int(shift / 2)) % 180).astype(np.uint8)
+            out = cv2.cvtColor(hsv, cv2.COLOR_HSV2RGB)
+        else:                        # pragma: no cover
+            from PIL import Image
+            hsv = np.asarray(Image.fromarray(u8).convert("HSV"),
+                             np.int16)
+            hsv[..., 0] = (hsv[..., 0] + int(shift * 255 / 360)) % 256
+            out = np.asarray(Image.fromarray(
+                hsv.astype(np.uint8), "HSV").convert("RGB"))
+        return out.astype(img.dtype if np.issubdtype(
+            np.asarray(img).dtype, np.floating) else np.uint8)
+
+
+class ImageColorJitter(Preprocessing):
+    """Random-order brightness/contrast/saturation/hue jitter
+    (ref ImageColorJitter.scala — the full photometric distort)."""
+
+    def __init__(self, brightness_delta: float = 32.0,
+                 contrast: Tuple[float, float] = (0.5, 1.5),
+                 saturation: Tuple[float, float] = (0.5, 1.5),
+                 hue_delta: float = 18.0, seed: int = 0):
+        self.rng = np.random.default_rng(seed)
+        self.stages = [
+            ImageBrightness(brightness_delta, seed=seed + 1),
+            ImageContrast(*contrast, seed=seed + 2),
+            ImageSaturation(*saturation, seed=seed + 3),
+            ImageHue(hue_delta, seed=seed + 4),
+        ]
+
+    def reseed(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
+        for i, st in enumerate(self.stages):
+            if hasattr(st, "reseed"):
+                st.reseed(seed + 10 + i)
+
+    def apply(self, img):
+        order = self.rng.permutation(len(self.stages))
+        out = img
+        for i in order:
+            out = self.stages[i].apply(out)
+        return out
+
+
+def expand_canvas(img: np.ndarray, rng, max_ratio: float, mean
+                  ) -> Tuple[np.ndarray, int, int]:
+    """Paste ``img`` at a random offset on a mean-filled canvas up to
+    ``max_ratio`` larger; returns (canvas, top, left) so detection
+    callers can shift boxes.  Shared by ImageExpand and DetExpand."""
+    h, w, c = img.shape
+    ratio = float(rng.uniform(1.0, max_ratio))
+    H, W = int(h * ratio), int(w * ratio)
+    top = int(rng.integers(0, H - h + 1))
+    left = int(rng.integers(0, W - w + 1))
+    canvas = np.empty((H, W, c), img.dtype)
+    canvas[...] = np.asarray(mean, np.float32).astype(img.dtype)
+    canvas[top:top + h, left:left + w] = img
+    return canvas, top, left
+
+
+class ImageExpand(Preprocessing):
+    """Zoom-out onto a mean-filled canvas (ref ImageExpand.scala)."""
+
+    def __init__(self, max_ratio: float = 4.0, mean=(123, 117, 104),
+                 prob: float = 0.5, seed: int = 0):
+        self.max_ratio = float(max_ratio)
+        self.mean = np.asarray(mean, np.float32)
+        self.prob = prob
+        self.rng = np.random.default_rng(seed)
+
+    def reseed(self, seed: int) -> None:
+        self.rng = np.random.default_rng(seed)
+
+    def apply(self, img):
+        if self.rng.random() >= self.prob:
+            return img
+        canvas, _, _ = expand_canvas(img, self.rng, self.max_ratio,
+                                     self.mean)
+        return canvas
+
+
+class ImageChannelOrder(Preprocessing):
+    """RGB <-> BGR swap (ref ImageChannelOrder / mat channel ops)."""
+
+    def apply(self, img):
+        return np.ascontiguousarray(img[..., ::-1])
 
 
 class ImageMatToTensor(Preprocessing):

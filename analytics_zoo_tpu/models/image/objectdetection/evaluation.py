@@ -34,17 +34,27 @@ class MeanAveragePrecision:
         self.iou_threshold = iou_threshold
         self.use_07 = use_07_metric
         self._dets: List[Tuple] = []    # (img, box, score, label)
-        self._gts: List[Tuple] = []     # (img, box, label)
+        self._gts: List[Tuple] = []     # (img, box, label, difficult)
         self._img = 0
 
     def add(self, det_boxes, det_scores, det_labels,
-            gt_boxes, gt_labels) -> None:
+            gt_boxes, gt_labels, gt_difficult=None) -> None:
+        """``gt_difficult`` follows PascalVOC semantics: difficult
+        ground truths are excluded from the positive count and a
+        detection matching one is IGNORED (neither TP nor FP) —
+        ref PascalVocEvaluator.scala's difficult handling."""
         i = self._img
         self._img += 1
+        if gt_difficult is None:
+            gt_difficult = [False] * len(gt_labels)
+        if len(gt_difficult) != len(gt_labels):
+            raise ValueError(
+                f"gt_difficult length {len(gt_difficult)} != "
+                f"gt_labels length {len(gt_labels)}")
         for b, s, l in zip(det_boxes, det_scores, det_labels):
             self._dets.append((i, np.asarray(b), float(s), int(l)))
-        for b, l in zip(gt_boxes, gt_labels):
-            self._gts.append((i, np.asarray(b), int(l)))
+        for b, l, d in zip(gt_boxes, gt_labels, gt_difficult):
+            self._gts.append((i, np.asarray(b), int(l), bool(d)))
 
     @staticmethod
     def _iou(a, b):
@@ -59,10 +69,10 @@ class MeanAveragePrecision:
     def result(self) -> Dict[str, float]:
         aps = {}
         for c in range(1, self.num_classes):
-            gts = [(i, b) for i, b, l in self._gts if l == c]
+            gts = [(i, b, d) for i, b, l, d in self._gts if l == c]
             dets = sorted([(i, b, s) for i, b, s, l in self._dets
                            if l == c], key=lambda t: -t[2])
-            npos = len(gts)
+            npos = sum(1 for _i, _b, d in gts if not d)
             if npos == 0:
                 continue
             matched = set()
@@ -70,13 +80,16 @@ class MeanAveragePrecision:
             fp = np.zeros(len(dets))
             for d, (img, box, _s) in enumerate(dets):
                 best, best_iou = None, self.iou_threshold
-                for g, (gimg, gbox) in enumerate(gts):
+                for g, (gimg, gbox, _gd) in enumerate(gts):
                     if gimg != img or g in matched:
                         continue
                     iou = self._iou(box, gbox)
                     if iou >= best_iou:
                         best, best_iou = g, iou
                 if best is not None:
+                    if gts[best][2]:
+                        # difficult match: ignore the detection entirely
+                        continue
                     matched.add(best)
                     tp[d] = 1
                 else:
