@@ -1,0 +1,91 @@
+"""Calibrated int8 kernels.
+
+Reference: InferenceModel.scala:400-421 — TF models are calibrated and
+converted to int8 OpenVINO IR (activation ranges recorded over a
+calibration set, then int8 execution).
+
+TPU-native version: symmetric per-tensor ACTIVATION scales (recorded by
+a calibration pass) + per-output-channel WEIGHT scales; matmul/conv run
+int8 x int8 -> int32 on the MXU (v5e int8 peak is 2x bf16) and rescale
+to f32 in the epilogue.  The quantized path is params-driven: a layer
+whose params carry ``kernel_scale``/``act_scale`` (with an int8
+``kernel``) executes quantized — no layer-class mutation, the same
+model object serves f32 and int8.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_activation(x, act_scale):
+    """Symmetric int8 quantization with a calibrated scale."""
+    return jnp.clip(jnp.round(x.astype(jnp.float32) / act_scale),
+                    -127, 127).astype(jnp.int8)
+
+
+def quantized_matmul(x, kernel_q, kernel_scale, act_scale):
+    """int8 x int8 -> int32 contraction over the last/first dims, f32
+    rescale epilogue.  ``kernel_scale`` has keepdims shape
+    (1, ..., out)."""
+    xq = quantize_activation(x, act_scale)
+    acc = jax.lax.dot_general(
+        xq, kernel_q, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    scale = act_scale * kernel_scale.reshape(
+        (1,) * (acc.ndim - 1) + (-1,))
+    return acc.astype(jnp.float32) * scale
+
+
+_INT8_CONV_OK = None
+
+
+def _int8_conv_supported() -> bool:
+    """Probe ONCE, eagerly, whether the backend compiles s8xs8->s32
+    convolution.  The probe must happen outside any jit trace: a
+    try/except around the traced call would only guard abstract
+    evaluation — backend rejection surfaces at compile time, outside
+    the except."""
+    global _INT8_CONV_OK
+    if _INT8_CONV_OK is None:
+        try:
+            x = jnp.zeros((1, 4, 4, 1), jnp.int8)
+            k = jnp.zeros((2, 2, 1, 1), jnp.int8)
+            out = jax.jit(lambda a, b: jax.lax.conv_general_dilated(
+                a, b, (1, 1), "SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                preferred_element_type=jnp.int32))(x, k)
+            jax.block_until_ready(out)
+            _INT8_CONV_OK = True
+        except Exception:
+            _INT8_CONV_OK = False
+    return _INT8_CONV_OK
+
+
+def quantized_conv(x, kernel_q, kernel_scale, act_scale, *, strides,
+                   padding, rhs_dilation, dimension_numbers,
+                   feature_group_count=1):
+    """int8 conv -> int32 accumulation, f32 rescale epilogue.  Uses the
+    dequantized-f32 form (same rounding, same numbers) when the backend
+    cannot compile integer convolution — decided by an eager probe, not
+    in-trace."""
+    xq = quantize_activation(x, act_scale)
+    if _int8_conv_supported():
+        acc = jax.lax.conv_general_dilated(
+            xq, kernel_q, window_strides=strides, padding=padding,
+            rhs_dilation=rhs_dilation,
+            dimension_numbers=dimension_numbers,
+            feature_group_count=feature_group_count,
+            preferred_element_type=jnp.int32)
+        scale = act_scale * kernel_scale.reshape(
+            (1,) * (acc.ndim - 1) + (-1,))
+        return acc.astype(jnp.float32) * scale
+    # fake-quant fallback: numerically identical rounding, f32 math
+    xdq = xq.astype(jnp.float32) * act_scale
+    kdq = kernel_q.astype(jnp.float32) * kernel_scale
+    return jax.lax.conv_general_dilated(
+        xdq, kdq, window_strides=strides, padding=padding,
+        rhs_dilation=rhs_dilation,
+        dimension_numbers=dimension_numbers,
+        feature_group_count=feature_group_count)

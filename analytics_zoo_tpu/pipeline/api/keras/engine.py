@@ -51,6 +51,41 @@ def fold_name(rng, name: str):
     return jax.random.fold_in(rng, zlib.crc32(name.encode()) & 0x7FFFFFFF)
 
 
+# ------------------------------------------------------- activation taps
+# Calibration hook (int8 activation quantization, ops/quant.py): inside
+# ``record_activations()`` the containers report each layer's INPUT
+# absmax.  Taps are a no-op under jit tracing (calibration runs eagerly)
+# and when no recorder is active — zero cost in the hot path.
+_ACT_TAP: Optional[Dict[str, float]] = None
+
+
+class record_activations:
+    """``with record_activations() as ranges:`` — run eager forwards;
+    ``ranges`` maps layer name -> max |input| seen."""
+
+    def __enter__(self) -> Dict[str, float]:
+        global _ACT_TAP
+        self._prev = _ACT_TAP
+        _ACT_TAP = {}
+        return _ACT_TAP
+
+    def __exit__(self, *exc):
+        global _ACT_TAP
+        _ACT_TAP = self._prev
+        return False
+
+
+def tap_activation(name: str, x) -> None:
+    if _ACT_TAP is None:
+        return
+    for leaf in jax.tree_util.tree_leaves(x):
+        if isinstance(leaf, jax.core.Tracer):
+            return               # inside jit — calibration must be eager
+        if jnp.issubdtype(jnp.asarray(leaf).dtype, jnp.floating):
+            m = float(jnp.max(jnp.abs(leaf)))
+            _ACT_TAP[name] = max(_ACT_TAP.get(name, 0.0), m)
+
+
 def _is_shape(x) -> bool:
     return isinstance(x, (tuple, list)) and all(
         v is None or isinstance(v, (int, np.integer)) for v in x)

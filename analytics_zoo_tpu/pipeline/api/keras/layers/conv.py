@@ -98,7 +98,17 @@ class _ConvND(Layer):
                             init="zero", regularizer=self.b_regularizer)
         return params
 
-    def _convolve(self, x, kernel):
+    def _convolve(self, x, kernel, quant=None):
+        if quant is not None:
+            # calibrated int8 path (ops/quant.py)
+            from analytics_zoo_tpu.ops.quant import quantized_conv
+            return quantized_conv(
+                x, kernel, quant["kernel_scale"], quant["act_scale"],
+                strides=self.strides,
+                padding=_same_or_valid(self.border_mode),
+                rhs_dilation=self.dilation,
+                dimension_numbers=_conv_dims(self.spatial),
+                feature_group_count=self.groups)
         policy = get_policy()
         return jax.lax.conv_general_dilated(
             policy.cast_compute(x), policy.cast_compute(kernel),
@@ -112,7 +122,9 @@ class _ConvND(Layer):
         if self.dim_ordering == "th":
             perm = (0,) + tuple(range(2, 2 + self.spatial)) + (1,)
             x = jnp.transpose(x, perm)
-        y = self._convolve(x, params["kernel"])
+        y = self._convolve(x, params["kernel"],
+                           quant=params if "kernel_scale" in params
+                           else None)
         if self.use_bias:
             y = y + params["bias"]
         if self.activation is not None:
@@ -452,9 +464,10 @@ class ShareConvolution2D(_ConvND):
         return jnp.pad(shape_or_x, ((0, 0), (self.pad_h, self.pad_h),
                                     (self.pad_w, self.pad_w), (0, 0)))
 
-    def _convolve(self, x, kernel):
+    def _convolve(self, x, kernel, quant=None):
         # x arrives channels-last from _ConvND.call
-        return super()._convolve(self._pad(x, symbolic=False), kernel)
+        return super()._convolve(self._pad(x, symbolic=False), kernel,
+                                 quant=quant)
 
     def compute_output_shape(self, input_shape):
         padded = self._from_tf(

@@ -77,7 +77,15 @@ class Dense(Layer):
         return params
 
     def call(self, params, x, training=False, rng=None):
-        y = _matmul(x, params["kernel"])
+        if "kernel_scale" in params:
+            # calibrated int8 path (ops/quant.py) — params-driven, set
+            # by InferenceModel quantization
+            from analytics_zoo_tpu.ops.quant import quantized_matmul
+            y = quantized_matmul(x, params["kernel"],
+                                 params["kernel_scale"],
+                                 params["act_scale"])
+        else:
+            y = _matmul(x, params["kernel"])
         if self.use_bias:
             y = y + params["bias"]
         if self.activation is not None:

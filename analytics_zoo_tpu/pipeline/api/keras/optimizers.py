@@ -45,8 +45,17 @@ def plateau(lr: float, factor: float = 0.1, patience: int = 10):
         "driver loop, not inside the jitted step")
 
 
+def _rebuild_optim(cls, kwargs):
+    return cls(**kwargs)
+
+
 class OptimMethod:
-    """A named optimizer: optax transformation + lr schedule."""
+    """A named optimizer: optax transformation + lr schedule.
+
+    Subclasses record their constructor kwargs (``_init_kwargs``) so the
+    optimizer pickles by RECONSTRUCTION — optax transformations are
+    closures and cannot pickle directly (needed by the NNFrames ML
+    persistence, nn_estimator.py)."""
 
     def __init__(self, tx: optax.GradientTransformation, name: str,
                  learning_rate: Union[float, Callable] = None):
@@ -59,6 +68,15 @@ class OptimMethod:
 
     def update(self, grads, opt_state, params):
         return self.tx.update(grads, opt_state, params)
+
+    def __reduce__(self):
+        kwargs = getattr(self, "_init_kwargs", None)
+        if kwargs is None:
+            raise TypeError(
+                f"{type(self).__name__} cannot be pickled: no recorded "
+                "constructor args (custom OptimMethod instances must "
+                "set self._init_kwargs or be rebuilt by hand)")
+        return (_rebuild_optim, (type(self), dict(kwargs)))
 
 
 def _sched(learning_rate, schedule):
@@ -76,6 +94,10 @@ class SGD(OptimMethod):
     def __init__(self, learning_rate: float = 0.01, momentum: float = 0.0,
                  dampening: float = 0.0, nesterov: bool = False,
                  weight_decay: float = 0.0, schedule=None):
+        self._init_kwargs = dict(
+            learning_rate=learning_rate, momentum=momentum,
+            dampening=dampening, nesterov=nesterov,
+            weight_decay=weight_decay, schedule=schedule)
         lr = _sched(learning_rate, schedule)
         chain = []
         if weight_decay:
@@ -92,6 +114,9 @@ class Adam(OptimMethod):
     def __init__(self, lr: float = 1e-3, beta_1: float = 0.9,
                  beta_2: float = 0.999, epsilon: float = 1e-8,
                  decay: float = 0.0, schedule=None):
+        self._init_kwargs = dict(lr=lr, beta_1=beta_1, beta_2=beta_2,
+                                 epsilon=epsilon, decay=decay,
+                                 schedule=schedule)
         if schedule is None and decay > 0:
             schedule = lambda step: lr / (1.0 + decay * step)
         sched = _sched(lr, schedule)
@@ -108,6 +133,10 @@ class AdamWeightDecay(OptimMethod):
                  total: int = -1, schedule_name: str = "linear",
                  beta_1: float = 0.9, beta_2: float = 0.999,
                  epsilon: float = 1e-6, weight_decay: float = 0.01):
+        self._init_kwargs = dict(
+            lr=lr, warmup_portion=warmup_portion, total=total,
+            schedule_name=schedule_name, beta_1=beta_1, beta_2=beta_2,
+            epsilon=epsilon, weight_decay=weight_decay)
         if total > 0:
             warm = int(max(warmup_portion, 0.0) * total)
             sched = optax.join_schedules(
@@ -125,6 +154,8 @@ class AdamWeightDecay(OptimMethod):
 class RMSprop(OptimMethod):
     def __init__(self, lr: float = 1e-3, decay_rate: float = 0.9,
                  epsilon: float = 1e-8, schedule=None):
+        self._init_kwargs = dict(lr=lr, decay_rate=decay_rate,
+                                 epsilon=epsilon, schedule=schedule)
         sched = _sched(lr, schedule)
         super().__init__(optax.rmsprop(sched, decay=decay_rate, eps=epsilon),
                          "rmsprop", sched)
@@ -133,6 +164,8 @@ class RMSprop(OptimMethod):
 class Adagrad(OptimMethod):
     def __init__(self, lr: float = 1e-2, epsilon: float = 1e-10,
                  schedule=None):
+        self._init_kwargs = dict(lr=lr, epsilon=epsilon,
+                                 schedule=schedule)
         sched = _sched(lr, schedule)
         super().__init__(optax.adagrad(sched, eps=epsilon), "adagrad", sched)
 
@@ -140,6 +173,7 @@ class Adagrad(OptimMethod):
 class Adadelta(OptimMethod):
     def __init__(self, lr: float = 1.0, rho: float = 0.95,
                  epsilon: float = 1e-8):
+        self._init_kwargs = dict(lr=lr, rho=rho, epsilon=epsilon)
         super().__init__(optax.adadelta(lr, rho=rho, eps=epsilon),
                          "adadelta", lr)
 
@@ -147,6 +181,8 @@ class Adadelta(OptimMethod):
 class Adamax(OptimMethod):
     def __init__(self, lr: float = 2e-3, beta_1: float = 0.9,
                  beta_2: float = 0.999, epsilon: float = 1e-8):
+        self._init_kwargs = dict(lr=lr, beta_1=beta_1, beta_2=beta_2,
+                                 epsilon=epsilon)
         super().__init__(optax.adamax(lr, b1=beta_1, b2=beta_2, eps=epsilon),
                          "adamax", lr)
 

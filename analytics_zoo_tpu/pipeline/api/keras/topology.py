@@ -19,8 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.pipeline.api.keras.engine import (
-    Container, KTensor, Layer, Node, Params, State, fold_name, to_batch_shape,
-    _is_shape,
+    Container, KTensor, Layer, Node, Params, State, fold_name,
+    tap_activation, to_batch_shape, _is_shape,
 )
 
 
@@ -379,6 +379,7 @@ class Sequential(KerasNet):
         x = inputs
         for i, l in enumerate(self.layers):
             sub_rng = fold_name(rng, l.name) if rng is not None else None
+            tap_activation(l.name, x)
             x, s = l.apply(self._layer_params(params, l), x,
                            state=state.get(l.name),
                            training=training, rng=sub_rng)
@@ -541,6 +542,7 @@ class Model(KerasNet):
             args = [values[id(t)] for t in node.inbound]
             x = args[0] if len(args) == 1 else args
             sub_rng = fold_name(rng, l.name) if rng is not None else None
+            tap_activation(l.name, x)
             out, s = l.apply(self._layer_params(params, l), x,
                              state=state.get(l.name),
                              training=training, rng=sub_rng,

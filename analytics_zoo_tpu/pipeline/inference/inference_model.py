@@ -48,6 +48,63 @@ def quantize_params(params, min_size: int = 1024):
     return jax.tree_util.tree_unflatten(treedef, qleaves), scales
 
 
+def calibrate_activations(model, calib_data, batch_size: int = 32,
+                          max_batches: int = 8) -> Dict[str, float]:
+    """Run eager forwards over a calibration set recording each layer's
+    input absmax (ref InferenceModel.scala:400-421's OpenVINO
+    calibration role).  ``calib_data`` is an ndarray/pytree or a
+    FeatureSet."""
+    from analytics_zoo_tpu.feature.feature_set import FeatureSet
+    from analytics_zoo_tpu.pipeline.api.keras.engine import (
+        record_activations)
+    variables = model.get_variables()
+    if isinstance(calib_data, FeatureSet):
+        batches = (b[0] for b in calib_data.epoch_batches(
+            0, batch_size, train=False))
+    else:
+        n = len(jax.tree_util.tree_leaves(calib_data)[0])
+        batches = (jax.tree_util.tree_map(
+            lambda a: a[i:i + batch_size], calib_data)
+            for i in range(0, n, batch_size))
+    ranges: Dict[str, float] = {}
+    with record_activations() as taps:
+        for i, xb in enumerate(batches):
+            if i >= max_batches:
+                break
+            model.apply(variables["params"], xb,
+                        state=variables["state"], training=False)
+        ranges.update(taps)
+    return ranges
+
+
+def quantize_params_calibrated(model, variables, act_ranges,
+                               min_size: int = 1024):
+    """Per-layer int8 weights (per-output-channel scales) + calibrated
+    symmetric activation scales, in the params-driven layout the Dense/
+    conv layers execute natively (kernel int8 + kernel_scale +
+    act_scale — see ops/quant.py)."""
+    params = variables["params"]
+    qparams = {}
+    for lname, p in params.items():
+        qp = dict(p) if isinstance(p, dict) else p
+        k = p.get("kernel") if isinstance(p, dict) else None
+        rng_max = act_ranges.get(lname, 0.0)
+        if k is not None and rng_max > 0.0:
+            arr = np.asarray(k)
+            if (arr.dtype == np.float32 and arr.ndim >= 2
+                    and arr.size >= min_size):
+                axes = tuple(range(arr.ndim - 1))
+                w_scale = np.maximum(
+                    np.max(np.abs(arr), axis=axes, keepdims=True)
+                    / 127.0, 1e-12).astype(np.float32)
+                qp["kernel"] = np.clip(
+                    np.round(arr / w_scale), -127, 127).astype(np.int8)
+                qp["kernel_scale"] = w_scale
+                qp["act_scale"] = np.float32(max(rng_max / 127.0, 1e-12))
+        qparams[lname] = qp
+    return {"params": qparams, "state": variables["state"]}
+
+
 def dequantize_params(qparams, scales):
     """``scales`` is the flat list from ``quantize_params``."""
     leaves, treedef = jax.tree_util.tree_flatten(qparams)
@@ -68,16 +125,40 @@ class InferenceModel:
         self.model = None
 
     # ------------------------------------------------------------- loaders
-    def load_zoo(self, model, quantize: bool = False) -> "InferenceModel":
-        """Load a native framework model (KerasNet/ZooModel);
-        ``quantize=True`` enables the int8 weight path
-        (doLoadTFAsCalibratedOpenVINO analogue)."""
+    def load_zoo(self, model, quantize: bool = False, calib_set=None,
+                 calib_batch_size: int = 32, calib_batches: int = 8,
+                 quant_min_size: int = 1024) -> "InferenceModel":
+        """Load a native framework model (KerasNet/ZooModel).
+
+        ``quantize=True`` → int8 WEIGHT-only path (dequantized in-jit,
+        4x less HBM weight traffic).  ``quantize="calibrated"`` +
+        ``calib_set`` → activation calibration: record per-layer input
+        ranges over the calibration set, then run matmul/conv as
+        int8 x int8 -> int32 with f32 rescale
+        (doLoadTFAsCalibratedOpenVINO, InferenceModel.scala:400-421).
+        """
         from analytics_zoo_tpu.models.common import ZooModel
         if isinstance(model, ZooModel):
             model = model.model
         self.model = model
         variables = model.get_variables()
-        if quantize:
+        if quantize == "calibrated":
+            if calib_set is None:
+                raise ValueError(
+                    "quantize='calibrated' needs calib_set= (ndarray, "
+                    "pytree, or FeatureSet of representative inputs)")
+            ranges = calibrate_activations(
+                model, calib_set, batch_size=calib_batch_size,
+                max_batches=calib_batches)
+            self._variables = quantize_params_calibrated(
+                model, variables, ranges, min_size=quant_min_size)
+            self._quantized = True
+
+            def fn(params, state, x):
+                out, _ = model.apply(params, x, state=state,
+                                     training=False)
+                return out
+        elif quantize:
             qp, scales = quantize_params(variables["params"])
             self._variables = {"params": qp, "state": variables["state"]}
             self._scales = scales

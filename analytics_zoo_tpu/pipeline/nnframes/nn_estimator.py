@@ -15,6 +15,9 @@ setLearningRate, setCachingSample...) is preserved.
 
 from __future__ import annotations
 
+import json
+import os
+import pickle
 from typing import Callable, Optional, Sequence
 
 import numpy as np
@@ -23,6 +26,41 @@ from analytics_zoo_tpu.common.triggers import EveryEpoch, MaxEpoch
 from analytics_zoo_tpu.feature.common import Preprocessing
 from analytics_zoo_tpu.feature.feature_set import FeatureSet
 from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+
+def _to_numpy_variables(model) -> None:
+    """Pin the model's variables as host numpy arrays and drop
+    compiled/device-bound caches so the pickled payload is
+    process/device independent."""
+    import jax
+    variables = model.get_variables()
+    model.set_variables(jax.tree_util.tree_map(
+        lambda a: np.asarray(jax.device_get(a)), variables))
+    # transient caches (e.g. _cached_infer_estimator holds jitted fns +
+    # Device handles) are rebuilt on demand — drop anything unpicklable
+    for k in list(vars(model)):
+        try:
+            pickle.dumps(vars(model)[k])
+        except Exception:
+            delattr(model, k)
+
+
+def _save_pickle(path: str, meta: dict, payload: dict) -> None:
+    """ML-persistence layout (ref NNEstimator.scala:808 write): a
+    directory with human-readable metadata.json + payload.pkl."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "metadata.json"), "w") as f:
+        json.dump(meta, f, indent=2)
+    with open(os.path.join(path, "payload.pkl"), "wb") as f:
+        pickle.dump(payload, f)
+
+
+def _load_pickle(path: str) -> tuple:
+    with open(os.path.join(path, "metadata.json")) as f:
+        meta = json.load(f)
+    with open(os.path.join(path, "payload.pkl"), "rb") as f:
+        payload = pickle.load(f)
+    return meta, payload
 
 
 def _col_to_array(series) -> np.ndarray:
@@ -165,6 +203,49 @@ class NNEstimator:
             .set_features_col(self.features_col) \
             .set_batch_size(self.batch_size)
 
+    # -------------------------------------------- ML persistence
+    def save(self, path: str) -> None:
+        """Persist the (possibly fitted) estimator: model architecture
+        + current variables + preprocessing + params
+        (ref NNEstimator.scala:808 NNEstimatorWriter)."""
+        _to_numpy_variables(self.model)
+        _save_pickle(path, {
+            "class": type(self).__name__,
+            "features_col": self.features_col,
+            "label_col": self.label_col,
+            "batch_size": self.batch_size,
+            "max_epoch": self.max_epoch,
+            "learning_rate": self.learning_rate,
+        }, {
+            "model": self.model,
+            "criterion": self.criterion,
+            "feature_preprocessing": self.feature_preprocessing,
+            "label_preprocessing": self.label_preprocessing,
+            "optim_method": self.optim_method,
+            "clip": self._clip,
+            "caching_sample": self.caching_sample,
+            "checkpoint_path": self.checkpoint_path,
+        })
+
+    @classmethod
+    def load(cls, path: str) -> "NNEstimator":
+        meta, payload = _load_pickle(path)
+        klass = {"NNEstimator": NNEstimator,
+                 "NNClassifier": NNClassifier}.get(meta["class"], cls)
+        est = klass(payload["model"], payload["criterion"],
+                    feature_preprocessing=payload["feature_preprocessing"],
+                    label_preprocessing=payload["label_preprocessing"])
+        est.features_col = meta["features_col"]
+        est.label_col = meta["label_col"]
+        est.batch_size = meta["batch_size"]
+        est.max_epoch = meta["max_epoch"]
+        est.learning_rate = meta["learning_rate"]
+        est.optim_method = payload.get("optim_method")
+        est._clip = payload.get("clip")
+        est.caching_sample = payload.get("caching_sample", True)
+        est.checkpoint_path = payload.get("checkpoint_path")
+        return est
+
 
 class NNModel:
     """Transformer: append a ``prediction`` column
@@ -204,6 +285,34 @@ class NNModel:
         result = df.copy()
         result[self.prediction_col] = list(out)
         return result
+
+    # -------------------------------------------- ML persistence
+    def save(self, path: str) -> None:
+        """Persist the transformer: trained variables + preprocessing +
+        column config (ref NNEstimator.scala:865 NNModelWriter)."""
+        _to_numpy_variables(self.model)
+        _save_pickle(path, {
+            "class": type(self).__name__,
+            "features_col": self.features_col,
+            "prediction_col": self.prediction_col,
+            "batch_size": self.batch_size,
+        }, {
+            "model": self.model,
+            "feature_preprocessing": self.feature_preprocessing,
+        })
+
+    @classmethod
+    def load(cls, path: str) -> "NNModel":
+        meta, payload = _load_pickle(path)
+        klass = {"NNModel": NNModel,
+                 "NNClassifierModel": NNClassifierModel}.get(
+                     meta["class"], cls)
+        m = klass(payload["model"],
+                  feature_preprocessing=payload["feature_preprocessing"])
+        m.features_col = meta["features_col"]
+        m.prediction_col = meta["prediction_col"]
+        m.batch_size = meta["batch_size"]
+        return m
 
 
 class NNClassifier(NNEstimator):

@@ -19,6 +19,8 @@ import json
 import logging
 import threading
 import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -103,52 +105,27 @@ class ClusterServing:
         self._stop = threading.Event()
         self._last_id = "0-0"
         self.total_records = 0
+        # per-record arrival→result latencies (seconds), bounded
+        self.latencies: deque = deque(maxlen=10000)
+        self._serve_start: Optional[float] = None
 
     # ------------------------------------------------------------ main loop
     def run_once(self, block_ms: int = 100) -> int:
         """One poll/predict/write cycle; returns #records served."""
+        self._serve_start = self._serve_start or time.time()
         entries = self.broker.xread(INPUT_STREAM, self._last_id,
                                     count=self.config.batch_size,
                                     block_ms=block_ms)
         if not entries:
             return 0
         t0 = time.time()
-        uris, arrays = [], []
-        for entry_id, fields in entries:
+        for entry_id, _fields in entries:
             self._last_id = entry_id
-            try:
-                uri, arr = decode_field(fields)
-            except Exception:
-                log.exception("undecodable record %s", entry_id)
-                continue
-            uris.append(uri)
-            arrays.append(arr)
-        if not arrays:
-            return 0
-        # fixed-shape batch: pad to batch_size so ONE executable serves
-        # all traffic (the reference's non-BLAS batched path, :186-237)
-        bs = self.config.batch_size
-        x = np.stack(arrays)
-        real = len(arrays)
-        if real < bs:
-            x = np.concatenate(
-                [x, np.zeros((bs - real,) + x.shape[1:], x.dtype)])
-        out = np.asarray(self.model.predict(x))[:real]
-        # top-N postprocess (PostProcessing.scala)
-        exp = np.exp(out - out.max(axis=-1, keepdims=True))
-        probs = exp / exp.sum(axis=-1, keepdims=True)
-        top = np.argsort(-probs, axis=-1)[:, :self.config.top_n]
-        for uri, t, p in zip(uris, top, probs):
-            value = json.dumps([[int(i), float(p[i])] for i in t])
-            self._write_result(uri, value)
-        self.total_records += real
-        wall = time.time() - t0
-        if self.summary is not None:
+        uris, arrays = self._decode_batch(entries)
+        real = self._predict_write(uris, arrays, t0)
+        if self.summary is not None and real:
             self.summary.add_scalar("Serving Throughput",
-                                    real / max(wall, 1e-9),
-                                    self.total_records)
-            self.summary.add_scalar("Total Records Number",
-                                    self.total_records,
+                                    real / max(time.time() - t0, 1e-9),
                                     self.total_records)
         # OOM guard (ClusterServing.scala:128-134)
         if self.broker.xlen(INPUT_STREAM) > self.config.max_stream_len:
@@ -166,26 +143,134 @@ class ClusterServing:
                 time.sleep(min(0.1 * (attempt + 1), 2.0))
         raise RuntimeError(f"could not write result for {uri}")
 
-    def run(self, poll_ms: int = 100) -> None:
-        log.info("cluster serving started (batch=%d)",
-                 self.config.batch_size)
-        # honor only stop signals issued at/after startup so a stale
-        # key from a previous shutdown can't kill a fresh worker, and a
-        # signal sent while we were still booting isn't lost
+    # -------------------------------------------------- pipelined serving
+    def _decode_batch(self, entries):
+        """Decode one batch of raw stream entries (runs in the decode
+        pool — pure CPU, no broker IO, so no connection sharing across
+        threads)."""
+        uris, arrays = [], []
+        for entry_id, fields in entries:
+            try:
+                uri, arr = decode_field(fields)
+            except Exception:
+                log.exception("undecodable record %s", entry_id)
+                continue
+            uris.append(uri)
+            arrays.append(arr)
+        return uris, arrays
+
+    def _predict_write(self, uris, arrays, t_arrival: float) -> int:
+        """Pad/predict/top-N/write one decoded batch; returns #served."""
+        if not arrays:
+            return 0
+        bs = self.config.batch_size
+        x = np.stack(arrays)
+        real = len(arrays)
+        if real < bs:
+            x = np.concatenate(
+                [x, np.zeros((bs - real,) + x.shape[1:], x.dtype)])
+        out = np.asarray(self.model.predict(x))[:real]
+        exp = np.exp(out - out.max(axis=-1, keepdims=True))
+        probs = exp / exp.sum(axis=-1, keepdims=True)
+        top = np.argsort(-probs, axis=-1)[:, :self.config.top_n]
+        done = time.time()
+        for uri, t, p in zip(uris, top, probs):
+            value = json.dumps([[int(i), float(p[i])] for i in t])
+            self._write_result(uri, value)
+            self.latencies.append(done - t_arrival)
+        self.total_records += real
+        if self.summary is not None:
+            self.summary.add_scalar("Total Records Number",
+                                    self.total_records,
+                                    self.total_records)
+        return real
+
+    def stats(self) -> Dict[str, float]:
+        """Throughput + latency percentiles over the records served so
+        far (the reference's TensorBoard serving scalars, :294-317,
+        plus percentiles)."""
+        lat = sorted(self.latencies)
+        pct = lambda p: (lat[min(int(p / 100 * len(lat)),
+                                 len(lat) - 1)] * 1e3) if lat else 0.0
+        wall = (time.time() - self._serve_start) \
+            if self._serve_start else 0.0
+        return {
+            "total_records": self.total_records,
+            "throughput_rps": self.total_records / wall if wall else 0.0,
+            "latency_p50_ms": pct(50),
+            "latency_p95_ms": pct(95),
+            "latency_p99_ms": pct(99),
+        }
+
+    def _should_stop(self, started: float) -> bool:
+        if self._stop.is_set():
+            return True
+        sig = self.broker.hgetall(STOP_KEY)
+        if sig:
+            raw = sig.get(b"stop", sig.get("stop", b"0"))
+            try:
+                ts = float(raw)
+            except (TypeError, ValueError):
+                ts = float("inf")   # unparseable → explicit stop
+            if ts >= started - 1.0:   # small clock-skew allowance
+                log.info("stop signal received; shutting down")
+                self.broker.delete(STOP_KEY)
+                return True
+        return False
+
+    def run(self, poll_ms: int = 100, decode_workers: int = 2,
+            pipeline_depth: int = 4) -> None:
+        """Pipelined loop: the decode POOL works batch N+1..N+depth
+        while the device predicts batch N (the reference parallelizes
+        decode per partition, ClusterServing.scala:156-237; here decode
+        threads overlap the XLA execute, which releases the GIL).  All
+        broker IO stays on this thread — the RESP socket is not
+        thread-safe."""
+        log.info("cluster serving started (batch=%d, decode_workers=%d)",
+                 self.config.batch_size, decode_workers)
         started = time.time()
-        while not self._stop.is_set():
-            self.run_once(block_ms=poll_ms)
-            sig = self.broker.hgetall(STOP_KEY)
-            if sig:
-                raw = sig.get(b"stop", sig.get("stop", b"0"))
-                try:
-                    ts = float(raw)
-                except (TypeError, ValueError):
-                    ts = float("inf")   # unparseable → explicit stop
-                if ts >= started - 1.0:   # small clock-skew allowance
-                    log.info("stop signal received; shutting down")
-                    self.broker.delete(STOP_KEY)
+        self._serve_start = self._serve_start or started
+        pool = ThreadPoolExecutor(decode_workers,
+                                  thread_name_prefix="serving-decode")
+        pending: deque = deque()   # (future, t_arrival)
+        try:
+            while True:
+                # keep the decode pipeline full
+                while len(pending) < pipeline_depth:
+                    entries = self.broker.xread(
+                        INPUT_STREAM, self._last_id,
+                        count=self.config.batch_size,
+                        block_ms=0 if pending else poll_ms)
+                    if not entries:
+                        break
+                    for entry_id, _f in entries:
+                        self._last_id = entry_id
+                    pending.append((pool.submit(self._decode_batch,
+                                                entries), time.time()))
+                if pending:
+                    fut, t_arrival = pending.popleft()
+                    uris, arrays = fut.result()
+                    self._predict_write(uris, arrays, t_arrival)
+                    if self.summary is not None and self.latencies:
+                        s = self.stats()
+                        self.summary.add_scalar(
+                            "Serving Throughput", s["throughput_rps"],
+                            self.total_records)
+                    if self.broker.xlen(INPUT_STREAM) \
+                            > self.config.max_stream_len:
+                        self.broker.xtrim(INPUT_STREAM,
+                                          self.config.max_stream_len)
+                if self._should_stop(started):
+                    # drain: every batch already read past (_last_id
+                    # advanced) MUST still be predicted + written, or
+                    # its clients wait forever
+                    while pending:
+                        fut, t_arrival = pending.popleft()
+                        uris, arrays = fut.result()
+                        self._predict_write(uris, arrays, t_arrival)
                     break
+        finally:
+            pool.shutdown(wait=False)
 
     def start_background(self) -> threading.Thread:
         t = threading.Thread(target=self.run, daemon=True)

@@ -1,0 +1,130 @@
+"""Dogs-vs-Cats transfer learning — runnable tutorial.
+
+This is the TPU-native retelling of the reference's dogs-vs-cats app
+(``apps/dogs-vs-cats/transfer-learning.ipynb``): take a network
+pretrained on a broad task, keep its convolutional feature extractor,
+and fine-tune a tiny head on the binary task.  On a real corpus you
+would point ``ImageSet.read`` at a directory of ``cat/`` and ``dog/``
+sub-folders of JPEGs; the tutorial ships with a synthetic stand-in so
+it runs anywhere (``--data-dir`` switches to real files).
+
+The workflow, step by step:
+
+1. **Pretrain** (stand-in for downloading a published checkpoint): a
+   small convnet learns a 4-class shapes task.  With a real checkpoint
+   you'd call ``Net.load`` instead (net/net.py).
+2. **Surgery** — ``new_graph("features")`` cuts the graph at the named
+   feature layer (NetUtils.scala:82 newGraph), ``freeze()`` marks the
+   backbone non-trainable (NetUtils.scala:267).
+3. **New head** — a fresh 2-way Dense stacked on the frozen features;
+   ``init_from`` adopts every pretrained weight that matches by name.
+4. **Augmented input pipeline** — ImageSet with ColorJitter + flip
+   (feature/image.py), the executor-side OpenCV role of the reference.
+5. **Fine-tune + verify**: train the head, assert the backbone stayed
+   bit-identical, evaluate.
+
+Run: ``python apps/dogs_vs_cats/transfer_learning.py [--epochs N]``
+"""
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def synthetic_pets(n, num_classes, side=24, seed=0):
+    """Stand-in corpus: blob position encodes the class."""
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, num_classes, size=(n, 1))
+    x = rs.rand(n, side, side, 3).astype(np.float32) * 0.25
+    for i in range(n):
+        c = int(y[i, 0])
+        x[i, 3 + c * 4: 9 + c * 4, 3:9] += 1.0
+    return (x * 255).clip(0, 255), y
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--data-dir", default=None,
+                   help="directory with one sub-folder per class; "
+                        "default = synthetic stand-in corpus")
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.epochs = 1
+    n = 256 if args.smoke else 2048
+
+    import jax
+
+    from analytics_zoo_tpu.feature.image import (
+        ImageColorJitter, ImageHFlip, ImageSet)
+    from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D, Dense, Flatten, MaxPooling2D)
+
+    # ---- 1. the "pretrained" backbone --------------------------------
+    inp = Input(shape=(24, 24, 3))
+    x = Convolution2D(8, 3, 3, activation="relu", border_mode="same",
+                      name="conv1")(inp)
+    x = MaxPooling2D(name="pool1")(x)
+    x = Convolution2D(16, 3, 3, activation="relu", border_mode="same",
+                      name="conv2")(x)
+    x = MaxPooling2D(name="pool2")(x)
+    x = Flatten(name="flat")(x)
+    feat = Dense(48, activation="relu", name="features")(x)
+    out = Dense(4, name="pretrain_head")(feat)
+    base = Model(inp, out)
+    base.compile(optimizer="adam",
+                 loss="sparse_categorical_crossentropy_with_logits",
+                 metrics=["accuracy"])
+    xa, ya = synthetic_pets(n, 4, seed=0)
+    base.fit(xa / 255.0, ya, batch_size=64, nb_epoch=args.epochs)
+
+    # ---- 2. graph surgery: feature extractor + freeze ----------------
+    backbone = base.new_graph("features")
+    backbone.freeze()
+
+    # ---- 3. fresh binary head ----------------------------------------
+    logits = Dense(2, name="cat_dog_head")(backbone.outputs[0])
+    ft = Model(backbone.inputs[0], logits)
+    ft.init_from(base)      # adopt pretrained weights by name
+    conv1_before = jax.device_get(ft.get_variables()["params"]["conv1"])
+
+    # ---- 4. augmented input pipeline ---------------------------------
+    if args.data_dir:
+        pets = ImageSet.read(args.data_dir, with_label=True)
+        xb = np.stack(pets.images).astype(np.float32)
+        yb = pets.labels.reshape(-1, 1)
+    else:
+        xb, yb = synthetic_pets(n, 2, seed=1)
+    aug = (ImageSet.from_ndarrays(xb, yb)
+           >> ImageColorJitter(brightness_delta=16.0, seed=1)
+           >> ImageHFlip(prob=0.5, seed=2))
+    fs = aug.to_feature_set()
+    xb_aug = np.stack(aug.images).astype(np.float32) / 255.0
+    del fs   # (shown for the FeatureSet route; fit takes arrays too)
+
+    # ---- 5. fine-tune the head, verify the freeze --------------------
+    ft.compile(optimizer="adam",
+               loss="sparse_categorical_crossentropy_with_logits",
+               metrics=["accuracy"])
+    ft.fit(xb_aug, yb, batch_size=64, nb_epoch=args.epochs)
+
+    conv1_after = jax.device_get(ft.get_variables()["params"]["conv1"])
+    for k in conv1_before:
+        np.testing.assert_array_equal(conv1_before[k], conv1_after[k])
+    scores = ft.evaluate(xb_aug, yb, batch_size=128)
+    print(f"dogs-vs-cats fine-tune: {scores} "
+          "(backbone verified bit-identical)")
+    return scores
+
+
+if __name__ == "__main__":
+    main()

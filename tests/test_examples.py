@@ -45,3 +45,17 @@ def test_multihost_example_runs():
          "--workers", "2"],
         capture_output=True, text=True, timeout=600)
     assert out.returncode == 0, out.stderr[-2000:]
+
+
+APPS = [
+    "apps.dogs_vs_cats.transfer_learning",
+    "apps.anomaly_detection.anomaly_detection_taxi",
+]
+
+
+@pytest.mark.parametrize("module", APPS)
+def test_app_smoke(module):
+    """The notebook-style apps (reference /apps analogue) run
+    end-to-end."""
+    mod = importlib.import_module(module)
+    assert mod.main(["--smoke"]) is not None

@@ -225,3 +225,178 @@ class TestServingCLI:
         from analytics_zoo_tpu.serving.cli import _build_model
         with pytest.raises(SystemExit):
             _build_model("no_colon_here")
+
+
+class TestCalibratedInt8:
+    def _trained_classifier(self):
+        """MLP+conv trained to high accuracy on a separable task."""
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+        rs = np.random.RandomState(0)
+        n, C = 512, 4
+        x = rs.randn(n, 8, 8, 3).astype(np.float32)
+        # class = argmax of per-quadrant mean brightness
+        q = np.stack([x[:, :4, :4].mean((1, 2, 3)),
+                      x[:, :4, 4:].mean((1, 2, 3)),
+                      x[:, 4:, :4].mean((1, 2, 3)),
+                      x[:, 4:, 4:].mean((1, 2, 3))], 1)
+        y = np.argmax(q, 1).astype(np.int32)
+        m = Sequential()
+        m.add(Convolution2D(16, 3, 3, input_shape=(8, 8, 3),
+                            activation="relu", border_mode="same"))
+        m.add(Flatten())
+        m.add(Dense(64, activation="relu"))
+        m.add(Dense(4))
+        m.compile(optimizer=Adam(lr=3e-3),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+        m.fit(x, y, batch_size=64, nb_epoch=15)
+        return m, x, y
+
+    @pytest.mark.slow
+    def test_calibrated_accuracy_within_half_point(self):
+        m, x, y = self._trained_classifier()
+        f32_acc = np.mean(
+            np.argmax(InferenceModel().load_zoo(m).predict(x), -1) == y)
+        q = InferenceModel().load_zoo(m, quantize="calibrated",
+                                      calib_set=x[:128])
+        assert q.is_quantized
+        q_acc = np.mean(np.argmax(q.predict(x), -1) == y)
+        assert f32_acc > 0.9                      # the task was learned
+        assert f32_acc - q_acc < 0.005            # <0.5% drop
+
+    def test_calibrated_params_are_int8(self):
+        m = small_classifier()
+        x = np.random.RandomState(1).randn(32, 8, 8, 3).astype(np.float32)
+        q = InferenceModel().load_zoo(m, quantize="calibrated",
+                                      calib_set=x, quant_min_size=16)
+        params = q._variables["params"]
+        quant_layers = [p for p in params.values()
+                        if isinstance(p, dict) and "kernel_scale" in p]
+        assert quant_layers, "no layer was quantized"
+        for p in quant_layers:
+            assert np.asarray(p["kernel"]).dtype == np.int8
+            assert p["act_scale"] > 0
+        out = q.predict(x)
+        ref = InferenceModel().load_zoo(m).predict(x)
+        rel = np.abs(out - ref) / (np.abs(ref).max() + 1e-6)
+        assert rel.max() < 0.1
+
+    def test_calibrated_requires_calib_set(self):
+        m = small_classifier()
+        with pytest.raises(ValueError, match="calib_set"):
+            InferenceModel().load_zoo(m, quantize="calibrated")
+
+    def test_record_activations_tap(self):
+        from analytics_zoo_tpu.pipeline.api.keras.engine import (
+            record_activations)
+        m = small_classifier()
+        v = m.get_variables()
+        x = np.ones((2, 8, 8, 3), np.float32) * 3.0
+        with record_activations() as taps:
+            m.apply(v["params"], x, state=v["state"], training=False)
+        names = [l.name for l in m.layers]
+        assert set(names) <= set(taps)
+        # first layer's input absmax is the raw input's
+        assert taps[names[0]] == pytest.approx(3.0)
+
+
+class TestPipelinedServing:
+    def test_decode_predict_overlap(self):
+        """Pipelined run must beat sequential decode+predict: with
+        ~25ms decode and ~25ms predict per batch, sequential costs
+        ~50ms/batch while the pipeline hides decode behind predict."""
+        import time as _t
+
+        class SlowModel:
+            def predict(self, x, batch_size=None):
+                _t.sleep(0.025)
+                return np.zeros((len(x), 4), np.float32)
+
+        def slow_decode(self, entries):
+            _t.sleep(0.025)
+            return ([f"u{i}" for i, _ in enumerate(entries)],
+                    [np.zeros((4,), np.float32) for _ in entries])
+
+        n_batches, bs = 12, 4
+        rs = np.random.RandomState(0)
+
+        def fill(broker):
+            inq = InputQueue(broker=broker)
+            for i in range(n_batches * bs):
+                inq.enqueue(f"r{i}", rs.rand(4).astype(np.float32))
+
+        # sequential: run_once pays decode + predict back to back
+        broker = EmbeddedBroker()
+        serving = ClusterServing(SlowModel(),
+                                 ServingConfig(batch_size=bs),
+                                 broker=broker)
+        serving._decode_batch = slow_decode.__get__(serving)
+        orig_decode = ClusterServing._decode_batch
+        fill(broker)
+        t0 = _t.time()
+        while serving.total_records < n_batches * bs:
+            # sequential emulation: decode then predict on this thread
+            entries = broker.xread("serving_stream", serving._last_id,
+                                   count=bs, block_ms=0)
+            if not entries:
+                break
+            for eid, _ in entries:
+                serving._last_id = eid
+            uris, arrays = serving._decode_batch(entries)
+            serving._predict_write(uris, arrays, _t.time())
+        seq_wall = _t.time() - t0
+
+        # pipelined: decode pool overlaps predicts
+        broker2 = EmbeddedBroker()
+        serving2 = ClusterServing(SlowModel(),
+                                  ServingConfig(batch_size=bs),
+                                  broker=broker2)
+        serving2._decode_batch = slow_decode.__get__(serving2)
+        fill(broker2)
+        t = threading.Thread(target=serving2.run,
+                             kwargs={"poll_ms": 5})
+        t0 = _t.time()
+        t.start()
+        while serving2.total_records < n_batches * bs \
+                and _t.time() - t0 < 30:
+            _t.sleep(0.005)
+        pipe_wall = _t.time() - t0
+        serving2.stop()
+        t.join(timeout=5)
+        assert serving2.total_records == n_batches * bs
+        # overlap: pipelined must be measurably faster than sequential
+        assert pipe_wall < seq_wall * 0.8, (seq_wall, pipe_wall)
+        s = serving2.stats()
+        assert s["latency_p50_ms"] > 0
+        assert s["latency_p95_ms"] >= s["latency_p50_ms"]
+
+    def test_stop_drains_inflight_batches(self):
+        """Records already read past (_last_id advanced) must be served
+        before shutdown — a stop may not strand queued clients."""
+        import time as _t
+
+        class SlowModel:
+            def predict(self, x, batch_size=None):
+                _t.sleep(0.05)
+                return np.zeros((len(x), 4), np.float32)
+
+        broker = EmbeddedBroker()
+        serving = ClusterServing(SlowModel(),
+                                 ServingConfig(batch_size=2),
+                                 broker=broker)
+        inq = InputQueue(broker=broker)
+        n = 16
+        for i in range(n):
+            inq.enqueue(f"d{i}", np.zeros(3, np.float32))
+        t = threading.Thread(target=serving.run, kwargs={"poll_ms": 5})
+        t.start()
+        while serving.total_records == 0:
+            _t.sleep(0.005)
+        serving.stop()            # several batches are still in flight
+        t.join(timeout=30)
+        assert not t.is_alive()
+        outq = OutputQueue(broker=broker)
+        # every record the server read past must have a result
+        assert serving.total_records >= 2
+        for i in range(serving.total_records):
+            assert outq.query(f"d{i}") is not None, f"d{i} stranded"

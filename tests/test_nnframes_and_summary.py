@@ -111,3 +111,79 @@ class TestTBWriter:
         assert glob.glob(os.path.join(str(tmp_path), "app", "train",
                                       "events.out.tfevents.*"))
         ts.close()
+
+
+class TestNNFramesPersistence:
+    def test_save_load_fresh_process_identical_transform(self, tmp_path):
+        """fit -> save -> load in a FRESH python process -> transform
+        output must be bit-identical (ref NNEstimator.scala:808,865 ML
+        persistence)."""
+        import subprocess
+        import sys
+
+        df, x, y = make_df()
+        model = Sequential()
+        model.add(Dense(16, activation="relu", input_shape=(6,)))
+        model.add(Dense(3))
+        est = (NNEstimator(model,
+                           "sparse_categorical_crossentropy_with_logits")
+               .set_batch_size(64).set_max_epoch(3)
+               .set_optim_method(Adam(lr=0.02)))
+        nn_model = est.fit(df)
+        out_here = np.stack(nn_model.transform(df)["prediction"].to_list())
+        mdir = str(tmp_path / "nn_model")
+        nn_model.save(mdir)
+        np.save(tmp_path / "x.npy", x)
+
+        script = f"""
+import numpy as np, pandas as pd
+import jax; jax.config.update("jax_platforms", "cpu")
+from analytics_zoo_tpu.pipeline.nnframes import NNModel
+m = NNModel.load({mdir!r})
+x = np.load({str(tmp_path / 'x.npy')!r})
+df = pd.DataFrame({{"features": list(x)}})
+out = np.stack(m.transform(df)["prediction"].to_list())
+np.save({str(tmp_path / 'out.npy')!r}, out)
+print("FRESH_OK")
+"""
+        r = subprocess.run([sys.executable, "-c", script],
+                           capture_output=True, text=True, timeout=300,
+                           env={**__import__('os').environ,
+                                "JAX_PLATFORMS": "cpu"})
+        assert "FRESH_OK" in r.stdout, r.stderr[-2000:]
+        out_fresh = np.load(tmp_path / "out.npy")
+        np.testing.assert_array_equal(out_here, out_fresh)
+
+    def test_estimator_save_load_roundtrip(self, tmp_path):
+        df, x, y = make_df()
+        model = Sequential()
+        model.add(Dense(8, activation="relu", input_shape=(6,)))
+        model.add(Dense(3))
+        est = (NNEstimator(model,
+                           "sparse_categorical_crossentropy_with_logits")
+               .set_batch_size(32).set_max_epoch(2))
+        est.save(str(tmp_path / "est"))
+        est2 = NNEstimator.load(str(tmp_path / "est"))
+        assert est2.batch_size == 32 and est2.max_epoch == 2
+        nn_model = est2.fit(df)
+        out = nn_model.transform(df)
+        assert "prediction" in out.columns
+
+    def test_classifier_model_class_preserved(self, tmp_path):
+        df, x, y = make_df()
+        model = Sequential()
+        model.add(Dense(8, activation="relu", input_shape=(6,)))
+        model.add(Dense(3))
+        from analytics_zoo_tpu.pipeline.nnframes import (
+            NNClassifier, NNClassifierModel)
+        clf = (NNClassifier(model,
+                            "sparse_categorical_crossentropy_with_logits")
+               .set_batch_size(32).set_max_epoch(2))
+        m = clf.fit(df)
+        m.save(str(tmp_path / "clf_model"))
+        from analytics_zoo_tpu.pipeline.nnframes.nn_estimator import (
+            NNModel)
+        m2 = NNModel.load(str(tmp_path / "clf_model"))
+        assert isinstance(m2, NNClassifierModel)
+        out = m2.transform(df)
+        assert out["prediction"].dtype == np.int64
