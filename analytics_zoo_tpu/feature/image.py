@@ -30,7 +30,19 @@ from analytics_zoo_tpu.feature.feature_set import FeatureSet
 
 
 def read_image(path: str, to_rgb: bool = True) -> np.ndarray:
-    """Decode one image file to HWC uint8."""
+    """Decode one image file (local or remote URI) to HWC uint8."""
+    from analytics_zoo_tpu.utils import file_io
+    if file_io.is_remote(path):
+        data = file_io.read_bytes(path)
+        if _HAS_CV2:
+            img = cv2.imdecode(np.frombuffer(data, np.uint8),
+                               cv2.IMREAD_COLOR)
+            if img is None:
+                raise IOError(f"cannot decode image {path}")
+            return cv2.cvtColor(img, cv2.COLOR_BGR2RGB) if to_rgb else img
+        import io                    # pragma: no cover
+        from PIL import Image
+        return np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
     if _HAS_CV2:
         img = cv2.imread(path, cv2.IMREAD_COLOR)
         if img is None:

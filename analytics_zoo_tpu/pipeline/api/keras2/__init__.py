@@ -1,23 +1,30 @@
-"""keras2 — Keras-2-style argument names for the core layer set.
+"""keras2 — the Keras-2 layer API (real classes over the keras-1
+engine).
 
-Reference: zoo/pipeline/api/keras2/layers/ (partial Keras-2 API: Dense,
-Conv1D/2D, pooling, merge functions, Softmax... with `units`/`filters`/
-`kernel_size`-style args instead of Keras-1 `output_dim`/`nb_filter`).
-Thin adapters over the keras-1 layer set.
+Reference: zoo/pipeline/api/keras2/layers/ — Dense, Conv1D/2D, pooling
+families, Cropping1D, LocallyConnected1D, Softmax(axis), the
+Average/Maximum/Minimum merge classes, plus the functional merge
+helpers — with keras-2 argument names (units/filters/kernel_size,
+kernel_initializer/bias_initializer, padding/data_format).
 """
 
 from analytics_zoo_tpu.pipeline.api.keras2.layers import (
-    Activation, AveragePooling1D, AveragePooling2D, Conv1D, Conv2D,
-    Dense, Dropout, Flatten, GlobalAveragePooling1D,
-    GlobalAveragePooling2D, GlobalMaxPooling1D, GlobalMaxPooling2D,
-    MaxPooling1D, MaxPooling2D, Softmax, add, average, concatenate,
-    maximum, minimum, multiply, subtract,
+    Activation, Add, Average, AveragePooling1D, AveragePooling2D,
+    Concatenate, Conv1D, Conv2D, Cropping1D, Dense, Dropout, Flatten,
+    GlobalAveragePooling1D, GlobalAveragePooling2D,
+    GlobalAveragePooling3D, GlobalMaxPooling1D, GlobalMaxPooling2D,
+    GlobalMaxPooling3D, LocallyConnected1D, MaxPooling1D, MaxPooling2D,
+    Maximum, Minimum, Multiply, Softmax, Subtract, add, average,
+    concatenate, maximum, minimum, multiply, subtract,
 )
 
 __all__ = [
-    "Activation", "AveragePooling1D", "AveragePooling2D", "Conv1D",
-    "Conv2D", "Dense", "Dropout", "Flatten", "GlobalAveragePooling1D",
-    "GlobalAveragePooling2D", "GlobalMaxPooling1D", "GlobalMaxPooling2D",
-    "MaxPooling1D", "MaxPooling2D", "Softmax", "add", "average",
+    "Activation", "Add", "Average", "AveragePooling1D",
+    "AveragePooling2D", "Concatenate", "Conv1D", "Conv2D", "Cropping1D",
+    "Dense", "Dropout", "Flatten", "GlobalAveragePooling1D",
+    "GlobalAveragePooling2D", "GlobalAveragePooling3D",
+    "GlobalMaxPooling1D", "GlobalMaxPooling2D", "GlobalMaxPooling3D",
+    "LocallyConnected1D", "MaxPooling1D", "MaxPooling2D", "Maximum",
+    "Minimum", "Multiply", "Softmax", "Subtract", "add", "average",
     "concatenate", "maximum", "minimum", "multiply", "subtract",
 ]

@@ -20,10 +20,17 @@ def read_images(path: str, pattern: str = "*.jpg",
     import pandas as pd
 
     from analytics_zoo_tpu.feature.image import ImageResize, read_image
-    files = sorted(glob.glob(os.path.join(path, pattern)))
-    if not files:
-        files = sorted(glob.glob(os.path.join(path, "**", pattern),
-                                 recursive=True))
+    from analytics_zoo_tpu.utils import file_io
+    if file_io.is_remote(path):
+        files = file_io.list_files(path.rstrip("/") + "/" + pattern)
+        if not files:
+            files = file_io.list_files(
+                path.rstrip("/") + "/**/" + pattern)
+    else:
+        files = sorted(glob.glob(os.path.join(path, pattern)))
+        if not files:
+            files = sorted(glob.glob(os.path.join(path, "**", pattern),
+                                     recursive=True))
     rows = []
     resize = (ImageResize(resize_h, resize_w)
               if resize_h and resize_w else None)

@@ -29,9 +29,36 @@ def _build_model(spec: str, weights: str = None):
     return model
 
 
+def _send_stop(cfg):
+    import time
+
+    from analytics_zoo_tpu.serving.redis_client import connect
+    from analytics_zoo_tpu.serving.server import STOP_KEY
+    broker = connect(cfg.redis_url)
+    broker.hset(STOP_KEY, {"stop": str(time.time())})
+    return broker
+
+
+def _start(cfg, args):
+    builder = args.builder or cfg.extra.get("model.builder")
+    if not builder:
+        raise SystemExit("start needs --builder or config model: builder:")
+    weights = args.weights or cfg.extra.get("model.weights")
+    model = _build_model(builder, weights)
+
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.serving.server import ClusterServing
+    im = InferenceModel().load_zoo(model, quantize=args.quantize)
+    serving = ClusterServing(im, cfg)
+    serving.run()
+    return 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(prog="cluster-serving")
-    p.add_argument("command", choices=["start", "stop"])
+    p.add_argument("command",
+                   choices=["init", "start", "stop", "restart",
+                            "shutdown"])
     p.add_argument("--config", "-c", default="config.yaml")
     p.add_argument("--builder", default=None,
                    help="pkg.module:function returning a built model "
@@ -42,8 +69,7 @@ def main(argv=None):
     args = p.parse_args(argv)
 
     import os
-    from analytics_zoo_tpu.serving.server import (
-        STOP_KEY, ClusterServing, ServingConfig)
+    from analytics_zoo_tpu.serving.server import ServingConfig
     from analytics_zoo_tpu.serving.redis_client import connect
 
     cfg = ServingConfig.from_yaml(args.config) \
@@ -51,24 +77,52 @@ def main(argv=None):
     if args.redis:
         cfg.redis_url = args.redis
 
+    if args.command == "init":
+        # validate the full setup without serving (ref
+        # cluster-serving-init): broker reachable + model builds
+        from analytics_zoo_tpu.serving.server import INPUT_STREAM
+        connect(cfg.redis_url).xlen(INPUT_STREAM)
+        builder = args.builder or cfg.extra.get("model.builder")
+        if builder:
+            _build_model(builder,
+                         args.weights or cfg.extra.get("model.weights"))
+        print("Cluster Serving has been properly set up.")
+        return 0
+
     if args.command == "stop":
-        import time
-        broker = connect(cfg.redis_url)
-        broker.hset(STOP_KEY, {"stop": str(time.time())})
+        _send_stop(cfg)
         print("stop signal sent")
         return 0
 
-    builder = args.builder or cfg.extra.get("model.builder")
-    if not builder:
-        raise SystemExit("start needs --builder or config model: builder:")
-    weights = args.weights or cfg.extra.get("model.weights")
-    model = _build_model(builder, weights)
+    if args.command == "shutdown":
+        # stop the worker AND the broker (ref cluster-serving-shutdown:
+        # stop + redis-cli shutdown); embedded brokers just stop
+        broker = _send_stop(cfg)
+        try:
+            broker.shutdown()
+        except Exception:
+            pass
+        print("Cluster Serving is shutdown.")
+        return 0
 
-    from analytics_zoo_tpu.pipeline.inference import InferenceModel
-    im = InferenceModel().load_zoo(model, quantize=args.quantize)
-    serving = ClusterServing(im, cfg)
-    serving.run()
-    return 0
+    if args.command == "restart":
+        import time
+
+        from analytics_zoo_tpu.serving.server import STOP_KEY
+        broker = _send_stop(cfg)
+        # wait for the old worker to acknowledge (it DELETEs STOP_KEY
+        # on shutdown) — starting immediately would let the new worker
+        # consume its own stop signal, or steal the old worker's
+        deadline = time.time() + 30.0
+        while broker.hgetall(STOP_KEY) and time.time() < deadline:
+            time.sleep(0.1)
+        if broker.hgetall(STOP_KEY):
+            # no worker was running — clear the stale signal ourselves
+            broker.delete(STOP_KEY)
+        print("stop acknowledged; restarting")
+        return _start(cfg, args)
+
+    return _start(cfg, args)
 
 
 if __name__ == "__main__":

@@ -103,6 +103,15 @@ class RedisClient:
     def xdel(self, stream: str, *ids) -> int:
         return self.execute("XDEL", stream, *ids)
 
+    def shutdown(self) -> None:
+        """Terminate the redis server (cluster-serving-shutdown's
+        ``redis-cli shutdown`` role); the server closes the connection
+        without a reply."""
+        try:
+            self.execute("SHUTDOWN", "NOSAVE")
+        except Exception:
+            pass   # connection drop IS the success signal
+
     def hset(self, key: str, fields: Dict[str, Any]) -> int:
         args = ["HSET", key]
         for k, v in fields.items():
@@ -212,6 +221,13 @@ class EmbeddedBroker:
 
     def close(self):
         pass
+
+    def shutdown(self) -> None:
+        """In-process broker: clear all state (the redis-server
+        shutdown analogue)."""
+        with self._lock:
+            self._streams.clear()
+            self._hashes.clear()
 
 
 def _id_gt(a: str, b: str) -> bool:

@@ -1,0 +1,87 @@
+"""File IO across local and remote filesystems.
+
+Reference: the HDFS/S3 helpers threaded through
+zoo/common/Utils.scala and zoo/pipeline/api/net/utils/File.scala
+(``getFileSystem``, ``saveBytes``/``readBytes`` with
+``hdfs://``/``s3://`` URIs) — every loader/saver in the reference
+accepts remote paths.
+
+TPU version: local paths use plain ``os``/``glob`` (no wrapper
+overhead in the hot input pipeline); remote schemes (``gs://``,
+``s3://``, ``hdfs://``, ...) route through fsspec, with a clear error
+naming the missing backend package when one isn't installed.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import os
+from typing import List
+
+_REMOTE_SCHEMES = ("gs://", "s3://", "s3a://", "hdfs://", "abfs://",
+                   "http://", "https://")
+
+
+def is_remote(path: str) -> bool:
+    return str(path).startswith(_REMOTE_SCHEMES)
+
+
+def _fs(path: str):
+    try:
+        import fsspec
+    except ImportError as e:             # pragma: no cover
+        raise ImportError(
+            f"remote path {path!r} needs fsspec (pip install fsspec "
+            "plus the scheme backend, e.g. gcsfs/s3fs)") from e
+    try:
+        fs, _ = fsspec.core.url_to_fs(path)
+        return fs
+    except ImportError as e:
+        raise ImportError(
+            f"no fsspec backend for {path!r}: {e} — install the "
+            "scheme's package (gcsfs for gs://, s3fs for s3://, "
+            "pyarrow for hdfs://)") from e
+
+
+def open_file(path: str, mode: str = "rb"):
+    """Open local or remote path; caller closes (context manager)."""
+    if is_remote(path):
+        # _fs() gives the install-the-backend diagnostic on missing
+        # scheme packages
+        return _fs(path).open(path, mode)
+    if "w" in mode:
+        os.makedirs(os.path.dirname(os.path.abspath(path)),
+                    exist_ok=True)
+    return open(path, mode)
+
+
+def read_bytes(path: str) -> bytes:
+    with open_file(path, "rb") as f:
+        return f.read()
+
+
+def write_bytes(path: str, data: bytes) -> None:
+    with open_file(path, "wb") as f:
+        f.write(data)
+
+
+def exists(path: str) -> bool:
+    if is_remote(path):
+        return _fs(path).exists(path)
+    return os.path.exists(path)
+
+
+def list_files(pattern: str) -> List[str]:
+    """Glob local or remote; remote results keep their scheme."""
+    if is_remote(pattern):
+        fs = _fs(pattern)
+        scheme = pattern.split("://", 1)[0]
+        return sorted(f"{scheme}://{p}" for p in fs.glob(pattern))
+    return sorted(_glob.glob(pattern))
+
+
+def makedirs(path: str) -> None:
+    if is_remote(path):
+        _fs(path).makedirs(path, exist_ok=True)
+    else:
+        os.makedirs(path, exist_ok=True)

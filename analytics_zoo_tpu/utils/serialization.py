@@ -25,6 +25,15 @@ def _atomic_write(path: str, data: bytes) -> None:
 
 
 def save_variables(path: str, variables: Any, over_write: bool = True) -> None:
+    from analytics_zoo_tpu.utils import file_io
+    if file_io.is_remote(path):
+        # remote stores (gs://, s3://, hdfs://...) — the reference's
+        # File.saveBytes role; remote writes are already atomic-ish
+        # (object stores commit on close)
+        if not over_write and file_io.exists(path):
+            raise FileExistsError(path)
+        file_io.write_bytes(path, fser.to_bytes(variables))
+        return
     if os.path.exists(path) and not over_write:
         raise FileExistsError(path)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -44,8 +53,8 @@ def load_variables(path: str, like: Any) -> Any:
     import jax
     import numpy as np
 
-    with open(path, "rb") as f:
-        data = f.read()
+    from analytics_zoo_tpu.utils import file_io
+    data = file_io.read_bytes(path)
     try:
         return fser.from_bytes(like, data)
     except (ValueError, KeyError):

@@ -119,3 +119,81 @@ class TestImage3D:
         np.testing.assert_allclose(rot, vol, atol=1e-3)
         ident = AffineTransform3D(np.eye(3)).apply(vol)
         np.testing.assert_allclose(ident, vol, atol=1e-5)
+
+
+class TestKeras2Semantics:
+    """keras-2 specifics beyond argument renames (ref
+    zoo/pipeline/api/keras2/layers/)."""
+
+    def test_bias_initializer_takes_effect(self):
+        from analytics_zoo_tpu.pipeline.api import keras2
+        import jax
+        d = keras2.Dense(4, bias_initializer="one", input_shape=(3,))
+        params = d.init(jax.random.PRNGKey(0), (None, 3))["params"]
+        np.testing.assert_array_equal(np.asarray(params["bias"]),
+                                      np.ones(4, np.float32))
+        d0 = keras2.Dense(4, input_shape=(3,))
+        p0 = d0.init(jax.random.PRNGKey(0), (None, 3))["params"]
+        np.testing.assert_array_equal(np.asarray(p0["bias"]),
+                                      np.zeros(4, np.float32))
+
+    def test_conv2d_dilation_rate(self):
+        from analytics_zoo_tpu.pipeline.api import keras2
+        import jax
+        c = keras2.Conv2D(2, 3, dilation_rate=2, padding="valid",
+                          input_shape=(9, 9, 1))
+        v = c.init(jax.random.PRNGKey(0), (None, 9, 9, 1))
+        out = c.call(v["params"], np.zeros((1, 9, 9, 1), np.float32))
+        # effective kernel 5x5 -> 9-4 = 5 spatial
+        assert out.shape == (1, 5, 5, 2)
+
+    def test_softmax_axis(self):
+        from analytics_zoo_tpu.pipeline.api import keras2
+        s = keras2.Softmax(axis=1)
+        x = np.random.RandomState(0).randn(2, 3, 4).astype(np.float32)
+        out = np.asarray(s.call({}, x))
+        np.testing.assert_allclose(out.sum(axis=1), np.ones((2, 4)),
+                                   rtol=1e-5)
+
+    def test_merge_classes(self):
+        from analytics_zoo_tpu.pipeline.api import keras2
+        a = np.array([[1.0, 2.0]], np.float32)
+        b = np.array([[3.0, 1.0]], np.float32)
+        assert np.allclose(keras2.Maximum().call({}, [a, b]), [[3, 2]])
+        assert np.allclose(keras2.Minimum().call({}, [a, b]), [[1, 1]])
+        assert np.allclose(keras2.Average().call({}, [a, b]), [[2, 1.5]])
+        assert np.allclose(keras2.Subtract().call({}, [a, b]),
+                           [[-2, 1]])
+
+    def test_locally_connected_and_cropping(self):
+        from analytics_zoo_tpu.pipeline.api import keras2
+        import jax
+        lc = keras2.LocallyConnected1D(3, 2, input_shape=(6, 4))
+        v = lc.init(jax.random.PRNGKey(0), (None, 6, 4))
+        out = lc.call(v["params"], np.zeros((2, 6, 4), np.float32))
+        assert out.shape == (2, 5, 3)
+        with pytest.raises(ValueError, match="valid"):
+            keras2.LocallyConnected1D(3, 2, padding="same")
+        cr = keras2.Cropping1D(cropping=2)
+        out = cr.call({}, np.zeros((2, 8, 3), np.float32))
+        assert out.shape == (2, 4, 3)
+
+    def test_keras2_functional_model_trains(self):
+        from analytics_zoo_tpu.pipeline.api import keras2
+        from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+        inp1 = Input(shape=(6,))
+        inp2 = Input(shape=(6,))
+        h1 = keras2.Dense(8, activation="relu")(inp1)
+        h2 = keras2.Dense(8, activation="relu")(inp2)
+        merged = keras2.concatenate([h1, h2])
+        out = keras2.Dense(2)(merged)
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+        m = Model([inp1, inp2], out)
+        m.compile(optimizer=Adam(lr=0.02),
+                  loss="sparse_categorical_crossentropy_with_logits")
+        rs = np.random.RandomState(0)
+        xa = rs.randn(128, 6).astype(np.float32)
+        xb = rs.randn(128, 6).astype(np.float32)
+        y = ((xa.sum(-1) + xb.sum(-1)) > 0).astype(np.int32)[:, None]
+        hist = m.fit([xa, xb], y, batch_size=32, nb_epoch=10)
+        assert hist[-1]["loss"] < hist[0]["loss"]

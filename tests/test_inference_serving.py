@@ -400,3 +400,25 @@ class TestPipelinedServing:
         assert serving.total_records >= 2
         for i in range(serving.total_records):
             assert outq.query(f"d{i}") is not None, f"d{i} stranded"
+
+
+class TestServingOpsCommands:
+    def test_init_validates_setup(self, capsys):
+        from analytics_zoo_tpu.serving import cli
+        rc = cli.main(["init", "--redis", "embedded"])
+        assert rc == 0
+        assert "properly set up" in capsys.readouterr().out
+
+    def test_shutdown_clears_broker(self, capsys):
+        from analytics_zoo_tpu.serving import cli
+        rc = cli.main(["shutdown", "--redis", "embedded"])
+        assert rc == 0
+        assert "shutdown" in capsys.readouterr().out
+
+    def test_embedded_broker_shutdown_clears_state(self):
+        b = EmbeddedBroker()
+        b.xadd("serving_stream", {"uri": "a", "data": "x"})
+        b.hset("h", {"k": "v"})
+        b.shutdown()
+        assert b.xlen("serving_stream") == 0
+        assert b.hgetall("h") == {}
