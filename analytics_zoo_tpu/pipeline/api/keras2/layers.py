@@ -196,8 +196,14 @@ GlobalMaxPooling1D = k1.GlobalMaxPooling1D
 GlobalMaxPooling2D = k1.GlobalMaxPooling2D
 GlobalMaxPooling3D = k1.GlobalMaxPooling3D
 Activation = k1.Activation
-Dropout = k1.Dropout
 Flatten = k1.Flatten
+
+
+class Dropout(k1.Dropout):
+    """keras-2 spells the probability ``rate`` (keras-1: ``p``)."""
+
+    def __init__(self, rate: float, **kwargs):
+        super().__init__(rate, **kwargs)
 
 
 class Softmax(Layer):

@@ -96,8 +96,19 @@ def main(argv=None):
 
     if args.command == "shutdown":
         # stop the worker AND the broker (ref cluster-serving-shutdown:
-        # stop + redis-cli shutdown); embedded brokers just stop
+        # stop + redis-cli shutdown).  Wait for the worker to ACK the
+        # stop (it DELETEs STOP_KEY after draining) before killing the
+        # broker — shutting redis down first would crash the worker
+        # mid-drain and lose read-past records.
+        import time
+
+        from analytics_zoo_tpu.serving.redis_client import EmbeddedBroker
+        from analytics_zoo_tpu.serving.server import STOP_KEY
         broker = _send_stop(cfg)
+        if not isinstance(broker, EmbeddedBroker):
+            deadline = time.time() + 30.0
+            while broker.hgetall(STOP_KEY) and time.time() < deadline:
+                time.sleep(0.1)
         try:
             broker.shutdown()
         except Exception:
@@ -108,17 +119,24 @@ def main(argv=None):
     if args.command == "restart":
         import time
 
+        from analytics_zoo_tpu.serving.redis_client import EmbeddedBroker
         from analytics_zoo_tpu.serving.server import STOP_KEY
         broker = _send_stop(cfg)
-        # wait for the old worker to acknowledge (it DELETEs STOP_KEY
-        # on shutdown) — starting immediately would let the new worker
-        # consume its own stop signal, or steal the old worker's
-        deadline = time.time() + 30.0
-        while broker.hgetall(STOP_KEY) and time.time() < deadline:
-            time.sleep(0.1)
-        if broker.hgetall(STOP_KEY):
-            # no worker was running — clear the stale signal ourselves
+        if isinstance(broker, EmbeddedBroker):
+            # in-process broker: no external worker can be listening —
+            # clear our own signal and start directly
             broker.delete(STOP_KEY)
+        else:
+            # wait for the old worker to acknowledge (it DELETEs
+            # STOP_KEY on shutdown) — starting immediately would let
+            # the new worker consume its own stop signal, or steal the
+            # old worker's
+            deadline = time.time() + 30.0
+            while broker.hgetall(STOP_KEY) and time.time() < deadline:
+                time.sleep(0.1)
+            if broker.hgetall(STOP_KEY):
+                # no worker was running — clear the stale signal
+                broker.delete(STOP_KEY)
         print("stop acknowledged; restarting")
         return _start(cfg, args)
 

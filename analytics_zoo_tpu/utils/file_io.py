@@ -75,8 +75,10 @@ def list_files(pattern: str) -> List[str]:
     """Glob local or remote; remote results keep their scheme."""
     if is_remote(pattern):
         fs = _fs(pattern)
-        scheme = pattern.split("://", 1)[0]
-        return sorted(f"{scheme}://{p}" for p in fs.glob(pattern))
+        # unstrip_protocol restores scheme AND netloc correctly (http
+        # globs come back as full URLs; hdfs globs as bare paths)
+        return sorted(fs.unstrip_protocol(p) if "://" not in str(p)
+                      else str(p) for p in fs.glob(pattern))
     return sorted(_glob.glob(pattern))
 
 

@@ -1,0 +1,88 @@
+"""Image augmentation (2D and 3D) — runnable tutorial.
+
+The TPU-native retelling of the reference's image-augmentation and
+image-augmentation-3d apps (``apps/image-augmentation*/``): a tour of
+the host-side transform library that feeds training — chained with
+``>>`` exactly like the reference's ``transform(...)`` pipelines.
+
+Covered:
+
+* 2D (feature/image.py): resize, crops, flip, ColorJitter
+  (brightness/contrast/saturation/hue in random order), Expand
+  (zoom-out onto a mean canvas), channel order/normalize.
+* 3D (feature/image3d.py): center/random crop, rotation, affine — the
+  medical-volume pipeline.
+* Detection-aware (feature/image_detection.py): the same moves with
+  boxes kept consistent (used by the SSD recipe).
+
+Run: ``python apps/image_augmentation/image_augmentation.py``
+"""
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--smoke", action="store_true")
+    p.parse_args(argv)
+
+    from analytics_zoo_tpu.feature.image import (
+        ImageChannelNormalize, ImageColorJitter, ImageExpand, ImageHFlip,
+        ImageRandomCrop, ImageResize, ImageSet)
+    from analytics_zoo_tpu.feature.image3d import (
+        CenterCrop3D, RandomCrop3D, Rotate3D)
+
+    rs = np.random.RandomState(0)
+
+    # ---- 2D pipeline -----------------------------------------------------
+    imgs = (rs.rand(8, 48, 48, 3) * 255).astype(np.uint8)
+    labels = rs.randint(0, 2, 8)
+    pipeline = (ImageSet.from_ndarrays(imgs, labels)
+                >> ImageResize(40, 40)
+                >> ImageExpand(max_ratio=2.0, prob=1.0, seed=1)
+                >> ImageRandomCrop(32, 32, seed=2)
+                >> ImageHFlip(prob=0.5, seed=3)
+                >> ImageColorJitter(seed=4)
+                >> ImageChannelNormalize(127.5, 127.5, 127.5,
+                                         127.5, 127.5, 127.5))
+    fs = pipeline.to_feature_set()
+    shapes = {im.shape for im in pipeline.images}
+    print(f"2D: {len(pipeline)} images -> shapes {shapes}, "
+          f"feature set of {fs.size}")
+    assert shapes == {(32, 32, 3)}
+
+    # ---- 3D pipeline -----------------------------------------------------
+    vols = rs.rand(4, 20, 20, 20).astype(np.float32)
+    out = [RandomCrop3D((16, 16, 16), seed=5).apply(
+        Rotate3D(22.5, axes=(0, 1)).apply(v)) for v in vols]
+    out = [CenterCrop3D((12, 12, 12)).apply(v) for v in out]
+    print(f"3D: {len(out)} volumes -> {out[0].shape}")
+    assert out[0].shape == (12, 12, 12)
+
+    # ---- detection-aware --------------------------------------------------
+    from analytics_zoo_tpu.feature.image_detection import (
+        DetExpand, DetHFlip, DetResize, DetectionSet)
+    sample = {"image": (rs.rand(48, 48, 3) * 255).astype(np.float32),
+              "boxes": np.array([[8, 8, 24, 24]], np.float32),
+              "labels": np.array([1], np.int32),
+              "difficult": np.array([False])}
+    ds = (DetectionSet.from_samples([sample])
+          >> DetHFlip(prob=1.0) >> DetExpand(prob=1.0, seed=6)
+          >> DetResize(32, 32))
+    m = ds.materialize(0).samples[0]
+    print(f"detection: image {m['image'].shape}, box {m['boxes'][0]}")
+    assert m["image"].shape == (32, 32, 3)
+    return True
+
+
+if __name__ == "__main__":
+    main()

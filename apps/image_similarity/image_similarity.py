@@ -1,0 +1,93 @@
+"""Image similarity search — runnable tutorial.
+
+The TPU-native retelling of the reference's image-similarity app
+(``apps/image-similarity/image-similarity.ipynb``, a real-estate
+visual search): embed every gallery image with a convnet FEATURE
+EXTRACTOR (the classifier minus its head, via graph surgery), then
+answer queries by cosine similarity in embedding space.
+
+Steps:
+
+1. **Train a small classifier** on a synthetic gallery (stand-in for a
+   published backbone — with one, use ``Net.load`` and skip this).
+2. **Cut the head off** — ``new_graph("features")`` turns the
+   classifier into an embedding model (NetUtils.scala:82).
+3. **Index the gallery**: one batched ``predict`` → (N, D) matrix,
+   L2-normalized.
+4. **Query**: embed the query, cosine-score against the index, top-K.
+   Same-class images must dominate the results.
+
+Run: ``python apps/image_similarity/image_similarity.py``
+"""
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def gallery(n, num_classes=4, side=16, seed=0):
+    rs = np.random.RandomState(seed)
+    y = rs.randint(0, num_classes, size=(n, 1))
+    x = rs.rand(n, side, side, 3).astype(np.float32) * 0.3
+    for i in range(n):
+        c = int(y[i, 0])
+        x[i, 2 + c * 3: 6 + c * 3, 2:6] += 1.0
+    return x, y
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.epochs = 2
+    n = 256 if args.smoke else 1024
+
+    from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D, Dense, Flatten, MaxPooling2D)
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    # ---- 1. classifier -------------------------------------------------
+    inp = Input(shape=(16, 16, 3))
+    x = Convolution2D(8, 3, 3, activation="relu", border_mode="same")(inp)
+    x = MaxPooling2D()(x)
+    x = Flatten()(x)
+    feat = Dense(32, activation="relu", name="features")(x)
+    out = Dense(4)(feat)
+    clf = Model(inp, out)
+    clf.compile(optimizer=Adam(lr=0.01),
+                loss="sparse_categorical_crossentropy_with_logits",
+                metrics=["accuracy"])
+    xg, yg = gallery(n, seed=0)
+    clf.fit(xg, yg, batch_size=64, nb_epoch=args.epochs)
+
+    # ---- 2. embedding model via surgery --------------------------------
+    embedder = clf.new_graph("features")
+
+    # ---- 3. index the gallery ------------------------------------------
+    emb = np.asarray(embedder.predict(xg, batch_size=256))
+    emb = emb / (np.linalg.norm(emb, axis=1, keepdims=True) + 1e-8)
+
+    # ---- 4. query -------------------------------------------------------
+    xq, yq = gallery(32, seed=7)
+    qemb = np.asarray(embedder.predict(xq, batch_size=32))
+    qemb = qemb / (np.linalg.norm(qemb, axis=1, keepdims=True) + 1e-8)
+    scores = qemb @ emb.T                      # cosine similarity
+    topk = np.argsort(-scores, axis=1)[:, :5]
+    hit = np.mean([
+        np.mean(yg[topk[i], 0] == yq[i, 0]) for i in range(len(xq))])
+    print(f"top-5 same-class hit rate: {hit:.2f}")
+    return hit
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Variational autoencoder — runnable tutorial.
+
+The TPU-native retelling of the reference's variational-autoencoder
+app (``apps/variational-autoencoder/*.ipynb``): an encoder producing
+(mean, log_var), the GaussianSampler reparameterisation layer
+(keras/layers GaussianSampler — elementwise.py:384), a decoder, and
+the ELBO loss written with the autograd CustomLoss surface
+(reconstruction + KL divergence).
+
+Steps:
+
+1. **Data** — blurry synthetic "digits" (oriented bars), enough for
+   the ELBO to visibly drop.
+2. **Encoder/decoder graph** with a sampled latent in the middle —
+   one functional Model, trained end-to-end.
+3. **ELBO as a custom loss**: MSE reconstruction + analytic KL to the
+   unit Gaussian, via ``autograd`` variables (the reference builds the
+   same with zoo autograd ops).
+4. **Generate**: decode fresh unit-Gaussian samples.
+
+Run: ``python apps/variational_autoencoder/vae_digits.py``
+"""
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def bars(n, side=12, seed=0):
+    rs = np.random.RandomState(seed)
+    x = np.zeros((n, side * side), np.float32)
+    for i in range(n):
+        img = np.zeros((side, side), np.float32)
+        pos = rs.randint(2, side - 2)
+        if rs.rand() < 0.5:
+            img[pos - 1:pos + 1, :] = 1.0
+        else:
+            img[:, pos - 1:pos + 1] = 1.0
+        x[i] = img.ravel()
+    return x
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=15)
+    p.add_argument("--latent", type=int, default=4)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.epochs = 2
+    n = 256 if args.smoke else 2048
+    D = 144
+
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Dense, GaussianSampler)
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    # ---- 2. encoder → sampler → decoder --------------------------------
+    inp = Input(shape=(D,))
+    h = Dense(64, activation="relu")(inp)
+    mean = Dense(args.latent, name="z_mean")(h)
+    log_var = Dense(args.latent, name="z_log_var")(h)
+    z = GaussianSampler()([mean, log_var])
+    d = Dense(64, activation="relu", name="dec_hidden")(z)
+    recon = Dense(D, activation="sigmoid", name="dec_out")(d)
+    # expose mean/log_var alongside the reconstruction so the loss can
+    # compute the KL term — a multi-output graph Model
+    vae = Model(inp, [recon, mean, log_var])
+
+    # ---- 3. ELBO loss ---------------------------------------------------
+    def elbo_loss(y_true, y_pred):
+        recon, mean, log_var = y_pred
+        target = y_true[0] if isinstance(y_true, (list, tuple)) else y_true
+        rec = jnp.mean(jnp.sum((recon - target) ** 2, axis=-1))
+        kl = -0.5 * jnp.mean(jnp.sum(
+            1.0 + log_var - mean ** 2 - jnp.exp(log_var), axis=-1))
+        return rec + kl
+
+    vae.compile(optimizer=Adam(lr=1e-3), loss=elbo_loss)
+    x = bars(n)
+    hist = vae.fit(x, x, batch_size=64, nb_epoch=args.epochs)
+    losses = [h["loss"] for h in hist]
+    print(f"ELBO: {losses[0]:.3f} -> {losses[-1]:.3f}")
+
+    # ---- 4. generate ----------------------------------------------------
+    dec_h = vae.layers_by_name("dec_hidden") if hasattr(
+        vae, "layers_by_name") else None
+    del dec_h
+    variables = vae.get_variables()
+    zs = np.random.RandomState(1).randn(4, args.latent).astype(np.float32)
+    params = variables["params"]
+    h = np.maximum(zs @ np.asarray(params["dec_hidden"]["kernel"])
+                   + np.asarray(params["dec_hidden"]["bias"]), 0.0)
+    logits = h @ np.asarray(params["dec_out"]["kernel"]) \
+        + np.asarray(params["dec_out"]["bias"])
+    samples = 1.0 / (1.0 + np.exp(-logits))
+    print(f"generated {samples.shape[0]} samples, "
+          f"pixel range [{samples.min():.2f}, {samples.max():.2f}]")
+    assert losses[-1] < losses[0]
+    return losses
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,12 @@ def test_multihost_example_runs():
 APPS = [
     "apps.dogs_vs_cats.transfer_learning",
     "apps.anomaly_detection.anomaly_detection_taxi",
+    "apps.image_similarity.image_similarity",
+    "apps.sentiment_analysis.sentiment_analysis",
+    "apps.recommendation_ncf.ncf_explicit_implicit",
+    "apps.variational_autoencoder.vae_digits",
+    "apps.fraud_detection.fraud_detection",
+    "apps.image_augmentation.image_augmentation",
 ]
 
 
