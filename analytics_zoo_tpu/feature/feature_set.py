@@ -123,14 +123,34 @@ class FeatureSet:
         return cls(x, y, shuffle=shuffle)
 
     @classmethod
-    def from_npy_dir(cls, path: str, num_slices: int = 1,
-                     shuffle: bool = True) -> "FeatureSet":
-        """Disk-backed mode: memory-mapped ``x.npy``/``y.npy``; with
-        ``num_slices > 1`` only 1/num_slices is materialised per
-        sub-epoch (DiskFeatureSet analogue, FeatureSet.scala:585-662)."""
-        x = np.load(os.path.join(path, "x.npy"), mmap_mode="r")
+    def from_npy_dir(cls, path: str, num_slices: Optional[int] = None,
+                     shuffle: bool = True,
+                     memory_type: str = "PMEM") -> "FeatureSet":
+        """Disk-backed mode with the reference's cache-tier policy
+        names (FeatureSet.scala memoryType — DRAM / PMEM / DIRECT,
+        :585-662):
+
+        * ``"DRAM"``  — materialise fully into host RAM,
+        * ``"PMEM"``  — memory-map (the persistent-memory tier's role:
+          bigger-than-RAM data paged on demand),
+        * ``"DIRECT"``— memory-map AND stream 1/num_slices per
+          sub-epoch (the disk-sliced DiskFeatureSet).
+
+        The fourth tier — device HBM — is above all of these:
+        ``DistributedTrainer.put_epoch`` + ``epoch_scan_fn``.
+        """
+        tier = memory_type.upper()
+        if tier not in ("DRAM", "PMEM", "DIRECT"):
+            raise ValueError(
+                f"memory_type {memory_type!r}: expected DRAM|PMEM|DIRECT")
+        mmap = None if tier == "DRAM" else "r"
+        x = np.load(os.path.join(path, "x.npy"), mmap_mode=mmap)
         ypath = os.path.join(path, "y.npy")
-        y = np.load(ypath, mmap_mode="r") if os.path.exists(ypath) else None
+        y = np.load(ypath, mmap_mode=mmap) if os.path.exists(ypath) \
+            else None
+        if num_slices is None:
+            # tier default only when the caller didn't choose
+            num_slices = 4 if tier == "DIRECT" else 1
         return cls(x, y, shuffle=shuffle, num_slices=num_slices)
 
     # ------------------------------------------------------------ transforms

@@ -14,6 +14,10 @@ Axis convention (outer → inner, fastest collectives innermost):
               a new TPU-native capability.
 - ``model`` : tensor parallelism for wide layers.
 - ``seq``   : sequence/context parallelism (ring attention).
+- ``pipe``  : pipeline parallelism (GPipe microbatch schedule over
+              ppermute — parallel/pipeline.py).
+- ``expert``: expert parallelism for MoE layers (all_to_all token
+              routing).
 
 A 1-chip mesh is simply shape ``{"data": 1}`` — every code path is
 written against the mesh so that single-chip and pod runs share code.
@@ -32,8 +36,11 @@ DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
 
-ALL_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS)
+ALL_AXES = (DATA_AXIS, FSDP_AXIS, MODEL_AXIS, SEQ_AXIS, PIPE_AXIS,
+            EXPERT_AXIS)
 
 
 def create_mesh(shape: Optional[Dict[str, int]] = None,

@@ -1,0 +1,129 @@
+"""Pipeline parallelism — GPipe microbatch schedule over the ``pipe``
+mesh axis.
+
+No reference analogue (the reference scales data-parallel only,
+wp-bigdl.md:113-171); this is TPU-native capability in the style of the
+public scaling-book/praxis SPMD pipelining recipe: every stage runs the
+SAME program under ``shard_map``; stage identity comes from
+``jax.lax.axis_index("pipe")``, activations advance one stage per tick
+via ``ppermute`` over ICI, and ``jax.grad`` differentiates straight
+through the schedule (the transpose of a ppermute is the reverse
+ppermute).
+
+Semantics: ``pipeline_apply(stage_fn, stacked_params, x)`` computes
+
+    stage_{P-1}( ... stage_1(stage_0(x)) ... )
+
+for P pipeline stages whose activations share one shape.  The batch is
+split into M microbatches; wall-clock fills/drains the classic
+``M + P - 1`` ticks.  Stage parameters are stacked on a leading axis
+sharded over ``pipe`` — each device materialises only its own stage's
+weights (P-way parameter sharding, the pipeline analogue of FSDP).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+
+def stack_stage_params(per_stage_params):
+    """[stage0_tree, stage1_tree, ...] with identical structure →
+    one tree with a leading stage axis (shard it over ``pipe``)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def stage_param_sharding(mesh, stacked_params):
+    """NamedSharding placing the leading stage axis on ``pipe``."""
+    shard = NamedSharding(mesh, P(mesh_lib.PIPE_AXIS))
+    return jax.tree_util.tree_map(lambda _: shard, stacked_params)
+
+
+def _spmd_pipeline(stage_fn: Callable, params, x, *, num_stages: int,
+                   num_microbatches: int):
+    """Runs INSIDE shard_map: ``params`` is this device's stage params
+    (leading stage axis already sharded away to size 1), ``x`` is the
+    full local batch on every stage (replicated over pipe)."""
+    m = num_microbatches
+    p = num_stages
+    stage = jax.lax.axis_index(mesh_lib.PIPE_AXIS)
+    params = jax.tree_util.tree_map(lambda a: a[0], params)
+
+    mb = x.reshape((m, x.shape[0] // m) + x.shape[1:])
+    state = jnp.zeros_like(mb[0])           # activation entering stage
+    outputs = jnp.zeros_like(mb)            # collected on last stage
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (when one remains)
+        inject = jnp.where(t < m, t, 0)
+        state = jnp.where(stage == 0, mb[inject], state)
+        y = stage_fn(params, state)
+        # last stage banks microbatch (t - (p-1)) when it's valid
+        out_slot = jnp.clip(t - (p - 1), 0, m - 1)
+        bank = (stage == p - 1) & (t >= p - 1)
+        outputs = jax.lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(bank, y, outputs[out_slot]), out_slot,
+            axis=0)
+        # advance the baton: stage i's output becomes stage i+1's input
+        state = jax.lax.ppermute(
+            y, mesh_lib.PIPE_AXIS,
+            [(i, (i + 1) % p) for i in range(p)])
+        return (state, outputs), None
+
+    (state, outputs), _ = jax.lax.scan(
+        tick, (state, outputs), jnp.arange(m + p - 1))
+    # broadcast the last stage's collected outputs to every stage so
+    # the loss (and psum'd grads) are computed identically everywhere
+    outputs = jax.lax.ppermute(
+        outputs, mesh_lib.PIPE_AXIS,
+        [(i, (i + 1) % p) for i in range(p)])     # last -> 0
+    outputs = _pipe_broadcast(outputs, src=0, p=p)
+    return outputs.reshape((outputs.shape[0] * outputs.shape[1],)
+                           + outputs.shape[2:])
+
+
+def _pipe_broadcast(v, src: int, p: int):
+    """Broadcast ``v`` from stage ``src`` to all stages (log-step
+    ppermute chain is overkill at typical P — one rotation per hop)."""
+    out = v
+    for _ in range(p - 1):
+        rolled = jax.lax.ppermute(
+            out, mesh_lib.PIPE_AXIS, [(i, (i + 1) % p) for i in range(p)])
+        stage = jax.lax.axis_index(mesh_lib.PIPE_AXIS)
+        out = jnp.where(stage == src, out, rolled)
+    return out
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh,
+                   num_microbatches: int):
+    """Forward through the pipeline; differentiable end-to-end.
+
+    ``stage_fn(params, h) -> h`` is ONE stage's computation (all stages
+    share code; weights differ).  ``stacked_params`` carries the
+    leading stage axis (shard with ``stage_param_sharding``).
+    ``x``: (B, ...) with B divisible by ``num_microbatches``.
+    Returns the last stage's outputs, replicated over ``pipe``.
+    """
+    p = mesh.shape[mesh_lib.PIPE_AXIS]
+    if p == 1:
+        params0 = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+        return stage_fn(params0, x)
+
+    fn = functools.partial(_spmd_pipeline, stage_fn, num_stages=p,
+                           num_microbatches=num_microbatches)
+    pspec_params = jax.tree_util.tree_map(
+        lambda _: P(mesh_lib.PIPE_AXIS), stacked_params)
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(pspec_params, P()),
+        out_specs=P(),
+        check_vma=False,
+    )(stacked_params, x)

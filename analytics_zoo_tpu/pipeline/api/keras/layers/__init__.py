@@ -6,6 +6,7 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.embedding import (
     Embedding, SparseEmbedding, WordEmbedding,
 )
 from analytics_zoo_tpu.pipeline.api.keras.layers.merge import Merge, merge
+from analytics_zoo_tpu.pipeline.api.keras.layers.moe import MoE
 from analytics_zoo_tpu.pipeline.api.keras.layers.normalization import (
     BatchNormalization, L2Normalization, LayerNorm,
 )
@@ -86,7 +87,7 @@ __all__ = [
     "BERT", "MultiHeadSelfAttention", "PositionwiseFeedForward",
     "TransformerLayer", "transformer_block",
     "SparseEmbedding", "AtrousConvolution1D", "ShareConvolution2D",
-    "SpaceToDepth2D",
+    "SpaceToDepth2D", "MoE",
     "AddConstant", "BinaryThreshold", "CAdd", "CMul", "Exp",
     "GaussianSampler", "HardShrink", "HardTanh", "Identity", "Log",
     "LRN2D", "Mul", "MulConstant", "Negative", "Power",

@@ -1,0 +1,159 @@
+"""Mixture-of-Experts with expert parallelism.
+
+No reference analogue (the reference is CPU data-parallel only) — this
+is TPU-native scale capability in the public GShard/Switch formulation:
+a learned router picks top-k experts per token, tokens dispatch to
+per-expert buffers through ONE-HOT EINSUMS (dense dispatch — static
+shapes, MXU-friendly, no gather/scatter), the expert FFNs run batched
+over a stacked expert dimension, and a combine einsum returns gated
+outputs.
+
+Expert parallelism is pure GSPMD: the stacked expert weights carry a
+``PartitionSpec("expert")`` on their leading axis (``param_pspecs``),
+so under a mesh with an ``expert`` axis XLA shards the expert FFN
+einsums and inserts the token all_to_all automatically.
+
+The router's load-balancing auxiliary loss (Switch eq. 4) is returned
+by ``aux_loss()`` after a forward — add it to the objective via
+``CustomLoss`` / a lambda criterion.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from analytics_zoo_tpu.ops import activations as acts
+from analytics_zoo_tpu.ops.dtypes import get_policy
+from analytics_zoo_tpu.parallel.mesh import EXPERT_AXIS
+from analytics_zoo_tpu.pipeline.api.keras.engine import Layer, Params
+
+
+class MoE(Layer):
+    """Switch/GShard feed-forward: router → top-k dispatch → per-expert
+    2-layer FFN → gated combine.  Input (..., d) keeps its shape."""
+
+    def __init__(self, num_experts: int, hidden_dim: int,
+                 top_k: int = 1, capacity_factor: float = 1.25,
+                 activation="relu", init="glorot_uniform", **kwargs):
+        super().__init__(**kwargs)
+        if top_k not in (1, 2):
+            raise ValueError("top_k must be 1 or 2")
+        self.num_experts = int(num_experts)
+        self.hidden_dim = int(hidden_dim)
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+        self.activation = acts.get(activation)
+        self.kernel_init = init
+        self._last_aux = None
+
+    def build(self, rng, input_shape) -> Params:
+        d = input_shape[-1]
+        e, h = self.num_experts, self.hidden_dim
+        params: Params = {}
+        self.add_weight(params, rng, "router", (d, e),
+                        init=self.kernel_init)
+        self.add_weight(params, rng, "w1", (e, d, h),
+                        init=self.kernel_init)
+        self.add_weight(params, rng, "b1", (e, h), init="zero")
+        self.add_weight(params, rng, "w2", (e, h, d),
+                        init=self.kernel_init)
+        self.add_weight(params, rng, "b2", (e, d), init="zero")
+        # expert parallelism: shard the stacked expert dim
+        for name in ("w1", "b1", "w2", "b2"):
+            self.param_pspecs[name] = P(EXPERT_AXIS)
+        return params
+
+    def _route(self, probs, tokens: int):
+        """probs (T, E) → (combine (T, E, C), aux scalar)."""
+        e = self.num_experts
+        cap = max(int(math.ceil(
+            tokens * self.top_k / e * self.capacity_factor)), 1)
+
+        def one_round(probs, taken):
+            """Assign each token its best remaining expert with
+            capacity bookkeeping; returns gate-weighted combine slab."""
+            expert = jnp.argmax(probs, axis=-1)               # (T,)
+            gate = jnp.max(probs, axis=-1)                    # (T,)
+            onehot = jax.nn.one_hot(expert, e)                # (T, E)
+            # position of each token within its expert's buffer
+            pos = jnp.cumsum(onehot, axis=0) - 1.0 + taken[None, :]
+            pos_tok = jnp.sum(pos * onehot, axis=-1)          # (T,)
+            keep = pos_tok < cap
+            slot = jax.nn.one_hot(pos_tok.astype(jnp.int32), cap)
+            combine = (gate * keep)[:, None, None] \
+                * onehot[:, :, None] * slot[:, None, :]       # (T,E,C)
+            new_taken = taken + jnp.sum(onehot * keep[:, None], axis=0)
+            return combine, onehot, new_taken
+
+        taken = jnp.zeros((e,), probs.dtype)
+        combine, onehot1, taken = one_round(probs, taken)
+        if self.top_k == 2:
+            probs2 = probs * (1.0 - onehot1)      # mask the 1st choice
+            combine2, _, taken = one_round(probs2, taken)
+            combine = combine + combine2
+        # Switch load-balancing loss: E * sum_e f_e * p_e
+        f = jnp.mean(onehot1, axis=0)             # fraction routed
+        p = jnp.mean(probs, axis=0)               # mean router prob
+        aux = e * jnp.sum(f * p)
+        return combine, aux
+
+    def _call_impl(self, params, x, training=False, rng=None):
+        policy = get_policy()
+        shape = x.shape
+        d = shape[-1]
+        xt = x.reshape(-1, d)                     # (T, d)
+        t = xt.shape[0]
+
+        logits = policy.cast_compute(xt) @ policy.cast_compute(
+            params["router"])
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        combine, aux = self._route(probs, t)
+        # _trace_aux is the same-trace value consumed by call_with_aux;
+        # aux_loss() only sees CONCRETE values — a tracer banked across
+        # the trace boundary would leak (and go stale on cached
+        # executions)
+        self._trace_aux = aux
+        self._last_aux = aux if not isinstance(aux, jax.core.Tracer) \
+            else None
+        dispatch = (combine > 0).astype(xt.dtype)  # (T, E, C)
+
+        # dispatch → per-expert buffers (E, C, d); all_to_all under
+        # GSPMD when tokens are data-sharded and experts expert-sharded
+        buf = jnp.einsum("tec,td->ecd", dispatch,
+                         policy.cast_compute(xt))
+        h = jnp.einsum("ecd,edh->ech", buf,
+                       policy.cast_compute(params["w1"])) \
+            + params["b1"][:, None, :]
+        h = self.activation(h) if self.activation else h
+        out = jnp.einsum("ech,eho->eco", policy.cast_compute(h),
+                         policy.cast_compute(params["w2"])) \
+            + params["b2"][:, None, :]
+        y = jnp.einsum("tec,eco->to", combine.astype(out.dtype), out)
+        return y.reshape(shape).astype(x.dtype)
+
+    def aux_loss(self):
+        """Load-balancing loss of the most recent EAGER forward (add to
+        the objective, scaled ~1e-2).  Inside jit, use
+        ``call_with_aux`` — values stored across a trace boundary
+        would be stale tracers."""
+        if self._last_aux is None:
+            raise ValueError(
+                "aux_loss(): no eager forward has run — under jit use "
+                "call_with_aux(params, x) to get (output, aux) in the "
+                "same trace")
+        return self._last_aux
+
+    def call_with_aux(self, params, x, training=False, rng=None):
+        """(output, load_balancing_aux) in one trace — the jit-safe
+        route for adding the Switch auxiliary loss to an objective."""
+        y = self._call_impl(params, x, training=training, rng=rng)
+        return y, self._trace_aux
+
+    call = _call_impl
+
+    def compute_output_shape(self, input_shape):
+        return input_shape

@@ -56,6 +56,8 @@ APPS = [
     "apps.variational_autoencoder.vae_digits",
     "apps.fraud_detection.fraud_detection",
     "apps.image_augmentation.image_augmentation",
+    "apps.object_detection.object_detection",
+    "apps.model_inference.model_inference_pipeline",
 ]
 
 

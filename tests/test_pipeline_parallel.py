@@ -1,0 +1,123 @@
+"""Pipeline parallelism (parallel/pipeline.py): the GPipe microbatch
+schedule over the ``pipe`` mesh axis must be numerically IDENTICAL to
+running the stages sequentially — forward and gradients — and must
+train."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+from analytics_zoo_tpu.parallel.pipeline import (
+    pipeline_apply, stack_stage_params, stage_param_sharding)
+
+pytestmark = pytest.mark.slow   # shard_map compiles over 8 devices
+
+
+def _stages(num_stages, d, seed=0):
+    rs = np.random.RandomState(seed)
+    return [{"w": jnp.asarray(rs.randn(d, d).astype(np.float32) * 0.3),
+             "b": jnp.asarray(rs.randn(d).astype(np.float32) * 0.1)}
+            for _ in range(num_stages)]
+
+
+def _stage_fn(p, h):
+    return jnp.tanh(h @ p["w"] + p["b"])
+
+
+def _sequential(per_stage, x):
+    h = x
+    for p in per_stage:
+        h = jnp.tanh(h @ p["w"] + p["b"])
+    return h
+
+
+class TestPipelineParallel:
+    @pytest.mark.parametrize("microbatches", [2, 4, 8])
+    def test_forward_matches_sequential(self, microbatches):
+        mesh = mesh_lib.create_mesh({"pipe": 4, "data": 2})
+        per_stage = _stages(4, 8)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.asarray(
+            np.random.RandomState(1).randn(16, 8).astype(np.float32))
+        with mesh:
+            out = pipeline_apply(_stage_fn, stacked, x, mesh,
+                                 num_microbatches=microbatches)
+        ref = _sequential(per_stage, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_sequential(self):
+        mesh = mesh_lib.create_mesh({"pipe": 4, "data": 2})
+        per_stage = _stages(4, 8, seed=2)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.asarray(
+            np.random.RandomState(3).randn(8, 8).astype(np.float32))
+
+        def loss(stacked):
+            with mesh:
+                return pipeline_apply(_stage_fn, stacked, x, mesh,
+                                      num_microbatches=4).sum()
+
+        def ref_loss(stacked):
+            h = x
+            for i in range(4):
+                h = jnp.tanh(h @ stacked["w"][i] + stacked["b"][i])
+            return h.sum()
+
+        g = jax.grad(loss)(stacked)
+        gref = jax.grad(ref_loss)(stacked)
+        for a, b in zip(jax.tree_util.tree_leaves(g),
+                        jax.tree_util.tree_leaves(gref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_single_stage_passthrough(self):
+        mesh = mesh_lib.create_mesh({"data": 8})
+        per_stage = _stages(1, 4)
+        stacked = stack_stage_params(per_stage)
+        x = jnp.ones((4, 4), jnp.float32)
+        with mesh:
+            out = pipeline_apply(_stage_fn, stacked, x, mesh,
+                                 num_microbatches=2)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_sequential(per_stage, x)),
+                                   rtol=1e-6)
+
+    def test_pipeline_trains(self):
+        """A 4-stage pipelined MLP regression: jitted train step with
+        stage params sharded over pipe; loss must drop."""
+        import optax
+        mesh = mesh_lib.create_mesh({"pipe": 4, "data": 2})
+        d = 8
+        per_stage = _stages(4, d, seed=4)
+        stacked = stack_stage_params(per_stage)
+        stacked = jax.device_put(stacked,
+                                 stage_param_sharding(mesh, stacked))
+        rs = np.random.RandomState(5)
+        x = jnp.asarray(rs.randn(32, d).astype(np.float32))
+        w_true = rs.randn(d, d).astype(np.float32)
+        y = jnp.asarray(np.tanh(np.asarray(x) @ w_true))
+
+        tx = optax.adam(1e-2)
+        opt_state = tx.init(stacked)
+
+        @jax.jit
+        def step(params, opt_state):
+            def loss_fn(p):
+                with mesh:
+                    out = pipeline_apply(_stage_fn, p, x, mesh,
+                                         num_microbatches=4)
+                return jnp.mean((out - y) ** 2)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        losses = []
+        params = stacked
+        for _ in range(30):
+            params, opt_state, l = step(params, opt_state)
+            losses.append(float(l))
+        assert losses[-1] < losses[0] * 0.5, losses[:3] + losses[-3:]

@@ -145,3 +145,42 @@ class TestFileIO:
             np.testing.assert_array_equal(out["w"], tree["w"])
         finally:
             file_io._REMOTE_SCHEMES = file_io._REMOTE_SCHEMES[:-1]
+
+
+class TestMemoryTiers:
+    """Cache-tier policy names (ref FeatureSet.scala memoryType)."""
+
+    def _dir(self, tmp_path):
+        import os
+        x = np.arange(40, dtype=np.float32).reshape(10, 4)
+        y = np.arange(10, dtype=np.int32)[:, None]
+        np.save(os.path.join(tmp_path, "x.npy"), x)
+        np.save(os.path.join(tmp_path, "y.npy"), y)
+        return str(tmp_path), x, y
+
+    def test_dram_materialises(self, tmp_path):
+        from analytics_zoo_tpu.feature.feature_set import FeatureSet
+        d, x, _ = self._dir(tmp_path)
+        fs = FeatureSet.from_npy_dir(d, memory_type="DRAM")
+        assert not isinstance(fs.x, np.memmap)
+        np.testing.assert_array_equal(np.asarray(fs.x), x)
+
+    def test_pmem_maps(self, tmp_path):
+        from analytics_zoo_tpu.feature.feature_set import FeatureSet
+        d, x, _ = self._dir(tmp_path)
+        fs = FeatureSet.from_npy_dir(d, memory_type="PMEM")
+        assert isinstance(fs.x, np.memmap)
+        assert fs.num_slices == 1
+
+    def test_direct_slices(self, tmp_path):
+        from analytics_zoo_tpu.feature.feature_set import FeatureSet
+        d, x, _ = self._dir(tmp_path)
+        fs = FeatureSet.from_npy_dir(d, memory_type="DIRECT")
+        assert isinstance(fs.x, np.memmap)
+        assert fs.num_slices > 1
+
+    def test_bad_tier_rejected(self, tmp_path):
+        from analytics_zoo_tpu.feature.feature_set import FeatureSet
+        d, _, _ = self._dir(tmp_path)
+        with pytest.raises(ValueError, match="DRAM|PMEM|DIRECT"):
+            FeatureSet.from_npy_dir(d, memory_type="optane")
