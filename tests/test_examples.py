@@ -24,6 +24,7 @@ EXAMPLES = [
     "examples.imageclassification.image_classification_example",
     "examples.objectdetection.ssd_example",
     "examples.inception.train_inception",
+    "examples.distributed.pipeline_moe_example",
 ]
 
 
