@@ -1,4 +1,4 @@
-"""Pallas flash-attention kernel for TPU.
+"""Pallas flash-attention kernels for TPU — forward AND backward.
 
 Single-chip long-context attention: O(T·Tb) VMEM instead of the O(T²)
 logits matrix XLA materialises for plain attention.  Pairs with
@@ -6,9 +6,16 @@ parallel/ring_attention.py (across-chip SP): ring handles the
 inter-chip blocks, this kernel is what each chip should run on its
 local block.
 
-Grid: (batch·heads, T/block_q).  K/V for the (batch·head) live in VMEM
-(fine for T·D up to ~4k·128 at bf16/f32); the kernel streams q blocks
-and runs the online-softmax recurrence over k blocks.
+The public ``flash_attention`` is differentiable: a ``custom_vjp``
+routes the backward through two Pallas kernels (the standard
+flash-attention backward — recompute the probability blocks from the
+forward's saved log-sum-exp, then ``dv = PᵀdO``, ``ds = P∘(dOVᵀ - D)``,
+``dq = dsK``, ``dk = dsᵀQ``), so the same memory bound holds in
+training.
+
+Grid: (batch·heads, T/block).  K/V (and in the backward Q/dO) for one
+(batch·head) live in VMEM — fine for T·D up to ~4k·128 at bf16/f32;
+the kernels stream the blocked operand.
 """
 
 from __future__ import annotations
@@ -21,13 +28,24 @@ import jax.numpy as jnp
 
 try:
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
     _HAS_PALLAS = True
 except Exception:           # pragma: no cover
     _HAS_PALLAS = False
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
+def _apply_causal_mask(s, q_start, k_start, block_q: int,
+                       block_k: int):
+    """Mask future positions in one (block_q, block_k) logits tile —
+    the ONE definition shared by the forward and both backward kernels
+    so P is recomputed under the identical mask."""
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(q_pos >= k_pos, s, -1e30)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k: int,
                   causal: bool, scale: float, block_q: int):
     t = k_ref.shape[0]
     d = q_ref.shape[-1]
@@ -43,11 +61,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         s = jnp.dot(q, k_blk.T,
                     preferred_element_type=jnp.float32)  # (bq, bk)
         if causal:
-            q_pos = q_idx * block_q + \
-                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            k_pos = i * block_k + \
-                jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, -1e30)
+            s = _apply_causal_mask(s, q_idx * block_q, i * block_k,
+                                   block_q, block_k)
         m_blk = jnp.max(s, axis=1)
         m_new = jnp.maximum(m, m_blk)
         corr = jnp.exp(m - m_new)
@@ -67,13 +82,216 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int,
         n_k = jnp.minimum(
             n_k, ((q_idx + 1) * block_q + block_k - 1) // block_k)
     acc, m, l = jax.lax.fori_loop(0, n_k, body, (acc, m0, l0))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # TPU blocks must be >=2D: lse is stored (block_q, 1)
+    lse_ref[:] = (m + jnp.log(l_safe))[:, None].astype(lse_ref.dtype)
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, *, block_k: int, causal: bool,
+                     scale: float, block_q: int):
+    """dq for one q block: stream k blocks, recompute P from lse."""
+    t = k_ref.shape[0]
+    d = q_ref.shape[-1]
+    # recompute logits EXACTLY as the forward did (same dtype for the
+    # q*scale product), so exp(s - lse) reproduces the forward's P —
+    # a higher-precision recompute would desynchronise from the saved
+    # lse under bf16
+    q = q_ref[:] * scale                          # (bq, d), input dtype
+    do = do_ref[:].astype(jnp.float32)            # (bq, d)
+    lse = lse_ref[:][:, 0]                        # (bq,)
+    delta = delta_ref[:][:, 0]                    # (bq,)
+    q_idx = pl.program_id(1)
+    n_k = t // block_k
+
+    def body(i, dq):
+        k_blk = k_ref[pl.ds(i * block_k, block_k), :]
+        v_blk = v_ref[pl.ds(i * block_k, block_k), :]
+        s = jnp.dot(q, k_blk.T,
+                    preferred_element_type=jnp.float32)
+        if causal:
+            s = _apply_causal_mask(s, q_idx * block_q, i * block_k,
+                                   block_q, block_k)
+        p = jnp.exp(s - lse[:, None])             # (bq, bk)
+        dp = jnp.dot(do, v_blk.T.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        return dq + jnp.dot(ds, k_blk.astype(jnp.float32),
+                            preferred_element_type=jnp.float32)
+
+    if causal:
+        n_k = jnp.minimum(
+            n_k, ((q_idx + 1) * block_q + block_k - 1) // block_k)
+    dq = jax.lax.fori_loop(
+        0, n_k, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, *, block_k: int, causal: bool,
+                      scale: float, block_q: int):
+    """dk/dv for one k block: stream q blocks."""
+    t = q_ref.shape[0]
+    d = k_ref.shape[-1]
+    k_blk = k_ref[:]                              # (bk, d) input dtype
+    v_blk = v_ref[:]                              # (bk, d)
+    k_idx = pl.program_id(1)
+    n_q = t // block_q
+
+    def body(j, carry):
+        dk, dv = carry
+        # same-dtype q*scale as the forward (see dq kernel note)
+        q_blk = q_ref[pl.ds(j * block_q, block_q), :] * scale
+        do_blk = do_ref[pl.ds(j * block_q, block_q), :] \
+            .astype(jnp.float32)
+        lse = lse_ref[pl.ds(j * block_q, block_q), :][:, 0]
+        delta = delta_ref[pl.ds(j * block_q, block_q), :][:, 0]
+        s = jnp.dot(q_blk, k_blk.T,
+                    preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            s = _apply_causal_mask(s, j * block_q, k_idx * block_k,
+                                   block_q, block_k)
+        p = jnp.exp(s - lse[:, None])
+        dv_new = dv + jnp.dot(p.T, do_blk,
+                              preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v_blk.T.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk_new = dk + jnp.dot(ds.T, q_blk.astype(jnp.float32),
+                              preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    j0 = 0
+    if causal:
+        # q blocks strictly before this k block see none of it
+        j0 = (k_idx * block_k) // block_q
+    dk, dv = jax.lax.fori_loop(
+        j0, n_q, body, (jnp.zeros((block_k, d), jnp.float32),
+                        jnp.zeros((block_k, d), jnp.float32)))
+    # dk = Σ ds_ijᵀ (scale·q_i): q_blk enters pre-scaled, so the scale
+    # is already in the accumulation
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _resolve_blocks(t: int, block_q: int, block_k: int):
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q != 0 or t % block_k != 0:
+        raise ValueError(
+            f"seq len {t} must divide block sizes ({block_q}, {block_k})")
+    return block_q, block_k
+
+
+def _flash_fwd_impl(q, k, v, cfg):
+    causal, scale, block_q, block_k, interpret = cfg
+    b, h, t, d = q.shape
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+    kernel = functools.partial(_flash_kernel, block_k=block_k,
+                               causal=causal, scale=scale,
+                               block_q=block_q)
+    out, lse = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((b * h, t, 1), jnp.float32)),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=(pl.BlockSpec((None, block_q, d),
+                                lambda i, j: (i, j, 0)),
+                   pl.BlockSpec((None, block_q, 1),
+                                lambda i, j: (i, j, 0))),
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, cfg):
+    out, _ = _flash_fwd_impl(q, k, v, cfg)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, cfg):
+    out, lse = _flash_fwd_impl(q, k, v, cfg)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(cfg, res, dout):
+    causal, scale, block_q, block_k, interpret = cfg
+    q, k, v, out, lse = res
+    b, h, t, d = q.shape
+    qf = q.reshape(b * h, t, d)
+    kf = k.reshape(b * h, t, d)
+    vf = v.reshape(b * h, t, d)
+    dof = dout.reshape(b * h, t, d)
+    of = out.reshape(b * h, t, d)
+    # D_i = rowsum(dO_i ∘ O_i) — cheap elementwise, computed by XLA
+    delta = jnp.sum(dof.astype(jnp.float32) * of.astype(jnp.float32),
+                    axis=-1, keepdims=True)       # (bh, t, 1)
+
+    dq_kernel = functools.partial(_flash_dq_kernel, block_k=block_k,
+                                  causal=causal, scale=scale,
+                                  block_q=block_q)
+    dq = pl.pallas_call(
+        dq_kernel,
+        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
+        grid=(b * h, t // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda i, j: (i, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d),
+                               lambda i, j: (i, j, 0)),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    dkv_kernel = functools.partial(_flash_dkv_kernel, block_k=block_k,
+                                   causal=causal, scale=scale,
+                                   block_q=block_q)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        out_shape=(jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((b * h, t, d), v.dtype)),
+        grid=(b * h, t // block_k),
+        in_specs=[
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, t, 1), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=(pl.BlockSpec((None, block_k, d),
+                                lambda i, j: (i, j, 0)),
+                   pl.BlockSpec((None, block_k, d),
+                                lambda i, j: (i, j, 0))),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse, delta)
+
+    return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
+            dv.reshape(b, h, t, d))
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None, block_q: int = 256,
                     block_k: int = 256, interpret: bool = False):
-    """q,k,v: (B, H, T, D) -> (B, H, T, D)."""
+    """q,k,v: (B, H, T, D) -> (B, H, T, D).  Differentiable (flash
+    backward kernels); falls back to dense XLA attention without
+    Pallas."""
     b, h, t, d = q.shape
     if scale is None:
         scale = d ** -0.5
@@ -82,31 +300,5 @@ def flash_attention(q, k, v, causal: bool = False,
             scaled_dot_product_attention)
         return scaled_dot_product_attention(q, k, v, causal=causal,
                                             scale=scale)
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    if t % block_q != 0 or t % block_k != 0:
-        raise ValueError(
-            f"seq len {t} must divide block sizes ({block_q}, {block_k})")
-
-    qf = q.reshape(b * h, t, d)
-    kf = k.reshape(b * h, t, d)
-    vf = v.reshape(b * h, t, d)
-
-    kernel = functools.partial(_flash_kernel, block_k=block_k,
-                               causal=causal, scale=scale,
-                               block_q=block_q)
-    out = pl.pallas_call(
-        kernel,
-        out_shape=jax.ShapeDtypeStruct((b * h, t, d), q.dtype),
-        grid=(b * h, t // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d),
-                         lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, d),
-                               lambda i, j: (i, j, 0)),
-        interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(b, h, t, d)
+    block_q, block_k = _resolve_blocks(t, block_q, block_k)
+    return _flash(q, k, v, (causal, scale, block_q, block_k, interpret))

@@ -113,8 +113,9 @@ class MultiHeadSelfAttention(Layer):
         # flash kernel constraints: pallas_call is not GSPMD-partitionable,
         # so only auto-route on a trivial (single-device) mesh; K/V for one
         # (batch, head) must fit VMEM (~4k·128 floats, see pallas_attention)
+        # — training included now that the flash backward kernels exist
         mesh_trivial = math.prod(_mesh().shape.values()) == 1
-        use_flash = (not use_sp and mask is None and not training and
+        use_flash = (not use_sp and mask is None and
                      jax.default_backend() == "tpu" and mesh_trivial and
                      t % 256 == 0 and self.head_dim % 64 == 0 and
                      t * self.head_dim <= 4096 * 128)

@@ -230,6 +230,93 @@ def bench_resnet50():
     return run_resnet_bench(jax.devices()[0])
 
 
+# --------------------------------------------------------------- attention
+def bench_attention(seq_len: int = 4096, batch: int = 4, heads: int = 8,
+                    head_dim: int = 128, repeats: int = 5):
+    """Long-context attention: the Pallas flash kernel vs XLA's naive
+    dense attention, causal, forward+backward — the single-chip half of
+    the long-context story (ring attention is the across-chip half)."""
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.ops.attention import (
+        scaled_dot_product_attention)
+    from analytics_zoo_tpu.ops.pallas_attention import flash_attention
+
+    rng = jax.random.PRNGKey(0)
+    shape = (batch, heads, seq_len, head_dim)
+    q, k, v = (jax.random.normal(jax.random.fold_in(rng, i), shape,
+                                 jnp.bfloat16) for i in range(3))
+
+    iters = 16
+
+    def timed(fn, q, k, v):
+        # forward+BACKWARD timing (the flash backward runs in Pallas
+        # kernels too).  `iters` steps chain inside ONE program (dq
+        # feeds the next query: real data dependency) so the ~70ms
+        # per-call tunnel round trip amortises away; each window ends
+        # with a D2H sync.
+        def loop(q, k, v):
+            def body(c, _):
+                g = jax.grad(lambda q: fn(q, k, v)
+                             .astype(jnp.float32).sum())(c)
+                return g.astype(c.dtype), None
+            out, _ = jax.lax.scan(body, q, None, length=iters)
+            return out.astype(jnp.float32).sum()
+
+        f = jax.jit(loop)
+        float(f(q, k, v))                 # compile + D2H sync
+        walls = []
+        for _ in range(repeats):
+            t0 = time.time()
+            val = f(q, k, v)
+            float(val)                    # D2H sync
+            walls.append(time.time() - t0)
+        return min(walls) / iters
+
+    flash = lambda q, k, v: flash_attention(q, k, v, causal=True)
+    dense = lambda q, k, v: scaled_dot_product_attention(
+        q, k, v, causal=True)
+
+    t_flash = timed(flash, q, k, v)
+    t_dense = timed(dense, q, k, v)
+
+    # 7 T²-sized matmuls total (fwd: QKᵀ, PV; bwd: S recompute, dV,
+    # dP, dQ, dK) over T²/2 causal pairs, 2 flops per MAC → 3.5x the
+    # 2-matmul forward
+    flops = 3.5 * 2 * 2 * batch * heads * (seq_len ** 2 / 2) * head_dim
+    tokens = batch * seq_len
+    dev = jax.devices()[0]
+
+    # scaling headroom: double the context, flash only (dense logits
+    # would not fit comfortably)
+    shape2 = (batch, heads, seq_len * 2, head_dim)
+    q2, k2, v2 = (jax.random.normal(jax.random.fold_in(rng, 10 + i),
+                                    shape2, jnp.bfloat16)
+                  for i in range(3))
+    t_flash_2x = timed(flash, q2, k2, v2)
+
+    return {
+        "metric": "flash_attention_tokens_per_sec",
+        "value": round(tokens / t_flash, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "workload": "attention",
+        "seq_len": seq_len,
+        "batch": batch,
+        "heads": heads,
+        "head_dim": head_dim,
+        "fwd_bwd": True,
+        "flash_ms": round(t_flash * 1e3, 2),
+        "dense_ms": round(t_dense * 1e3, 2),
+        "speedup_vs_dense": round(t_dense / t_flash, 2),
+        "flash_tflops": round(flops / t_flash / 1e12, 1),
+        "flash_2x_seq_ms": round(t_flash_2x * 1e3, 2),
+        "device": str(dev),
+        "device_kind": getattr(dev, "device_kind", "?"),
+    }
+
+
 # ----------------------------------------------------------------- serving
 def bench_serving(n_records: int = 2048, batch_size: int = 32):
     """Cluster-serving throughput (BASELINE.md config 5): enqueue → RESP
@@ -319,6 +406,7 @@ WORKLOADS = {
     "ncf": bench_ncf,
     "resnet50": bench_resnet50,
     "serving": bench_serving,
+    "attention": bench_attention,
 }
 
 # keep failure-path metric names identical to the success paths so a
@@ -327,6 +415,7 @@ METRIC_NAMES = {
     "ncf": "ncf_movielens1m_train_throughput",
     "resnet50": "resnet50_imagenet_train_throughput",
     "serving": "cluster_serving_throughput",
+    "attention": "flash_attention_tokens_per_sec",
 }
 
 
