@@ -131,48 +131,46 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dk_ref, dv_ref, *, block_k: int, causal: bool,
                       scale: float, block_q: int):
-    """dk/dv for one k block: stream q blocks."""
-    t = q_ref.shape[0]
-    d = k_ref.shape[-1]
+    """dk/dv for one (k block, q block) grid cell.  The grid's
+    innermost axis walks q blocks while dk/dv REVISIT the same output
+    block — TPU pallas executes the grid sequentially per core, so
+    accumulating into the output across the q axis is safe, and only
+    ONE q block lives in VMEM at a time (the full-T operand layout
+    OOM'd scoped vmem at T=8k)."""
+    q_idx = pl.program_id(2)
+    k_idx = pl.program_id(1)
     k_blk = k_ref[:]                              # (bk, d) input dtype
     v_blk = v_ref[:]                              # (bk, d)
-    k_idx = pl.program_id(1)
-    n_q = t // block_q
+    # same-dtype q*scale as the forward (see dq kernel note)
+    q_blk = q_ref[:] * scale                      # (bq, d)
+    do_blk = do_ref[:].astype(jnp.float32)        # (bq, d)
+    lse = lse_ref[:][:, 0]
+    delta = delta_ref[:][:, 0]
 
-    def body(j, carry):
-        dk, dv = carry
-        # same-dtype q*scale as the forward (see dq kernel note)
-        q_blk = q_ref[pl.ds(j * block_q, block_q), :] * scale
-        do_blk = do_ref[pl.ds(j * block_q, block_q), :] \
-            .astype(jnp.float32)
-        lse = lse_ref[pl.ds(j * block_q, block_q), :][:, 0]
-        delta = delta_ref[pl.ds(j * block_q, block_q), :][:, 0]
-        s = jnp.dot(q_blk, k_blk.T,
-                    preferred_element_type=jnp.float32)  # (bq, bk)
-        if causal:
-            s = _apply_causal_mask(s, j * block_q, k_idx * block_k,
-                                   block_q, block_k)
-        p = jnp.exp(s - lse[:, None])
-        dv_new = dv + jnp.dot(p.T, do_blk,
-                              preferred_element_type=jnp.float32)
-        dp = jnp.dot(do_blk, v_blk.T.astype(jnp.float32),
-                     preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        dk_new = dk + jnp.dot(ds.T, q_blk.astype(jnp.float32),
-                              preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_ref[:] = jnp.zeros_like(dk_ref)
+        dv_ref[:] = jnp.zeros_like(dv_ref)
 
-    j0 = 0
+    s = jnp.dot(q_blk, k_blk.T,
+                preferred_element_type=jnp.float32)  # (bq, bk)
     if causal:
-        # q blocks strictly before this k block see none of it
-        j0 = (k_idx * block_k) // block_q
-    dk, dv = jax.lax.fori_loop(
-        j0, n_q, body, (jnp.zeros((block_k, d), jnp.float32),
-                        jnp.zeros((block_k, d), jnp.float32)))
+        s = _apply_causal_mask(s, q_idx * block_q, k_idx * block_k,
+                               block_q, block_k)
+    p = jnp.exp(s - lse[:, None])
+    dv_upd = jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+    dp = jnp.dot(do_blk, v_blk.T.astype(jnp.float32),
+                 preferred_element_type=jnp.float32)
+    ds = p * (dp - delta[:, None])
     # dk = Σ ds_ijᵀ (scale·q_i): q_blk enters pre-scaled, so the scale
     # is already in the accumulation
-    dk_ref[:] = dk.astype(dk_ref.dtype)
-    dv_ref[:] = dv.astype(dv_ref.dtype)
+    dk_upd = jnp.dot(ds.T, q_blk.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    contributes = jnp.logical_or(
+        not causal,
+        (q_idx + 1) * block_q - 1 >= k_idx * block_k)
+    dk_ref[:] += jnp.where(contributes, dk_upd, 0.0).astype(dk_ref.dtype)
+    dv_ref[:] += jnp.where(contributes, dv_upd, 0.0).astype(dv_ref.dtype)
 
 
 def _resolve_blocks(t: int, block_q: int, block_k: int):
@@ -259,25 +257,36 @@ def _flash_vjp_bwd(cfg, res, dout):
     dkv_kernel = functools.partial(_flash_dkv_kernel, block_k=block_k,
                                    causal=causal, scale=scale,
                                    block_q=block_q)
+    # grid (bh, k blocks, q blocks): dk/dv output blocks are revisited
+    # along the innermost q axis (sequential per core → accumulation is
+    # safe); dk/dv must be f32 so the += accumulation doesn't round
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        out_shape=(jax.ShapeDtypeStruct((b * h, t, d), k.dtype),
-                   jax.ShapeDtypeStruct((b * h, t, d), v.dtype)),
-        grid=(b * h, t // block_k),
+        out_shape=(jax.ShapeDtypeStruct((b * h, t, d), jnp.float32),
+                   jax.ShapeDtypeStruct((b * h, t, d), jnp.float32)),
+        grid=(b * h, t // block_k, t // block_q),
         in_specs=[
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, t, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, t, 1), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, t, 1), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_q, d),
+                         lambda i, jk, jq: (i, jq, 0)),
+            pl.BlockSpec((None, block_k, d),
+                         lambda i, jk, jq: (i, jk, 0)),
+            pl.BlockSpec((None, block_k, d),
+                         lambda i, jk, jq: (i, jk, 0)),
+            pl.BlockSpec((None, block_q, d),
+                         lambda i, jk, jq: (i, jq, 0)),
+            pl.BlockSpec((None, block_q, 1),
+                         lambda i, jk, jq: (i, jq, 0)),
+            pl.BlockSpec((None, block_q, 1),
+                         lambda i, jk, jq: (i, jq, 0)),
         ],
         out_specs=(pl.BlockSpec((None, block_k, d),
-                                lambda i, j: (i, j, 0)),
+                                lambda i, jk, jq: (i, jk, 0)),
                    pl.BlockSpec((None, block_k, d),
-                                lambda i, j: (i, j, 0))),
+                                lambda i, jk, jq: (i, jk, 0))),
         interpret=interpret,
     )(qf, kf, vf, dof, lse, delta)
+    dk = dk.astype(k.dtype)
+    dv = dv.astype(v.dtype)
 
     return (dq.reshape(b, h, t, d), dk.reshape(b, h, t, d),
             dv.reshape(b, h, t, d))

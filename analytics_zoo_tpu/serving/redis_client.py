@@ -88,11 +88,47 @@ class RedisClient:
     def xread(self, stream: str, last_id: str = "0-0",
               count: int = 64, block_ms: Optional[int] = None):
         args = ["XREAD", "COUNT", count]
-        if block_ms is not None:
+        # BLOCK 0 means block FOREVER to redis; callers use 0/None for
+        # "return immediately", so only emit BLOCK for positive waits
+        if block_ms:
             args += ["BLOCK", block_ms]
         args += ["STREAMS", stream, last_id]
         reply = self.execute(*args)
         return _parse_xread(reply)
+
+    def xgroup_create(self, stream: str, group: str,
+                      start_id: str = "0") -> None:
+        """Create a consumer group (MKSTREAM so a fresh deployment
+        works before the first enqueue); BUSYGROUP = already exists."""
+        try:
+            self.execute("XGROUP", "CREATE", stream, group, start_id,
+                         "MKSTREAM")
+        except RuntimeError as e:
+            if "BUSYGROUP" not in str(e):
+                raise
+
+    def xreadgroup(self, group: str, consumer: str, stream: str,
+                   count: int = 64, block_ms: Optional[int] = None):
+        """Pop NEW entries for this consumer — each stream entry is
+        delivered to exactly one consumer in the group."""
+        args = ["XREADGROUP", "GROUP", group, consumer, "COUNT", count]
+        if block_ms:          # see xread: BLOCK 0 = forever on redis
+            args += ["BLOCK", block_ms]
+        args += ["STREAMS", stream, ">"]
+        return _parse_xread(self.execute(*args))
+
+    def xack(self, stream: str, group: str, *ids) -> int:
+        return self.execute("XACK", stream, group, *ids)
+
+    def xautoclaim(self, stream: str, group: str, consumer: str,
+                   min_idle_ms: int, count: int = 64):
+        """Claim another consumer's pending entries idle for at least
+        ``min_idle_ms`` (crash recovery; Redis >= 6.2)."""
+        reply = self.execute("XAUTOCLAIM", stream, group, consumer,
+                             min_idle_ms, "0-0", "COUNT", count)
+        # reply: [next_cursor, [[id, [k,v,...]], ...], (deleted ids)]
+        entries = reply[1] if reply and len(reply) > 1 else []
+        return _parse_xread([[stream, entries]])
 
     def xlen(self, stream: str) -> int:
         return self.execute("XLEN", stream)
@@ -151,6 +187,9 @@ class EmbeddedBroker:
     def __init__(self):
         self._streams: Dict[str, List[Tuple[str, Dict]]] = {}
         self._hashes: Dict[str, Dict[str, Any]] = {}
+        # (stream, group) -> {"delivered": last id handed out,
+        #                     "pending": {id: consumer}}
+        self._groups: Dict[Tuple[str, str], Dict[str, Any]] = {}
         self._seq = itertools.count(1)
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -181,6 +220,74 @@ class EmbeddedBroker:
                 if remaining <= 0:
                     return out
                 self._cv.wait(min(remaining, 0.05))
+
+    def xgroup_create(self, stream: str, group: str,
+                      start_id: str = "0") -> None:
+        with self._lock:
+            entries = self._streams.setdefault(stream, [])
+            if start_id in ("0", "0-0"):
+                cursor = "0-0"
+            elif start_id == "$":
+                cursor = entries[-1][0] if entries else "0-0"
+            else:
+                cursor = start_id   # must be an exact ms-seq id
+                _id_gt(cursor, "0-0")   # validates the format
+            self._groups.setdefault(
+                (stream, group),
+                {"delivered": cursor, "pending": {}})
+
+    def xreadgroup(self, group: str, consumer: str, stream: str,
+                   count: int = 64, block_ms: Optional[int] = None):
+        deadline = time.time() + (block_ms or 0) / 1000.0
+        while True:
+            with self._cv:
+                g = self._groups.get((stream, group))
+                if g is None:
+                    raise RuntimeError(
+                        f"NOGROUP no such consumer group {group}")
+                entries = self._streams.get(stream, [])
+                out = [(i, f) for i, f in entries
+                       if _id_gt(i, g["delivered"])][:count]
+                if out:
+                    g["delivered"] = out[-1][0]
+                    now = time.time()
+                    for i, _f in out:
+                        g["pending"][i] = (consumer, now)
+                    return out
+                if block_ms is None or time.time() >= deadline:
+                    return out
+                self._cv.wait(min(deadline - time.time(), 0.05))
+
+    def xack(self, stream: str, group: str, *ids) -> int:
+        with self._lock:
+            g = self._groups.get((stream, group))
+            if g is None:
+                return 0
+            n = 0
+            for i in ids:
+                n += g["pending"].pop(i, None) is not None
+            return n
+
+    def xautoclaim(self, stream: str, group: str, consumer: str,
+                   min_idle_ms: int, count: int = 64):
+        with self._lock:
+            g = self._groups.get((stream, group))
+            if g is None:
+                return []
+            now = time.time()
+            stale = [i for i, (_c, ts) in g["pending"].items()
+                     if (now - ts) * 1000.0 >= min_idle_ms][:count]
+            if not stale:
+                return []
+            by_id = dict(self._streams.get(stream, []))
+            out = []
+            for i in stale:
+                g["pending"][i] = (consumer, now)
+                if i in by_id:
+                    out.append((i, by_id[i]))
+                else:           # trimmed away — drop from pending
+                    g["pending"].pop(i, None)
+            return out
 
     def xlen(self, stream: str) -> int:
         with self._lock:

@@ -60,12 +60,19 @@ class ServingConfig:
                  batch_size: int = 4, top_n: int = 1,
                  max_stream_len: int = 100000,
                  log_dir: Optional[str] = None,
+                 consumer_group: Optional[str] = None,
+                 consumer_name: str = "worker-0",
                  extra: Optional[Dict[str, str]] = None):
         self.redis_url = redis_url
         self.batch_size = int(batch_size)
         self.top_n = int(top_n)
         self.max_stream_len = int(max_stream_len)
         self.log_dir = log_dir
+        # consumer_group set → multiple workers SHARE the stream, each
+        # record served exactly once (the reference parallelizes per
+        # Spark partition; redis-native scale-out uses XREADGROUP)
+        self.consumer_group = consumer_group
+        self.consumer_name = consumer_name
         self.extra = extra or {}   # raw section.key entries (model.* etc)
 
     @classmethod
@@ -87,6 +94,9 @@ class ServingConfig:
             batch_size=int(cfg.get("params.batch_size", 4) or 4),
             top_n=int(cfg.get("params.top_n", 1) or 1),
             log_dir=cfg.get("params.log_dir") or None,
+            consumer_group=cfg.get("params.consumer_group") or None,
+            consumer_name=cfg.get("params.consumer_name", "worker-0")
+            or "worker-0",
             extra=cfg,
         )
 
@@ -105,6 +115,9 @@ class ClusterServing:
         self._stop = threading.Event()
         self._last_id = "0-0"
         self.total_records = 0
+        if self.config.consumer_group:
+            self.broker.xgroup_create(INPUT_STREAM,
+                                      self.config.consumer_group)
         # per-record arrival→result latencies (seconds), bounded
         self.latencies: deque = deque(maxlen=10000)
         self._serve_start: Optional[float] = None
@@ -113,16 +126,13 @@ class ClusterServing:
     def run_once(self, block_ms: int = 100) -> int:
         """One poll/predict/write cycle; returns #records served."""
         self._serve_start = self._serve_start or time.time()
-        entries = self.broker.xread(INPUT_STREAM, self._last_id,
-                                    count=self.config.batch_size,
-                                    block_ms=block_ms)
+        entries = self._read_entries(self.config.batch_size, block_ms)
         if not entries:
             return 0
         t0 = time.time()
-        for entry_id, _fields in entries:
-            self._last_id = entry_id
         uris, arrays = self._decode_batch(entries)
         real = self._predict_write(uris, arrays, t0)
+        self._ack(entries)
         if self.summary is not None and real:
             self.summary.add_scalar("Serving Throughput",
                                     real / max(time.time() - t0, 1e-9),
@@ -144,6 +154,49 @@ class ClusterServing:
         raise RuntimeError(f"could not write result for {uri}")
 
     # -------------------------------------------------- pipelined serving
+    def _read_entries(self, count: int, block_ms: int):
+        """Read the next batch: plain XREAD (single worker owns the
+        stream) or XREADGROUP (workers share it, exactly-once
+        delivery)."""
+        cfg = self.config
+        if cfg.consumer_group:
+            return self.broker.xreadgroup(
+                cfg.consumer_group, cfg.consumer_name, INPUT_STREAM,
+                count=count, block_ms=block_ms)
+        entries = self.broker.xread(INPUT_STREAM, self._last_id,
+                                    count=count, block_ms=block_ms)
+        for entry_id, _f in entries:
+            self._last_id = entry_id
+        return entries
+
+    def _ack(self, entries) -> None:
+        if self.config.consumer_group and entries:
+            self.broker.xack(INPUT_STREAM, self.config.consumer_group,
+                             *[i for i, _ in entries])
+
+    def _reclaim_stale(self, min_idle_ms: int = 30000):
+        """Crash recovery: claim entries another worker read but never
+        acknowledged (died between XREADGROUP and XACK) and serve them
+        — without this, records in a dead worker's pending list would
+        wait forever."""
+        cfg = self.config
+        if not cfg.consumer_group:
+            return 0
+        try:
+            entries = self.broker.xautoclaim(
+                INPUT_STREAM, cfg.consumer_group, cfg.consumer_name,
+                min_idle_ms, count=cfg.batch_size)
+        except Exception:
+            log.exception("xautoclaim failed")
+            return 0
+        if not entries:
+            return 0
+        uris, arrays = self._decode_batch(entries)
+        real = self._predict_write(uris, arrays, time.time())
+        self._ack(entries)
+        log.info("reclaimed %d stale pending records", real)
+        return real
+
     def _decode_batch(self, entries):
         """Decode one batch of raw stream entries (runs in the decode
         pool — pure CPU, no broker IO, so no connection sharing across
@@ -232,25 +285,28 @@ class ClusterServing:
         self._serve_start = self._serve_start or started
         pool = ThreadPoolExecutor(decode_workers,
                                   thread_name_prefix="serving-decode")
-        pending: deque = deque()   # (future, t_arrival)
+        pending: deque = deque()   # (future, t_arrival, entries)
+        last_reclaim = started
         try:
             while True:
+                if time.time() - last_reclaim > 10.0:
+                    self._reclaim_stale()
+                    last_reclaim = time.time()
                 # keep the decode pipeline full
                 while len(pending) < pipeline_depth:
-                    entries = self.broker.xread(
-                        INPUT_STREAM, self._last_id,
-                        count=self.config.batch_size,
-                        block_ms=0 if pending else poll_ms)
+                    entries = self._read_entries(
+                        self.config.batch_size,
+                        0 if pending else poll_ms)
                     if not entries:
                         break
-                    for entry_id, _f in entries:
-                        self._last_id = entry_id
                     pending.append((pool.submit(self._decode_batch,
-                                                entries), time.time()))
+                                                entries), time.time(),
+                                    entries))
                 if pending:
-                    fut, t_arrival = pending.popleft()
+                    fut, t_arrival, entries = pending.popleft()
                     uris, arrays = fut.result()
                     self._predict_write(uris, arrays, t_arrival)
+                    self._ack(entries)
                     if self.summary is not None and self.latencies:
                         s = self.stats()
                         self.summary.add_scalar(
@@ -265,9 +321,10 @@ class ClusterServing:
                     # advanced) MUST still be predicted + written, or
                     # its clients wait forever
                     while pending:
-                        fut, t_arrival = pending.popleft()
+                        fut, t_arrival, entries = pending.popleft()
                         uris, arrays = fut.result()
                         self._predict_write(uris, arrays, t_arrival)
+                        self._ack(entries)
                     break
         finally:
             pool.shutdown(wait=False)

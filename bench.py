@@ -258,9 +258,15 @@ def bench_attention(seq_len: int = 4096, batch: int = 4, heads: int = 8,
         # with a D2H sync.
         def loop(q, k, v):
             def body(c, _):
-                g = jax.grad(lambda q: fn(q, k, v)
-                             .astype(jnp.float32).sum())(c)
-                return g.astype(c.dtype), None
+                # differentiate wrt ALL inputs and fold every grad into
+                # the carry — otherwise jit dead-code-eliminates the
+                # dk/dv kernels and "fwd+bwd" silently times fwd+dq
+                gq, gk, gv = jax.grad(
+                    lambda q, k, v: fn(q, k, v)
+                    .astype(jnp.float32).sum(), argnums=(0, 1, 2)
+                )(c, k, v)
+                nxt = (gq + gk + gv).astype(c.dtype)
+                return nxt, None
             out, _ = jax.lax.scan(body, q, None, length=iters)
             return out.astype(jnp.float32).sum()
 

@@ -422,3 +422,95 @@ class TestServingOpsCommands:
         b.shutdown()
         assert b.xlen("serving_stream") == 0
         assert b.hgetall("h") == {}
+
+
+class TestConsumerGroups:
+    """Multi-worker scale-out: workers sharing a consumer group must
+    serve each record exactly once (the reference's per-partition
+    parallel serving, redis-native via XREADGROUP)."""
+
+    def test_two_workers_split_the_stream(self):
+        m = small_classifier()
+        im = InferenceModel().load_zoo(m)
+        broker = EmbeddedBroker()
+        w1 = ClusterServing(im, ServingConfig(
+            batch_size=4, consumer_group="serve",
+            consumer_name="w1"), broker=broker)
+        w2 = ClusterServing(im, ServingConfig(
+            batch_size=4, consumer_group="serve",
+            consumer_name="w2"), broker=broker)
+        inq = InputQueue(broker=broker)
+        outq = OutputQueue(broker=broker)
+        n = 32
+        rs = np.random.RandomState(0)
+        for i in range(n):
+            inq.enqueue(f"g{i}", rs.randn(8, 8, 3).astype(np.float32))
+
+        import time as _t
+        t1 = threading.Thread(target=w1.run, kwargs={"poll_ms": 5})
+        t2 = threading.Thread(target=w2.run, kwargs={"poll_ms": 5})
+        t1.start(); t2.start()
+        t0 = _t.time()
+        while (w1.total_records + w2.total_records) < n \
+                and _t.time() - t0 < 60:
+            _t.sleep(0.01)
+        w1.stop(); w2.stop()
+        t1.join(timeout=15); t2.join(timeout=15)
+
+        # exactly-once: totals sum to n (no double-serving)
+        assert w1.total_records + w2.total_records == n
+        for i in range(n):
+            assert outq.query(f"g{i}") is not None, f"g{i} unserved"
+        # nothing left pending after acks
+        g = broker._groups[("serving_stream", "serve")]
+        assert not g["pending"]
+
+    def test_group_read_is_exclusive(self):
+        broker = EmbeddedBroker()
+        broker.xgroup_create("serving_stream", "g")
+        for i in range(6):
+            broker.xadd("serving_stream", {"uri": f"u{i}", "data": "x"})
+        a = broker.xreadgroup("g", "c1", "serving_stream", count=4)
+        b = broker.xreadgroup("g", "c2", "serving_stream", count=4)
+        ids_a = {i for i, _ in a}
+        ids_b = {i for i, _ in b}
+        assert len(ids_a) == 4 and len(ids_b) == 2
+        assert not ids_a & ids_b          # disjoint delivery
+        broker.xack("serving_stream", "g", *ids_a)
+        g = broker._groups[("serving_stream", "g")]
+        assert set(g["pending"]) == ids_b
+
+    def test_crashed_worker_records_are_reclaimed(self):
+        """Entries read but never acked (worker died) are re-served by
+        another worker via xautoclaim."""
+        m = small_classifier()
+        im = InferenceModel().load_zoo(m)
+        broker = EmbeddedBroker()
+        rs = np.random.RandomState(0)
+        inq = InputQueue(broker=broker)
+        for i in range(4):
+            inq.enqueue(f"c{i}", rs.randn(8, 8, 3).astype(np.float32))
+        # "crashed" worker: reads but never acks
+        broker.xgroup_create("serving_stream", "serve")
+        dead = broker.xreadgroup("serve", "dead", "serving_stream",
+                                 count=4)
+        assert len(dead) == 4
+        # survivor reclaims with a zero idle threshold and serves
+        w = ClusterServing(im, ServingConfig(
+            batch_size=4, consumer_group="serve",
+            consumer_name="alive"), broker=broker)
+        served = w._reclaim_stale(min_idle_ms=0)
+        assert served == 4
+        outq = OutputQueue(broker=broker)
+        for i in range(4):
+            assert outq.query(f"c{i}") is not None
+        assert not broker._groups[("serving_stream", "serve")]["pending"]
+
+    def test_embedded_group_dollar_start(self):
+        broker = EmbeddedBroker()
+        broker.xadd("serving_stream", {"uri": "old", "data": "x"})
+        broker.xgroup_create("serving_stream", "g", start_id="$")
+        assert broker.xreadgroup("g", "c", "serving_stream") == []
+        broker.xadd("serving_stream", {"uri": "new", "data": "x"})
+        got = broker.xreadgroup("g", "c", "serving_stream")
+        assert len(got) == 1 and got[0][1]["uri"] == b"new"
