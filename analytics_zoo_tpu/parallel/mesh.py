@@ -159,3 +159,22 @@ def global_batch_rows(mesh: Mesh, batch_size: int) -> int:
     ``batch_size`` whenever hosts replicate instead of splitting)."""
     return batch_size * (jax.process_count()
                          if data_split_across_hosts(mesh) else 1)
+
+
+def fetch_global(tree):
+    """Bring a (possibly cross-process-sharded) pytree to host numpy.
+
+    ``jax.device_get`` refuses arrays whose shards live on other
+    processes' devices (e.g. fsdp-sharded params on a multi-host mesh);
+    those leaves go through ``process_allgather`` instead — a
+    collective, so EVERY process must call this together (the reference
+    analogue is InternalDistriOptimizer.getModel pulling the
+    AllReduceParameter chunks back to the driver, Topology.scala:1549).
+    """
+    def fetch(leaf):
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            from jax.experimental import multihost_utils
+            return multihost_utils.process_allgather(leaf, tiled=True)
+        return jax.device_get(leaf)
+
+    return jax.tree_util.tree_map(fetch, tree)

@@ -23,6 +23,7 @@ import jax
 import numpy as np
 
 from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
 from analytics_zoo_tpu.common.triggers import (
     EveryEpoch, MaxEpoch, TrainingState, Trigger)
 from analytics_zoo_tpu.parallel.trainer import ClipSpec, DistributedTrainer
@@ -109,7 +110,6 @@ class Estimator:
             clip=self._clip, optim_groups=self.optim_groups)
         # The global batch must tile the data-parallel mesh (the analogue
         # of BigDL's batchSize % totalCores == 0 requirement).
-        from analytics_zoo_tpu.parallel import mesh as mesh_lib
         mesh_lib.local_batch_size(trainer.mesh, batch_size)
         if getattr(train_set, "size", batch_size) < batch_size:
             raise ValueError(
@@ -150,9 +150,9 @@ class Estimator:
 
         # --- epoch loop -----------------------------------------------------
         def save_snapshot():
-            ckpt.save({"params": jax.device_get(params),
-                       "state": jax.device_get(state),
-                       "opt_state": jax.device_get(opt_state),
+            ckpt.save({"params": mesh_lib.fetch_global(params),
+                       "state": mesh_lib.fetch_global(state),
+                       "opt_state": mesh_lib.fetch_global(opt_state),
                        "epoch": ts.epoch, "iteration": ts.iteration},
                       step=ts.iteration)
 
@@ -250,8 +250,8 @@ class Estimator:
                 save_snapshot()
             ts.epoch_finished = False
 
-        self.variables = {"params": jax.device_get(params),
-                          "state": jax.device_get(state)}
+        self.variables = {"params": mesh_lib.fetch_global(params),
+                          "state": mesh_lib.fetch_global(state)}
         self.model.set_variables(self.variables)
         return self
 

@@ -1,0 +1,104 @@
+"""Hard-drive failure detection with an autoencoder — runnable tutorial.
+
+The TPU-native retelling of the reference's anomaly-detection-hd app
+(``apps/anomaly-detection-hd/autoencoder-zoo.ipynb``): most drives are
+healthy, failures are rare and unlabeled at training time, so train an
+**autoencoder on healthy telemetry only** and flag drives whose SMART
+readings it cannot reconstruct.
+
+The workflow, step by step:
+
+1. **The telemetry** — per-drive SMART-like attribute vectors
+   (reallocated sectors, seek error rate, temperature, spin-retry...)
+   drawn from a correlated healthy distribution; a small fraction of
+   drives are degraded (several attributes drift off-manifold).
+2. **Fit the normal manifold** — a Dense bottleneck autoencoder
+   (the notebook's ``Sequential`` of encoder/decoder Dense layers)
+   trained with MSE on drives assumed healthy — including the few
+   contaminating failures, exactly the unsupervised setting.
+3. **Score** — reconstruction error per drive; the autoencoder
+   reconstructs healthy telemetry well and degraded telemetry badly.
+4. **Threshold + evaluate** — flag the top ``k`` errors as failing and
+   report precision/recall against the injected ground truth.
+
+Run: ``python apps/anomaly_detection_hd/hdd_failure_autoencoder.py``
+"""
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+N_ATTRS = 12
+
+
+def smart_telemetry(drives: int, failure_rate: float, seed: int = 0):
+    """Correlated healthy SMART vectors + off-manifold degraded drives."""
+    rs = np.random.RandomState(seed)
+    # healthy attributes live on a low-dim manifold: a few latent
+    # health factors mixed into the observed attributes
+    latent = rs.randn(drives, 3).astype(np.float32)
+    mix = rs.randn(3, N_ATTRS).astype(np.float32)
+    x = latent @ mix + 0.1 * rs.randn(drives, N_ATTRS).astype(np.float32)
+    n_fail = max(1, int(drives * failure_rate))
+    failing = rs.choice(drives, n_fail, replace=False)
+    # degraded drives drift off-manifold in a random attribute subset
+    for d in failing:
+        attrs = rs.choice(N_ATTRS, 5, replace=False)
+        x[d, attrs] += rs.choice([-1.0, 1.0], 5) * (3.5 + rs.rand(5))
+    return x, np.sort(failing)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--drives", type=int, default=20000)
+    p.add_argument("--failure-rate", type=float, default=0.01)
+    p.add_argument("--epochs", type=int, default=15)
+    p.add_argument("--batch-size", type=int, default=512)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.drives, args.epochs, args.batch_size = 3000, 8, 256
+
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    # step 1 — telemetry (unlabeled: failures contaminate training)
+    x, failing = smart_telemetry(args.drives, args.failure_rate)
+    mu, sd = x.mean(0), x.std(0) + 1e-6
+    x = (x - mu) / sd
+
+    # step 2 — bottleneck autoencoder
+    model = Sequential()
+    model.add(Dense(32, activation="relu", input_shape=(N_ATTRS,)))
+    model.add(Dense(3, activation="relu"))        # the bottleneck
+    model.add(Dense(32, activation="relu"))
+    model.add(Dense(N_ATTRS))
+    model.compile(optimizer=Adam(lr=1e-3), loss="mse")
+    model.fit(x, x, batch_size=args.batch_size, nb_epoch=args.epochs)
+
+    # step 3 — reconstruction error per drive
+    recon = model.predict(x, batch_size=args.batch_size)
+    err = np.mean((recon - x) ** 2, axis=1)
+
+    # step 4 — flag top-k and evaluate against injected failures
+    k = len(failing)
+    flagged = np.sort(np.argsort(err)[-k:])
+    hit = len(np.intersect1d(flagged, failing))
+    precision = hit / k
+    recall = hit / len(failing)
+    print(f"[hdd-autoencoder] drives={args.drives} failures={len(failing)} "
+          f"flagged={k} precision={precision:.2f} recall={recall:.2f}")
+    assert recall >= 0.5, (recall, precision)
+    return {"precision": precision, "recall": recall}
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() else 1)

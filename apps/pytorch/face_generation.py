@@ -1,0 +1,163 @@
+"""Face generation with a PyTorch-defined GAN — runnable tutorial.
+
+The TPU-native retelling of the reference's pytorch app
+(``apps/pytorch/face_generation.ipynb``): the user writes generator and
+discriminator as ordinary ``torch.nn`` modules, and the framework runs
+them — here not over a JNI bridge to libtorch (``TorchNet.scala:40``)
+but fx-traced into native JAX layers (``pipeline/api/net/torch_net.py``)
+so the whole adversarial step compiles into ONE XLA program and the
+weights train natively under a zoo optimizer.
+
+The workflow, step by step:
+
+1. **The faces** — 16x16 grayscale "faces": an oval head, two eyes,
+   a mouth, with jittered geometry (zero-egress stand-in for the
+   notebook's CelebA-like crops).
+2. **Torch modules** — ``Generator`` (latent → image, Tanh output) and
+   ``Discriminator`` (image → realness logit) in plain PyTorch.
+3. **Convert** — ``TorchNet.from_pytorch`` turns each into a native
+   layer: torch weights become JAX param pytrees.
+4. **Adversarial training** — a jitted alternating step: D maximizes
+   real-vs-fake discrimination, G maximizes D's confusion (the
+   non-saturating loss), both under Adam — the role the reference
+   fills with ``GanOptimMethod``'s alternating sub-steps.
+5. **Generate + sanity-check** — sample the trained G; its images must
+   match the data's gross statistics and D must find them plausible.
+
+Run: ``python apps/pytorch/face_generation.py``
+"""
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+IMG = 16
+LATENT = 32
+
+
+def synthetic_faces(n: int, seed: int = 0) -> np.ndarray:
+    """Oval head + eyes + mouth with geometric jitter, in [-1, 1]."""
+    rs = np.random.RandomState(seed)
+    yy, xx = np.mgrid[:IMG, :IMG].astype(np.float32)
+    faces = np.full((n, IMG, IMG), -1.0, np.float32)
+    for i in range(n):
+        cy, cx = 7.5 + rs.randn(), 7.5 + rs.randn() * 0.5
+        ry, rx = 6.0 + rs.rand(), 5.0 + rs.rand()
+        head = (((yy - cy) / ry) ** 2 + ((xx - cx) / rx) ** 2) < 1.0
+        img = np.where(head, 0.8, -1.0).astype(np.float32)
+        ey = int(round(cy - 2))
+        for dx in (-2, 2):                       # eyes
+            ex = int(round(cx + dx))
+            img[max(ey, 0):ey + 2, max(ex, 0):ex + 2] = -0.6
+        my = int(round(cy + 2.5))                 # mouth
+        img[my:my + 1, int(cx) - 2:int(cx) + 3] = -0.4
+        faces[i] = img + 0.05 * rs.randn(IMG, IMG)
+    return faces.reshape(n, IMG * IMG)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--faces", type=int, default=4096)
+    p.add_argument("--steps", type=int, default=600)
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--lr", type=float, default=2e-4)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.faces, args.steps = 1024, 120
+
+    import jax
+    import jax.numpy as jnp
+    import torch.nn as nn
+
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+    from analytics_zoo_tpu.pipeline.api.net import TorchNet
+
+    # step 2 — plain PyTorch definitions
+    generator = nn.Sequential(
+        nn.Linear(LATENT, 128), nn.ReLU(),
+        nn.Linear(128, 256), nn.ReLU(),
+        nn.Linear(256, IMG * IMG), nn.Tanh())
+    discriminator = nn.Sequential(
+        nn.Linear(IMG * IMG, 128), nn.ReLU(),
+        nn.Linear(128, 64), nn.ReLU(),
+        nn.Linear(64, 1))
+
+    # step 3 — fx-trace into native layers
+    g_net = TorchNet.from_pytorch(generator, input_shape=(LATENT,))
+    d_net = TorchNet.from_pytorch(discriminator, input_shape=(IMG * IMG,))
+    g_params = g_net.init(jax.random.PRNGKey(0))["params"]
+    d_params = d_net.init(jax.random.PRNGKey(1))["params"]
+
+    g_opt, d_opt = Adam(lr=args.lr), Adam(lr=args.lr)
+    g_state = g_opt.init(g_params)
+    d_state = d_opt.init(d_params)
+
+    def bce_logits(logits, target):
+        # stable sigmoid BCE: max(x,0) - x*t + log1p(exp(-|x|))
+        return jnp.mean(jnp.maximum(logits, 0) - logits * target
+                        + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+
+    # step 4 — one fused adversarial step (D update then G update)
+    @jax.jit
+    def gan_step(g_params, d_params, g_state, d_state, real, rng):
+        z = jax.random.normal(rng, (real.shape[0], LATENT))
+
+        def d_loss_fn(dp):
+            fake = g_net.call(g_params, z)
+            real_logit = d_net.call(dp, real)
+            fake_logit = d_net.call(dp, fake)
+            return (bce_logits(real_logit, jnp.ones_like(real_logit))
+                    + bce_logits(fake_logit, jnp.zeros_like(fake_logit)))
+
+        d_loss, d_grads = jax.value_and_grad(d_loss_fn)(d_params)
+        d_updates, d_state2 = d_opt.update(d_grads, d_state, d_params)
+        d_params2 = jax.tree_util.tree_map(
+            lambda p, u: p + u, d_params, d_updates)
+
+        def g_loss_fn(gp):
+            fake = g_net.call(gp, z)
+            fake_logit = d_net.call(d_params2, fake)
+            # non-saturating generator loss
+            return bce_logits(fake_logit, jnp.ones_like(fake_logit))
+
+        g_loss, g_grads = jax.value_and_grad(g_loss_fn)(g_params)
+        g_updates, g_state2 = g_opt.update(g_grads, g_state, g_params)
+        g_params2 = jax.tree_util.tree_map(
+            lambda p, u: p + u, g_params, g_updates)
+        return g_params2, d_params2, g_state2, d_state2, g_loss, d_loss
+
+    data = synthetic_faces(args.faces)
+    rng = jax.random.PRNGKey(42)
+    rs = np.random.RandomState(0)
+    for step in range(args.steps):
+        idx = rs.randint(0, args.faces, args.batch_size)
+        rng, sub = jax.random.split(rng)
+        (g_params, d_params, g_state, d_state, g_loss,
+         d_loss) = gan_step(g_params, d_params, g_state, d_state,
+                            jnp.asarray(data[idx]), sub)
+        if step % max(args.steps // 6, 1) == 0:
+            print(f"  step {step:4d}  d_loss={float(d_loss):.3f} "
+                  f"g_loss={float(g_loss):.3f}")
+
+    # step 5 — generate and sanity-check
+    z = jax.random.normal(jax.random.PRNGKey(7), (64, LATENT))
+    samples = np.asarray(g_net.call(g_params, z))
+    data_mean, gen_mean = float(data.mean()), float(samples.mean())
+    print(f"[face-gan] data mean {data_mean:.3f} vs generated mean "
+          f"{gen_mean:.3f}; generated range "
+          f"[{samples.min():.2f}, {samples.max():.2f}]")
+    assert np.isfinite(samples).all()
+    assert abs(gen_mean - data_mean) < 0.45, (gen_mean, data_mean)
+    return {"data_mean": data_mean, "gen_mean": gen_mean}
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() else 1)

@@ -1,0 +1,127 @@
+"""Wide & Deep recommendation on Census-style tabular data — runnable
+tutorial.
+
+The TPU-native retelling of the reference's wide-n-deep app
+(``apps/recommendation-wide-n-deep/wide_n_deep.ipynb``, model
+``models/recommendation/WideAndDeep.scala:101``, feature engineering
+``models/recommendation/Utils.scala:325``): predict whether a user
+will engage with an item from demographic columns, combining
+
+* a **wide** half — a linear model over one-hot base columns plus
+  hand-crafted cross-product columns (memorization), and
+* a **deep** half — embeddings for the categorical columns plus the
+  continuous columns through an MLP (generalization).
+
+The workflow, step by step:
+
+1. **The table** — a MovieLens-meets-Census synthetic: per-row
+   ``gender``, ``age_bucket``, ``occupation``, ``hours_per_week`` and
+   an engagement label driven by a few of them (so the model has real
+   signal to find).  ``ColumnFeatureInfo`` declares which columns feed
+   the wide half, which get crossed, which are embedded, and which
+   pass through continuous — the exact contract of the reference's
+   ``ColumnFeatureInfo``.
+2. **Feature engineering** — ``model.features_from_columns`` turns the
+   named columns into the model's input arrays (wide indices built
+   with the same base+cross offset scheme as the reference's
+   ``getWideTensor``).
+3. **Train** — ``compile``/``fit`` with Adam on
+   sparse-categorical-crossentropy, exactly like the notebook.
+4. **Evaluate + recommend** — accuracy on a held-out slice, then
+   per-user engagement probabilities via softmax over the logits.
+
+Run: ``python apps/recommendation_wide_deep/wide_n_deep.py``
+"""
+
+import argparse
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+
+def census_like_table(rows: int, seed: int = 0):
+    """Synthetic Census-style columns with a learnable engagement rule."""
+    rs = np.random.RandomState(seed)
+    gender = rs.randint(0, 3, rows)
+    age = rs.randint(0, 10, rows)
+    occupation = rs.randint(0, 21, rows)
+    hours = rs.rand(rows).astype(np.float32)
+    cols = {
+        "gender": gender,
+        "age_bucket": age,
+        "gender_age": gender * 10 + age,          # cross column
+        "occupation": occupation,
+        "hours_per_week": hours,
+    }
+    # engagement depends on a cross effect (wide half's job) plus a
+    # smooth occupation/hours effect (deep half's job)
+    logit = (((gender == 1) & (age >= 5)).astype(np.float32) * 1.5
+             + np.sin(occupation / 21.0 * np.pi) + hours - 1.2)
+    label = (logit + 0.3 * rs.randn(rows) > 0).astype(np.int32)
+    return cols, label.reshape(-1, 1)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--rows", type=int, default=60000)
+    p.add_argument("--epochs", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=1024)
+    p.add_argument("--model-type", default="wide_n_deep",
+                   choices=["wide_n_deep", "wide", "deep"])
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.rows, args.epochs, args.batch_size = 3000, 1, 256
+
+    from analytics_zoo_tpu.models.recommendation import (
+        ColumnFeatureInfo, WideAndDeep)
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    # step 1 — declare the column roles (ColumnFeatureInfo contract)
+    info = ColumnFeatureInfo(
+        wide_base_cols=["gender", "age_bucket"], wide_base_dims=[3, 10],
+        wide_cross_cols=["gender_age"], wide_cross_dims=[30],
+        embed_cols=["occupation"], embed_in_dims=[21], embed_out_dims=[8],
+        continuous_cols=["hours_per_week"])
+    cols, y = census_like_table(args.rows)
+
+    # step 2 — feature engineering
+    model = WideAndDeep(2, info, model_type=args.model_type)
+    x = model.features_from_columns(cols)
+
+    # hold out the tail 20% for evaluation
+    n_train = int(args.rows * 0.8)
+    x_train = [a[:n_train] for a in x]
+    x_test = [a[n_train:] for a in x]
+    y_train, y_test = y[:n_train], y[n_train:]
+
+    # step 3 — train
+    model.compile(optimizer=Adam(lr=1e-2),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+    model.fit(x_train, y_train, batch_size=args.batch_size,
+              nb_epoch=args.epochs)
+
+    # step 4 — evaluate + recommend
+    scores = model.evaluate(x_test, y_test, batch_size=args.batch_size)
+    print(f"[wide&deep/{args.model_type}] held-out:", scores)
+    logits = model.predict(x_test, batch_size=args.batch_size)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    for i in range(3):
+        print(f"  user-row {n_train + i}: engage probability "
+              f"{probs[i, 1]:.3f} (label {int(y_test[i, 0])})")
+    acc = scores.get("sparse_categorical_accuracy",
+                     scores.get("accuracy"))
+    assert acc and acc > 0.55, scores
+    return scores
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() else 1)

@@ -59,6 +59,12 @@ APPS = [
     "apps.image_augmentation.image_augmentation",
     "apps.object_detection.object_detection",
     "apps.model_inference.model_inference_pipeline",
+    "apps.recommendation_wide_deep.wide_n_deep",
+    "apps.anomaly_detection_hd.hdd_failure_autoencoder",
+    "apps.image_augmentation_3d.image_augmentation_3d",
+    "apps.tfnet.image_classification_inference",
+    "apps.pytorch.face_generation",
+    "apps.ray.sharded_parameter_server",
 ]
 
 
