@@ -139,38 +139,45 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     OOM'd scoped vmem at T=8k)."""
     q_idx = pl.program_id(2)
     k_idx = pl.program_id(1)
-    k_blk = k_ref[:]                              # (bk, d) input dtype
-    v_blk = v_ref[:]                              # (bk, d)
-    # same-dtype q*scale as the forward (see dq kernel note)
-    q_blk = q_ref[:] * scale                      # (bq, d)
-    do_blk = do_ref[:].astype(jnp.float32)        # (bq, d)
-    lse = lse_ref[:][:, 0]
-    delta = delta_ref[:][:, 0]
 
     @pl.when(q_idx == 0)
     def _init():
         dk_ref[:] = jnp.zeros_like(dk_ref)
         dv_ref[:] = jnp.zeros_like(dv_ref)
 
-    s = jnp.dot(q_blk, k_blk.T,
-                preferred_element_type=jnp.float32)  # (bq, bk)
-    if causal:
-        s = _apply_causal_mask(s, q_idx * block_q, k_idx * block_k,
-                               block_q, block_k)
-    p = jnp.exp(s - lse[:, None])
-    dv_upd = jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
-    dp = jnp.dot(do_blk, v_blk.T.astype(jnp.float32),
-                 preferred_element_type=jnp.float32)
-    ds = p * (dp - delta[:, None])
-    # dk = Σ ds_ijᵀ (scale·q_i): q_blk enters pre-scaled, so the scale
-    # is already in the accumulation
-    dk_upd = jnp.dot(ds.T, q_blk.astype(jnp.float32),
+    def _compute():
+        k_blk = k_ref[:]                          # (bk, d) input dtype
+        v_blk = v_ref[:]                          # (bk, d)
+        # same-dtype q*scale as the forward (see dq kernel note)
+        q_blk = q_ref[:] * scale                  # (bq, d)
+        do_blk = do_ref[:].astype(jnp.float32)    # (bq, d)
+        lse = lse_ref[:][:, 0]
+        delta = delta_ref[:][:, 0]
+
+        s = jnp.dot(q_blk, k_blk.T,
+                    preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            s = _apply_causal_mask(s, q_idx * block_q, k_idx * block_k,
+                                   block_q, block_k)
+        p = jnp.exp(s - lse[:, None])
+        dv_upd = jnp.dot(p.T, do_blk, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do_blk, v_blk.T.astype(jnp.float32),
                      preferred_element_type=jnp.float32)
-    contributes = jnp.logical_or(
-        not causal,
-        (q_idx + 1) * block_q - 1 >= k_idx * block_k)
-    dk_ref[:] += jnp.where(contributes, dk_upd, 0.0).astype(dk_ref.dtype)
-    dv_ref[:] += jnp.where(contributes, dv_upd, 0.0).astype(dv_ref.dtype)
+        ds = p * (dp - delta[:, None])
+        # dk = Σ ds_ijᵀ (scale·q_i): q_blk enters pre-scaled, so the
+        # scale is already in the accumulation
+        dk_upd = jnp.dot(ds.T, q_blk.astype(jnp.float32),
+                         preferred_element_type=jnp.float32)
+        dk_ref[:] += dk_upd.astype(dk_ref.dtype)
+        dv_ref[:] += dv_upd.astype(dv_ref.dtype)
+
+    if causal:
+        # skip fully-masked cells (q block entirely above the diagonal)
+        # — ~half the grid at large T would otherwise burn full matmuls
+        # on results that are discarded
+        pl.when((q_idx + 1) * block_q - 1 >= k_idx * block_k)(_compute)
+    else:
+        _compute()
 
 
 def _resolve_blocks(t: int, block_q: int, block_k: int):

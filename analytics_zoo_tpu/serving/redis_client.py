@@ -128,7 +128,18 @@ class RedisClient:
                              min_idle_ms, "0-0", "COUNT", count)
         # reply: [next_cursor, [[id, [k,v,...]], ...], (deleted ids)]
         entries = reply[1] if reply and len(reply) > 1 else []
-        return _parse_xread([[stream, entries]])
+        # Redis 6.2 returns [id, nil] for pending entries whose data
+        # was XTRIMmed out of the stream (7.0 drops them server-side).
+        # Their payload is unrecoverable — ack them out of the PEL so
+        # they can't wedge every future reclaim pass.
+        live, dead = [], []
+        for entry_id, kvs in entries:
+            (live if kvs is not None else dead).append((entry_id, kvs))
+        if dead:
+            self.xack(stream, group,
+                      *[i.decode() if isinstance(i, bytes) else i
+                        for i, _ in dead])
+        return _parse_xread([[stream, live]])
 
     def xlen(self, stream: str) -> int:
         return self.execute("XLEN", stream)
@@ -173,6 +184,8 @@ def _parse_xread(reply):
         return out
     for _stream, entries in reply:
         for entry_id, kvs in entries:
+            if kvs is None:      # trimmed-entry tombstone (Redis 6.2)
+                continue
             fields = {kvs[i].decode(): kvs[i + 1]
                       for i in range(0, len(kvs), 2)}
             out.append((entry_id.decode()

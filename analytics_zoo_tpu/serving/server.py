@@ -121,6 +121,10 @@ class ClusterServing:
         # per-record arrival→result latencies (seconds), bounded
         self.latencies: deque = deque(maxlen=10000)
         self._serve_start: Optional[float] = None
+        # entry ids read by THIS worker and not yet acked (in the
+        # decode/predict pipeline) — the reclaim pass must not treat
+        # them as another worker's stale pending
+        self._inflight: set = set()
 
     # ------------------------------------------------------------ main loop
     def run_once(self, block_ms: int = 100) -> int:
@@ -189,6 +193,11 @@ class ClusterServing:
         except Exception:
             log.exception("xautoclaim failed")
             return 0
+        # XAUTOCLAIM does not exclude the caller: under a deep backlog
+        # (pipeline_depth batches waiting > min_idle_ms) it hands back
+        # THIS worker's own un-acked in-flight entries — serving those
+        # here would double-predict and double-write them.
+        entries = [e for e in entries if e[0] not in self._inflight]
         if not entries:
             return 0
         uris, arrays = self._decode_batch(entries)
@@ -299,6 +308,7 @@ class ClusterServing:
                         0 if pending else poll_ms)
                     if not entries:
                         break
+                    self._inflight.update(i for i, _ in entries)
                     pending.append((pool.submit(self._decode_batch,
                                                 entries), time.time(),
                                     entries))
@@ -307,6 +317,8 @@ class ClusterServing:
                     uris, arrays = fut.result()
                     self._predict_write(uris, arrays, t_arrival)
                     self._ack(entries)
+                    self._inflight.difference_update(
+                        i for i, _ in entries)
                     if self.summary is not None and self.latencies:
                         s = self.stats()
                         self.summary.add_scalar(
@@ -325,6 +337,8 @@ class ClusterServing:
                         uris, arrays = fut.result()
                         self._predict_write(uris, arrays, t_arrival)
                         self._ack(entries)
+                        self._inflight.difference_update(
+                            i for i, _ in entries)
                     break
         finally:
             pool.shutdown(wait=False)
