@@ -48,3 +48,28 @@ class FnPreprocessing(Preprocessing):
 
     def apply(self, sample):
         return self.fn(sample)
+
+
+class SplitColumns(Preprocessing):
+    """Split a packed ``(n, sum(sizes))`` feature matrix into a LIST of
+    ``(n, size_i)`` blocks — the bridge from a single DataFrame
+    ``features`` column to a multi-input model (the reference packs
+    WideAndDeep features into one assembled vector the same way,
+    models/recommendation/Utils.scala:325)."""
+
+    def __init__(self, sizes):
+        self.sizes = [int(s) for s in sizes]
+
+    def apply(self, sample):
+        import numpy as np
+        m = np.asarray(sample)
+        if sum(self.sizes) != m.shape[-1]:
+            raise ValueError(
+                f"SplitColumns sizes {self.sizes} sum to "
+                f"{sum(self.sizes)} but the packed matrix has "
+                f"{m.shape[-1]} columns")
+        out, lo = [], 0
+        for s in self.sizes:
+            out.append(m[..., lo:lo + s])
+            lo += s
+        return out

@@ -70,6 +70,19 @@ def _col_to_array(series) -> np.ndarray:
     return series.to_numpy()
 
 
+def _coerce_features(x, preprocessing):
+    """Apply the feature preprocessing and coerce to model input(s).
+    A preprocessing may split the feature column into a LIST of model
+    inputs (multi-input models, e.g. WideAndDeep's [wide_indices,
+    embed_ids, continuous]) — shared by the fit and transform paths so
+    their coercion can never diverge."""
+    if preprocessing is not None:
+        x = preprocessing(x)
+    if isinstance(x, (list, tuple)):
+        return [np.asarray(a, np.float32) for a in x]
+    return np.asarray(x, np.float32)
+
+
 class NNEstimator:
     def __init__(self, model, criterion,
                  feature_preprocessing: Optional[Preprocessing] = None,
@@ -89,6 +102,7 @@ class NNEstimator:
         self.validation = None          # (trigger, df, methods, batch)
         self._clip = None
         self._tb = None
+        self.fitted_estimator = None    # set by fit(); per-epoch history
 
     # ----------------------------------------------- Spark-ML-style setters
     def set_features_col(self, name):
@@ -157,10 +171,9 @@ class NNEstimator:
 
     # ------------------------------------------------------------------ fit
     def _extract(self, df, with_label: bool = True):
-        x = _col_to_array(df[self.features_col])
-        if self.feature_preprocessing is not None:
-            x = self.feature_preprocessing(x)
-        x = np.asarray(x, np.float32)
+        x = _coerce_features(
+            _col_to_array(df[self.features_col]),
+            self.feature_preprocessing)
         y = None
         if with_label and self.label_col in df.columns:
             y = _col_to_array(df[self.label_col])
@@ -195,6 +208,10 @@ class NNEstimator:
                   checkpoint_trigger=EveryEpoch(),
                   validation_set=val_set, validation_method=val_methods,
                   batch_size=self.batch_size)
+        # the trained Estimator (per-epoch history, summaries) stays
+        # inspectable, like the Spark-ML model keeping its training
+        # summary
+        self.fitted_estimator = est
         return self._make_model()
 
     def _make_model(self) -> "NNModel":
@@ -276,12 +293,13 @@ class NNModel:
 
     setBatchSize = set_batch_size
 
+    def _extract_features(self, df):
+        return _coerce_features(_col_to_array(df[self.features_col]),
+                                self.feature_preprocessing)
+
     def transform(self, df):
-        x = _col_to_array(df[self.features_col])
-        if self.feature_preprocessing is not None:
-            x = self.feature_preprocessing(x)
         out = np.asarray(self.model.predict(
-            np.asarray(x, np.float32), batch_size=self.batch_size))
+            self._extract_features(df), batch_size=self.batch_size))
         result = df.copy()
         result[self.prediction_col] = list(out)
         return result
@@ -329,11 +347,8 @@ class NNClassifier(NNEstimator):
 
 class NNClassifierModel(NNModel):
     def transform(self, df):
-        x = _col_to_array(df[self.features_col])
-        if self.feature_preprocessing is not None:
-            x = self.feature_preprocessing(x)
         out = np.asarray(self.model.predict(
-            np.asarray(x, np.float32), batch_size=self.batch_size))
+            self._extract_features(df), batch_size=self.batch_size))
         result = df.copy()
         result[self.prediction_col] = np.argmax(out, axis=-1).astype(
             np.int64)

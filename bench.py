@@ -15,6 +15,13 @@ records it):
   faster of the two.
 * ``resnet50`` — ResNet-50 synthetic-ImageNet training throughput
   (BASELINE.md config 3; ref examples/resnet/TrainImageNet.scala).
+* ``wide_deep`` — Wide&Deep on Census-style columns through the
+  NNFrames estimator (BASELINE.md config 2; ref NNEstimator.scala:198).
+* ``inception`` — Inception-v1 defined in tf.keras, converted by the
+  TFPark adapter, trained by the distributed engine (BASELINE.md
+  config 4; ref examples/inception/Train.scala over tfpark).
+* ``serving`` / ``attention`` — cluster-serving throughput (config 5)
+  and the Pallas flash-attention long-context kernel.
 
 Prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline", ...}``
 on success, or a diagnostic JSON line (``"error"`` key, value 0) on
@@ -230,6 +237,22 @@ def bench_resnet50():
     return run_resnet_bench(jax.devices()[0])
 
 
+# --------------------------------------------------------------- wide_deep
+def bench_wide_deep():
+    import jax
+
+    from analytics_zoo_tpu.benchmarks.wide_deep import run_wide_deep_bench
+    return run_wide_deep_bench(jax.devices()[0])
+
+
+# --------------------------------------------------------------- inception
+def bench_inception():
+    import jax
+
+    from analytics_zoo_tpu.benchmarks.inception import run_inception_bench
+    return run_inception_bench(jax.devices()[0])
+
+
 # --------------------------------------------------------------- attention
 def bench_attention(seq_len: int = 4096, batch: int = 4, heads: int = 8,
                     head_dim: int = 128, repeats: int = 5):
@@ -413,6 +436,8 @@ WORKLOADS = {
     "resnet50": bench_resnet50,
     "serving": bench_serving,
     "attention": bench_attention,
+    "wide_deep": bench_wide_deep,
+    "inception": bench_inception,
 }
 
 # keep failure-path metric names identical to the success paths so a
@@ -422,6 +447,8 @@ METRIC_NAMES = {
     "resnet50": "resnet50_imagenet_train_throughput",
     "serving": "cluster_serving_throughput",
     "attention": "flash_attention_tokens_per_sec",
+    "wide_deep": "wide_deep_census_train_throughput",
+    "inception": "inception_v1_tfpark_train_throughput",
 }
 
 

@@ -51,6 +51,43 @@ class TestNNFrames:
         acc = float(np.mean(out["prediction"].to_numpy() == y))
         assert acc > 0.85
 
+    def test_multi_input_model_via_split_columns(self):
+        """A packed features column + SplitColumns preprocessing feeds
+        a multi-input model (WideAndDeep's NNFrames path — BASELINE.md
+        config 2)."""
+        from analytics_zoo_tpu.feature.common import SplitColumns
+        from analytics_zoo_tpu.models.recommendation import (
+            ColumnFeatureInfo, WideAndDeep)
+
+        info = ColumnFeatureInfo(
+            wide_base_cols=["g", "a"], wide_base_dims=[3, 5],
+            embed_cols=["o"], embed_in_dims=[7], embed_out_dims=[4],
+            continuous_cols=["h"])
+        rs = np.random.RandomState(0)
+        n = 256
+        cols = {"g": rs.randint(0, 3, n), "a": rs.randint(0, 5, n),
+                "o": rs.randint(0, 7, n),
+                "h": rs.rand(n).astype(np.float32)}
+        y = ((cols["g"] == 1) | (cols["h"] > 0.6)).astype(np.int64)
+
+        wd = WideAndDeep(2, info)
+        feats = wd.features_from_columns(cols)
+        sizes = [f.shape[1] for f in feats]
+        packed = np.concatenate(
+            [f.astype(np.float32) for f in feats], axis=1)
+        df = pd.DataFrame({"features": list(packed), "label": y})
+
+        clf = (NNClassifier(wd.model,
+                            "sparse_categorical_crossentropy_with_logits",
+                            feature_preprocessing=SplitColumns(sizes))
+               .set_batch_size(64).set_max_epoch(12)
+               .set_optim_method(Adam(lr=0.05)))
+        m = clf.fit(df)
+        assert clf.fitted_estimator.history   # per-epoch records kept
+        out = m.transform(df)
+        acc = float(np.mean(out["prediction"].to_numpy() == y))
+        assert acc > 0.8, acc
+
     def test_custom_column_names(self):
         df, x, y = make_df(n=64)
         df = df.rename(columns={"features": "f", "label": "l"})
