@@ -29,20 +29,34 @@ from analytics_zoo_tpu.feature.common import Preprocessing
 from analytics_zoo_tpu.feature.feature_set import FeatureSet
 
 
+def decode_image_bytes(data: bytes, to_rgb: bool = True,
+                       context: str = "") -> np.ndarray:
+    """Decode one encoded image (JPEG/PNG bytes) to HWC uint8 — the
+    per-record decode the reference ran on executors for byte-RDD
+    inputs (TFBytesDataset, serving ImageProcessing.scala:24).
+    ``context`` names the source (path / record id) in decode errors."""
+    what = f"image {context}" if context else "image bytes"
+    if _HAS_CV2:
+        img = cv2.imdecode(np.frombuffer(data, np.uint8),
+                           cv2.IMREAD_COLOR)
+        if img is None:
+            raise IOError(f"cannot decode {what}")
+        return cv2.cvtColor(img, cv2.COLOR_BGR2RGB) if to_rgb else img
+    import io                        # pragma: no cover
+    from PIL import Image
+    try:
+        rgb = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+    except Exception as e:
+        raise IOError(f"cannot decode {what}") from e
+    return rgb if to_rgb else rgb[..., ::-1]
+
+
 def read_image(path: str, to_rgb: bool = True) -> np.ndarray:
     """Decode one image file (local or remote URI) to HWC uint8."""
     from analytics_zoo_tpu.utils import file_io
     if file_io.is_remote(path):
-        data = file_io.read_bytes(path)
-        if _HAS_CV2:
-            img = cv2.imdecode(np.frombuffer(data, np.uint8),
-                               cv2.IMREAD_COLOR)
-            if img is None:
-                raise IOError(f"cannot decode image {path}")
-            return cv2.cvtColor(img, cv2.COLOR_BGR2RGB) if to_rgb else img
-        import io                    # pragma: no cover
-        from PIL import Image
-        return np.asarray(Image.open(io.BytesIO(data)).convert("RGB"))
+        return decode_image_bytes(file_io.read_bytes(path), to_rgb,
+                                  context=path)
     if _HAS_CV2:
         img = cv2.imread(path, cv2.IMREAD_COLOR)
         if img is None:

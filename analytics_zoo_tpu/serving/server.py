@@ -42,10 +42,11 @@ def decode_field(fields: Dict[str, bytes]):
     uri = fields["uri"].decode() if isinstance(fields["uri"], bytes) \
         else fields["uri"]
     if "image" in fields:
-        import cv2
+        from analytics_zoo_tpu.feature.image import decode_image_bytes
         raw = base64.b64decode(fields["image"])
-        img = cv2.imdecode(np.frombuffer(raw, np.uint8),
-                           cv2.IMREAD_COLOR)
+        # serving consumes BGR, matching the reference's OpenCV path
+        # (ImageProcessing.scala:24)
+        img = decode_image_bytes(raw, to_rgb=False, context=uri)
         return uri, img.astype(np.float32)
     raw = base64.b64decode(fields["data"])
     import io

@@ -136,10 +136,63 @@ class TFDataset:
                    batch_size, batch_per_thread)
 
     @classmethod
+    def from_bytes(cls, records, labels=None, transform=None,
+                   batch_size: int = -1,
+                   batch_per_thread: int = -1) -> "TFDataset":
+        """Encoded image bytes → decoded dataset (the in-process form
+        of the reference's TFBytesDataset, tf_dataset.py:826: a byte
+        RDD of JPEGs decoded per executor).
+
+        ``transform``: optional ``Preprocessing`` applied per decoded
+        HWC uint8 image (resize/normalize/...); without one, all
+        images must already share a shape.
+        """
+        from analytics_zoo_tpu.feature.image import decode_image_bytes
+        imgs = []
+        for i, rec in enumerate(records):
+            img = decode_image_bytes(rec, context=f"record {i}")
+            if transform is not None:
+                img = transform(img)
+            imgs.append(np.asarray(img))
+        x = np.stack(imgs)
+        y = None
+        if labels is not None:
+            y = np.asarray(labels)
+            if y.ndim == 1:
+                y = y[:, None]
+        return cls(FeatureSet.from_ndarrays(x, y),
+                   batch_size, batch_per_thread)
+
+    @classmethod
+    def from_strings(cls, texts, labels=None, word_index=None,
+                     sequence_length: int = 128,
+                     max_words_num: int = -1,
+                     shuffle: bool = True,
+                     batch_size: int = -1,
+                     batch_per_thread: int = -1) -> "TFDataset":
+        """Raw strings → tokenize → word2idx → pad → dataset (the
+        in-process form of the reference's TFTextDataset,
+        tf_dataset.py:876: a string RDD run through TextSet stages).
+
+        Returns the dataset; the fitted ``word_index`` is available as
+        ``ds.word_index`` for inference-time reuse (pass it back in).
+        """
+        from analytics_zoo_tpu.feature.text import TextSet
+        ts = (TextSet.from_texts(list(texts), labels).tokenize()
+              .word2idx(max_words_num=max_words_num,
+                        existing_map=word_index)
+              .shape_sequence(sequence_length))
+        ds = cls(ts.to_feature_set(shuffle=shuffle),
+                 batch_size, batch_per_thread)
+        ds.word_index = ts.word_index
+        return ds
+
+    @classmethod
     def from_string_rdd(cls, *a, **kw):
         raise NotImplementedError(
             "RDD sources require the Spark-bridge deployment; use "
-            "from_ndarrays / from_tf_data_dataset / from_feature_set")
+            "from_strings / from_bytes / from_ndarrays / "
+            "from_tf_data_dataset / from_feature_set")
 
     from_rdd = from_string_rdd
     from_bytes_rdd = from_string_rdd

@@ -96,6 +96,37 @@ class TestNewFactories:
         ds = TFDataset.from_text_set(ts, batch_size=2)
         assert ds.feature_set.size == 3
 
+    def test_from_bytes_decodes_images(self):
+        import cv2
+        from analytics_zoo_tpu.feature.image import ImageResize
+        rs = np.random.RandomState(0)
+        recs = []
+        for i in range(6):   # varying sizes: the transform unifies them
+            ok, enc = cv2.imencode(
+                ".jpg", (rs.rand(20 + i, 16, 3) * 255).astype(np.uint8))
+            recs.append(enc.tobytes())
+        ds = TFDataset.from_bytes(recs, labels=np.arange(6) % 2,
+                                  transform=ImageResize(16, 16),
+                                  batch_size=2)
+        xb, yb = next(ds.feature_set.epoch_batches(0, 2))
+        assert xb.shape == (2, 16, 16, 3) and yb.shape == (2, 1)
+
+    def test_from_strings_tokenizes_and_reuses_index(self):
+        ds = TFDataset.from_strings(
+            ["the cat sat", "a dog ran fast", "the dog sat"],
+            labels=[0, 1, 0], sequence_length=5, batch_size=2)
+        xb, yb = next(ds.feature_set.epoch_batches(0, 2))
+        assert xb.shape == (2, 5) and yb.shape == (2, 1)
+        assert ds.word_index
+        # inference-time reuse of the fitted vocabulary
+        ds2 = TFDataset.from_strings(["the cat ran"],
+                                     word_index=ds.word_index,
+                                     sequence_length=5,
+                                     batch_per_thread=1)
+        assert ds2.word_index == ds.word_index
+        x2 = next(ds2.feature_set.epoch_batches(0, 1, train=False))[0]
+        assert x2.shape == (1, 5)
+
     def test_from_torch_dataloader(self):
         import torch
         from torch.utils.data import DataLoader, TensorDataset
