@@ -1,0 +1,131 @@
+"""Streaming text classification (reference
+examples/streaming/textclassification: a Spark Streaming job reads
+lines off a socket stream and classifies each micro-batch with the
+TextClassifier).
+
+TPU retelling: raw sentences are tokenized with a vocabulary fitted at
+training time (``TFDataset.from_strings``' word_index), streamed
+through the broker as index arrays, and served by the pipelined
+Cluster Serving engine — the generic ``data`` record path, no
+image-specific code.
+
+Run: ``python examples/streaming/streaming_text_classification.py``
+"""
+
+import argparse
+import os
+import sys
+import threading
+import time
+
+_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import numpy as np
+
+GOOD = ["great fun wonderful fine superb lovely good happy",
+        "excellent amazing brilliant delightful good charming"]
+BAD = ["awful terrible dreadful poor bad sad gloomy",
+       "horrible disappointing miserable bad boring broken"]
+
+
+def make_sentences(n, seed=0):
+    rs = np.random.RandomState(seed)
+    texts, labels = [], []
+    for _ in range(n):
+        y = rs.randint(0, 2)
+        pool = (GOOD if y else BAD)[rs.randint(0, 2)].split()
+        texts.append(" ".join(rs.choice(pool, 6)))
+        labels.append(y)
+    return texts, np.asarray(labels)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--train-rows", type=int, default=512)
+    p.add_argument("--stream-rows", type=int, default=64)
+    p.add_argument("--seq-len", type=int, default=10)
+    p.add_argument("--epochs", type=int, default=6)
+    p.add_argument("--smoke", action="store_true")
+    args = p.parse_args(argv)
+    if args.smoke:
+        args.train_rows, args.stream_rows, args.epochs = 256, 24, 4
+
+    from analytics_zoo_tpu.models.textclassification import TextClassifier
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+    from analytics_zoo_tpu.serving.redis_client import EmbeddedBroker
+    from analytics_zoo_tpu.serving.server import (ClusterServing,
+                                                  ServingConfig)
+    from analytics_zoo_tpu.tfpark.tf_dataset import TFDataset
+
+    # --- train the classifier; the dataset fits the vocabulary --------
+    texts, labels = make_sentences(args.train_rows)
+    ds = TFDataset.from_strings(texts, labels,
+                                sequence_length=args.seq_len,
+                                batch_size=64)
+    vocab = len(ds.word_index) + 1
+    clf = TextClassifier(class_num=2, token_length=16,
+                         sequence_length=args.seq_len,
+                         max_words_num=vocab, encoder="cnn")
+    clf.compile(optimizer=Adam(lr=1e-2),
+                loss="sparse_categorical_crossentropy_with_logits",
+                metrics=["accuracy"])
+    clf.fit(ds.feature_set, batch_size=64, nb_epoch=args.epochs)
+
+    # --- stream raw sentences through the serving engine --------------
+    broker = EmbeddedBroker()
+    im = InferenceModel().load_zoo(clf.model)
+    serving = ClusterServing(im, ServingConfig(batch_size=8, top_n=1),
+                             broker=broker)
+    worker = serving.start_background()
+
+    stream_texts, stream_labels = make_sentences(args.stream_rows,
+                                                 seed=9)
+    inq = InputQueue(broker=broker)
+
+    def producer():
+        # tokenise each line with the FITTED vocabulary (word_index
+        # reuse — the socket-stream preprocessing of the reference)
+        tok = TFDataset.from_strings(stream_texts,
+                                     word_index=ds.word_index,
+                                     sequence_length=args.seq_len,
+                                     shuffle=False, batch_per_thread=1)
+        x = next(tok.feature_set.epoch_batches(
+            0, len(stream_texts), train=False))[0]
+        for i, row in enumerate(x):
+            inq.enqueue(f"line-{i}", row.astype(np.float32))
+            time.sleep(0.002)
+
+    t = threading.Thread(target=producer)
+    t.start()          # produce concurrently with the serving drain
+
+    outq = OutputQueue(broker=broker)
+    correct = served = 0
+    deadline = time.time() + 60
+    for i in range(args.stream_rows):
+        res = None
+        while res is None and time.time() < deadline:
+            res = outq.query(f"line-{i}", timeout_s=5.0)
+        if res is None:
+            continue
+        served += 1
+        pred = res[0][0] if isinstance(res, list) else res
+        correct += int(int(pred) == int(stream_labels[i]))
+    t.join()
+    serving.stop()
+    worker.join(timeout=10)
+
+    acc = correct / max(served, 1)
+    print(f"[streaming-text] served {served}/{args.stream_rows} lines, "
+          f"accuracy {acc:.2f}")
+    assert served >= args.stream_rows * 0.9, served
+    assert acc > 0.7, acc
+    return {"served": served, "accuracy": acc}
+
+
+if __name__ == "__main__":
+    sys.exit(0 if main() else 1)

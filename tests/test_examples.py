@@ -25,6 +25,8 @@ EXAMPLES = [
     "examples.objectdetection.ssd_example",
     "examples.inception.train_inception",
     "examples.distributed.pipeline_moe_example",
+    "examples.streaming.streaming_object_detection",
+    "examples.streaming.streaming_text_classification",
 ]
 
 
