@@ -32,12 +32,17 @@ class ImageModel(ZooModel):
         self.config = config or ImageConfigure()
         super().__init__()
 
+    def _materialize_image_set(self, image_set, cfg: ImageConfigure
+                               ) -> np.ndarray:
+        """Shared preprocess → stacked batch step of predictImageSet."""
+        if cfg.preprocessor is not None:
+            image_set = image_set.transform(cfg.preprocessor)
+        return np.stack(image_set.images).astype(np.float32)
+
     def predict_image_set(self, image_set, configure: Optional[
             ImageConfigure] = None, batch_size: int = 32):
         cfg = configure or self.config
-        if cfg.preprocessor is not None:
-            image_set = image_set.transform(cfg.preprocessor)
-        x = np.stack(image_set.images).astype(np.float32)
+        x = self._materialize_image_set(image_set, cfg)
         out = self.predict(x, batch_size=batch_size)
         if cfg.postprocessor is not None:
             out = cfg.postprocessor(out)

@@ -14,9 +14,12 @@ from analytics_zoo_tpu.models.image.objectdetection.ssd import (
 from analytics_zoo_tpu.models.image.objectdetection.evaluation import (
     MeanAveragePrecision,
 )
+from analytics_zoo_tpu.models.image.objectdetection.detector import (
+    ObjectDetector,
+)
 
 __all__ = [
     "decode_boxes", "encode_boxes", "iou_matrix", "nms", "ssd_priors",
     "MultiBoxLoss", "match_priors", "SSDDetector", "ssd_lite",
-    "ssd_vgg300", "MeanAveragePrecision",
+    "ssd_vgg300", "MeanAveragePrecision", "ObjectDetector",
 ]

@@ -403,3 +403,64 @@ class TestLazyAugmentation:
         # source samples are untouched (lazy chain copies)
         np.testing.assert_array_equal(
             samples[0]["boxes"], np.array([[2, 2, 10, 10]], np.float32))
+
+
+class TestObjectDetectorFacade:
+    """ObjectDetector: the loadModel/predictImageSet facade
+    (ref ObjectDetector.scala)."""
+
+    def test_save_load_roundtrip_preserves_detections(self, tmp_path):
+        from analytics_zoo_tpu.models.image.objectdetection import (
+            ObjectDetector)
+        det = ObjectDetector("ssd_lite", num_classes=3, image_size=32,
+                             score_threshold=0.0,
+                             label_map={"bg": 0, "cat": 1, "dog": 2})
+        rs = np.random.RandomState(0)
+        imgs = rs.rand(2, 32, 32, 3).astype(np.float32)
+        before = det.detect(imgs)
+
+        path = str(tmp_path / "det.zoomodel")
+        det.save_model(path)
+        # building another model first shifts the layer auto-names —
+        # load must still match the saved tree (positional fallback)
+        ObjectDetector("ssd_lite", num_classes=3, image_size=32)
+        loaded = ObjectDetector.load_model(path)
+        assert loaded.config.label_map == {"bg": 0, "cat": 1, "dog": 2}
+        after = loaded.detect(imgs)
+        for (b0, s0, l0), (b1, s1, l1) in zip(before, after):
+            np.testing.assert_allclose(b0, b1, atol=1e-5)
+            np.testing.assert_allclose(s0, s1, atol=1e-5)
+            np.testing.assert_array_equal(l0, l1)
+
+    def test_wrong_architecture_rejected(self, tmp_path):
+        from analytics_zoo_tpu.models.image.objectdetection import (
+            ObjectDetector)
+        det = ObjectDetector("ssd_lite", num_classes=3, image_size=32)
+        path = str(tmp_path / "det.zoomodel")
+        det.save_model(path)
+        import json
+        from flax import serialization as fser
+        with open(path, "rb") as f:
+            payload = fser.msgpack_restore(f.read())
+        meta = json.loads(payload["meta"])
+        meta["num_classes"] = 7               # architecture mismatch
+        payload["meta"] = json.dumps(meta)
+        with open(path, "wb") as f:
+            f.write(fser.to_bytes(payload))
+        with pytest.raises(ValueError, match="does not match"):
+            ObjectDetector.load_model(path)
+
+    def test_predict_image_set_and_visualize(self):
+        from analytics_zoo_tpu.feature.image import ImageSet
+        from analytics_zoo_tpu.models.image.objectdetection import (
+            ObjectDetector)
+        det = ObjectDetector("ssd_lite", num_classes=2, image_size=32,
+                             score_threshold=0.0)
+        rs = np.random.RandomState(1)
+        imgs = rs.rand(3, 32, 32, 3).astype(np.float32)
+        s = ImageSet.from_ndarrays(imgs, np.zeros(3))
+        results = det.predict_image_set(s, batch_size=2)
+        assert len(results) == 3
+        boxes, scores, labels = results[0]
+        drawn = det.visualize(imgs[0], boxes, scores, labels)
+        assert drawn.shape == imgs[0].shape
