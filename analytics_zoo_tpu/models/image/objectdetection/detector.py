@@ -95,7 +95,13 @@ class ObjectDetector(ImageModel):
         x = self._materialize_image_set(image_set, cfg)
         out = []
         for lo in range(0, len(x), batch_size):
-            out.extend(self.detect(x[lo:lo + batch_size]))
+            chunk = x[lo:lo + batch_size]
+            real = len(chunk)
+            if real < batch_size:    # pad: ONE compiled program serves
+                chunk = np.concatenate(   # every tail size
+                    [chunk, np.zeros((batch_size - real,)
+                                     + chunk.shape[1:], chunk.dtype)])
+            out.extend(self.detect(chunk)[:real])
         if cfg.postprocessor is not None:
             out = [cfg.postprocessor(o) for o in out]
         return out
@@ -115,7 +121,10 @@ class ObjectDetector(ImageModel):
         img = np.asarray(image)
         if img.dtype != np.uint8:    # drawing needs a uint8 canvas
             hi = float(img.max()) if img.size else 1.0
-            img = (img * (255.0 / hi if hi > 0 else 1.0))
+            # [0,1]-normalised floats scale up; 0..255 floats just clip
+            # (a ratio-based stretch would distort appearance)
+            if hi <= 1.0:
+                img = img * 255.0
             img = np.clip(img, 0, 255).astype(np.uint8)
         img = np.ascontiguousarray(img)
         h, w = img.shape[:2]
@@ -192,6 +201,13 @@ class ObjectDetector(ImageModel):
         det = cls(label_map=label_map, **meta)
         like = det.model.get_variables()
         order = [l.name for l in det.model.layers]
+        indices = [int(key.split("_")[-1])
+                   for tree in payload["variables"].values()
+                   for key in tree]
+        if any(i >= len(order) for i in indices):
+            raise ValueError(
+                f"{path}: saved detector does not match the rebuilt "
+                f"{meta['model_type']} architecture (extra layers)")
         restored = {
             kind: {order[int(key.split("_")[-1])]: sub
                    for key, sub in tree.items()}

@@ -41,12 +41,27 @@ def main(argv=None):
                            batches))
     print(f"{len(outs)} concurrent batches -> {outs[0].shape}")
 
-    # int8 weight-only quantization (the OpenVINO calibration role)
+    # int8 weight-only quantization (the vnni/bigdl local-quant role)
     q = InferenceModel().load_zoo(model, quantize=True)
     f32 = im.predict(batches[0], batch_size=16)
     i8 = q.predict(batches[0], batch_size=16)
     rel = np.abs(i8 - f32).max() / (np.abs(f32).max() + 1e-9)
-    print(f"int8 vs f32 max relative error: {rel:.4f}")
+    print(f"int8 weight-only vs f32 max relative error: {rel:.4f}")
+
+    # calibrated activation quantization: feed a representative set,
+    # record per-layer activation ranges, run int8 x int8 matmuls
+    # (the OpenVINO calibration role, InferenceModel.scala:400-421)
+    calib = rs.rand(64, 28, 28, 1).astype(np.float32)
+    qc = InferenceModel().load_zoo(model, quantize="calibrated",
+                                   calib_set=calib)
+    i8c = qc.predict(batches[0], batch_size=16)
+    # the quality gate the reference touts (<0.1% acc drop): top-1
+    # agreement between calibrated-int8 and f32 predictions
+    agree = float((np.argmax(i8c, -1) == np.argmax(f32, -1)).mean())
+    rel_c = np.abs(i8c - f32).max() / (np.abs(f32).max() + 1e-9)
+    print(f"calibrated int8 vs f32: max rel err {rel_c:.4f}, "
+          f"top-1 agreement {agree:.3f}")
+    assert agree >= 0.9, agree
     return rel
 
 
