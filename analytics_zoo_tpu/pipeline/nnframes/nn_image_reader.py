@@ -16,7 +16,7 @@ def read_images(path: str, pattern: str = "*.jpg",
                 resize_h: Optional[int] = None,
                 resize_w: Optional[int] = None):
     """Return a pandas DataFrame with columns [origin, height, width,
-    n_channels, data] — the NNImageSchema row shape."""
+    n_channels, mode, data] — the NNImageSchema row shape."""
     import pandas as pd
 
     from analytics_zoo_tpu.feature.image import ImageResize, read_image
@@ -43,6 +43,9 @@ def read_images(path: str, pattern: str = "*.jpg",
             "height": img.shape[0],
             "width": img.shape[1],
             "n_channels": img.shape[2],
+            # NNImageSchema `mode`: OpenCV type code of the STORED
+            # buffer — data is float32 HWC, i.e. CV_32FC3
+            "mode": 21,
             "data": img.astype(np.float32),
         })
     return pd.DataFrame(rows)
