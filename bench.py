@@ -482,8 +482,12 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="all",
                     choices=sorted(WORKLOADS) + ["all"])
-    ap.add_argument("--retries", type=int, default=3)
-    ap.add_argument("--retry-wait", type=float, default=15.0)
+    # a tunneled backend can disappear for MINUTES at a time (observed
+    # round 3) — retry long enough to ride out a transient blip without
+    # stalling a dead backend forever (fast-fail ~4 min of waits;
+    # hang-every-probe worst case ~16 min: 6x120s probes + 5x45s waits)
+    ap.add_argument("--retries", type=int, default=6)
+    ap.add_argument("--retry-wait", type=float, default=45.0)
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--run-timeout", type=float, default=900.0)
     ap.add_argument("--child", action="store_true",
@@ -527,6 +531,13 @@ def main(argv=None):
     rc = 0
     for name in names:
         result, err = _run_child(name, args.run_timeout)
+        if result is None or result.get("error"):
+            # one more chance after a pause: a mid-bench backend blip
+            # (hang OR crash) should not zero this workload's number
+            time.sleep(30)
+            retry_result, retry_err = _run_child(name, args.run_timeout)
+            if retry_result is not None and not retry_result.get("error"):
+                result, err = retry_result, retry_err
         if result is None:
             result = dict(diag_for(name), error="workload run failed",
                           error_tail=err)
