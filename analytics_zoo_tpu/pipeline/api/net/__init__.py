@@ -1,5 +1,6 @@
-from analytics_zoo_tpu.pipeline.api.net.torch_net import TorchNet
+from analytics_zoo_tpu.pipeline.api.net.torch_net import (TorchCriterion,
+                                                          TorchNet)
 from analytics_zoo_tpu.pipeline.api.net.tf_net import TFNet
 from analytics_zoo_tpu.pipeline.api.net.net import Net
 
-__all__ = ["TorchNet", "TFNet", "Net"]
+__all__ = ["TorchNet", "TorchCriterion", "TFNet", "Net"]

@@ -38,6 +38,25 @@ def _pair(v):
     return (v, v) if isinstance(v, int) else tuple(v)
 
 
+def _make_loss(elem_fn):
+    """jnp version of an elementwise-residual torch loss functional."""
+    def loss(a, b, reduction="mean", **legacy):
+        bad = {k: v for k, v in legacy.items() if v is not None}
+        if bad:
+            raise NotImplementedError(
+                f"TorchCriterion: unsupported loss kwargs {sorted(bad)}")
+        r = elem_fn(a - b)
+        if reduction == "mean":
+            return jnp.mean(r)
+        if reduction == "sum":
+            return jnp.sum(r)
+        if reduction == "none":
+            return r
+        raise NotImplementedError(
+            f"TorchCriterion: unsupported reduction {reduction!r}")
+    return loss
+
+
 class _Emitter:
     """Evaluate an fx graph with jnp semantics (NCHW preserved: torch
     convention kept inside the subgraph; XLA re-layouts for TPU)."""
@@ -166,6 +185,16 @@ class _Emitter:
             F.softmax: lambda a, dim=-1: jax.nn.softmax(a, axis=dim),
             F.log_softmax: lambda a, dim=-1:
                 jax.nn.log_softmax(a, axis=dim),
+            # losses (TorchCriterion path); extra kwargs are torch's
+            # deprecated legacy aliases (size_average/reduce/weight),
+            # traced through as None and ignored when unset
+            F.mse_loss: _make_loss(jnp.square),
+            F.l1_loss: _make_loss(jnp.abs),
+            torch.abs: jnp.abs, torch.square: jnp.square,
+            torch.pow: jnp.power, operator.pow: jnp.power,
+            torch.exp: jnp.exp, torch.log: jnp.log,
+            torch.clamp: lambda a, min=None, max=None:
+                jnp.clip(a, min, max),
             F.avg_pool2d: None,  # routed below
         }
         if fn in table and table[fn] is not None:
@@ -295,3 +324,32 @@ class TorchNet(Layer):
              for k, v in self._initial_params.items()},
             jax.ShapeDtypeStruct(concrete, jnp.float32))
         return (None,) + tuple(out.shape[1:])
+
+
+class TorchCriterion:
+    """A torch loss module as a zoo Objective: ``loss(y_true, y_pred)``.
+
+    Reference: pipeline/api/net/TorchCriterion.scala + pyzoo
+    torch_criterion.py — there the loss ran inside libtorch over JNI
+    each iteration; here it is fx-traced ONCE into jnp ops and compiles
+    into the jitted train step with the rest of the program.
+
+    The torch convention is ``forward(input, target)``; the zoo loss
+    convention is ``(y_true, y_pred)`` — the adapter swaps them.
+    """
+
+    def __init__(self, torch_module):
+        import torch.fx
+        self.gm = torch.fx.symbolic_trace(torch_module.eval())
+        self._params = TorchNet._extract_params(torch_module)
+        self._emitter = _Emitter(self.gm, self._params)
+        # objectives.get reads __name__ for the Objective label
+        self.name = self.__name__ = type(torch_module).__name__
+
+    @classmethod
+    def from_pytorch(cls, criterion) -> "TorchCriterion":
+        return cls(criterion)
+
+    def __call__(self, y_true, y_pred):
+        out = self._emitter.run(self._params, [y_pred, y_true])
+        return jnp.mean(out)   # scalarise any per-element remainder
