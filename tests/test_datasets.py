@@ -1,0 +1,106 @@
+"""Bundled dataset loaders (ref pyzoo keras/datasets/) — shapes,
+determinism, and learnability of the synthetic fallbacks."""
+
+import numpy as np
+
+from analytics_zoo_tpu.pipeline.api.keras import Sequential
+from analytics_zoo_tpu.pipeline.api.keras.datasets import (
+    boston_housing, imdb, mnist, reuters)
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Dense, Embedding, Flatten, GlobalAveragePooling1D)
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+
+def _pad(seqs, maxlen):
+    out = np.zeros((len(seqs), maxlen), np.int32)
+    for i, s in enumerate(seqs):
+        t = s[:maxlen]
+        out[i, :len(t)] = t
+    return out
+
+
+class TestDatasets:
+    def test_mnist_shapes_and_learnable(self):
+        (xtr, ytr), (xte, yte) = mnist.load_data(n_train=1500,
+                                                 n_test=300)
+        assert xtr.shape == (1500, 28, 28) and xtr.dtype == np.uint8
+        assert set(np.unique(ytr)) <= set(range(10))
+        m = Sequential()
+        m.add(Flatten(input_shape=(28, 28)))
+        m.add(Dense(64, activation="relu"))
+        m.add(Dense(10))
+        m.compile(optimizer=Adam(lr=1e-3),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+        m.fit(xtr.astype(np.float32) / 255.0, ytr[:, None],
+              batch_size=128, nb_epoch=6)
+        acc = m.evaluate(xte.astype(np.float32) / 255.0, yte[:, None],
+                         batch_size=128)["sparse_categorical_accuracy"]
+        assert acc > 0.5, acc
+
+    def test_mnist_deterministic(self):
+        a = mnist.load_data(n_train=64, n_test=16)
+        b = mnist.load_data(n_train=64, n_test=16)
+        np.testing.assert_array_equal(a[0][0], b[0][0])
+
+    def test_imdb_learnable(self):
+        (xtr, ytr), (xte, yte) = imdb.load_data(n_train=800, n_test=200)
+        x = _pad(xtr, 80)
+        xt = _pad(xte, 80)
+        m = Sequential()
+        m.add(Embedding(500, 16, input_shape=(80,)))
+        m.add(GlobalAveragePooling1D())
+        m.add(Dense(2))
+        m.compile(optimizer=Adam(lr=5e-3),
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+        m.fit(x, ytr[:, None], batch_size=128, nb_epoch=8)
+        acc = m.evaluate(xt, yte[:, None], batch_size=128)[
+            "sparse_categorical_accuracy"]
+        assert acc > 0.75, acc
+
+    def test_imdb_num_words_caps_vocab(self):
+        (xtr, _), _ = imdb.load_data(n_train=50, n_test=10,
+                                     num_words=100)
+        assert max(int(s.max()) for s in xtr) < 100
+
+    def test_boston_housing_regression(self):
+        (xtr, ytr), (xte, yte) = boston_housing.load_data()
+        assert xtr.shape == (404, 13) and yte.shape == (102,)
+        mu, sd = xtr.mean(0), xtr.std(0) + 1e-6
+        m = Sequential()
+        m.add(Dense(32, activation="relu", input_shape=(13,)))
+        m.add(Dense(1))
+        m.compile(optimizer=Adam(lr=1e-2), loss="mse")
+        hist = m.fit(((xtr - mu) / sd).astype(np.float32),
+                     ytr[:, None].astype(np.float32),
+                     batch_size=96, nb_epoch=30)
+        assert hist[-1]["loss"] < hist[0]["loss"] * 0.5
+
+    def test_reuters_topic_bands(self):
+        (xtr, ytr), _ = reuters.load_data(n_train=100, n_test=10)
+        assert len(xtr) == 100
+        assert set(np.unique(ytr)) <= set(range(46))
+        # topic band words present in each document
+        for s, label in zip(xtr[:10], ytr[:10]):
+            band = 10 + int(label) * 20
+            assert ((s >= band) & (s < band + 20)).sum() >= 3
+
+    def test_raw_keras_archive_convention(self, tmp_path):
+        """The raw Keras imdb.npz form (keys x/y, lists inside object
+        arrays) loads and splits like Keras does."""
+        x = np.asarray([[1, 5, 9], [1, 7], [1, 3, 4, 8], [1, 2],
+                        [1, 6, 6], [1, 9, 9, 9], [1, 4], [1, 8, 2],
+                        [1, 5], [1, 3]], dtype=object)
+        y = np.arange(10) % 2
+        p = str(tmp_path / "imdb.npz")
+        np.savez(p, x=np.asarray([list(map(int, s)) for s in x],
+                                 dtype=object), y=y)
+        (xtr, ytr), (xte, yte) = imdb.load_data(path=p, num_words=6)
+        assert len(xtr) == 8 and len(xte) == 2
+        assert max(int(np.asarray(s).max()) for s in xtr) < 6
+
+    def test_maxlen_guard(self):
+        import pytest
+        with pytest.raises(ValueError, match="maxlen"):
+            imdb.load_data(maxlen=5)
