@@ -514,3 +514,11 @@ class TestConsumerGroups:
         broker.xadd("serving_stream", {"uri": "new", "data": "x"})
         got = broker.xreadgroup("g", "c", "serving_stream")
         assert len(got) == 1 and got[0][1]["uri"] == b"new"
+
+
+def test_quick_start_self_contained():
+    """The serving quick-start demo (ref pyzoo serving/quick_start.py)
+    round-trips enqueue -> predict -> result with zero services."""
+    from analytics_zoo_tpu.serving.quick_start import main
+    result = main(["--smoke"])
+    assert result and len(result) == 3        # top-3 [class, prob]
