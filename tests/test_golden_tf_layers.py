@@ -1,0 +1,242 @@
+"""Broad golden layer harness vs tf.keras — the KerasRunner analogue
+(ref zoo/src/test/.../KerasRunner.scala:30: generate Keras code, run
+it, compare forward/backward).  Here tf.keras runs in-process: OUR
+initialized weights are copied into the tf layer, then forward outputs
+and input-gradients must agree.
+
+Complements tests/test_conv_layers.py (torch oracle for conv/pool) and
+tests/test_golden_rnn.py (recurrent/norm oracles): this file sweeps the
+wide non-recurrent middle of the catalog against a SECOND independent
+oracle.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from analytics_zoo_tpu.pipeline.api.keras import layers as L
+
+RNG = jax.random.PRNGKey(0)
+
+pytestmark = pytest.mark.slow   # TF-oracle comparisons: many jit compiles
+
+@pytest.fixture(autouse=True)
+def _f32_policy():
+    """Golden comparisons run full-f32: the default bf16 compute policy
+    would swamp the 1e-4 tolerances with quantization noise."""
+    from analytics_zoo_tpu.ops import dtypes
+    old = dtypes.get_policy()
+    dtypes.set_policy("float32", "float32")
+    yield
+    dtypes._policy = old
+
+
+def zoo_forward_and_grad(layer, x):
+    """Init + forward + d(sum(out))/dx; returns (params, out, gx)."""
+    v = layer.init(RNG, x.shape[1:])
+
+    def f(xx):
+        out, _ = layer.apply(v["params"], xx, state=v["state"],
+                             training=False)
+        return jnp.sum(out), out
+
+    # full-f32 matmuls for the comparison (JAX's default matmul
+    # precision on TPU-style paths is bf16-ish; tf.keras is f32)
+    with jax.default_matmul_precision("float32"):
+        (_, out), gx = jax.value_and_grad(f, has_aux=True)(
+            jnp.asarray(x))
+    return v, np.asarray(out), np.asarray(gx)
+
+
+def tf_forward_and_grad(tf_layer, x, weights):
+    xt = tf.constant(x)
+    _ = tf_layer(xt)                       # build
+    if weights:
+        tf_layer.set_weights(weights)
+    with tf.GradientTape() as tape:
+        tape.watch(xt)
+        out = tf_layer(xt, training=False)
+        s = tf.reduce_sum(out)
+    gx = tape.gradient(s, xt)
+    return out.numpy(), (None if gx is None else gx.numpy())
+
+
+def check(layer, tf_layer, x, weight_names=(), tol=1e-4,
+          grad_tol=1e-3):
+    v, out, gx = zoo_forward_and_grad(layer, x)
+    weights = [np.asarray(v["params"][n]) for n in weight_names]
+    ref, ref_gx = tf_forward_and_grad(tf_layer, x, weights)
+    np.testing.assert_allclose(out, ref, rtol=tol, atol=tol)
+    if ref_gx is not None:
+        np.testing.assert_allclose(gx, ref_gx, rtol=grad_tol,
+                                   atol=grad_tol)
+
+
+class TestGoldenCore:
+    def test_dense_relu(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(4, 7).astype(np.float32)
+        check(L.Dense(5, activation="relu"),
+              tf.keras.layers.Dense(5, activation="relu"), x,
+              ("kernel", "bias"))
+
+    def test_conv1d_same(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 10, 4).astype(np.float32)
+        check(L.Convolution1D(6, 3, border_mode="same"),
+              tf.keras.layers.Conv1D(6, 3, padding="same"), x,
+              ("kernel", "bias"))
+
+    def test_conv2d_valid_stride2(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 9, 9, 3).astype(np.float32)
+        check(L.Convolution2D(5, 3, 3, subsample=(2, 2)),
+              tf.keras.layers.Conv2D(5, 3, strides=2, padding="valid"),
+              x, ("kernel", "bias"))
+
+    def test_separable_conv2d(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 8, 8, 3).astype(np.float32)
+        layer = L.SeparableConvolution2D(6, 3, 3, border_mode="same")
+        v, out, gx = zoo_forward_and_grad(layer, x)
+        # our depthwise layout (kh,kw,1,in*mult) → tf (kh,kw,in,mult)
+        dw = np.asarray(v["params"]["depthwise_kernel"]).reshape(
+            3, 3, 3, 1)
+        tfl = tf.keras.layers.SeparableConv2D(6, 3, padding="same")
+        ref, ref_gx = tf_forward_and_grad(
+            tfl, x, [dw, np.asarray(v["params"]["pointwise_kernel"]),
+                     np.asarray(v["params"]["bias"])])
+        np.testing.assert_allclose(out, ref, rtol=5e-4, atol=5e-4)
+        np.testing.assert_allclose(gx, ref_gx, rtol=1e-3, atol=1e-3)
+
+    def test_atrous_conv2d(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 10, 10, 3).astype(np.float32)
+        check(L.AtrousConvolution2D(4, 3, 3, atrous_rate=(2, 2)),
+              tf.keras.layers.Conv2D(4, 3, dilation_rate=2,
+                                     padding="valid"),
+              x, ("kernel", "bias"))
+
+    def test_embedding(self):
+        rs = np.random.RandomState(0)
+        x = rs.randint(0, 11, (3, 6)).astype(np.int32)
+        layer = L.Embedding(11, 5)
+        v = layer.init(RNG, x.shape[1:])
+        out, _ = layer.apply(v["params"], x, state=v["state"])
+        tfl = tf.keras.layers.Embedding(11, 5)
+        _ = tfl(tf.constant(x))
+        tfl.set_weights([np.asarray(v["params"]["embeddings"])])
+        np.testing.assert_allclose(np.asarray(out),
+                                   tfl(tf.constant(x)).numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestGoldenPoolingShape:
+    def test_average_pooling2d_same(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 7, 7, 3).astype(np.float32)
+        check(L.AveragePooling2D(pool_size=(2, 2), border_mode="same"),
+              tf.keras.layers.AveragePooling2D(2, padding="same"), x)
+
+    def test_max_pooling1d(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 8, 3).astype(np.float32)
+        check(L.MaxPooling1D(pool_length=2),
+              tf.keras.layers.MaxPooling1D(2), x)
+
+    def test_global_max_pooling2d(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 5, 5, 4).astype(np.float32)
+        check(L.GlobalMaxPooling2D(), tf.keras.layers.GlobalMaxPooling2D(),
+              x)
+
+    def test_zero_padding2d(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 4, 4, 3).astype(np.float32)
+        check(L.ZeroPadding2D(padding=(1, 2)),
+              tf.keras.layers.ZeroPadding2D((1, 2)), x)
+
+    def test_cropping2d(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 8, 8, 3).astype(np.float32)
+        check(L.Cropping2D(cropping=((1, 1), (2, 1))),
+              tf.keras.layers.Cropping2D(((1, 1), (2, 1))), x)
+
+    def test_upsampling2d(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 4, 4, 3).astype(np.float32)
+        check(L.UpSampling2D(size=(2, 2)),
+              tf.keras.layers.UpSampling2D(2), x)
+
+    def test_repeat_vector(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(3, 6).astype(np.float32)
+        check(L.RepeatVector(4), tf.keras.layers.RepeatVector(4), x)
+
+    def test_permute(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 3, 4, 5).astype(np.float32)
+        check(L.Permute(dims=(2, 1, 3)),
+              tf.keras.layers.Permute((2, 1, 3)), x)
+
+
+class TestGoldenActivations:
+    @pytest.mark.parametrize("zoo,tfl", [
+        (lambda: L.ELU(alpha=0.7),
+         lambda: tf.keras.layers.ELU(alpha=0.7)),
+        (lambda: L.LeakyReLU(alpha=0.2),
+         lambda: tf.keras.layers.LeakyReLU(0.2)),
+        (lambda: L.ThresholdedReLU(theta=0.5),
+         lambda: tf.keras.layers.ThresholdedReLU(0.5)),
+    ])
+    def test_advanced_activation(self, zoo, tfl):
+        rs = np.random.RandomState(0)
+        x = rs.randn(3, 6).astype(np.float32)
+        check(zoo(), tfl(), x)
+
+    def test_batchnorm_inference(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(4, 6).astype(np.float32)
+        layer = L.BatchNormalization()
+        v = layer.init(RNG, x.shape[1:])
+        # seed non-trivial moving stats so inference actually normalises
+        v["state"]["moving_mean"] = jnp.asarray(
+            rs.randn(6).astype(np.float32))
+        v["state"]["moving_var"] = jnp.asarray(
+            rs.rand(6).astype(np.float32) + 0.5)
+        out, _ = layer.apply(v["params"], x, state=v["state"],
+                             training=False)
+        tfl = tf.keras.layers.BatchNormalization(epsilon=layer.epsilon)
+        _ = tfl(tf.constant(x))
+        tfl.set_weights([np.asarray(v["params"]["gamma"]),
+                         np.asarray(v["params"]["beta"]),
+                         np.asarray(v["state"]["moving_mean"]),
+                         np.asarray(v["state"]["moving_var"])])
+        ref = tfl(tf.constant(x), training=False).numpy()
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-4)
+
+
+class TestGoldenMerge:
+    @pytest.mark.parametrize("mode,tfl", [
+        ("sum", lambda: tf.keras.layers.Add()),
+        ("mul", lambda: tf.keras.layers.Multiply()),
+        ("max", lambda: tf.keras.layers.Maximum()),
+        ("ave", lambda: tf.keras.layers.Average()),
+        ("concat", lambda: tf.keras.layers.Concatenate()),
+    ])
+    def test_merge_modes(self, mode, tfl):
+        rs = np.random.RandomState(0)
+        a = rs.randn(2, 5).astype(np.float32)
+        b = rs.randn(2, 5).astype(np.float32)
+        layer = L.Merge(mode=mode)
+        v = layer.init(RNG, [a.shape[1:], b.shape[1:]])
+        out, _ = layer.apply(v["params"], [jnp.asarray(a),
+                                           jnp.asarray(b)],
+                             state=v["state"])
+        ref = tfl()([tf.constant(a), tf.constant(b)]).numpy()
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5,
+                                   atol=1e-5)
