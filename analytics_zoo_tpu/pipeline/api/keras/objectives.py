@@ -55,15 +55,25 @@ def binary_crossentropy(y_true, y_pred):
     return -jnp.mean(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
 
 
+def _norm_probs(y_pred):
+    """Keras-1 probability-input convention: renormalise over the class
+    axis before the log (keras backend categorical_crossentropy) — this
+    also changes d(loss)/d(y_pred) to the on-simplex gradient, which
+    golden tests check against the tf.keras oracle."""
+    denom = jnp.clip(jnp.sum(y_pred, axis=-1, keepdims=True), _EPS,
+                     None)   # degenerate all-zero rows stay finite
+    return _clip(y_pred / denom)
+
+
 def categorical_crossentropy(y_true, y_pred):
     """One-hot targets vs probability predictions."""
-    p = _clip(y_pred)
+    p = _norm_probs(y_pred)
     return -jnp.mean(jnp.sum(y_true * jnp.log(p), axis=-1))
 
 
 def sparse_categorical_crossentropy(y_true, y_pred):
     """Integer targets vs probability predictions."""
-    p = _clip(y_pred)
+    p = _norm_probs(y_pred)
     labels = y_true.astype(jnp.int32)
     if labels.ndim == p.ndim:            # (B,1) -> (B,)
         labels = labels.squeeze(-1)

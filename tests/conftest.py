@@ -32,3 +32,14 @@ def _fresh_context():
     yield
     from analytics_zoo_tpu.common.zoo_context import reset_zoo_context
     reset_zoo_context()
+
+
+@pytest.fixture
+def f32_policy():
+    """Full-f32 dtype policy for golden-oracle comparisons (default
+    policy is bf16 compute, which would swamp 1e-4 tolerances)."""
+    from analytics_zoo_tpu.ops import dtypes
+    old = dtypes.get_policy()
+    dtypes.set_policy(param_dtype="float32", compute_dtype="float32")
+    yield
+    dtypes._policy = old

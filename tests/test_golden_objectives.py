@@ -1,0 +1,123 @@
+"""Golden objective tests vs tf.keras losses (KerasRunner's
+code_for_loss role, KerasRunner.scala:54): every objective with a
+tf.keras equivalent must agree on values AND d(loss)/d(y_pred)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from analytics_zoo_tpu.pipeline.api.keras import objectives as O
+
+pytestmark = pytest.mark.slow   # TF-oracle comparisons
+
+
+def zoo_loss_and_grad(name, y_true, y_pred):
+    fn = O.get(name)
+    with jax.default_matmul_precision("float32"):
+        val, g = jax.value_and_grad(
+            lambda p: fn(jnp.asarray(y_true), p))(jnp.asarray(y_pred))
+    return float(val), np.asarray(g)
+
+
+def tf_loss_and_grad(tf_fn, y_true, y_pred):
+    yp = tf.constant(y_pred)
+    with tf.GradientTape() as tape:
+        tape.watch(yp)
+        val = tf.reduce_mean(tf_fn(tf.constant(y_true), yp))
+    return float(val.numpy()), tape.gradient(val, yp).numpy()
+
+
+RS = lambda: np.random.RandomState(0)
+
+
+def probs(shape, seed=0):
+    p = np.random.RandomState(seed).rand(*shape).astype(np.float32) + .05
+    return (p / p.sum(-1, keepdims=True)).astype(np.float32)
+
+
+class TestGoldenObjectives:
+    @pytest.mark.parametrize("name,tf_fn", [
+        ("mse", tf.keras.losses.mse),
+        ("mae", tf.keras.losses.mae),
+        ("mape", tf.keras.losses.mape),
+        ("msle", tf.keras.losses.msle),
+        ("poisson", tf.keras.losses.poisson),
+        ("squared_hinge", tf.keras.losses.squared_hinge),
+        ("hinge", tf.keras.losses.hinge),
+    ])
+    def test_regression_losses(self, name, tf_fn):
+        rs = RS()
+        y_true = (rs.rand(6, 4).astype(np.float32) + 0.1)
+        y_pred = (rs.rand(6, 4).astype(np.float32) + 0.1)
+        if name in ("hinge", "squared_hinge"):
+            y_true = np.sign(rs.randn(6, 4)).astype(np.float32)
+        v, g = zoo_loss_and_grad(name, y_true, y_pred)
+        rv, rg = tf_loss_and_grad(tf_fn, y_true, y_pred)
+        assert abs(v - rv) < 1e-4, (name, v, rv)
+        np.testing.assert_allclose(g, rg, rtol=1e-3, atol=1e-4)
+
+    def test_binary_crossentropy(self):
+        rs = RS()
+        y_true = rs.randint(0, 2, (8, 1)).astype(np.float32)
+        y_pred = rs.rand(8, 1).astype(np.float32) * 0.9 + 0.05
+        v, g = zoo_loss_and_grad("binary_crossentropy", y_true, y_pred)
+        rv, rg = tf_loss_and_grad(tf.keras.losses.binary_crossentropy,
+                                  y_true, y_pred)
+        assert abs(v - rv) < 1e-4
+        np.testing.assert_allclose(g, rg, rtol=1e-3, atol=1e-3)
+
+    def test_categorical_crossentropy(self):
+        y_pred = probs((6, 5))
+        y_true = np.eye(5, dtype=np.float32)[
+            RS().randint(0, 5, 6)]
+        v, g = zoo_loss_and_grad("categorical_crossentropy",
+                                 y_true, y_pred)
+        rv, rg = tf_loss_and_grad(
+            tf.keras.losses.categorical_crossentropy, y_true, y_pred)
+        assert abs(v - rv) < 1e-4
+        np.testing.assert_allclose(g, rg, rtol=1e-3, atol=1e-3)
+
+    def test_sparse_categorical_crossentropy(self):
+        y_pred = probs((6, 5))
+        y_true = RS().randint(0, 5, (6, 1)).astype(np.int32)
+        v, g = zoo_loss_and_grad("sparse_categorical_crossentropy",
+                                 y_true, y_pred)
+        rv, rg = tf_loss_and_grad(
+            tf.keras.losses.sparse_categorical_crossentropy,
+            y_true, y_pred)
+        assert abs(v - rv) < 1e-4
+        np.testing.assert_allclose(g, rg, rtol=1e-3, atol=1e-3)
+
+    def test_sparse_with_logits_matches_tf(self):
+        rs = RS()
+        logits = rs.randn(6, 5).astype(np.float32)
+        y_true = rs.randint(0, 5, (6, 1)).astype(np.int32)
+        v, g = zoo_loss_and_grad(
+            "sparse_categorical_crossentropy_with_logits",
+            y_true, logits)
+        rv, rg = tf_loss_and_grad(
+            lambda yt, yp: tf.keras.losses.sparse_categorical_crossentropy(
+                yt, yp, from_logits=True), y_true, logits)
+        assert abs(v - rv) < 1e-4
+        np.testing.assert_allclose(g, rg, rtol=1e-3, atol=1e-4)
+
+    def test_kld(self):
+        a, b = probs((5, 4), 0), probs((5, 4), 1)
+        v, g = zoo_loss_and_grad("kld", a, b)
+        rv, rg = tf_loss_and_grad(
+            tf.keras.losses.kullback_leibler_divergence, a, b)
+        assert abs(v - rv) < 1e-4
+        np.testing.assert_allclose(g, rg, rtol=1e-3, atol=1e-3)
+
+    def test_cosine_proximity(self):
+        rs = RS()
+        a = rs.randn(4, 6).astype(np.float32)
+        b = rs.randn(4, 6).astype(np.float32)
+        v, g = zoo_loss_and_grad("cosine_proximity", a, b)
+        rv, rg = tf_loss_and_grad(tf.keras.losses.cosine_similarity,
+                                  a, b)
+        assert abs(v - rv) < 1e-4, (v, rv)
+        np.testing.assert_allclose(g, rg, rtol=1e-3, atol=1e-3)

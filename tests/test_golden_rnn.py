@@ -27,13 +27,9 @@ pytestmark = pytest.mark.slow   # oracle comparisons: TF/torch + many jit compil
 
 
 @pytest.fixture(autouse=True)
-def _f32_policy():
-    """Golden comparisons need f32 end-to-end (default policy is bf16)."""
-    from analytics_zoo_tpu.ops import dtypes
-    old = dtypes.get_policy()
-    dtypes.set_policy(param_dtype="float32", compute_dtype="float32")
+def _f32_policy(f32_policy):
+    """All tests here run under the shared full-f32 golden policy."""
     yield
-    dtypes._policy = old
 
 
 def _native_forward_and_grad(layer, params, x):

@@ -24,14 +24,9 @@ RNG = jax.random.PRNGKey(0)
 pytestmark = pytest.mark.slow   # TF-oracle comparisons: many jit compiles
 
 @pytest.fixture(autouse=True)
-def _f32_policy():
-    """Golden comparisons run full-f32: the default bf16 compute policy
-    would swamp the 1e-4 tolerances with quantization noise."""
-    from analytics_zoo_tpu.ops import dtypes
-    old = dtypes.get_policy()
-    dtypes.set_policy("float32", "float32")
+def _f32_policy(f32_policy):
+    """All tests here run under the shared full-f32 golden policy."""
     yield
-    dtypes._policy = old
 
 
 def zoo_forward_and_grad(layer, x):
