@@ -52,3 +52,10 @@ def set_policy(param_dtype: str = "float32",
     _policy = Policy(param_dtype=_DTYPES[param_dtype],
                      compute_dtype=_DTYPES[compute_dtype])
     return _policy
+
+
+def restore_policy(policy: Policy) -> None:
+    """Put back a Policy captured earlier via get_policy() (scoped
+    overrides, e.g. golden tests forcing f32)."""
+    global _policy
+    _policy = policy

@@ -42,4 +42,4 @@ def f32_policy():
     old = dtypes.get_policy()
     dtypes.set_policy(param_dtype="float32", compute_dtype="float32")
     yield
-    dtypes._policy = old
+    dtypes.restore_policy(old)

@@ -1,0 +1,76 @@
+"""Golden optimizer-trajectory tests vs tf.keras: N update steps on an
+identical quadratic must land on the same parameters (the reference
+inherits BigDL optim semantics and adds Keras-style Adam /
+AdamWeightDecay — optimizers/Adam.scala, AdamWeightDecay.scala)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from analytics_zoo_tpu.pipeline.api.keras import optimizers as O
+
+pytestmark = pytest.mark.slow   # TF-oracle comparisons
+
+TARGET = np.asarray([1.5, -2.0, 0.3, 4.0, -0.7], np.float32)
+
+
+def zoo_trajectory(opt, steps: int):
+    w = jnp.zeros(5, jnp.float32)
+    state = opt.init(w)
+    for _ in range(steps):
+        grad = 2.0 * (w - TARGET)        # d/dw sum((w-target)^2)
+        updates, state = opt.update(grad, state, w)
+        w = w + updates
+    return np.asarray(w)
+
+
+def tf_trajectory(tf_opt, steps: int):
+    w = tf.Variable(tf.zeros(5))
+    for _ in range(steps):
+        grad = 2.0 * (w - tf.constant(TARGET))
+        tf_opt.apply_gradients([(grad, w)])
+    return w.numpy()
+
+
+class TestGoldenOptimizers:
+    def test_sgd_plain(self):
+        np.testing.assert_allclose(
+            zoo_trajectory(O.SGD(learning_rate=0.05), 20),
+            tf_trajectory(tf.keras.optimizers.SGD(0.05), 20),
+            rtol=1e-5, atol=1e-6)
+
+    def test_sgd_momentum(self):
+        np.testing.assert_allclose(
+            zoo_trajectory(O.SGD(learning_rate=0.03, momentum=0.9), 25),
+            tf_trajectory(tf.keras.optimizers.SGD(0.03, momentum=0.9),
+                          25),
+            rtol=1e-4, atol=1e-5)
+
+    def test_sgd_nesterov(self):
+        np.testing.assert_allclose(
+            zoo_trajectory(O.SGD(learning_rate=0.03, momentum=0.9,
+                                 nesterov=True), 25),
+            tf_trajectory(tf.keras.optimizers.SGD(0.03, momentum=0.9,
+                                                  nesterov=True), 25),
+            rtol=1e-4, atol=1e-5)
+
+    def test_adam(self):
+        np.testing.assert_allclose(
+            zoo_trajectory(O.Adam(lr=0.1), 30),
+            tf_trajectory(tf.keras.optimizers.Adam(0.1), 30),
+            rtol=1e-3, atol=1e-3)
+
+    def test_rmsprop(self):
+        np.testing.assert_allclose(
+            zoo_trajectory(O.RMSprop(lr=0.05), 30),
+            tf_trajectory(tf.keras.optimizers.RMSprop(0.05), 30),
+            rtol=2e-2, atol=2e-2)   # eps placement differs slightly
+
+    def test_adagrad(self):
+        np.testing.assert_allclose(
+            zoo_trajectory(O.Adagrad(lr=0.2), 30),
+            tf_trajectory(tf.keras.optimizers.Adagrad(
+                0.2, initial_accumulator_value=0.0), 30),
+            rtol=2e-2, atol=2e-2)

@@ -23,13 +23,8 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-pytestmark = pytest.mark.slow   # oracle comparisons: TF/torch + many jit compiles
-
-
-@pytest.fixture(autouse=True)
-def _f32_policy(f32_policy):
-    """All tests here run under the shared full-f32 golden policy."""
-    yield
+pytestmark = [pytest.mark.slow,   # oracle comparisons, many jits
+              pytest.mark.usefixtures("f32_policy")]
 
 
 def _native_forward_and_grad(layer, params, x):

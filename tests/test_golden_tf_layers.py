@@ -21,13 +21,8 @@ from analytics_zoo_tpu.pipeline.api.keras import layers as L
 
 RNG = jax.random.PRNGKey(0)
 
-pytestmark = pytest.mark.slow   # TF-oracle comparisons: many jit compiles
-
-@pytest.fixture(autouse=True)
-def _f32_policy(f32_policy):
-    """All tests here run under the shared full-f32 golden policy."""
-    yield
-
+pytestmark = [pytest.mark.slow,   # TF-oracle comparisons
+              pytest.mark.usefixtures("f32_policy")]
 
 def zoo_forward_and_grad(layer, x):
     """Init + forward + d(sum(out))/dx; returns (params, out, gx)."""
