@@ -1,0 +1,102 @@
+"""Golden metric tests vs tf.keras.metrics: batch-accumulated values
+must agree (the reference's metrics inherit BigDL ValidationMethod
+semantics — keras/metrics/Accuracy.scala, SURVEY.md §2.3)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+from analytics_zoo_tpu.pipeline.api.keras import metrics as M
+
+pytestmark = pytest.mark.slow   # TF-oracle comparisons
+
+
+def _acc(metric, partials):
+    """Fold through the REAL accumulation path (metrics.accumulate —
+    the single implementation shared by the eval runners)."""
+    return float(M.accumulate([metric],
+                              [(p,) for p in partials])[metric.name])
+
+
+def tf_value(tf_metric, batches):
+    for yt, yp in batches:
+        tf_metric.update_state(yt, yp)
+    return float(tf_metric.result().numpy())
+
+
+class TestGoldenMetrics:
+    def _batches(self, classes=5, n=3, bs=8, seed=0):
+        rs = np.random.RandomState(seed)
+        out = []
+        for _ in range(n):
+            yp = rs.rand(bs, classes).astype(np.float32)
+            yt = rs.randint(0, classes, (bs, 1))
+            out.append((yt, yp))
+        return out
+
+    def test_sparse_categorical_accuracy(self):
+        b = self._batches()
+        got = _acc(M.SparseCategoricalAccuracy(),
+                   [M.SparseCategoricalAccuracy().batch_update(
+                       jnp.asarray(yt), jnp.asarray(yp),
+                       jnp.ones(len(yp), jnp.float32)) for yt, yp in b])
+        ref = tf_value(tf.keras.metrics.SparseCategoricalAccuracy(), b)
+        assert abs(got - ref) < 1e-6, (got, ref)
+
+    def test_categorical_accuracy(self):
+        b = [(np.eye(5, dtype=np.float32)[yt[:, 0]], yp)
+             for yt, yp in self._batches()]
+        got = _acc(M.CategoricalAccuracy(),
+                   [M.CategoricalAccuracy().batch_update(
+                       jnp.asarray(yt), jnp.asarray(yp),
+                       jnp.ones(len(yp), jnp.float32)) for yt, yp in b])
+        ref = tf_value(tf.keras.metrics.CategoricalAccuracy(), b)
+        assert abs(got - ref) < 1e-6, (got, ref)
+
+    def test_binary_accuracy(self):
+        rs = np.random.RandomState(1)
+        b = [(rs.randint(0, 2, (8, 1)).astype(np.float32),
+              rs.rand(8, 1).astype(np.float32)) for _ in range(3)]
+        got = _acc(M.BinaryAccuracy(),
+                   [M.BinaryAccuracy().batch_update(
+                       jnp.asarray(yt), jnp.asarray(yp),
+                       jnp.ones(len(yp), jnp.float32)) for yt, yp in b])
+        ref = tf_value(tf.keras.metrics.BinaryAccuracy(), b)
+        assert abs(got - ref) < 1e-6, (got, ref)
+
+    def test_top5(self):
+        b = self._batches(classes=12)
+        got = _acc(M.Top5Accuracy(),
+                   [M.Top5Accuracy().batch_update(
+                       jnp.asarray(yt), jnp.asarray(yp),
+                       jnp.ones(len(yp), jnp.float32)) for yt, yp in b])
+        ref = tf_value(
+            tf.keras.metrics.SparseTopKCategoricalAccuracy(k=5), b)
+        assert abs(got - ref) < 1e-6, (got, ref)
+
+    def test_mae(self):
+        rs = np.random.RandomState(2)
+        b = [(rs.rand(8, 3).astype(np.float32),
+              rs.rand(8, 3).astype(np.float32)) for _ in range(3)]
+        got = _acc(M.MAE(),
+                   [M.MAE().batch_update(
+                       jnp.asarray(yt), jnp.asarray(yp),
+                       jnp.ones(len(yp), jnp.float32)) for yt, yp in b])
+        ref = tf_value(tf.keras.metrics.MeanAbsoluteError(), b)
+        assert abs(got - ref) < 1e-5, (got, ref)
+
+    def test_auc_close_to_tf(self):
+        rs = np.random.RandomState(3)
+        y = rs.randint(0, 2, (64, 1)).astype(np.float32)
+        # correlated scores so AUC is far from 0.5
+        p = np.clip(y * 0.4 + rs.rand(64, 1) * 0.6, 0, 1) \
+            .astype(np.float32)
+        b = [(y[i:i + 16], p[i:i + 16]) for i in range(0, 64, 16)]
+        m = M.AUC(num_thresholds=200)
+        got = _acc(m, [m.batch_update(
+            jnp.asarray(yt), jnp.asarray(yp),
+            jnp.ones(len(yp), jnp.float32)) for yt, yp in b])
+        ref = tf_value(tf.keras.metrics.AUC(num_thresholds=200), b)
+        assert abs(got - ref) < 0.02, (got, ref)   # binned estimators

@@ -69,8 +69,10 @@ class TestGoldenOptimizers:
             rtol=2e-2, atol=2e-2)   # eps placement differs slightly
 
     def test_adagrad(self):
+        # optax and TF both default initial_accumulator_value to 0.1 —
+        # aligned accumulators allow a tight tolerance
         np.testing.assert_allclose(
             zoo_trajectory(O.Adagrad(lr=0.2), 30),
             tf_trajectory(tf.keras.optimizers.Adagrad(
-                0.2, initial_accumulator_value=0.0), 30),
-            rtol=2e-2, atol=2e-2)
+                0.2, initial_accumulator_value=0.1), 30),
+            rtol=1e-3, atol=1e-3)
