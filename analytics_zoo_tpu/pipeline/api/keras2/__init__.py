@@ -8,6 +8,8 @@ helpers — with keras-2 argument names (units/filters/kernel_size,
 kernel_initializer/bias_initializer, padding/data_format).
 """
 
+from analytics_zoo_tpu.pipeline.api.keras2.models import (  # noqa: F401
+    Model, Sequential)
 from analytics_zoo_tpu.pipeline.api.keras2.layers import (
     Activation, Add, Average, AveragePooling1D, AveragePooling2D,
     Concatenate, Conv1D, Conv2D, Cropping1D, Dense, Dropout, Flatten,
@@ -19,6 +21,7 @@ from analytics_zoo_tpu.pipeline.api.keras2.layers import (
 )
 
 __all__ = [
+    "Model", "Sequential",
     "Activation", "Add", "Average", "AveragePooling1D",
     "AveragePooling2D", "Concatenate", "Conv1D", "Conv2D", "Cropping1D",
     "Dense", "Dropout", "Flatten", "GlobalAveragePooling1D",

@@ -65,6 +65,23 @@ class TestLocallyConnected:
 
 
 class TestKeras2:
+    def test_keras2_sequential_fit_epochs(self):
+        """keras2.Sequential takes Keras-2 calling conventions
+        (epochs=, validation_split=) end-to-end."""
+        from analytics_zoo_tpu.pipeline.api import keras2 as K2
+        m = K2.Sequential()
+        m.add(K2.Dense(16, activation="relu", input_shape=(6,)))
+        m.add(K2.Dense(2))
+        m.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy_with_logits",
+                  metrics=["accuracy"])
+        rs = np.random.RandomState(0)
+        x = rs.randn(256, 6).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int64)[:, None]
+        hist = m.fit(x, y, batch_size=64, epochs=5,
+                     validation_split=0.25)
+        assert len(hist) == 5 and "val" in hist[-1]
+
     def test_keras2_mnist_style_model(self):
         from analytics_zoo_tpu.pipeline.api.keras import Sequential
         from analytics_zoo_tpu.pipeline.api import keras2 as K2
