@@ -1,0 +1,31 @@
+"""Keras-1 regularizer creators (ref pyzoo keras/regularizers.py —
+L1L2Regularizer over the bigdl penalties).
+
+A regularizer here is the ``(l1, l2)`` coefficient pair consumed by
+``Layer.add_weight(..., regularizer=...)`` (engine.py:257): the
+penalty is added to the training loss inside the jitted step, so it
+differentiates and shards with everything else.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+Regularizer = Tuple[float, float]
+
+
+def l1(l: float = 0.01) -> Regularizer:
+    return (float(l), 0.0)
+
+
+def l2(l: float = 0.01) -> Regularizer:
+    return (0.0, float(l))
+
+
+def l1l2(l1: float = 0.01, l2: float = 0.01) -> Regularizer:
+    return (float(l1), float(l2))
+
+
+L1Regularizer = l1
+L2Regularizer = l2
+L1L2Regularizer = l1l2

@@ -240,3 +240,26 @@ class TestMisc:
 
         out = fwd(variables["params"], jnp.ones((2, 3)))
         assert out.shape == (2, 4)
+
+
+class TestRegularizersModule:
+    def test_creators_and_penalty(self):
+        """keras.regularizers (ref pyzoo keras/regularizers.py): the
+        (l1,l2) pairs wire into Layer.regularization_loss."""
+        import jax
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.pipeline.api.keras import regularizers
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+
+        assert regularizers.l1(0.3) == (0.3, 0.0)
+        assert regularizers.l2(0.2) == (0.0, 0.2)
+        assert regularizers.l1l2(0.3, 0.2) == (0.3, 0.2)
+
+        layer = Dense(4, input_shape=(3,),
+                      W_regularizer=regularizers.l1l2(0.5, 0.25))
+        v = layer.init(jax.random.PRNGKey(0))
+        w = v["params"]["kernel"]
+        expect = 0.5 * float(jnp.sum(jnp.abs(w))) \
+            + 0.25 * float(jnp.sum(jnp.square(w)))
+        got = float(layer.regularization_loss(v["params"]))
+        assert abs(got - expect) < 1e-5
