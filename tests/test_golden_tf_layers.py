@@ -230,3 +230,45 @@ class TestGoldenMerge:
         ref = tfl()([tf.constant(a), tf.constant(b)]).numpy()
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5,
                                    atol=1e-5)
+
+
+class TestGolden3DAndMisc:
+    def test_conv3d_valid(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(1, 6, 6, 6, 2).astype(np.float32)
+        check(L.Convolution3D(4, 3, 3, 3),
+              tf.keras.layers.Conv3D(4, 3, padding="valid"), x,
+              ("kernel", "bias"), tol=5e-4)
+
+    def test_max_pooling3d(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(1, 6, 6, 6, 3).astype(np.float32)
+        check(L.MaxPooling3D(pool_size=(2, 2, 2)),
+              tf.keras.layers.MaxPooling3D(2), x)
+
+    def test_average_pooling3d(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(1, 6, 6, 6, 3).astype(np.float32)
+        check(L.AveragePooling3D(pool_size=(2, 2, 2)),
+              tf.keras.layers.AveragePooling3D(2), x)
+
+    def test_global_average_pooling3d(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 4, 4, 4, 3).astype(np.float32)
+        check(L.GlobalAveragePooling3D(),
+              tf.keras.layers.GlobalAveragePooling3D(), x)
+
+    # (LocallyConnected1D has no tf.keras-3 oracle — removed upstream;
+    # its per-patch math is verified directly in test_extra_layers.py)
+
+    def test_masking_passthrough_values(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 5, 3).astype(np.float32)
+        x[0, 2] = 0.0                          # fully-masked timestep
+        layer = L.Masking(mask_value=0.0)
+        v = layer.init(RNG, x.shape[1:])
+        out, _ = layer.apply(v["params"], jnp.asarray(x),
+                             state=v["state"])
+        ref = tf.keras.layers.Masking(0.0)(tf.constant(x)).numpy()
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6,
+                                   atol=1e-6)
