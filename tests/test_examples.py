@@ -27,6 +27,7 @@ EXAMPLES = [
     "examples.distributed.pipeline_moe_example",
     "examples.streaming.streaming_object_detection",
     "examples.streaming.streaming_text_classification",
+    "examples.distributed.long_context_example",
 ]
 
 

@@ -261,14 +261,17 @@ class TestGolden3DAndMisc:
     # (LocallyConnected1D has no tf.keras-3 oracle — removed upstream;
     # its per-patch math is verified directly in test_extra_layers.py)
 
-    def test_masking_passthrough_values(self):
+    def test_masking_zeroes_masked_timesteps(self):
         rs = np.random.RandomState(0)
         x = rs.randn(2, 5, 3).astype(np.float32)
-        x[0, 2] = 0.0                          # fully-masked timestep
-        layer = L.Masking(mask_value=0.0)
+        # NONZERO mask value: the masked step must be actively zeroed
+        # (a pure-identity Masking would fail this)
+        x[0, 2] = 7.0
+        layer = L.Masking(mask_value=7.0)
         v = layer.init(RNG, x.shape[1:])
         out, _ = layer.apply(v["params"], jnp.asarray(x),
                              state=v["state"])
-        ref = tf.keras.layers.Masking(0.0)(tf.constant(x)).numpy()
+        ref = tf.keras.layers.Masking(7.0)(tf.constant(x)).numpy()
+        assert np.allclose(ref[0, 2], 0.0)     # oracle zeroes it
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6,
                                    atol=1e-6)
