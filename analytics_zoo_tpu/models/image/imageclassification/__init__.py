@@ -1,5 +1,7 @@
 from analytics_zoo_tpu.models.image.imageclassification.nets import (
-    ImageClassifier, inception_v1, lenet, resnet,
+    ImageClassifier, alexnet, densenet, inception_v1, lenet, mobilenet,
+    resnet, squeezenet, vgg,
 )
 
-__all__ = ["ImageClassifier", "inception_v1", "lenet", "resnet"]
+__all__ = ["ImageClassifier", "alexnet", "densenet", "inception_v1",
+           "lenet", "mobilenet", "resnet", "squeezenet", "vgg"]

@@ -157,6 +157,174 @@ def inception_v1(num_classes: int = 1000,
     return Model(inp, out)
 
 
+def mobilenet(num_classes: int = 1000,
+              input_shape: Tuple[int, int, int] = (224, 224, 3),
+              alpha: float = 1.0) -> Model:
+    """MobileNet-v1 (the published "mobilenet" family of
+    ImageClassificationConfig.scala): each block is depthwise 3x3 →
+    BN → ReLU → pointwise 1x1 → BN → ReLU — BOTH nonlinearities, per
+    the paper (a fused separable conv would be a low-rank factorized
+    conv, not MobileNet)."""
+    def dw_block(x, in_ch, out_ch, stride):
+        # depthwise: one 3x3 filter per input channel (groups=in_ch)
+        x = Convolution2D(in_ch, 3, 3, subsample=(stride, stride),
+                          border_mode="same", bias=False,
+                          groups=in_ch)(x)
+        x = BatchNormalization()(x)
+        x = Activation("relu")(x)
+        x = Convolution2D(out_ch, 1, 1, bias=False)(x)
+        x = BatchNormalization()(x)
+        return Activation("relu")(x)
+
+    inp = Input(shape=input_shape)
+    ch = int(32 * alpha)
+    x = _conv_bn(inp, ch, 3, 2)
+    for filters, stride in ((64, 1), (128, 2), (128, 1), (256, 2),
+                            (256, 1), (512, 2), (512, 1), (512, 1),
+                            (512, 1), (512, 1), (512, 1), (1024, 2),
+                            (1024, 1)):
+        out_ch = int(filters * alpha)
+        x = dw_block(x, ch, out_ch, stride)
+        ch = out_ch
+    x = GlobalAveragePooling2D()(x)
+    out = Dense(num_classes)(x)
+    return Model(inp, out)
+
+
+def vgg(depth: int = 16, num_classes: int = 1000,
+        input_shape: Tuple[int, int, int] = (224, 224, 3)) -> Model:
+    """VGG-16/19 (published "vgg-16"/"vgg-19")."""
+    cfg = {16: (2, 2, 3, 3, 3), 19: (2, 2, 4, 4, 4)}[depth]
+    inp = Input(shape=input_shape)
+    x = inp
+    filters = 64
+    for n_convs in cfg:
+        for _ in range(n_convs):
+            x = Convolution2D(filters, 3, 3, border_mode="same",
+                              activation="relu")(x)
+        x = MaxPooling2D(pool_size=(2, 2))(x)
+        filters = min(filters * 2, 512)
+    x = Flatten()(x)
+    x = Dense(4096, activation="relu")(x)
+    x = Dropout(0.5)(x)
+    x = Dense(4096, activation="relu")(x)
+    x = Dropout(0.5)(x)
+    out = Dense(num_classes)(x)
+    return Model(inp, out)
+
+
+def squeezenet(num_classes: int = 1000,
+               input_shape: Tuple[int, int, int] = (224, 224, 3)
+               ) -> Model:
+    """SqueezeNet v1.1 (published "squeezenet")."""
+    def fire(x, squeeze, expand):
+        s = Convolution2D(squeeze, 1, 1, activation="relu")(x)
+        e1 = Convolution2D(expand, 1, 1, activation="relu")(s)
+        e3 = Convolution2D(expand, 3, 3, border_mode="same",
+                           activation="relu")(s)
+        return Merge(mode="concat")([e1, e3])
+
+    inp = Input(shape=input_shape)
+    x = Convolution2D(64, 3, 3, subsample=(2, 2),
+                      activation="relu")(inp)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = fire(x, 16, 64)
+    x = fire(x, 16, 64)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = fire(x, 32, 128)
+    x = fire(x, 32, 128)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = fire(x, 48, 192)
+    x = fire(x, 48, 192)
+    x = fire(x, 64, 256)
+    x = fire(x, 64, 256)
+    x = Dropout(0.5)(x)
+    x = Convolution2D(num_classes, 1, 1)(x)
+    out = GlobalAveragePooling2D()(x)
+    return Model(inp, out)
+
+
+def densenet(depth: int = 121, num_classes: int = 1000,
+             input_shape: Tuple[int, int, int] = (224, 224, 3),
+             growth_rate: int = None) -> Model:
+    """DenseNet-121/161/169 (incl. the published "densenet-161"; block
+    configs and growth rates per the DenseNet paper)."""
+    try:
+        blocks, default_growth = {
+            121: ((6, 12, 24, 16), 32),
+            161: ((6, 12, 36, 24), 48),
+            169: ((6, 12, 32, 32), 32),
+        }[depth]
+    except KeyError:
+        raise ValueError(f"densenet depth must be 121/161/169, "
+                         f"got {depth}") from None
+    growth_rate = growth_rate or default_growth
+
+    def dense_block(x, n_layers):
+        for _ in range(n_layers):
+            y = BatchNormalization()(x)
+            y = Activation("relu")(y)
+            y = Convolution2D(4 * growth_rate, 1, 1, bias=False)(y)
+            y = BatchNormalization()(y)
+            y = Activation("relu")(y)
+            y = Convolution2D(growth_rate, 3, 3, border_mode="same",
+                              bias=False)(y)
+            x = Merge(mode="concat")([x, y])
+        return x
+
+    def transition(x, out_ch):
+        x = BatchNormalization()(x)
+        x = Activation("relu")(x)
+        x = Convolution2D(out_ch, 1, 1, bias=False)(x)
+        return AveragePooling2D(pool_size=(2, 2))(x)
+
+    inp = Input(shape=input_shape)
+    x = _conv_bn(inp, 2 * growth_rate, 7, 2)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                     border_mode="same")(x)
+    ch = 2 * growth_rate
+    for i, n_layers in enumerate(blocks):
+        x = dense_block(x, n_layers)
+        ch += n_layers * growth_rate
+        if i < len(blocks) - 1:
+            ch //= 2
+            x = transition(x, ch)
+    x = BatchNormalization()(x)
+    x = Activation("relu")(x)
+    x = GlobalAveragePooling2D()(x)
+    out = Dense(num_classes)(x)
+    return Model(inp, out)
+
+
+def alexnet(num_classes: int = 1000,
+            input_shape: Tuple[int, int, int] = (227, 227, 3)) -> Model:
+    """AlexNet (published "alexnet"; LRN replaced by BN, the modern
+    equivalent)."""
+    inp = Input(shape=input_shape)
+    x = Convolution2D(96, 11, 11, subsample=(4, 4),
+                      activation="relu")(inp)
+    x = BatchNormalization()(x)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = Convolution2D(256, 5, 5, border_mode="same",
+                      activation="relu")(x)
+    x = BatchNormalization()(x)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = Convolution2D(384, 3, 3, border_mode="same",
+                      activation="relu")(x)
+    x = Convolution2D(384, 3, 3, border_mode="same",
+                      activation="relu")(x)
+    x = Convolution2D(256, 3, 3, border_mode="same",
+                      activation="relu")(x)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = Flatten()(x)
+    x = Dense(4096, activation="relu")(x)
+    x = Dropout(0.5)(x)
+    x = Dense(4096, activation="relu")(x)
+    x = Dropout(0.5)(x)
+    out = Dense(num_classes)(x)
+    return Model(inp, out)
+
+
 _BUILDERS = {
     "lenet": lenet,
     "resnet-18": lambda **kw: resnet(18, **kw),
@@ -164,6 +332,14 @@ _BUILDERS = {
     "resnet-50": lambda **kw: resnet(50, **kw),
     "resnet-101": lambda **kw: resnet(101, **kw),
     "inception-v1": inception_v1,
+    "mobilenet": mobilenet,
+    "vgg-16": lambda **kw: vgg(16, **kw),
+    "vgg-19": lambda **kw: vgg(19, **kw),
+    "squeezenet": squeezenet,
+    "densenet-121": lambda **kw: densenet(121, **kw),
+    "densenet-161": lambda **kw: densenet(161, **kw),
+    "densenet-169": lambda **kw: densenet(169, **kw),
+    "alexnet": alexnet,
 }
 
 

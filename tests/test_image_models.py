@@ -113,3 +113,26 @@ class TestNets:
         changed = any(not np.allclose(a, b)
                       for a, b in zip(before, after))
         assert changed, "BN moving stats should update during fit"
+
+
+class TestPublishedFamilies:
+    """The by-name builder catalog covers the reference's published
+    model families (ImageClassificationConfig.scala:41-60)."""
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("name,size", [
+        ("mobilenet", 64), ("vgg-16", 64), ("vgg-19", 64),
+        ("squeezenet", 64), ("densenet-121", 64),
+        ("densenet-161", 64), ("densenet-169", 64), ("alexnet", 227),
+    ])
+    def test_builds_and_forward(self, name, size):
+        from analytics_zoo_tpu.models.image.imageclassification import (
+            ImageClassifier)
+        m = ImageClassifier(model_name=name, num_classes=7,
+                            input_shape=(size, size, 3))
+        m.model.init()
+        x = np.random.RandomState(0).rand(2, size, size, 3) \
+            .astype(np.float32)
+        out = np.asarray(m.predict(x, batch_size=2))
+        assert out.shape == (2, 7)
+        assert np.isfinite(out).all()
