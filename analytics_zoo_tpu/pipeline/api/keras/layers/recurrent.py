@@ -120,8 +120,24 @@ class SimpleRNN(_RNNBase):
 
 
 class LSTM(_RNNBase):
-    """Gate order i, f, c, o (Keras-1 / Recurrent.scala LSTM)."""
+    """Gate order i, f, c, o (Keras-1 / Recurrent.scala LSTM).
+
+    ``unit_forget_bias``: initialise the forget-gate bias slice to 1
+    (Jozefowicz et al.; the KERAS-2 default — keras-1 zero-init stays
+    the default here, and the keras2 wrapper opts in)."""
     n_gates = 4
+
+    def __init__(self, output_dim, unit_forget_bias: bool = False,
+                 **kwargs):
+        super().__init__(output_dim, **kwargs)
+        self.unit_forget_bias = unit_forget_bias
+
+    def build(self, rng, input_shape):
+        params = super().build(rng, input_shape)
+        if self.unit_forget_bias:
+            h = self.output_dim
+            params["bias"] = params["bias"].at[h:2 * h].set(1.0)
+        return params
 
     def initial_carry(self, batch: int):
         z = jnp.zeros((batch, self.output_dim), jnp.float32)

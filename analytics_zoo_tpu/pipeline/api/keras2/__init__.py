@@ -11,7 +11,8 @@ kernel_initializer/bias_initializer, padding/data_format).
 from analytics_zoo_tpu.pipeline.api.keras2.models import (  # noqa: F401
     Model, Sequential)
 from analytics_zoo_tpu.pipeline.api.keras2.layers import (
-    Activation, Add, Average, AveragePooling1D, AveragePooling2D,
+    GRU, LSTM, Activation, Add, Average, BatchNormalization, Embedding,
+    SimpleRNN, AveragePooling1D, AveragePooling2D,
     Concatenate, Conv1D, Conv2D, Cropping1D, Dense, Dropout, Flatten,
     GlobalAveragePooling1D, GlobalAveragePooling2D,
     GlobalAveragePooling3D, GlobalMaxPooling1D, GlobalMaxPooling2D,
@@ -21,7 +22,8 @@ from analytics_zoo_tpu.pipeline.api.keras2.layers import (
 )
 
 __all__ = [
-    "Model", "Sequential",
+    "Model", "Sequential", "LSTM", "GRU", "SimpleRNN", "Embedding",
+    "BatchNormalization",
     "Activation", "Add", "Average", "AveragePooling1D",
     "AveragePooling2D", "Concatenate", "Conv1D", "Conv2D", "Cropping1D",
     "Dense", "Dropout", "Flatten", "GlobalAveragePooling1D",

@@ -300,4 +300,72 @@ __all__ = [
     "Flatten", "Softmax", "Average", "Maximum", "Minimum", "Add",
     "Multiply", "Subtract", "Concatenate", "add", "multiply", "average",
     "maximum", "minimum", "subtract", "concatenate",
+    "LSTM", "GRU", "SimpleRNN", "Embedding", "BatchNormalization",
 ]
+
+
+class _Keras2RNN:
+    """Keras-2 recurrent arg names: units, recurrent_activation,
+    kernel_initializer/recurrent_initializer, *_regularizer."""
+
+    def __init__(self, units, activation="tanh",
+                 recurrent_activation="sigmoid",
+                 return_sequences=False, go_backwards=False,
+                 kernel_initializer="glorot_uniform",
+                 recurrent_initializer="orthogonal",
+                 kernel_regularizer=None, recurrent_regularizer=None,
+                 bias_regularizer=None, **kw):
+        super().__init__(
+            units, activation=activation,
+            inner_activation=recurrent_activation,
+            return_sequences=return_sequences,
+            go_backwards=go_backwards, init=kernel_initializer,
+            inner_init=recurrent_initializer,
+            W_regularizer=kernel_regularizer,
+            U_regularizer=recurrent_regularizer,
+            b_regularizer=bias_regularizer, **kw)
+
+
+class LSTM(_Keras2RNN, k1.LSTM):
+    def __init__(self, units, unit_forget_bias=True, **kw):
+        # keras-2 default: forget-gate bias initialised to 1
+        super().__init__(units, unit_forget_bias=unit_forget_bias,
+                         **kw)
+
+
+class GRU(_Keras2RNN, k1.GRU):
+    pass
+
+
+class SimpleRNN(_Keras2RNN, k1.SimpleRNN):
+    pass
+
+
+class Embedding(k1.Embedding):
+    def __init__(self, input_dim, output_dim,
+                 embeddings_initializer="uniform",
+                 embeddings_regularizer=None, mask_zero=False,
+                 **kw):
+        if mask_zero:
+            import warnings
+            warnings.warn(
+                "keras2.Embedding(mask_zero=True): embedded vectors of "
+                "id-0 steps are zeroed, but downstream RNN layers do "
+                "NOT skip masked timesteps (keras-2 carries state "
+                "through them); final states can differ from Keras 2 "
+                "on padded sequences", stacklevel=2)
+        super().__init__(input_dim, output_dim,
+                         init=embeddings_initializer,
+                         W_regularizer=embeddings_regularizer,
+                         mask_zero=mask_zero, **kw)
+
+
+class BatchNormalization(k1.BatchNormalization):
+    def __init__(self, axis=-1, momentum=0.99, epsilon=1e-3,
+                 center=True, scale=True,
+                 beta_initializer="zero", gamma_initializer="one",
+                 **kw):
+        super().__init__(epsilon=epsilon, momentum=momentum,
+                         beta_init=beta_initializer,
+                         gamma_init=gamma_initializer, axis=axis,
+                         scale=scale, center=center, **kw)

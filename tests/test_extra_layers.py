@@ -82,6 +82,23 @@ class TestKeras2:
                      validation_split=0.25)
         assert len(hist) == 5 and "val" in hist[-1]
 
+    def test_keras2_recurrent_stack(self):
+        """keras2 recurrent/embedding/norm classes translate Keras-2
+        arg names (units, recurrent_activation, *_initializer) onto
+        the keras-1 engine."""
+        from analytics_zoo_tpu.pipeline.api import keras2 as K2
+        m = K2.Sequential()
+        m.add(K2.Embedding(50, 8, input_shape=(12,)))
+        m.add(K2.GRU(16, recurrent_activation="sigmoid"))
+        m.add(K2.BatchNormalization(momentum=0.9))
+        m.add(K2.Dense(units=2))
+        m.compile("adam", "sparse_categorical_crossentropy_with_logits")
+        rs = np.random.RandomState(0)
+        x = rs.randint(0, 50, (64, 12))
+        y = rs.randint(0, 2, (64, 1))
+        hist = m.fit(x, y, batch_size=32, epochs=2)
+        assert len(hist) == 2 and np.isfinite(hist[-1]["loss"])
+
     def test_keras2_mnist_style_model(self):
         from analytics_zoo_tpu.pipeline.api.keras import Sequential
         from analytics_zoo_tpu.pipeline.api import keras2 as K2
