@@ -127,9 +127,11 @@ class LSTM(_RNNBase):
     the default here, and the keras2 wrapper opts in)."""
     n_gates = 4
 
-    def __init__(self, output_dim, unit_forget_bias: bool = False,
-                 **kwargs):
-        super().__init__(output_dim, **kwargs)
+    def __init__(self, output_dim, *args,
+                 unit_forget_bias: bool = False, **kwargs):
+        # keyword-only: keras-1 callers use the positional slots for
+        # activation etc. (LSTM(128, "relu") must keep meaning that)
+        super().__init__(output_dim, *args, **kwargs)
         self.unit_forget_bias = unit_forget_bias
 
     def build(self, rng, input_shape):

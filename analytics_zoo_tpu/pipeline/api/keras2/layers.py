@@ -327,10 +327,11 @@ class _Keras2RNN:
 
 
 class LSTM(_Keras2RNN, k1.LSTM):
-    def __init__(self, units, unit_forget_bias=True, **kw):
+    def __init__(self, units, *args, unit_forget_bias=True, **kw):
         # keras-2 default: forget-gate bias initialised to 1
-        super().__init__(units, unit_forget_bias=unit_forget_bias,
-                         **kw)
+        # (keyword-only so LSTM(64, "relu") still binds activation)
+        super().__init__(units, *args,
+                         unit_forget_bias=unit_forget_bias, **kw)
 
 
 class GRU(_Keras2RNN, k1.GRU):

@@ -28,6 +28,7 @@ EXAMPLES = [
     "examples.streaming.streaming_object_detection",
     "examples.streaming.streaming_text_classification",
     "examples.distributed.long_context_example",
+    "examples.quantization.int8_perf_example",
 ]
 
 
