@@ -27,31 +27,9 @@ import numpy as np
 
 from analytics_zoo_tpu.utils.pbwire import Field, Message
 
-# ------------------------------------------------------------------ crc32c
-
-_CRC_TABLE = None
-
-
-def _crc_table() -> np.ndarray:
-    global _CRC_TABLE
-    if _CRC_TABLE is None:
-        poly = 0x82F63B78        # reversed Castagnoli polynomial
-        table = np.empty(256, np.uint32)
-        for i in range(256):
-            crc = i
-            for _ in range(8):
-                crc = (crc >> 1) ^ poly if crc & 1 else crc >> 1
-            table[i] = crc
-        _CRC_TABLE = table
-    return _CRC_TABLE
-
-
-def crc32c(data: bytes) -> int:
-    table = _crc_table()
-    crc = np.uint32(0xFFFFFFFF)
-    for b in np.frombuffer(data, np.uint8):
-        crc = table[(crc ^ b) & np.uint32(0xFF)] ^ (crc >> np.uint8(8))
-    return int(crc ^ np.uint32(0xFFFFFFFF))
+# crc32c lives in the native data-path module (C++ with a pure-Python
+# fallback) and is shared with the TensorBoard writer
+from analytics_zoo_tpu.native import crc32c  # noqa: F401
 
 
 def masked_crc32c(data: bytes) -> int:

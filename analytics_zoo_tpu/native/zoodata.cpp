@@ -51,6 +51,33 @@ void shuffle_indices(int64_t* idx, int64_t n, uint64_t seed) {
     }
 }
 
+// CRC-32C (Castagnoli) — the TFRecord / TensorBoard record-framing
+// checksum (feature/tfrecord.py, utils/tb_writer.py).  Byte-table
+// implementation; the Python per-byte loop is ~100x slower on
+// multi-MB TFRecord payloads.
+// table built at static-init time: ctypes calls drop the GIL, so a
+// lazy in-call init would race between threads
+struct CrcTable {
+    uint32_t t[256];
+    CrcTable() {
+        const uint32_t poly = 0x82F63B78u;
+        for (uint32_t i = 0; i < 256; ++i) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; ++k)
+                c = (c & 1) ? (c >> 1) ^ poly : c >> 1;
+            t[i] = c;
+        }
+    }
+};
+static const CrcTable crc_table;
+
+uint32_t crc32c_update(const uint8_t* data, int64_t n, uint32_t crc) {
+    crc ^= 0xFFFFFFFFu;
+    for (int64_t i = 0; i < n; ++i)
+        crc = crc_table.t[(crc ^ data[i]) & 0xFFu] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
 // Cast-and-scale uint8 image rows to float32 (decode postprocessing),
 // threaded: out = (in - mean) * inv_std per channel-agnostic scalar.
 void u8_to_f32_scaled(const uint8_t* src, float* out, int64_t n,

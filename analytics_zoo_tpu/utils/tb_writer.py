@@ -23,27 +23,9 @@ import struct
 import time
 from typing import Optional
 
-# ------------------------------------------------------------------ crc32c
-_CRC_TABLE = []
-
-
-def _make_table():
-    poly = 0x82F63B78
-    for n in range(256):
-        c = n
-        for _ in range(8):
-            c = (c >> 1) ^ poly if c & 1 else c >> 1
-        _CRC_TABLE.append(c)
-
-
-_make_table()
-
-
-def crc32c(data: bytes, crc: int = 0) -> int:
-    crc = crc ^ 0xFFFFFFFF
-    for b in data:
-        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
-    return crc ^ 0xFFFFFFFF
+# crc32c lives in the native data-path module (C++ with a pure-Python
+# fallback) and is shared with the TFRecord codec
+from analytics_zoo_tpu.native import crc32c  # noqa: F401
 
 
 def masked_crc32c(data: bytes) -> int:
