@@ -51,3 +51,30 @@ class TestNativeLib:
         # same shuffled content as the pure-numpy reference
         perm = fs._epoch_perm(0)
         np.testing.assert_array_equal(batches[0][0], x[perm[:1024]])
+
+
+class TestNativeCRC:
+    def test_native_and_python_fallback_agree(self):
+        """Both crc32c paths must produce identical checksums — a
+        divergence would write unreadable TFRecord/TB files on hosts
+        without the toolchain."""
+        import analytics_zoo_tpu.native as nat
+        vectors = [b"", b"a", b"123456789", bytes(range(256)) * 100]
+        native_vals = None
+        if nat.get_lib() is not None:
+            native_vals = [nat.crc32c(v) for v in vectors]
+        lib, tried = nat._lib, nat._tried
+        try:
+            nat._lib, nat._tried = None, True      # force fallback
+            py_vals = [nat.crc32c(v) for v in vectors]
+        finally:
+            nat._lib, nat._tried = lib, tried
+        assert py_vals[2] == 0xE3069283            # canonical vector
+        if native_vals is not None:
+            assert native_vals == py_vals
+
+    def test_incremental_chaining(self):
+        from analytics_zoo_tpu.native import crc32c
+        # chaining continues the running crc (streaming writers)
+        assert crc32c(b" world", crc32c(b"hello")) == \
+            crc32c(b"hello world")
