@@ -522,3 +522,33 @@ def test_quick_start_self_contained():
     from analytics_zoo_tpu.serving.quick_start import main
     result = main(["--smoke"])
     assert result and len(result) == 3        # top-3 [class, prob]
+
+
+class TestReclaimSafety:
+    def test_reclaim_skips_own_inflight_entries(self):
+        """XAUTOCLAIM does not exclude the caller, so under a deep
+        backlog the reclaim tick could hand a worker its OWN un-acked
+        pipeline batches back — those must be skipped, not
+        double-served."""
+        m = small_classifier()
+        im = InferenceModel().load_zoo(m)
+        broker = EmbeddedBroker()
+        w = ClusterServing(im, ServingConfig(
+            batch_size=4, consumer_group="serve",
+            consumer_name="w1"), broker=broker)
+        inq = InputQueue(broker=broker)
+        rs = np.random.RandomState(0)
+        for i in range(4):
+            inq.enqueue(f"r{i}", rs.randn(8, 8, 3).astype(np.float32))
+        # the worker reads the batch into its pipeline (un-acked)...
+        entries = broker.xreadgroup("serve", "w1", "serving_stream",
+                                    count=4)
+        w._inflight.update(i for i, _ in entries)
+        # ...then the reclaim tick fires with zero idle threshold:
+        # every pending entry is eligible, all are ours -> skip all
+        assert w._reclaim_stale(min_idle_ms=0) == 0
+        assert w.total_records == 0
+        # a genuinely stale entry (a DEAD worker's) is still reclaimed
+        w._inflight.clear()
+        assert w._reclaim_stale(min_idle_ms=0) == 4
+        assert w.total_records == 4
