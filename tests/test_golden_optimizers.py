@@ -117,3 +117,19 @@ class TestAdamWeightDecay:
         # then decay linearly over the remaining 80
         assert sizes[1] < sizes[10] < sizes[19], sizes[:20:5]
         assert sizes[79] < sizes[19] * 0.5, (sizes[19], sizes[79])
+
+
+class TestGoldenOptimizersExtra:
+    def test_adadelta(self):
+        np.testing.assert_allclose(
+            zoo_trajectory(O.Adadelta(lr=1.0, rho=0.95,
+                                      epsilon=1e-7), 30),
+            tf_trajectory(tf.keras.optimizers.Adadelta(
+                1.0, rho=0.95, epsilon=1e-7), 30),
+            rtol=2e-2, atol=2e-2)   # eps placement differs slightly
+
+    def test_adamax(self):
+        np.testing.assert_allclose(
+            zoo_trajectory(O.Adamax(lr=0.05), 30),
+            tf_trajectory(tf.keras.optimizers.Adamax(0.05), 30),
+            rtol=1e-2, atol=1e-2)
