@@ -87,6 +87,14 @@ def gather_rows(src: np.ndarray, idx: np.ndarray,
 
 
 def shuffle_indices(n: int, seed: int) -> np.ndarray:
+    """Seeded Fisher-Yates permutation.
+
+    Deterministic per seed WITHIN each path, but the native (mt19937_64)
+    and numpy-fallback permutations differ for the same seed — callers
+    needing one order on every host regardless of toolchain (the
+    FeatureSet epoch-shuffle contract) must use
+    ``FeatureSet._epoch_perm``'s pure-numpy path instead.
+    """
     lib = get_lib()
     if lib is None:
         return np.random.default_rng(seed).permutation(n)
