@@ -270,11 +270,18 @@ class Deconvolution2D(Layer):
 
     def call(self, params, x, training=False, rng=None):
         policy = get_policy()
+        # transpose_kernel=True gives the GRADIENT-of-conv semantics of
+        # Keras / BigDL SpatialFullConvolution / tf Conv2DTranspose
+        # (spatial flip + I/O swap of the HWIO spec, landing exactly on
+        # our (kh, kw, out, in) layout); without it conv_transpose is a
+        # plain fractionally-strided conv with the kernel as-is.
+        # Golden-tested vs tf in tests/test_golden_tf_layers.py.
         y = jax.lax.conv_transpose(
             policy.cast_compute(x), policy.cast_compute(params["kernel"]),
             strides=self.strides,
             padding=_same_or_valid(self.border_mode),
-            dimension_numbers=("NHWC", "HWOI", "NHWC"))
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            transpose_kernel=True)
         if self.use_bias:
             y = y + params["bias"]
         if self.activation is not None:

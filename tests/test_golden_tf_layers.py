@@ -275,3 +275,22 @@ class TestGolden3DAndMisc:
         assert np.allclose(ref[0, 2], 0.0)     # oracle zeroes it
         np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-6,
                                    atol=1e-6)
+
+
+class TestGoldenDeconvolution:
+    @pytest.mark.parametrize("stride,mode", [
+        ((1, 1), "valid"), ((2, 2), "valid"), ((2, 2), "same")])
+    def test_deconv2d_matches_tf(self, stride, mode):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 5, 5, 3).astype(np.float32)
+        layer = L.Deconvolution2D(4, 3, 3, subsample=stride,
+                                  border_mode=mode)
+        v, out, gx = zoo_forward_and_grad(layer, x)
+        tfl = tf.keras.layers.Conv2DTranspose(4, 3, strides=stride,
+                                              padding=mode)
+        # identical layouts: (kh, kw, out, in)
+        ref, ref_gx = tf_forward_and_grad(
+            tfl, x, [np.asarray(v["params"]["kernel"]),
+                     np.asarray(v["params"]["bias"])])
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(gx, ref_gx, rtol=1e-3, atol=1e-3)
