@@ -294,3 +294,20 @@ class TestGoldenDeconvolution:
                      np.asarray(v["params"]["bias"])])
         np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
         np.testing.assert_allclose(gx, ref_gx, rtol=1e-3, atol=1e-3)
+
+
+class TestGoldenWrappers:
+    def test_time_distributed_dense(self):
+        rs = np.random.RandomState(0)
+        x = rs.randn(2, 6, 5).astype(np.float32)
+        layer = L.TimeDistributed(L.Dense(4, activation="relu"))
+        v, out, gx = zoo_forward_and_grad(layer, x)
+        inner = [np.asarray(l) for l in
+                 jax.tree_util.tree_leaves(v["params"])]
+        tfl = tf.keras.layers.TimeDistributed(
+            tf.keras.layers.Dense(4, activation="relu"))
+        kernel = next(a for a in inner if a.ndim == 2)
+        bias = next(a for a in inner if a.ndim == 1)
+        ref, ref_gx = tf_forward_and_grad(tfl, x, [kernel, bias])
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(gx, ref_gx, rtol=1e-3, atol=1e-3)
