@@ -168,8 +168,14 @@ class ObjectDetector(ImageModel):
         # saved tree by the model's deterministic LAYER ORDER instead
         # so any process can reload it
         order = [l.name for l in self.model.layers]
+        if len(set(order)) != len(order):
+            dupes = sorted({n for n in order if order.count(n) > 1})
+            raise ValueError(
+                f"duplicate layer names {dupes}: order-keyed save would "
+                "silently overwrite one layer's weights with another's")
+        index_of = {n: i for i, n in enumerate(order)}
         variables = {
-            kind: {f"layer_{order.index(n):04d}": sub
+            kind: {f"layer_{index_of[n]:04d}": sub
                    for n, sub in tree.items()}
             for kind, tree in variables.items()}
         meta = {

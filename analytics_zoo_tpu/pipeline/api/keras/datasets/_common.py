@@ -33,7 +33,12 @@ def cap_num_words(split, num_words: Optional[int]):
     x, y = split
     capped = [np.where(np.asarray(s) < num_words,
                        np.asarray(s), 2).astype(np.int32) for s in x]
-    return np.asarray(capped, dtype=object), y
+    # build the object array explicitly: np.asarray(..., dtype=object)
+    # on same-length sequences would yield a 2-D object array, silently
+    # changing the container shape depending on the input
+    out = np.empty(len(capped), dtype=object)
+    out[:] = capped
+    return out, y
 
 
 def check_maxlen(maxlen: int, minimum: int) -> None:

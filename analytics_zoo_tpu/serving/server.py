@@ -135,9 +135,7 @@ class ClusterServing:
         if not entries:
             return 0
         t0 = time.time()
-        uris, arrays = self._decode_batch(entries)
-        real = self._predict_write(uris, arrays, t0)
-        self._ack(entries)
+        real = self._serve_entries(entries, t0)
         if self.summary is not None and real:
             self.summary.add_scalar("Serving Throughput",
                                     real / max(time.time() - t0, 1e-9),
@@ -201,26 +199,75 @@ class ClusterServing:
         entries = [e for e in entries if e[0] not in self._inflight]
         if not entries:
             return 0
-        uris, arrays = self._decode_batch(entries)
-        real = self._predict_write(uris, arrays, time.time())
-        self._ack(entries)
-        log.info("reclaimed %d stale pending records", real)
+        # a reclaimed batch can be the very poison that killed its
+        # original worker — _serve_entries guarantees it cannot kill
+        # THIS one too (no crash-loop across reclaiming workers)
+        real = self._serve_entries(entries, time.time())
+        log.info("reclaimed %d stale pending records (%d poison)",
+                 real, len(entries) - real)
         return real
 
     def _decode_batch(self, entries):
         """Decode one batch of raw stream entries (runs in the decode
         pool — pure CPU, no broker IO, so no connection sharing across
-        threads)."""
-        uris, arrays = [], []
+        threads).  Undecodable records are collected into ``failed``
+        (uri, exception) rather than silently dropped — the serve path
+        writes them an error result, because acking consumes the record
+        and a consumed record with no result strands its client."""
+        uris, arrays, failed = [], [], []
         for entry_id, fields in entries:
             try:
                 uri, arr = decode_field(fields)
-            except Exception:
+            except Exception as e:
                 log.exception("undecodable record %s", entry_id)
+                failed.append((self._uri_of(fields), e))
                 continue
             uris.append(uri)
             arrays.append(arr)
-        return uris, arrays
+        return uris, arrays, failed
+
+    @staticmethod
+    def _uri_of(fields) -> str:
+        uri = fields.get("uri", b"") if hasattr(fields, "get") else b""
+        return uri.decode() if isinstance(uri, bytes) else uri
+
+    def _serve_entries(self, entries, t_arrival: float) -> int:
+        """Decode + serve one raw batch with the poison-batch contract
+        applied (shared by run_once, the pipelined loop via
+        _consume_batch, and _reclaim_stale).  Returns #served."""
+        try:
+            decoded = self._decode_batch(entries)
+        except Exception as e:
+            log.exception("decode failed for batch (%d records)",
+                          len(entries))
+            decoded = ([], [], [(self._uri_of(f), e) for _, f in entries])
+        return self._serve_decoded(decoded, t_arrival, entries)
+
+    def _serve_decoded(self, decoded, t_arrival: float, entries) -> int:
+        """Predict + write a decoded batch, then ack it.  The poison
+        contract: NO failure in predict/write may escape (it would kill
+        the worker loop with the batch un-acked), and every record that
+        is acked without a prediction gets an explicit ERROR result so
+        its client never blocks forever on a consumed record.
+        ``decoded`` is (uris, arrays) or (uris, arrays, failed)."""
+        uris, arrays, *rest = decoded
+        failed = list(rest[0]) if rest else []
+        real = 0
+        try:
+            real = self._predict_write(uris, arrays, t_arrival)
+        except Exception as e:
+            log.exception("poison batch skipped (%d records)",
+                          len(entries))
+            failed += [(u, e) for u in uris]
+        for uri, exc in failed:
+            try:
+                if uri:
+                    self._write_result(uri, json.dumps(
+                        {"error": f"{type(exc).__name__}: {exc}"}))
+            except Exception:
+                log.exception("could not write error result for %s", uri)
+        self._ack(entries)
+        return real
 
     def _predict_write(self, uris, arrays, t_arrival: float) -> int:
         """Pad/predict/top-N/write one decoded batch; returns #served."""
@@ -315,11 +362,7 @@ class ClusterServing:
                                     entries))
                 if pending:
                     fut, t_arrival, entries = pending.popleft()
-                    uris, arrays = fut.result()
-                    self._predict_write(uris, arrays, t_arrival)
-                    self._ack(entries)
-                    self._inflight.difference_update(
-                        i for i, _ in entries)
+                    self._consume_batch(fut, t_arrival, entries)
                     if self.summary is not None and self.latencies:
                         s = self.stats()
                         self.summary.add_scalar(
@@ -335,14 +378,27 @@ class ClusterServing:
                     # its clients wait forever
                     while pending:
                         fut, t_arrival, entries = pending.popleft()
-                        uris, arrays = fut.result()
-                        self._predict_write(uris, arrays, t_arrival)
-                        self._ack(entries)
-                        self._inflight.difference_update(
-                            i for i, _ in entries)
+                        self._consume_batch(fut, t_arrival, entries)
                     break
         finally:
             pool.shutdown(wait=False)
+
+    def _consume_batch(self, fut, t_arrival, entries) -> None:
+        """Serve one pipelined batch whose decode ran in the pool:
+        resolve the decode future (a future that raised becomes an
+        all-failed decode) and hand off to the shared poison-safe serve
+        path, then clear the batch's in-flight ids."""
+        try:
+            try:
+                decoded = fut.result()
+            except Exception as e:
+                log.exception("decode future failed (%d records)",
+                              len(entries))
+                decoded = ([], [],
+                           [(self._uri_of(f), e) for _, f in entries])
+            self._serve_decoded(decoded, t_arrival, entries)
+        finally:
+            self._inflight.difference_update(i for i, _ in entries)
 
     def start_background(self) -> threading.Thread:
         t = threading.Thread(target=self.run, daemon=True)

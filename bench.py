@@ -32,6 +32,7 @@ recorded TPU number exists to compare against.
 
 import argparse
 import json
+import os
 import sys
 import time
 import traceback
@@ -67,35 +68,43 @@ _PROBE_SNIPPET = (
 )
 
 
-def _probe_backend(retries: int = 3, wait_s: float = 15.0,
-                   probe_timeout_s: float = 120.0):
+def _probe_backend(budget_s: float = 1500.0, probe_timeout_s: float = 120.0):
     """Check the accelerator backend is usable BEFORE touching it in
     this process.
 
     Backend init on a contended chip can *block indefinitely* inside
     the PJRT client (observed in round 1: rc=124 with no output), so an
     in-process try/except is not enough — the probe runs a tiny op in a
-    subprocess with a hard timeout, retrying a bounded number of
-    times.  Only after a probe succeeds do we initialise the backend in
-    this process.  Returns (ok, error_string_or_None)."""
+    subprocess with a hard timeout.  Contention can last many minutes
+    (round 3 recorded zeros because the probe gave up after ~7 min), so
+    probing is *deadline*-based: keep trying until ``budget_s`` of wall
+    clock is spent, with exponential backoff between attempts (15 s →
+    240 s cap).  Only after a probe succeeds do we initialise the
+    backend in this process.  Returns (ok, error_string_or_None)."""
     import subprocess
 
+    deadline = time.time() + budget_s
+    wait_s = 15.0
     last_err = None
-    for attempt in range(retries):
+    attempt = 0
+    while True:
+        attempt += 1
         try:
             r = subprocess.run(
                 [sys.executable, "-c", _PROBE_SNIPPET],
                 capture_output=True, text=True, timeout=probe_timeout_s)
             if r.returncode == 0 and "OK" in r.stdout:
                 return True, None
-            last_err = (f"probe rc={r.returncode}: "
+            last_err = (f"probe attempt {attempt} rc={r.returncode}: "
                         f"{(r.stderr or r.stdout)[-1500:]}")
         except subprocess.TimeoutExpired:
-            last_err = (f"probe timed out after {probe_timeout_s}s "
-                        "(backend init blocked — chip contended?)")
-        if attempt + 1 < retries:
-            time.sleep(wait_s)
-    return False, last_err
+            last_err = (f"probe attempt {attempt} timed out after "
+                        f"{probe_timeout_s}s (backend init blocked — "
+                        "chip contended?)")
+        if time.time() + wait_s + probe_timeout_s > deadline:
+            return False, last_err
+        time.sleep(wait_s)
+        wait_s = min(wait_s * 2, 240.0)
 
 
 # --------------------------------------------------------------------- ncf
@@ -478,16 +487,32 @@ def _run_child(workload: str, timeout_s: float):
                   f"{(r.stderr or '')[-1500:]}")
 
 
+ARTIFACT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_results_r04.json")
+
+
+def _write_artifact(results, meta):
+    """Persist every per-workload result to a committed artifact so
+    numbers survive the driver's tail-line parse (round 3 lesson:
+    successful non-tail lines were never durably recorded).  Written
+    incrementally after each workload so a later hang can't lose
+    earlier results."""
+    try:
+        with open(ARTIFACT_PATH, "w") as f:
+            json.dump({"meta": meta, "results": results}, f, indent=2)
+    except OSError:
+        pass  # artifact write must never take down the bench
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="all",
                     choices=sorted(WORKLOADS) + ["all"])
     # a tunneled backend can disappear for MINUTES at a time (observed
-    # round 3) — retry long enough to ride out a transient blip without
-    # stalling a dead backend forever (fast-fail ~4 min of waits;
-    # hang-every-probe worst case ~16 min: 6x120s probes + 5x45s waits)
-    ap.add_argument("--retries", type=int, default=6)
-    ap.add_argument("--retry-wait", type=float, default=45.0)
+    # rounds 1 and 3) — the probe is deadline-based: keep probing with
+    # exponential backoff until ~25 min of wall clock is spent.  A bench
+    # that can't outlast contention is a bench that records zeros.
+    ap.add_argument("--probe-budget", type=float, default=1500.0)
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--run-timeout", type=float, default=900.0)
     ap.add_argument("--child", action="store_true",
@@ -514,35 +539,78 @@ def main(argv=None):
                        error_tail=_short_tb()))
             return 1
 
-    ok, err = _probe_backend(args.retries, args.retry_wait,
-                             args.probe_timeout)
+    t_start = time.time()
+    meta = {"argv": sys.argv[1:], "started_unix": round(t_start, 1)}
+    names = sorted(WORKLOADS, key=lambda n: n == "resnet50") \
+        if args.workload == "all" else [args.workload]
+
+    ok, err = _probe_backend(args.probe_budget, args.probe_timeout)
+    results = []
     if not ok:
-        _emit(dict(diag_for("resnet50" if args.workload == "all"
-                            else args.workload),
-                   error="backend probe failed after retries",
-                   error_tail=err))
+        # emit a zero line per workload (north-star resnet50 LAST for
+        # the driver's tail parse) and record the artifact — a dead
+        # backend must still leave a complete, honest record
+        for name in names:
+            results.append(dict(diag_for(name),
+                                error="backend probe failed within budget",
+                                error_tail=err))
+            _emit(results[-1])
+        meta["probe_failed"] = True
+        _write_artifact(results, meta)
         return 1
 
     # "all" runs every workload and prints the north-star ResNet-50
     # line LAST (the driver records the tail line); each workload gets
     # its own child process so one crash can't take out the others.
-    names = sorted(WORKLOADS, key=lambda n: n == "resnet50") \
-        if args.workload == "all" else [args.workload]
     rc = 0
+    backend_down = False
     for name in names:
+        if backend_down:
+            result = dict(diag_for(name),
+                          error="backend down (confirmed by re-probe)",
+                          error_tail=err)
+            results.append(result)
+            _emit(result)
+            _write_artifact(results, meta)
+            rc = 1
+            continue
         result, err = _run_child(name, args.run_timeout)
         if result is None or result.get("error"):
-            # one more chance after a pause: a mid-bench backend blip
-            # (hang OR crash) should not zero this workload's number
-            time.sleep(30)
+            # Decide whether a retry is worth its wall-clock: a mid-run
+            # *crash* gets one retry after a pause; a *hang/timeout*
+            # first re-probes the backend (cheap) — if the chip is
+            # confirmed unreachable even after a 10-min re-probe
+            # budget, burning another --run-timeout per workload would
+            # roughly double worst-case wall time for nothing
+            # (round-3 advisor finding).
+            timed_out = err is not None and "timed out" in err
+            if timed_out:
+                ok2, _probe_err = _probe_backend(600.0, args.probe_timeout)
+                if not ok2:
+                    backend_down = True
+                    result = dict(diag_for(name),
+                                  error="workload hung and backend "
+                                        "unreachable on re-probe",
+                                  error_tail=err)
+                    results.append(result)
+                    _emit(result)
+                    _write_artifact(results, meta)
+                    rc = 1
+                    continue
+            else:
+                time.sleep(30)
             retry_result, retry_err = _run_child(name, args.run_timeout)
             if retry_result is not None and not retry_result.get("error"):
                 result, err = retry_result, retry_err
         if result is None:
             result = dict(diag_for(name), error="workload run failed",
                           error_tail=err)
+        results.append(result)
         _emit(result)
+        _write_artifact(results, meta)
         rc = rc or (1 if result.get("error") else 0)
+    meta["wall_s"] = round(time.time() - t_start, 1)
+    _write_artifact(results, meta)
     return rc
 
 

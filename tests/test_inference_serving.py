@@ -370,6 +370,61 @@ class TestPipelinedServing:
         assert s["latency_p50_ms"] > 0
         assert s["latency_p95_ms"] >= s["latency_p50_ms"]
 
+    def test_poison_records_do_not_kill_worker(self):
+        """Poison input must not kill the serving thread with its batch
+        un-acked.  Two poison shapes: (a) an undecodable image record —
+        skipped per-record by _decode_batch; (b) a record whose decoded
+        shape mismatches its batch — np.stack raises out of
+        _predict_write, and _consume_batch must ack + skip that batch
+        and keep serving the rest."""
+        import time as _t
+
+        class Model:
+            def predict(self, x, batch_size=None):
+                return np.zeros((len(x), 4), np.float32)
+
+        broker = EmbeddedBroker()
+        bs = 4
+        serving = ClusterServing(Model(), ServingConfig(batch_size=bs),
+                                 broker=broker)
+        inq = InputQueue(broker=broker)
+        n = 16
+        expect_served = set()
+        poison_batch = {i for i in range(8, 12)}   # batch 2
+        for i in range(n):
+            if i == 5:
+                # (a) undecodable image — dropped per-record in decode
+                inq.enqueue_image(f"p{i}", b"not-a-jpeg")
+            elif i == 9:
+                # (b) wrong shape — poisons batch 2 at np.stack time
+                inq.enqueue(f"p{i}", np.zeros(7, np.float32))
+            else:
+                inq.enqueue(f"p{i}", np.zeros(3, np.float32))
+                if i not in poison_batch:
+                    expect_served.add(i)
+        t = threading.Thread(target=serving.run, kwargs={"poll_ms": 5})
+        t.start()
+        deadline = _t.time() + 30
+        while serving.total_records < len(expect_served) \
+                and _t.time() < deadline:
+            _t.sleep(0.005)
+        serving.stop()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert serving.total_records == len(expect_served)
+        outq = OutputQueue(broker=broker)
+        for i in expect_served:
+            assert outq.query(f"p{i}") is not None, f"p{i} missing"
+        # every record acked WITHOUT a prediction — the whole poisoned
+        # batch AND the per-record decode failure — carries an explicit
+        # error result: a consumed record must never leave its client
+        # blocking forever on an empty key
+        for i in sorted(poison_batch | {5}):
+            res = outq.query(f"p{i}")
+            assert isinstance(res, dict) and "error" in res, (i, res)
+        # pipeline state is clean: nothing left marked in-flight
+        assert not serving._inflight
+
     def test_stop_drains_inflight_batches(self):
         """Records already read past (_last_id advanced) must be served
         before shutdown — a stop may not strand queued clients."""
