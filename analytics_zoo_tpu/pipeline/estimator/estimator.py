@@ -150,11 +150,17 @@ class Estimator:
 
         # --- epoch loop -----------------------------------------------------
         def save_snapshot():
-            ckpt.save({"params": mesh_lib.fetch_global(params),
+            # fetch_global is a COLLECTIVE (cross-process allgather for
+            # non-addressable shards) — every process must run it; only
+            # the coordinator writes the file, like the reference's
+            # driver-side snapshot (Topology.scala:1293). Restore assumes
+            # model_dir is on a filesystem all hosts can read.
+            payload = {"params": mesh_lib.fetch_global(params),
                        "state": mesh_lib.fetch_global(state),
                        "opt_state": mesh_lib.fetch_global(opt_state),
-                       "epoch": ts.epoch, "iteration": ts.iteration},
-                      step=ts.iteration)
+                       "epoch": ts.epoch, "iteration": ts.iteration}
+            if jax.process_index() == 0:
+                ckpt.save(payload, step=ts.iteration)
 
         stop = False
         while not stop and not end_trigger(ts):

@@ -18,7 +18,10 @@ from flax import serialization as fser
 
 
 def _atomic_write(path: str, data: bytes) -> None:
-    tmp = path + ".tmp"
+    # pid-unique tmp name: on a shared filesystem two processes writing
+    # the same snapshot concurrently must not interleave into one tmp
+    # file or rename a partially-written one
+    tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "wb") as f:
         f.write(data)
     os.replace(tmp, path)

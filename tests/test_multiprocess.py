@@ -1,0 +1,126 @@
+"""Real 2-process ``jax.distributed`` end-to-end test.
+
+The reference validates distribution with multi-partition ``local[N]``
+Spark runs (DistriEstimatorSpec.scala); the single-process 8-device
+mesh in conftest covers the SPMD math, but the ``process_count > 1``
+branches (make_array_from_process_local_data placement, per-host batch
+slicing, predict row-slicing, coordinator-only checkpointing) only
+execute with a REAL multi-process coordinator handshake.  This test
+launches 2 workers x 4 virtual CPU devices via ``ZooCluster`` (gloo
+collectives) and checks:
+
+  * both hosts converge to IDENTICAL final params (the SPMD programs
+    stayed in lockstep through fit + checkpoint-resume),
+  * each host's ``predict`` returns exactly its own rows,
+  * the 2-process run matches a single-process 8-device oracle run
+    trained on the equivalently-ordered global batches.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.parallel.launcher import ZooCluster
+
+pytestmark = pytest.mark.slow   # 2 subprocess jax inits + compiles
+
+WORKER = os.path.join(os.path.dirname(__file__),
+                      "distributed_fit_worker.py")
+
+
+def _single_process_oracle():
+    """Train the same model single-process on the 8-device mesh, over
+    global batches ordered exactly as the 2-process run builds them
+    (batch b = [host0 rows 16b:16b+16, host1 rows 16b:16b+16])."""
+    import jax
+
+    from analytics_zoo_tpu.common.triggers import MaxEpoch
+    from analytics_zoo_tpu.feature.feature_set import FeatureSet
+    from analytics_zoo_tpu.ops import dtypes
+    from analytics_zoo_tpu.pipeline.estimator import Estimator
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import SGD
+
+    from tests.distributed_fit_worker import build_model, make_data
+
+    old = dtypes.get_policy()
+    dtypes.set_policy(param_dtype="float32", compute_dtype="float32")
+    try:
+        x, y = make_data()
+        order = np.concatenate([
+            np.r_[b * 16:(b + 1) * 16, 32 + b * 16:32 + (b + 1) * 16]
+            for b in range(2)])
+        train_set = FeatureSet.from_ndarrays(x[order], y[order],
+                                             shuffle=False)
+        model = build_model()
+        est = Estimator(model, optim_method=SGD(learning_rate=0.1))
+        est.train(train_set, "mse", end_trigger=MaxEpoch(3),
+                  batch_size=32)
+        params = [np.asarray(l) for l in
+                  jax.tree_util.tree_leaves(est.variables["params"])]
+        preds = est.predict(x, batch_size=32)
+        return params, np.asarray(preds), \
+            [h["loss"] for h in est.history]
+    finally:
+        dtypes.restore_policy(old)
+
+
+def test_two_process_fit_predict_resume(tmp_path):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(WORKER)))
+    env = {
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "JAX_PLATFORMS": "cpu",
+        "ZOO_TEST_OUT": str(tmp_path),
+        "PYTHONPATH": repo_root + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+    }
+    cluster = ZooCluster(num_processes=2, env=env)
+    cluster.start(WORKER)
+    try:
+        codes = cluster.wait(timeout=600)
+    finally:
+        cluster.stop()
+    assert codes == [0, 0], f"worker exit codes {codes}"
+
+    w0 = np.load(tmp_path / "worker0.npz")
+    w1 = np.load(tmp_path / "worker1.npz")
+
+    # hosts agree bit-for-bit on every param after fit AND after the
+    # checkpoint-resume continuation — lockstep proof
+    p_keys = sorted(k for k in w0.files if k.startswith(("p2_", "p3_")))
+    assert p_keys
+    for k in p_keys:
+        np.testing.assert_array_equal(w0[k], w1[k], err_msg=k)
+    # training moved the params between epoch 2 and epoch 3
+    assert any(not np.array_equal(w0[k], w0[k.replace("p2", "p3")])
+               for k in p_keys if k.startswith("p2_"))
+
+    # oracle run in THIS process (single-process, 8 devices)
+    oracle_params, oracle_preds, oracle_losses = _single_process_oracle()
+
+    p3 = [w0[k] for k in sorted(k for k in w0.files
+                                if k.startswith("p3_"))]
+    assert len(p3) == len(oracle_params)
+    for got, want in zip(p3, oracle_params):
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    # per-host predict slicing: worker k got exactly rows [32k, 32k+32)
+    np.testing.assert_allclose(w0["preds"], oracle_preds[:32],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(w1["preds"], oracle_preds[32:],
+                               rtol=1e-5, atol=1e-6)
+
+    # reported per-epoch losses match (epoch 1+2 from phase 1)
+    np.testing.assert_allclose(w0["losses"], oracle_losses[:2],
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_array_equal(w0["losses"], w1["losses"])
+
+    # coordinator-only checkpoint write: snapshots exist and were
+    # written once (no stray per-process tmp files left behind)
+    snaps = [f for f in os.listdir(tmp_path / "ckpt")
+             if f.endswith(".ckpt")]
+    assert snaps
+    assert not [f for f in os.listdir(tmp_path / "ckpt")
+                if f.endswith(".tmp")]
