@@ -21,11 +21,23 @@ from analytics_zoo_tpu.pipeline.api.keras import Input, Model
 from analytics_zoo_tpu.pipeline.api.keras.layers import (
     Activation, AveragePooling2D, BatchNormalization, Convolution2D, Dense,
     Dropout, Flatten, GlobalAveragePooling2D, MaxPooling2D, Merge,
-    SpaceToDepth2D,
+    SpaceToDepth2D, ZeroPadding2D,
 )
 
 
-def _conv_bn(x, filters, k, stride=1, act=True, border="same"):
+def _conv_bn(x, filters, k, stride=1, act=True, border="same",
+             torch_pad=False):
+    """Conv→BN→ReLU.  ``torch_pad`` reproduces the torch/Caffe lineage's
+    explicit SYMMETRIC padding (pad (k-1)//2 on both sides, then a
+    valid conv): XLA's SAME pads asymmetrically under stride 2 (e.g.
+    0/1 for k=3), which samples different pixel positions — imported
+    torchvision checkpoints are only numerically faithful with the
+    source's alignment.  For stride 1 the two are identical, so SAME
+    is kept (one op instead of two)."""
+    if torch_pad and stride > 1 and k > 1:
+        p = (k - 1) // 2
+        x = ZeroPadding2D((p, p))(x)
+        border = "valid"
     x = Convolution2D(filters, k, k, subsample=(stride, stride),
                       border_mode=border, bias=False)(x)
     x = BatchNormalization()(x)
@@ -50,9 +62,9 @@ def lenet(num_classes: int = 10,
 
 
 # ----------------------------------------------------------------- ResNet
-def _basic_block(x, filters, stride):
+def _basic_block(x, filters, stride, torch_pad=False):
     shortcut = x
-    y = _conv_bn(x, filters, 3, stride)
+    y = _conv_bn(x, filters, 3, stride, torch_pad=torch_pad)
     y = _conv_bn(y, filters, 3, 1, act=False)
     if stride != 1 or x.shape[-1] != filters:
         shortcut = _conv_bn(x, filters, 1, stride, act=False)
@@ -60,10 +72,10 @@ def _basic_block(x, filters, stride):
     return Activation("relu")(out)
 
 
-def _bottleneck_block(x, filters, stride):
+def _bottleneck_block(x, filters, stride, torch_pad=False):
     shortcut = x
     y = _conv_bn(x, filters, 1, 1)
-    y = _conv_bn(y, filters, 3, stride)
+    y = _conv_bn(y, filters, 3, stride, torch_pad=torch_pad)
     y = _conv_bn(y, 4 * filters, 1, 1, act=False)
     if stride != 1 or x.shape[-1] != 4 * filters:
         shortcut = _conv_bn(x, 4 * filters, 1, stride, act=False)
@@ -82,7 +94,7 @@ _RESNET_SPECS = {
 
 def resnet(depth: int = 50, num_classes: int = 1000,
            input_shape: Tuple[int, int, int] = (224, 224, 3),
-           stem: str = "conv7") -> Model:
+           stem: str = "conv7", conv_padding: str = "same") -> Model:
     """ResNet for ImageNet-scale inputs (TrainImageNet.scala recipe).
 
     ``stem="conv7"`` is the classic 7x7/stride-2 stem; ``"space_to_depth"``
@@ -90,24 +102,43 @@ def resnet(depth: int = 50, num_classes: int = 1000,
     channels, then a 4x4/stride-1 conv whose 8x8-pixel receptive field
     covers the 7x7 original) — same output shape and capacity, ~4x the
     stem's MXU utilisation on TPU.
+
+    ``conv_padding="torch"`` uses the torch/Caffe lineage's explicit
+    symmetric padding on the stem, the stem maxpool, and every
+    stride-2 3x3 conv (see ``_conv_bn``) — the alignment published
+    torchvision checkpoints were trained with (the block layout here
+    already matches torchvision's v1.5: stride on the 3x3).  The
+    default SAME padding is what you want when training from scratch
+    (fewer ops, identical capacity).
     """
     block, reps = _RESNET_SPECS[depth]
+    torch_pad = conv_padding == "torch"
+    if conv_padding not in ("same", "torch"):
+        raise ValueError(f"conv_padding must be 'same' or 'torch', "
+                         f"got {conv_padding!r}")
     inp = Input(shape=input_shape)
     if stem == "space_to_depth":
         x = SpaceToDepth2D(2)(inp)
         x = _conv_bn(x, 64, 4, 1)
     elif stem == "conv7":
-        x = _conv_bn(inp, 64, 7, 2)
+        x = _conv_bn(inp, 64, 7, 2, torch_pad=torch_pad)
     else:
         raise ValueError(f"unknown stem {stem!r}; "
                          "expected 'conv7' or 'space_to_depth'")
-    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
-                     border_mode="same")(x)
+    if torch_pad:
+        # zero-pad then valid pool == torch's pad-1 maxpool (post-ReLU
+        # activations are >= 0, so zero padding never wins the max)
+        x = ZeroPadding2D((1, 1))(x)
+        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                         border_mode="valid")(x)
+    else:
+        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                         border_mode="same")(x)
     filters = 64
     for stage, n in enumerate(reps):
         for i in range(n):
             stride = 2 if (stage > 0 and i == 0) else 1
-            x = block(x, filters, stride)
+            x = block(x, filters, stride, torch_pad=torch_pad)
         filters *= 2
     x = GlobalAveragePooling2D()(x)
     out = Dense(num_classes)(x)
@@ -345,19 +376,41 @@ _BUILDERS = {
 
 class ImageClassifier(ImageModel):
     """Build a named classification net (the by-name loading surface of
-    ImageClassificationConfig.scala)."""
+    ImageClassificationConfig.scala).
+
+    ``pretrained`` imports a published checkpoint — a torchvision
+    ``.pth`` state_dict or a tf.keras model / ``.h5`` file (see
+    ``pretrained.py``) — and installs the matching per-model preprocess
+    configure, the reference's load-by-name +
+    ImageClassificationConfig behavior."""
 
     def __init__(self, model_name: str = "resnet-50",
                  num_classes: int = 1000,
                  input_shape: Tuple[int, int, int] = (224, 224, 3),
-                 config: ImageConfigure = None):
+                 config: ImageConfigure = None,
+                 pretrained=None, source: str = None):
         if model_name not in _BUILDERS:
             raise ValueError(
                 f"unknown model {model_name!r}; "
                 f"available: {sorted(_BUILDERS)}")
         self._builder = _BUILDERS[model_name]
         self._kw = dict(num_classes=num_classes, input_shape=input_shape)
+        if pretrained is not None:
+            from analytics_zoo_tpu.models.image.imageclassification \
+                .pretrained import infer_source
+            # source must be known BEFORE build: torchvision resnets
+            # need the torch padding alignment in the graph
+            source = source or infer_source(pretrained)
+            if source == "torchvision" and model_name.startswith("resnet"):
+                self._kw["conv_padding"] = "torch"
         super().__init__(config)
+        if pretrained is not None:
+            from analytics_zoo_tpu.models.image.imageclassification \
+                .pretrained import load_pretrained, pretrained_configure
+            load_pretrained(self.model, pretrained, source=source)
+            if config is None:
+                self.config = pretrained_configure(
+                    model_name, source, input_shape=input_shape)
 
     def build_model(self):
         return self._builder(**self._kw)

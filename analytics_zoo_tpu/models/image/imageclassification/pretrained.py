@@ -1,0 +1,310 @@
+"""Published-checkpoint import for the image model zoo.
+
+The reference ships load-by-name pretrained models with per-model
+preprocess configs
+(zoo/models/image/imageclassification/ImageClassificationConfig.scala:190,
+zoo/models/image/common/ImageModel.scala:47 — ``ImageClassifier.loadModel``
+pulls an analytics-zoo-published weight artifact).  There is no
+analytics-zoo weight zoo for this framework, so the equivalent user
+journey — "load a pretrained resnet-50 and predict" — is served by
+importing the ecosystem's published checkpoints directly:
+
+* **torchvision** ``.pth``/``.pt`` state_dicts (resnet family — the
+  block layout here matches torchvision's v1.5, and
+  ``resnet(conv_padding="torch")`` reproduces its padding alignment
+  exactly);
+* **tf.keras / keras-applications** models or ``.h5``/``.keras`` files
+  (vgg family — architectures match layer-for-layer).
+
+Both sources are normalised into one canonical group sequence and
+installed by a single loop: mapping is positional over the
+deterministic builder layer order (the same contract ObjectDetector
+persistence uses), and both sides must agree exactly — any shape or
+kind mismatch raises with the offending slot named.
+
+Numeric fidelity notes:
+* a source conv bias facing a bias-free target conv is folded into the
+  IMMEDIATELY FOLLOWING BN's running mean (BN(conv(x)+b) ==
+  BN'(conv(x)) with mean' = mean - b); if no BN directly follows, the
+  import refuses rather than guessing;
+* the source BN epsilon is folded into the stored ``moving_var``
+  (``var' = var + eps_src - eps_layer`` so the layer's
+  ``rsqrt(var' + eps_layer)`` equals the source's
+  ``rsqrt(var + eps_src)`` exactly) — unlike patching the live layer,
+  this survives save_model/load_weights round-trips.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.common import ChainedPreprocessing
+from analytics_zoo_tpu.feature.image import (
+    ImageCenterCrop, ImageChannelNormalize, ImageChannelOrder, ImageResize)
+from analytics_zoo_tpu.models.image.common import ImageConfigure
+
+_TORCH_BN_EPS = 1e-5
+
+# canonical group: ("conv"|"dense", {kernel[, bias], __name__})
+#               or ("bn", {gamma, beta, moving_mean, moving_var,
+#                          epsilon, __name__})
+Group = Tuple[str, Dict[str, Any]]
+
+
+# ------------------------------------------------------------- model slots
+def _model_slots(model) -> List[Tuple[str, Any]]:
+    """The model's weight-bearing layers, in builder order, classified
+    as 'conv' / 'bn' / 'dense'."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        BatchNormalization, Dense)
+    from analytics_zoo_tpu.pipeline.api.keras.layers.conv import _ConvND
+
+    slots: List[Tuple[str, Any]] = []
+    for layer in model.layers:
+        if isinstance(layer, BatchNormalization):
+            slots.append(("bn", layer))
+        elif isinstance(layer, _ConvND):
+            slots.append(("conv", layer))
+        elif isinstance(layer, Dense):
+            slots.append(("dense", layer))
+    return slots
+
+
+# ----------------------------------------------------- source -> groups
+def _to_numpy(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _torch_groups(state_dict) -> List[Group]:
+    """Normalise a torch ``state_dict`` (insertion-ordered = module
+    definition order) into canonical groups."""
+    grouped: Dict[str, Dict[str, np.ndarray]] = {}
+    order: List[str] = []
+    for key, tensor in state_dict.items():
+        prefix, _, leaf = key.rpartition(".")
+        if leaf == "num_batches_tracked":
+            continue
+        if prefix not in grouped:
+            grouped[prefix] = {}
+            order.append(prefix)
+        grouped[prefix][leaf] = _to_numpy(tensor)
+
+    out: List[Group] = []
+    for prefix in order:
+        g = grouped[prefix]
+        if "running_mean" in g:
+            out.append(("bn", {
+                "gamma": g["weight"], "beta": g["bias"],
+                "moving_mean": g["running_mean"],
+                "moving_var": g["running_var"],
+                "epsilon": _TORCH_BN_EPS, "__name__": prefix}))
+        elif g["weight"].ndim == 4:
+            # OIHW -> HWIO; also correct for grouped/depthwise convs
+            # (torch (C,1,kh,kw) -> (kh,kw,1,C), I = in/groups)
+            ng: Dict[str, Any] = {
+                "kernel": np.transpose(g["weight"], (2, 3, 1, 0)),
+                "__name__": prefix}
+            if "bias" in g:
+                ng["bias"] = g["bias"]
+            out.append(("conv", ng))
+        elif g["weight"].ndim == 2:
+            ng = {"kernel": g["weight"].T, "__name__": prefix}
+            if "bias" in g:
+                ng["bias"] = g["bias"]
+            out.append(("dense", ng))
+        else:
+            raise ValueError(
+                f"cannot classify checkpoint module {prefix!r} "
+                f"(weight shape {g['weight'].shape})")
+    return out
+
+
+def _keras_groups(keras_model) -> List[Group]:
+    """Normalise a live tf.keras model into canonical groups."""
+    out: List[Group] = []
+    for kl in keras_model.layers:
+        cls = type(kl).__name__
+        w = [np.asarray(a) for a in kl.get_weights()]
+        if cls in ("Conv2D", "DepthwiseConv2D"):
+            kernel = w[0]
+            if cls == "DepthwiseConv2D":
+                # keras depthwise (kh, kw, C, mult) -> grouped HWIO
+                kh, kw, c, mult = kernel.shape
+                kernel = kernel.reshape(kh, kw, 1, c * mult)
+            g: Dict[str, Any] = {"kernel": kernel, "__name__": kl.name}
+            if len(w) > 1:
+                g["bias"] = w[1]
+            out.append(("conv", g))
+        elif cls == "Dense":
+            g = {"kernel": w[0], "__name__": kl.name}
+            if len(w) > 1:
+                g["bias"] = w[1]
+            out.append(("dense", g))
+        elif cls == "BatchNormalization":
+            gamma, beta, mean, var = w
+            out.append(("bn", {
+                "gamma": gamma, "beta": beta, "moving_mean": mean,
+                "moving_var": var, "epsilon": float(kl.epsilon),
+                "__name__": kl.name}))
+        elif w:
+            raise ValueError(
+                f"unsupported source layer {cls} ({kl.name}) with "
+                "weights")
+    return out
+
+
+# -------------------------------------------------------------- installer
+def _install(model, groups: List[Group]) -> None:
+    """Install canonical groups into the model's weight slots."""
+    slots = _model_slots(model)
+    if len(groups) != len(slots):
+        raise ValueError(
+            f"checkpoint has {len(groups)} weight modules but the model "
+            f"has {len(slots)} weight layers — architectures differ")
+
+    model.init()
+    variables = model.get_variables()
+    params, state = variables["params"], variables["state"]
+
+    for i, ((skind, layer), (gkind, g)) in enumerate(zip(slots, groups)):
+        name = layer.name
+        if skind != gkind:
+            raise ValueError(
+                f"layer {name} is a {skind} but checkpoint module "
+                f"{g['__name__']!r} is a {gkind}")
+        if skind in ("conv", "dense"):
+            _assign(params, name, "kernel", g["kernel"])
+            if "bias" in g:
+                if "bias" in params[name]:
+                    _assign(params, name, "bias", g["bias"])
+                elif skind == "conv" and i + 1 < len(slots) \
+                        and slots[i + 1][0] == "bn" \
+                        and groups[i + 1][0] == "bn":
+                    # fold ONLY into the BN that consumes THIS conv's
+                    # output (the immediately following slot) — folding
+                    # into a later BN would be silently wrong
+                    groups[i + 1][1]["moving_mean"] = \
+                        groups[i + 1][1]["moving_mean"] - g["bias"]
+                else:
+                    raise ValueError(
+                        f"checkpoint module {g['__name__']!r} has a "
+                        f"bias but target layer {name} is bias-free "
+                        "and not directly followed by a BN to fold "
+                        "it into")
+        else:  # bn
+            _assign(params, name, "gamma", g["gamma"])
+            _assign(params, name, "beta", g["beta"])
+            _assign(state, name, "moving_mean", g["moving_mean"])
+            # epsilon folded into the stored variance — exact, and it
+            # survives save/load (the layer object keeps its own eps)
+            var = g["moving_var"] + (g["epsilon"] - layer.epsilon)
+            _assign(state, name, "moving_var", var)
+    model.set_variables({"params": params, "state": state})
+
+
+def _assign(tree, layer_name: str, key: str, value: np.ndarray) -> None:
+    cur = tree[layer_name][key]
+    if tuple(np.shape(cur)) != tuple(np.shape(value)):
+        raise ValueError(
+            f"{layer_name}.{key}: checkpoint shape "
+            f"{tuple(np.shape(value))} != model shape "
+            f"{tuple(np.shape(cur))}")
+    tree[layer_name][key] = np.asarray(value).astype(
+        np.asarray(cur).dtype)
+
+
+# --------------------------------------------------------------- entries
+def load_torch_state_dict(model, state_dict) -> None:
+    """Import a torchvision-layout state_dict into ``model`` in place.
+
+    ``state_dict`` may be the dict itself or a checkpoint dict holding
+    one under the conventional ``"state_dict"`` key.
+    """
+    inner = state_dict.get("state_dict") \
+        if isinstance(state_dict, dict) else None
+    if isinstance(inner, dict):
+        state_dict = inner
+    _install(model, _torch_groups(state_dict))
+
+
+def load_keras_model(model, keras_model) -> None:
+    """Import a tf.keras model's weights into ``model`` in place.
+
+    ``keras_model`` is a live tf.keras ``Model`` (e.g.
+    ``tf.keras.applications.VGG16(...)`` after ``load_weights``) or a
+    path loadable by ``tf.keras.models.load_model``.
+    """
+    if isinstance(keras_model, (str, os.PathLike)):
+        import tensorflow as tf
+        keras_model = tf.keras.models.load_model(keras_model,
+                                                 compile=False)
+    _install(model, _keras_groups(keras_model))
+
+
+def infer_source(src) -> Optional[str]:
+    """'torchvision' | 'keras' from the checkpoint's type / extension."""
+    if isinstance(src, (str, os.PathLike)):
+        ext = os.path.splitext(str(src))[1].lower()
+        return {".pth": "torchvision", ".pt": "torchvision",
+                ".h5": "keras", ".keras": "keras"}.get(ext)
+    if isinstance(src, dict):
+        return "torchvision"
+    if type(src).__module__.split(".")[0] in ("keras", "tensorflow",
+                                              "tf_keras"):
+        return "keras"
+    return None
+
+
+def load_pretrained(model, src, source: Optional[str] = None) -> None:
+    """Dispatch on ``source`` ('torchvision' | 'keras') or the file
+    extension (.pth/.pt vs .h5/.keras)."""
+    source = source or infer_source(src)
+    if source == "torchvision":
+        if isinstance(src, (str, os.PathLike)):
+            import torch
+            src = torch.load(src, map_location="cpu", weights_only=True)
+        load_torch_state_dict(model, src)
+    elif source == "keras":
+        load_keras_model(model, src)
+    else:
+        raise ValueError(
+            f"cannot infer checkpoint source for {src!r}; pass "
+            "source='torchvision' or source='keras'")
+
+
+# Per-model preprocess for pretrained weights — the per-name configure
+# table of ImageClassificationConfig.scala:190 (means/std in the 0-255
+# pixel domain the ImageSet pipeline produces).
+_TV_MEAN = (0.485 * 255, 0.456 * 255, 0.406 * 255)
+_TV_STD = (0.229 * 255, 0.224 * 255, 0.225 * 255)
+_CAFFE_MEAN_BGR = (103.939, 116.779, 123.68)
+
+
+def pretrained_configure(
+        model_name: str, source: str = "torchvision",
+        input_shape: Tuple[int, int, int] = (224, 224, 3)
+) -> ImageConfigure:
+    """ImageConfigure matching the preprocessing the published weights
+    were trained with, cropped to the MODEL'S input size (published
+    recipes use 256-resize/224-crop; other input sizes scale the
+    resize by the same 256/224 shortest-side ratio)."""
+    crop_h, crop_w = int(input_shape[0]), int(input_shape[1])
+    resize_h = round(crop_h * 256 / 224)
+    resize_w = round(crop_w * 256 / 224)
+    steps = [ImageResize(resize_h, resize_w),
+             ImageCenterCrop(crop_h, crop_w)]
+    if source == "torchvision":
+        steps.append(ImageChannelNormalize(*_TV_MEAN, *_TV_STD))
+    elif source == "keras":
+        # caffe-style: BGR order, mean subtraction only (VGG lineage)
+        steps.append(ImageChannelOrder())   # RGB -> BGR
+        steps.append(ImageChannelNormalize(*_CAFFE_MEAN_BGR))
+    else:
+        raise ValueError(f"unknown pretrained source {source!r}")
+    return ImageConfigure(preprocessor=ChainedPreprocessing(steps),
+                          batch_per_partition=4)
