@@ -66,6 +66,12 @@ def gelu(x):
     return jax.nn.gelu(x, approximate=True)
 
 
+def gelu_erf(x):
+    """Exact (erf-based) GELU — the variant published BERT checkpoints
+    were trained with (google-research bert modeling.py gelu)."""
+    return jax.nn.gelu(x, approximate=False)
+
+
 def swish(x):
     return jax.nn.silu(x)
 
@@ -88,6 +94,7 @@ _REGISTRY = {
     "elu": elu,
     "selu": selu,
     "gelu": gelu,
+    "gelu_erf": gelu_erf,
     "swish": swish,
     "silu": swish,
     "exp": exp,

@@ -194,28 +194,39 @@ class PositionwiseFeedForward(Layer):
         return params
 
     def call(self, params, x, training=False, rng=None):
-        h = self.activation(_mm(x, params["up_kernel"]) +
-                            params["up_bias"])
+        h = _mm(x, params["up_kernel"]) + params["up_bias"]
+        if self.activation is not None:   # get() -> None means identity
+            h = self.activation(h)
         return (_mm(h, params["down_kernel"]) +
                 params["down_bias"]).astype(x.dtype)
 
 
 def transformer_block(x, mask, hidden_size: int, n_head: int,
                       intermediate_size: int, dropout: float = 0.1,
-                      causal: bool = False):
-    """Post-LN transformer encoder block (BERT-style)."""
+                      causal: bool = False, activation="gelu",
+                      ln_eps: float = 1e-5,
+                      hidden_dropout: Optional[float] = None):
+    """Post-LN transformer encoder block (BERT-style).
+
+    ``dropout`` is the attention-probs dropout; ``hidden_dropout``
+    (default: same value) applies to the attention output and FFN
+    output, matching the published recipe's separate
+    attention_probs_dropout_prob / hidden_dropout_prob knobs."""
+    if hidden_dropout is None:
+        hidden_dropout = dropout
     attn_in = [x, mask] if mask is not None else x
     a = MultiHeadSelfAttention(hidden_size, n_head,
                                attn_dropout=dropout,
                                causal=causal)(attn_in)
-    a = Dropout(dropout)(a)
+    a = Dropout(hidden_dropout)(a)
     from analytics_zoo_tpu.pipeline.api.keras.layers.merge import Merge
     x = Merge(mode="sum")([x, a])
-    x = LayerNorm()(x)
-    f = PositionwiseFeedForward(hidden_size, intermediate_size)(x)
-    f = Dropout(dropout)(f)
+    x = LayerNorm(epsilon=ln_eps)(x)
+    f = PositionwiseFeedForward(hidden_size, intermediate_size,
+                                activation=activation)(x)
+    f = Dropout(hidden_dropout)(f)
     x = Merge(mode="sum")([x, f])
-    return LayerNorm()(x)
+    return LayerNorm(epsilon=ln_eps)(x)
 
 
 class BERT:
@@ -227,13 +238,18 @@ class BERT:
                  n_block: int = 12, n_head: int = 12,
                  seq_len: int = 512, intermediate_size: int = 3072,
                  max_position_len: int = 512, type_vocab_size: int = 2,
-                 hidden_drop: float = 0.1, attn_drop: float = 0.1):
+                 hidden_drop: float = 0.1, attn_drop: float = 0.1,
+                 hidden_act: str = "gelu", ln_eps: float = 1e-12):
+        # hidden_act/ln_eps defaults follow the published BERT recipe
+        # (tanh-approx gelu is "gelu_new"; checkpoints trained with the
+        # erf gelu import with hidden_act="gelu_erf")
         self.cfg = dict(vocab=vocab, hidden_size=hidden_size,
                         n_block=n_block, n_head=n_head, seq_len=seq_len,
                         intermediate_size=intermediate_size,
                         max_position_len=max_position_len,
                         type_vocab_size=type_vocab_size,
-                        hidden_drop=hidden_drop, attn_drop=attn_drop)
+                        hidden_drop=hidden_drop, attn_drop=attn_drop,
+                        hidden_act=hidden_act, ln_eps=ln_eps)
 
     def build(self) -> Model:
         c = self.cfg
@@ -250,12 +266,15 @@ class BERT:
         pos_e = Embedding(c["max_position_len"], c["hidden_size"],
                           init="normal")(pos)
         x = Merge(mode="sum")([tok_e, seg_e, pos_e])
-        x = LayerNorm()(x)
+        x = LayerNorm(epsilon=c["ln_eps"])(x)
         x = Dropout(c["hidden_drop"])(x)
         for _ in range(c["n_block"]):
             x = transformer_block(x, mask, c["hidden_size"], c["n_head"],
                                   c["intermediate_size"],
-                                  dropout=c["attn_drop"])
+                                  dropout=c["attn_drop"],
+                                  hidden_dropout=c["hidden_drop"],
+                                  activation=c["hidden_act"],
+                                  ln_eps=c["ln_eps"])
         seq_output = x
         from analytics_zoo_tpu.pipeline.api.keras.layers.core import Lambda
         first_tok = Lambda(lambda t: t[:, 0],
@@ -314,6 +333,7 @@ class TransformerLayer:
             x = transformer_block(x, None, c["hidden_size"], c["n_head"],
                                   c["intermediate_size"],
                                   dropout=c["attn_drop"],
+                                  hidden_dropout=c["hidden_drop"],
                                   causal=not c["bidirectional"])
         from analytics_zoo_tpu.pipeline.api.keras.layers.core import Lambda
         first_tok = Lambda(lambda t: t[:, 0],

@@ -1,0 +1,252 @@
+"""Pretrained BERT checkpoint import.
+
+The reference's BERT estimators consume google-research checkpoint
+directories (pyzoo/zoo/tfpark/text/estimator/bert_base.py —
+``bert_config_file`` + ``init_checkpoint``;
+zoo/pipeline/api/keras/layers/BERT.scala:66).  This module loads those
+published artifacts into the native BERT encoder
+(pipeline/api/keras/layers/attention.py):
+
+* a **google TF checkpoint** — ``bert_model.ckpt`` prefix or the
+  directory holding it (read via ``tf.train.load_checkpoint``; TF
+  kernels are already (in, out));
+* a **HuggingFace transformers** ``BertModel`` instance or its torch
+  state_dict (torch Linear weights are (out, in) and get transposed).
+
+Per-block Q/K/V projections fuse into the encoder's single
+``qkv_kernel`` matmul (concatenated on the output dim — the fused
+``(B,T,3H) -> (b,t,3,heads,head_dim)`` reshape reads Q then K then V,
+matching this concatenation order).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+
+# -------------------------------------------------------------- config io
+def bert_kwargs_from_config(config_path: str) -> Dict[str, Any]:
+    """Translate a google ``bert_config.json`` into ``BERT(...)``
+    kwargs (google field names per bert/modeling.py BertConfig)."""
+    with open(config_path) as f:
+        c = json.load(f)
+    act = str(c.get("hidden_act", "gelu"))
+    return dict(
+        vocab=int(c["vocab_size"]),
+        hidden_size=int(c["hidden_size"]),
+        n_block=int(c["num_hidden_layers"]),
+        n_head=int(c["num_attention_heads"]),
+        intermediate_size=int(c["intermediate_size"]),
+        max_position_len=int(c.get("max_position_embeddings", 512)),
+        type_vocab_size=int(c.get("type_vocab_size", 2)),
+        hidden_drop=float(c.get("hidden_dropout_prob", 0.1)),
+        attn_drop=float(c.get("attention_probs_dropout_prob", 0.1)),
+        # google "gelu" is the exact erf gelu; HF "gelu_new" is the
+        # tanh approximation this framework calls "gelu"
+        hidden_act={"gelu": "gelu_erf", "gelu_new": "gelu"}.get(act, act),
+    )
+
+
+# ------------------------------------------------------------ source readers
+def _google_reader(src: str) -> Callable[[str, int], np.ndarray]:
+    """get(name_template, block_index) over a TF checkpoint."""
+    import tensorflow as tf
+
+    prefix = src
+    if os.path.isdir(src):
+        ckpt = tf.train.latest_checkpoint(src)
+        if ckpt is None:
+            for cand in ("bert_model.ckpt", "model.ckpt"):
+                if os.path.exists(os.path.join(src, cand + ".index")):
+                    ckpt = os.path.join(src, cand)
+                    break
+        if ckpt is None:
+            raise FileNotFoundError(
+                f"no TF checkpoint found under {src!r}")
+        prefix = ckpt
+    reader = tf.train.load_checkpoint(prefix)
+
+    def get(name: str) -> np.ndarray:
+        return np.asarray(reader.get_tensor(name))
+
+    return get
+
+
+def _hf_reader(src) -> Callable[[str, int], np.ndarray]:
+    """get(name) over a HF BertModel / torch state_dict, addressed by
+    the GOOGLE variable names (translated internally)."""
+    if hasattr(src, "state_dict"):
+        src = src.state_dict()
+    sd = {k: (v.detach().cpu().numpy() if hasattr(v, "detach")
+              else np.asarray(v)) for k, v in src.items()}
+    # some exports prefix with "bert."
+    if not any(k.startswith("embeddings.") for k in sd) and any(
+            k.startswith("bert.") for k in sd):
+        sd = {k[len("bert."):]: v for k, v in sd.items()
+              if k.startswith("bert.")}
+
+    g2hf = {
+        "bert/embeddings/word_embeddings":
+            "embeddings.word_embeddings.weight",
+        "bert/embeddings/token_type_embeddings":
+            "embeddings.token_type_embeddings.weight",
+        "bert/embeddings/position_embeddings":
+            "embeddings.position_embeddings.weight",
+        "bert/embeddings/LayerNorm/gamma": "embeddings.LayerNorm.weight",
+        "bert/embeddings/LayerNorm/beta": "embeddings.LayerNorm.bias",
+        "bert/pooler/dense/kernel": "pooler.dense.weight",
+        "bert/pooler/dense/bias": "pooler.dense.bias",
+    }
+
+    def translate(name: str) -> str:
+        if name in g2hf:
+            return g2hf[name]
+        # bert/encoder/layer_N/...
+        parts = name.split("/")
+        assert parts[1] == "encoder", name
+        n = parts[2].split("_")[1]
+        tail = "/".join(parts[3:])
+        t2hf = {
+            "attention/self/query/kernel": "attention.self.query.weight",
+            "attention/self/query/bias": "attention.self.query.bias",
+            "attention/self/key/kernel": "attention.self.key.weight",
+            "attention/self/key/bias": "attention.self.key.bias",
+            "attention/self/value/kernel": "attention.self.value.weight",
+            "attention/self/value/bias": "attention.self.value.bias",
+            "attention/output/dense/kernel":
+                "attention.output.dense.weight",
+            "attention/output/dense/bias": "attention.output.dense.bias",
+            "attention/output/LayerNorm/gamma":
+                "attention.output.LayerNorm.weight",
+            "attention/output/LayerNorm/beta":
+                "attention.output.LayerNorm.bias",
+            "intermediate/dense/kernel": "intermediate.dense.weight",
+            "intermediate/dense/bias": "intermediate.dense.bias",
+            "output/dense/kernel": "output.dense.weight",
+            "output/dense/bias": "output.dense.bias",
+            "output/LayerNorm/gamma": "output.LayerNorm.weight",
+            "output/LayerNorm/beta": "output.LayerNorm.bias",
+        }
+        return f"encoder.layer.{n}.{t2hf[tail]}"
+
+    def get(name: str) -> np.ndarray:
+        arr = sd[translate(name)]
+        # torch Linear weights are (out, in); callers address GOOGLE
+        # kernels, which are (in, out)
+        return arr.T if name.endswith("/kernel") else arr
+
+    return get
+
+
+# ---------------------------------------------------------------- installer
+def load_bert_checkpoint(model, src) -> None:
+    """Import pretrained BERT weights into ``model`` in place.
+
+    ``model`` is any graph Model containing the native BERT encoder
+    (the encoder itself, or an estimator's head model — encoder layers
+    precede head layers in creation order).  ``src`` is a google
+    checkpoint prefix/directory, a HF ``BertModel``, or a torch
+    state_dict.
+    """
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.layers.attention import (
+        MultiHeadSelfAttention, PositionwiseFeedForward)
+    from analytics_zoo_tpu.pipeline.api.keras.layers.embedding import (
+        Embedding)
+    from analytics_zoo_tpu.pipeline.api.keras.layers.normalization import (
+        LayerNorm)
+
+    get = _google_reader(src) if isinstance(src, (str, os.PathLike)) \
+        else _hf_reader(src)
+
+    embeds = [l for l in model.layers if isinstance(l, Embedding)]
+    lns = [l for l in model.layers if isinstance(l, LayerNorm)]
+    attns = [l for l in model.layers
+             if isinstance(l, MultiHeadSelfAttention)]
+    ffns = [l for l in model.layers
+            if isinstance(l, PositionwiseFeedForward)]
+    denses = [l for l in model.layers if isinstance(l, Dense)]
+    n = len(attns)
+    if len(embeds) < 3 or len(lns) != 2 * n + 1 or len(ffns) != n \
+            or not denses:
+        raise ValueError(
+            f"model does not look like the native BERT encoder "
+            f"(embeddings={len(embeds)}, layernorms={len(lns)}, "
+            f"attention={n}, ffn={len(ffns)}, dense={len(denses)})")
+
+    # lazy init: only initialises if the model has no variables yet —
+    # re-importing into a fine-tuned model must NOT wipe head weights
+    variables = model.get_variables()
+    params, state = variables["params"], variables["state"]
+
+    def put(layer, key: str, value: np.ndarray) -> None:
+        cur = params[layer.name][key]
+        if tuple(np.shape(cur)) != tuple(np.shape(value)):
+            raise ValueError(
+                f"{layer.name}.{key}: checkpoint shape "
+                f"{tuple(np.shape(value))} != model shape "
+                f"{tuple(np.shape(cur))}")
+        params[layer.name][key] = np.asarray(value).astype(
+            np.asarray(cur).dtype)
+
+    # embeddings: builder creation order is token, segment, position
+    tok, seg, pos = embeds[0], embeds[1], embeds[2]
+    put(tok, "embeddings", get("bert/embeddings/word_embeddings"))
+    put(seg, "embeddings", get("bert/embeddings/token_type_embeddings"))
+    emb_pos = get("bert/embeddings/position_embeddings")
+    # checkpoints carry 512 position rows; the model may be built with
+    # a shorter max_position_len — slice the prefix (standard practice)
+    model_pos = np.shape(params[pos.name]["embeddings"])[0]
+    put(pos, "embeddings", emb_pos[:model_pos])
+    put(lns[0], "gamma", get("bert/embeddings/LayerNorm/gamma"))
+    put(lns[0], "beta", get("bert/embeddings/LayerNorm/beta"))
+
+    for i in range(n):
+        p = f"bert/encoder/layer_{i}"
+        qkv_k = np.concatenate(
+            [get(f"{p}/attention/self/{w}/kernel") for w in
+             ("query", "key", "value")], axis=1)
+        qkv_b = np.concatenate(
+            [get(f"{p}/attention/self/{w}/bias") for w in
+             ("query", "key", "value")])
+        put(attns[i], "qkv_kernel", qkv_k)
+        put(attns[i], "qkv_bias", qkv_b)
+        put(attns[i], "out_kernel",
+            get(f"{p}/attention/output/dense/kernel"))
+        put(attns[i], "out_bias", get(f"{p}/attention/output/dense/bias"))
+        put(lns[2 * i + 1], "gamma",
+            get(f"{p}/attention/output/LayerNorm/gamma"))
+        put(lns[2 * i + 1], "beta",
+            get(f"{p}/attention/output/LayerNorm/beta"))
+        put(ffns[i], "up_kernel", get(f"{p}/intermediate/dense/kernel"))
+        put(ffns[i], "up_bias", get(f"{p}/intermediate/dense/bias"))
+        put(ffns[i], "down_kernel", get(f"{p}/output/dense/kernel"))
+        put(ffns[i], "down_bias", get(f"{p}/output/dense/bias"))
+        put(lns[2 * i + 2], "gamma", get(f"{p}/output/LayerNorm/gamma"))
+        put(lns[2 * i + 2], "beta", get(f"{p}/output/LayerNorm/beta"))
+
+    # pooler = the first Dense created (BERT.build runs before any head)
+    put(denses[0], "kernel", get("bert/pooler/dense/kernel"))
+    put(denses[0], "bias", get("bert/pooler/dense/bias"))
+
+    model.set_variables({"params": params, "state": state})
+
+
+def bert_for_checkpoint(ckpt_dir: str, seq_len: int = 128, **overrides):
+    """Build a native ``BERT`` from a google checkpoint directory's
+    ``bert_config.json`` (the reference's bert_config_file contract)."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers.attention import BERT
+
+    base = ckpt_dir if os.path.isdir(ckpt_dir) \
+        else os.path.dirname(ckpt_dir)     # a ckpt PREFIX also works
+    cfg_path = os.path.join(base, "bert_config.json")
+    kwargs: Dict[str, Any] = {}
+    if os.path.exists(cfg_path):
+        kwargs = bert_kwargs_from_config(cfg_path)
+    kwargs["seq_len"] = seq_len
+    kwargs.update(overrides)
+    return BERT(**kwargs)

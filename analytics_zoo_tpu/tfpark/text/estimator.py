@@ -32,10 +32,36 @@ class BERTBaseEstimator:
 
     head_on_pooled = True
 
-    def __init__(self, bert: Optional[BERT] = None, **bert_kwargs):
+    def __init__(self, bert: Optional[BERT] = None,
+                 bert_checkpoint: Optional[str] = None, **bert_kwargs):
+        """``bert_checkpoint`` is the reference's ``bert_config_file``
+        + ``init_checkpoint`` contract (bert_base.py): a google BERT
+        checkpoint directory — the encoder is configured from its
+        ``bert_config.json`` and initialised from its weights (heads
+        stay randomly initialised, as in fine-tuning)."""
+        if bert is None and bert_checkpoint is not None:
+            from analytics_zoo_tpu.tfpark.text.bert_checkpoint import (
+                bert_for_checkpoint)
+            bert = bert_for_checkpoint(bert_checkpoint, **bert_kwargs)
         self.bert = bert or BERT(**bert_kwargs)
         self.encoder, self.cfg = _bert_io(self.bert)
         self.model = self._build_model()
+        if bert_checkpoint is not None:
+            from analytics_zoo_tpu.tfpark.text.bert_checkpoint import (
+                load_bert_checkpoint)
+            load_bert_checkpoint(self.model, bert_checkpoint)
+            if self.encoder is not self.model:
+                # the head model and the bare encoder each hold their
+                # own variable trees (layers are shared, variables are
+                # not) — sync the encoder's copies from the loaded
+                # model instead of re-reading the checkpoint
+                mv = self.model.get_variables()
+                ev = self.encoder.get_variables()
+                for kind in ("params", "state"):
+                    for lname in ev[kind]:
+                        if lname in mv[kind]:
+                            ev[kind][lname] = mv[kind][lname]
+                self.encoder.set_variables(ev)
 
     # subclasses attach a head; the base serves raw features
     def _build_model(self) -> Model:

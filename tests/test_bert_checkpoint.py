@@ -1,0 +1,184 @@
+"""Golden tests for pretrained BERT checkpoint import.
+
+Oracle: HuggingFace ``transformers.BertModel`` (torch) with random
+init — hidden states and pooled output must match the native encoder
+after import.  The google-TF-checkpoint path is validated by writing
+the SAME weights under google's variable names with tf.compat.v1
+Saver and importing the resulting checkpoint directory end-to-end
+through ``BERTClassifier(bert_checkpoint=...)``.
+
+Ref: pyzoo/zoo/tfpark/text/estimator/bert_base.py (bert_config_file +
+init_checkpoint), zoo/pipeline/api/keras/layers/BERT.scala:66.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow   # torch/tf oracles
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+HID, HEADS, BLOCKS, VOCAB, SEQ, INTER = 64, 4, 2, 97, 24, 128
+
+
+def _hf_model():
+    cfg = transformers.BertConfig(
+        vocab_size=VOCAB, hidden_size=HID, num_hidden_layers=BLOCKS,
+        num_attention_heads=HEADS, intermediate_size=INTER,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_act="gelu",          # exact erf gelu (google's variant)
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        layer_norm_eps=1e-12)
+    torch.manual_seed(11)
+    m = transformers.BertModel(cfg)
+    m.eval()
+    return m
+
+
+def _native_bert():
+    from analytics_zoo_tpu.pipeline.api.keras.layers.attention import BERT
+    return BERT(vocab=VOCAB, hidden_size=HID, n_block=BLOCKS,
+                n_head=HEADS, seq_len=SEQ, intermediate_size=INTER,
+                max_position_len=64, type_vocab_size=2,
+                hidden_drop=0.0, attn_drop=0.0,
+                hidden_act="gelu_erf", ln_eps=1e-12).build()
+
+
+def _fixture_batch(pad_from: int = 18):
+    rs = np.random.RandomState(5)
+    ids = rs.randint(0, VOCAB, size=(2, SEQ)).astype(np.int32)
+    seg = rs.randint(0, 2, size=(2, SEQ)).astype(np.int32)
+    pos = np.broadcast_to(np.arange(SEQ, dtype=np.int32), ids.shape)
+    mask = np.ones((2, SEQ), np.int32)
+    mask[:, pad_from:] = 0          # realistic padded tail
+    return ids, seg, pos, mask
+
+
+def _hf_forward(hf, ids, seg, mask):
+    with torch.no_grad():
+        out = hf(input_ids=torch.from_numpy(ids.astype(np.int64)),
+                 token_type_ids=torch.from_numpy(seg.astype(np.int64)),
+                 attention_mask=torch.from_numpy(mask.astype(np.int64)))
+    return out.last_hidden_state.numpy(), out.pooler_output.numpy()
+
+
+def test_hf_import_matches_transformers(f32_policy):
+    from analytics_zoo_tpu.tfpark.text.bert_checkpoint import (
+        load_bert_checkpoint)
+
+    hf = _hf_model()
+    model = _native_bert()
+    load_bert_checkpoint(model, hf)
+
+    ids, seg, pos, mask = _fixture_batch()
+    want_seq, want_pool = _hf_forward(hf, ids, seg, mask)
+    got_seq, got_pool = model.predict([ids, seg, pos, mask],
+                                      batch_size=2)
+    got_seq, got_pool = np.asarray(got_seq), np.asarray(got_pool)
+    # compare only non-padded positions: masked-out tokens attend to
+    # the same keys but HF's extended mask still lets them see
+    # themselves differently — their states are not meaningful output
+    np.testing.assert_allclose(got_seq[:, :18], want_seq[:, :18],
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(got_pool, want_pool, rtol=1e-4,
+                               atol=1e-4)
+
+
+def _save_google_ckpt(hf, out_dir: str) -> str:
+    """Write the HF model's weights as a google-layout TF checkpoint +
+    bert_config.json (the published artifact format)."""
+    import tensorflow as tf
+
+    sd = {k: v.detach().cpu().numpy() for k, v in hf.state_dict().items()}
+    g: dict = {
+        "bert/embeddings/word_embeddings":
+            sd["embeddings.word_embeddings.weight"],
+        "bert/embeddings/token_type_embeddings":
+            sd["embeddings.token_type_embeddings.weight"],
+        "bert/embeddings/position_embeddings":
+            sd["embeddings.position_embeddings.weight"],
+        "bert/embeddings/LayerNorm/gamma":
+            sd["embeddings.LayerNorm.weight"],
+        "bert/embeddings/LayerNorm/beta": sd["embeddings.LayerNorm.bias"],
+        "bert/pooler/dense/kernel": sd["pooler.dense.weight"].T,
+        "bert/pooler/dense/bias": sd["pooler.dense.bias"],
+    }
+    for i in range(BLOCKS):
+        h = f"encoder.layer.{i}"
+        p = f"bert/encoder/layer_{i}"
+        for w in ("query", "key", "value"):
+            g[f"{p}/attention/self/{w}/kernel"] = \
+                sd[f"{h}.attention.self.{w}.weight"].T
+            g[f"{p}/attention/self/{w}/bias"] = \
+                sd[f"{h}.attention.self.{w}.bias"]
+        g[f"{p}/attention/output/dense/kernel"] = \
+            sd[f"{h}.attention.output.dense.weight"].T
+        g[f"{p}/attention/output/dense/bias"] = \
+            sd[f"{h}.attention.output.dense.bias"]
+        g[f"{p}/attention/output/LayerNorm/gamma"] = \
+            sd[f"{h}.attention.output.LayerNorm.weight"]
+        g[f"{p}/attention/output/LayerNorm/beta"] = \
+            sd[f"{h}.attention.output.LayerNorm.bias"]
+        g[f"{p}/intermediate/dense/kernel"] = \
+            sd[f"{h}.intermediate.dense.weight"].T
+        g[f"{p}/intermediate/dense/bias"] = \
+            sd[f"{h}.intermediate.dense.bias"]
+        g[f"{p}/output/dense/kernel"] = sd[f"{h}.output.dense.weight"].T
+        g[f"{p}/output/dense/bias"] = sd[f"{h}.output.dense.bias"]
+        g[f"{p}/output/LayerNorm/gamma"] = \
+            sd[f"{h}.output.LayerNorm.weight"]
+        g[f"{p}/output/LayerNorm/beta"] = sd[f"{h}.output.LayerNorm.bias"]
+
+    tf_vars = {name: tf.Variable(val) for name, val in g.items()}
+    saver = tf.compat.v1.train.Saver(tf_vars)
+    saver.save(None, os.path.join(out_dir, "bert_model.ckpt"))
+    with open(os.path.join(out_dir, "bert_config.json"), "w") as f:
+        json.dump({
+            "vocab_size": VOCAB, "hidden_size": HID,
+            "num_hidden_layers": BLOCKS, "num_attention_heads": HEADS,
+            "intermediate_size": INTER, "max_position_embeddings": 64,
+            "type_vocab_size": 2, "hidden_act": "gelu",
+            "hidden_dropout_prob": 0.0,
+            "attention_probs_dropout_prob": 0.0}, f)
+    return out_dir
+
+
+def test_google_ckpt_dir_via_bert_classifier(f32_policy, tmp_path):
+    """The reference's fine-tune journey: point BERTClassifier at a
+    google checkpoint dir; the encoder is configured from
+    bert_config.json and initialised from bert_model.ckpt."""
+    from analytics_zoo_tpu.tfpark.text import BERTClassifier
+
+    hf = _hf_model()
+    ckpt_dir = _save_google_ckpt(hf, str(tmp_path))
+
+    clf = BERTClassifier(num_classes=3, dropout=0.0,
+                         bert_checkpoint=ckpt_dir, seq_len=SEQ)
+    assert clf.cfg["hidden_act"] == "gelu_erf"   # from config json
+    assert clf.cfg["n_block"] == BLOCKS
+
+    ids, seg, pos, mask = _fixture_batch()
+    # encoder outputs match the HF oracle through the loaded weights
+    got_seq, got_pool = clf.encoder.predict([ids, seg, pos, mask],
+                                            batch_size=2)
+    want_seq, want_pool = _hf_forward(hf, ids, seg, mask)
+    np.testing.assert_allclose(np.asarray(got_pool), want_pool,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(got_seq)[:, :18],
+                               want_seq[:, :18], rtol=1e-4, atol=1e-4)
+
+    # the fine-tune surface runs end to end from the checkpoint
+    # (batch == mesh data-parallel degree)
+    rs = np.random.RandomState(9)
+    ids8 = rs.randint(0, VOCAB, size=(8, SEQ)).astype(np.int32)
+    feats = {"input_ids": ids8,
+             "attention_mask": np.ones((8, SEQ), np.int32),
+             "token_type_ids": np.zeros((8, SEQ), np.int32)}
+    labels = rs.randint(0, 3, size=8)
+    clf.train(feats, labels, batch_size=8, epochs=1)
+    out = clf.predict(feats, batch_size=8)
+    assert np.asarray(out).shape == (8, 3)
