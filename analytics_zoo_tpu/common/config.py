@@ -41,6 +41,11 @@ _DEFAULTS: Dict[str, Any] = {
     # Gradient allreduce in bf16 (the analogue of BigDL's compressed
     # FP16 gradient serialization during sync, SURVEY.md §2.4).
     "train.grad_sync_dtype": "float32",
+    # Steps fused into one device dispatch by the training engine when
+    # triggers are epoch-scoped (a lax.scan over k stacked batches):
+    # per-step host/dispatch overhead drops ~k-fold while HBM holds
+    # only k x batch rows. 1 = classic per-step dispatch.
+    "train.steps_per_dispatch": 16,
     # Input pipeline ---------------------------------------------------
     # Device-batch prefetch depth (background thread overlapping host
     # batch assembly + H2D copy with device compute); 0 disables.

@@ -213,6 +213,29 @@ class FeatureSet:
                     mask = np.concatenate([mask, np.zeros(pad, np.float32)])
                 yield (xb, yb, mask)
 
+    def epoch_chunks(self, epoch: int, batch_size: int, steps: int
+                     ) -> Iterator[Tuple]:
+        """Chunked training iterator: yields ``(x, y)`` host arrays of
+        up to ``steps`` whole batches each (same per-epoch permutation
+        and remainder-drop as ``epoch_batches``).
+
+        The training engine scans each chunk on-device in ONE dispatch
+        (``DistributedTrainer.epoch_scan_fn(k, batch_size)``), cutting
+        per-step host/dispatch overhead by ``steps`` while only ever
+        holding ``steps x batch_size`` rows in HBM — the middle tier
+        between per-step dispatch and the whole-epoch HBM scan."""
+        n = self._size
+        idx = self._epoch_perm(epoch) if self.shuffle else np.arange(n)
+        nb_total = n // batch_size
+        b = 0
+        while b < nb_total:
+            k = min(int(steps), nb_total - b)
+            sel = idx[b * batch_size:(b + k) * batch_size]
+            yield (_tree_take(self.x, sel),
+                   _tree_take(self.y, sel) if self.y is not None
+                   else None, k)
+            b += k
+
     def slice_batches(self, epoch: int, slice_index: int, batch_size: int
                       ) -> Iterator[Tuple]:
         """Disk-slice training: iterate one 1/num_slices shard of this

@@ -270,17 +270,41 @@ class DistributedTrainer:
         local_bs = mesh_lib.local_batch_size(self.mesh, batch_size)
         del local_bs   # validation only
         global_bs = mesh_lib.global_batch_rows(self.mesh, batch_size)
+        # multi-host: make_array_from_process_local_data lays the global
+        # epoch out as CONTIGUOUS PER-HOST BLOCKS ([host0 rows][host1
+        # rows]...), so step i must gather each host's rows
+        # [i*bs:(i+1)*bs] from within its own block — a flat
+        # [i*global_bs:(i+1)*global_bs] slice would hand step i ONE
+        # host's data. The block-local slice is communication-free
+        # (every device slices rows it already holds) and reproduces
+        # the per-step put_batch batch composition exactly.
+        nproc = jax.process_count() \
+            if mesh_lib.data_split_across_hosts(self.mesh) else 1
 
-        def epoch(params, opt_state, state, x, y, rng):
+        def epoch(params, opt_state, state, x, y, rng, start_step=0):
+            # rng for step i is fold_in(rng, start_step + i): with
+            # start_step = the global iteration counter this matches
+            # the per-step path's fold_in(rng, ts.iteration) exactly,
+            # so chunked dispatch is a pure performance knob — same
+            # rng stream, same batches, same updates
             def body(carry, i):
                 params, opt_state, state = carry
-                take = lambda a: jax.lax.dynamic_slice_in_dim(
-                    a, i * global_bs, global_bs, axis=0)
+
+                def take(a):
+                    if nproc > 1:
+                        r = a.reshape((nproc, num_batches, batch_size)
+                                      + a.shape[1:])
+                        blk = jax.lax.dynamic_slice_in_dim(r, i, 1,
+                                                           axis=1)
+                        return blk.reshape((nproc * batch_size,)
+                                           + a.shape[1:])
+                    return jax.lax.dynamic_slice_in_dim(
+                        a, i * global_bs, global_bs, axis=0)
                 batch = (jax.tree_util.tree_map(take, x),
                          jax.tree_util.tree_map(take, y))
                 params, opt_state, state, loss = self._step_core(
                     params, opt_state, state, batch,
-                    jax.random.fold_in(rng, i))
+                    jax.random.fold_in(rng, start_step + i))
                 return (params, opt_state, state), loss
 
             (params, opt_state, state), losses = jax.lax.scan(

@@ -18,6 +18,33 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 
+class _RespReader:
+    """Buffered RESP framing over a recv callable — the \\r\\n line /
+    exact-n bulk reads shared by the client and the TCP broker."""
+
+    def __init__(self, recv):
+        self._recv = recv
+        self.buf = b""
+
+    def _fill(self) -> None:
+        chunk = self._recv(65536)
+        if not chunk:
+            raise ConnectionError("connection closed")
+        self.buf += chunk
+
+    def line(self) -> bytes:
+        while b"\r\n" not in self.buf:
+            self._fill()
+        line, self.buf = self.buf.split(b"\r\n", 1)
+        return line
+
+    def exact(self, n: int) -> bytes:
+        while len(self.buf) < n + 2:    # payload + trailing \r\n
+            self._fill()
+        data, self.buf = self.buf[:n], self.buf[n + 2:]
+        return data
+
+
 class RedisClient:
     """Speaks RESP2 for the commands serving needs: XADD, XREAD, XLEN,
     XTRIM, XDEL, HSET, HGETALL, DEL, PING, INFO."""
@@ -25,7 +52,7 @@ class RedisClient:
     def __init__(self, host: str = "localhost", port: int = 6379,
                  timeout: float = 5.0):
         self.sock = socket.create_connection((host, port), timeout)
-        self.buf = b""
+        self._reader = _RespReader(self.sock.recv)
 
     # ------------------------------------------------------------ protocol
     def execute(self, *args) -> Any:
@@ -40,22 +67,10 @@ class RedisClient:
         return self._read_reply()
 
     def _read_line(self) -> bytes:
-        while b"\r\n" not in self.buf:
-            chunk = self.sock.recv(65536)
-            if not chunk:
-                raise ConnectionError("redis closed")
-            self.buf += chunk
-        line, self.buf = self.buf.split(b"\r\n", 1)
-        return line
+        return self._reader.line()
 
     def _read_exact(self, n: int) -> bytes:
-        while len(self.buf) < n + 2:
-            chunk = self.sock.recv(65536)
-            if not chunk:
-                raise ConnectionError("redis closed")
-            self.buf += chunk
-        data, self.buf = self.buf[:n], self.buf[n + 2:]
-        return data
+        return self._reader.exact(n)
 
     def _read_reply(self) -> Any:
         line = self._read_line()
@@ -355,6 +370,217 @@ def _id_gt(a: str, b: str) -> bool:
         ms, _, seq = x.partition("-")
         return (int(ms), int(seq or 0))
     return parse(a) > parse(b)
+
+
+# ----------------------------------------------------------- TCP broker
+def _enc_simple(s: str) -> bytes:
+    return b"+%s\r\n" % s.encode()
+
+
+def _enc_err(s: str) -> bytes:
+    return b"-%s\r\n" % s.encode()
+
+
+def _enc_int(i: int) -> bytes:
+    return b":%d\r\n" % int(i)
+
+
+def _enc_bulk(v) -> bytes:
+    if v is None:
+        return b"$-1\r\n"
+    if isinstance(v, str):
+        v = v.encode()
+    return b"$%d\r\n%s\r\n" % (len(v), v)
+
+
+def _enc_array(items) -> bytes:
+    if items is None:
+        return b"*-1\r\n"
+    return b"*%d\r\n" % len(items) + b"".join(items)
+
+
+def _enc_entries(entries) -> bytes:
+    """[(id, {k: bytes})] -> RESP [[id, [k, v, ...]], ...]"""
+    out = []
+    for entry_id, fields in entries:
+        kvs = []
+        for k, v in fields.items():
+            kvs.append(_enc_bulk(k))
+            kvs.append(_enc_bulk(v))
+        out.append(_enc_array([_enc_bulk(entry_id), _enc_array(kvs)]))
+    return _enc_array(out)
+
+
+class BrokerServer:
+    """TCP RESP front-end over an ``EmbeddedBroker`` — a single-node
+    "real" broker, so the socket ``RedisClient`` serves against an
+    actual wire protocol (and single-host deployments run without a
+    Redis install).  Speaks exactly the command subset the serving
+    stack uses; one thread per connection (blocking XREADs park their
+    own connection only)."""
+
+    def __init__(self, broker: Optional[EmbeddedBroker] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.broker = broker if broker is not None else EmbeddedBroker()
+        self._srv = socket.create_server((host, port))
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._stop = threading.Event()
+        self._conns: set = set()
+        self._accept = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._accept.start()
+
+    @property
+    def url(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _addr = self._srv.accept()
+            except OSError:
+                return
+            self._conns.add(conn)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        reader = _RespReader(conn.recv)
+        try:
+            while not self._stop.is_set():
+                line = reader.line()
+                if not line.startswith(b"*"):
+                    conn.sendall(_enc_err("ERR protocol"))
+                    continue
+                n = int(line[1:])
+                args = []
+                for _ in range(n):
+                    lens = reader.line()
+                    assert lens.startswith(b"$"), lens
+                    args.append(reader.exact(int(lens[1:])))
+                if not args:
+                    continue
+                cmd = args[0].decode().upper()
+                if cmd == "SHUTDOWN":
+                    self.broker.shutdown()
+                    conn.close()       # connection drop = success signal
+                    self.stop()
+                    return
+                try:
+                    conn.sendall(self._dispatch(cmd, args[1:]))
+                except ConnectionError:
+                    raise
+                except Exception as e:   # command error -> RESP error
+                    conn.sendall(_enc_err(f"ERR {e}"))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            self._conns.discard(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _dispatch(self, cmd: str, a: List[bytes]) -> bytes:
+        b = self.broker
+        dec = lambda x: x.decode()
+        if cmd == "PING":
+            return _enc_simple("PONG")
+        if cmd == "INFO":
+            return _enc_bulk("# Server\r\nembedded_broker:1\r\n")
+        if cmd == "XADD":
+            fields = {dec(a[i]): a[i + 1] for i in range(2, len(a), 2)}
+            return _enc_bulk(b.xadd(dec(a[0]), fields))
+        if cmd == "XREAD":
+            opts = self._stream_opts(a)
+            entries = b.xread(opts["stream"], opts["id"],
+                              count=opts["count"],
+                              block_ms=opts["block"])
+            if not entries:
+                return _enc_array(None)
+            return _enc_array([_enc_array(
+                [_enc_bulk(opts["stream"]), _enc_entries(entries)])])
+        if cmd == "XREADGROUP":
+            group, consumer = dec(a[1]), dec(a[2])
+            opts = self._stream_opts(a[3:])
+            entries = b.xreadgroup(group, consumer, opts["stream"],
+                                   count=opts["count"],
+                                   block_ms=opts["block"])
+            if not entries:
+                return _enc_array(None)
+            return _enc_array([_enc_array(
+                [_enc_bulk(opts["stream"]), _enc_entries(entries)])])
+        if cmd == "XGROUP":
+            if dec(a[0]).upper() != "CREATE":
+                return _enc_err("ERR unsupported XGROUP subcommand")
+            # embedded create is idempotent, so no BUSYGROUP ever; a
+            # real failure (bad start id) must surface as ERR — the
+            # client deliberately swallows BUSYGROUP only
+            b.xgroup_create(dec(a[1]), dec(a[2]), dec(a[3]))
+            return _enc_simple("OK")
+        if cmd == "XACK":
+            return _enc_int(b.xack(dec(a[0]), dec(a[1]),
+                                   *[dec(i) for i in a[2:]]))
+        if cmd == "XAUTOCLAIM":
+            # stream group consumer min-idle start [COUNT n]
+            count = 64
+            if len(a) >= 7 and dec(a[5]).upper() == "COUNT":
+                count = int(a[6])
+            entries = b.xautoclaim(dec(a[0]), dec(a[1]), dec(a[2]),
+                                   int(a[3]), count=count)
+            return _enc_array([_enc_bulk("0-0"), _enc_entries(entries),
+                               _enc_array([])])
+        if cmd == "XLEN":
+            return _enc_int(b.xlen(dec(a[0])))
+        if cmd == "XTRIM":
+            return _enc_int(b.xtrim(dec(a[0]), int(a[2])))
+        if cmd == "XDEL":
+            return _enc_int(b.xdel(dec(a[0]), *[dec(i) for i in a[1:]]))
+        if cmd == "HSET":
+            fields = {dec(a[i]): a[i + 1] for i in range(1, len(a), 2)}
+            return _enc_int(b.hset(dec(a[0]), fields))
+        if cmd == "HGETALL":
+            flat = []
+            for k, v in b.hgetall(dec(a[0])).items():
+                flat.append(_enc_bulk(k))
+                flat.append(_enc_bulk(v))
+            return _enc_array(flat)
+        if cmd == "DEL":
+            return _enc_int(b.delete(*[dec(k) for k in a]))
+        return _enc_err(f"ERR unknown command '{cmd}'")
+
+    @staticmethod
+    def _stream_opts(a: List[bytes]) -> Dict[str, Any]:
+        """Parse [COUNT n] [BLOCK ms] STREAMS stream id."""
+        out: Dict[str, Any] = {"count": 64, "block": None}
+        i = 0
+        while i < len(a):
+            word = a[i].decode().upper()
+            if word == "COUNT":
+                out["count"] = int(a[i + 1])
+                i += 2
+            elif word == "BLOCK":
+                out["block"] = int(a[i + 1])
+                i += 2
+            elif word == "STREAMS":
+                out["stream"] = a[i + 1].decode()
+                out["id"] = a[i + 2].decode()
+                i += 3
+            else:
+                i += 1
+        return out
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for c in list(self._conns):   # copy: serve threads discard
+            try:
+                c.close()
+            except OSError:
+                pass
 
 
 def connect(url: Optional[str] = None):

@@ -63,12 +63,20 @@ class ServingConfig:
                  log_dir: Optional[str] = None,
                  consumer_group: Optional[str] = None,
                  consumer_name: str = "worker-0",
+                 pipeline_depth: int = 2,
                  extra: Optional[Dict[str, str]] = None):
         self.redis_url = redis_url
         self.batch_size = int(batch_size)
         self.top_n = int(top_n)
         self.max_stream_len = int(max_stream_len)
         self.log_dir = log_dir
+        # how many batches may be read-ahead into the decode pipeline.
+        # Each read-ahead batch waits ~1 predict before its own turn, so
+        # depth trades tail latency for decode/predict overlap: 2 keeps
+        # the overlap (decode N+1 under predict N) at roughly half the
+        # queue-wait p50 of deeper pipelines.  Clamped to >= 1: depth 0
+        # would make the run loop read nothing, forever.
+        self.pipeline_depth = max(1, int(pipeline_depth))
         # consumer_group set → multiple workers SHARE the stream, each
         # record served exactly once (the reference parallelizes per
         # Spark partition; redis-native scale-out uses XREADGROUP)
@@ -98,6 +106,7 @@ class ServingConfig:
             consumer_group=cfg.get("params.consumer_group") or None,
             consumer_name=cfg.get("params.consumer_name", "worker-0")
             or "worker-0",
+            pipeline_depth=int(cfg.get("params.pipeline_depth", 2) or 2),
             extra=cfg,
         )
 
@@ -329,15 +338,18 @@ class ClusterServing:
         return False
 
     def run(self, poll_ms: int = 100, decode_workers: int = 2,
-            pipeline_depth: int = 4) -> None:
+            pipeline_depth: Optional[int] = None) -> None:
         """Pipelined loop: the decode POOL works batch N+1..N+depth
         while the device predicts batch N (the reference parallelizes
         decode per partition, ClusterServing.scala:156-237; here decode
         threads overlap the XLA execute, which releases the GIL).  All
         broker IO stays on this thread — the RESP socket is not
         thread-safe."""
-        log.info("cluster serving started (batch=%d, decode_workers=%d)",
-                 self.config.batch_size, decode_workers)
+        if pipeline_depth is None:
+            pipeline_depth = self.config.pipeline_depth
+        log.info("cluster serving started (batch=%d, decode_workers=%d, "
+                 "depth=%d)", self.config.batch_size, decode_workers,
+                 pipeline_depth)
         started = time.time()
         self._serve_start = self._serve_start or started
         pool = ThreadPoolExecutor(decode_workers,

@@ -100,7 +100,8 @@ class TFOptimizer:
             "to TFOptimizer.from_loss(model, criterion, dataset, "
             "optim_method=...) — the optimizer is explicit — or, for a "
             "custom update rule, pass an optax.GradientTransformation "
-            "as optim_method.")
+            "as optim_method (worked migration: "
+            "examples/tfpark/custom_update_rule.py).")
 
     # -------------------------------------------------------------- running
     def set_train_summary(self, log_dir: str, app_name: str):

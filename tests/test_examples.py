@@ -14,6 +14,7 @@ EXAMPLES = [
     "examples.autogradexamples.custom_loss_example",
     "examples.qaranker.qa_ranker",
     "examples.tfpark.tf_optimizer_example",
+    "examples.tfpark.custom_update_rule",
     "examples.pytorch.torch_train_example",
     "examples.inference.inference_model_example",
     "examples.nnframes.nnframes_example",

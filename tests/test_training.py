@@ -155,3 +155,64 @@ def test_deterministic_shuffling_is_reproducible():
     b3 = [b[0] for b in fs.epoch_batches(2, 10)]
     np.testing.assert_array_equal(np.concatenate(b1), np.concatenate(b2))
     assert not np.array_equal(np.concatenate(b1), np.concatenate(b3))
+
+
+def test_epoch_chunks_match_epoch_batches():
+    """Chunked iteration covers exactly the same rows in the same order
+    as per-step iteration (same per-epoch permutation, remainder
+    dropped), in chunks of whole batches."""
+    fs = FeatureSet.from_ndarrays(np.arange(103, dtype=np.float32),
+                                  np.arange(103, dtype=np.float32))
+    per_step = np.concatenate(
+        [b[0] for b in fs.epoch_batches(3, 10)])
+    chunks = list(fs.epoch_chunks(3, 10, steps=4))
+    np.testing.assert_array_equal(
+        np.concatenate([c[0] for c in chunks]), per_step)
+    assert [c[2] for c in chunks] == [4, 4, 2]   # 10 batches -> 4+4+2
+
+
+def test_chunked_dispatch_is_a_pure_performance_knob():
+    """The chunked fit path (train.steps_per_dispatch>1) is SEMANTICS-
+    PRESERVING vs per-step dispatch: same step count, same rng stream
+    (fold_in by the global iteration — verified with a Dropout model,
+    which consumes rng every step), same final params."""
+    from analytics_zoo_tpu.common.config import get_config
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dropout
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import SGD
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(320, 6).astype(np.float32)
+    w = rs.randn(6, 1).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+
+    def fit(steps_per_dispatch):
+        from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+        Layer.reset_name_counters()
+        cfg = get_config()
+        old = cfg.get("train.steps_per_dispatch")
+        cfg.set("train.steps_per_dispatch", steps_per_dispatch)
+        try:
+            m = Sequential()
+            m.add(Dense(8, activation="relu", input_shape=(6,)))
+            m.add(Dropout(0.25))
+            m.add(Dense(1))
+            est = Estimator(m, optim_method=SGD(learning_rate=0.05))
+            est.train(FeatureSet.from_ndarrays(x, y), "mse",
+                      end_trigger=MaxEpoch(4), batch_size=16)
+            return est
+        finally:
+            cfg.set("train.steps_per_dispatch", old)
+
+    chunked = fit(8)
+    stepped = fit(1)
+    assert chunked.train_state.iteration == \
+        stepped.train_state.iteration == 4 * (320 // 16)
+    c_leaves = jax.tree_util.tree_leaves(chunked.variables["params"])
+    s_leaves = jax.tree_util.tree_leaves(stepped.variables["params"])
+    for c, s in zip(c_leaves, s_leaves):
+        np.testing.assert_allclose(np.asarray(c), np.asarray(s),
+                                   rtol=1e-5, atol=1e-6)
+    # reported loss granularity differs by design (chunk mean vs last
+    # batch); the optimizer trajectory — the semantics — is identical
+    assert np.isfinite(chunked.train_state.last_loss)
+    assert np.isfinite(stepped.train_state.last_loss)
