@@ -190,26 +190,31 @@ def inception_v1(num_classes: int = 1000,
 
 def mobilenet(num_classes: int = 1000,
               input_shape: Tuple[int, int, int] = (224, 224, 3),
-              alpha: float = 1.0) -> Model:
+              alpha: float = 1.0, activation: str = "relu") -> Model:
     """MobileNet-v1 (the published "mobilenet" family of
     ImageClassificationConfig.scala): each block is depthwise 3x3 →
-    BN → ReLU → pointwise 1x1 → BN → ReLU — BOTH nonlinearities, per
+    BN → act → pointwise 1x1 → BN → act — BOTH nonlinearities, per
     the paper (a fused separable conv would be a low-rank factorized
-    conv, not MobileNet)."""
+    conv, not MobileNet).  ``activation="relu6"`` matches the
+    published keras-applications weights (XLA SAME padding already
+    matches keras's zero-pad(0,1)+valid alignment on stride 2)."""
     def dw_block(x, in_ch, out_ch, stride):
         # depthwise: one 3x3 filter per input channel (groups=in_ch)
         x = Convolution2D(in_ch, 3, 3, subsample=(stride, stride),
                           border_mode="same", bias=False,
                           groups=in_ch)(x)
         x = BatchNormalization()(x)
-        x = Activation("relu")(x)
+        x = Activation(activation)(x)
         x = Convolution2D(out_ch, 1, 1, bias=False)(x)
         x = BatchNormalization()(x)
-        return Activation("relu")(x)
+        return Activation(activation)(x)
 
     inp = Input(shape=input_shape)
     ch = int(32 * alpha)
-    x = _conv_bn(inp, ch, 3, 2)
+    x = Convolution2D(ch, 3, 3, subsample=(2, 2), border_mode="same",
+                      bias=False)(inp)
+    x = BatchNormalization()(x)
+    x = Activation(activation)(x)
     for filters, stride in ((64, 1), (128, 2), (128, 1), (256, 2),
                             (256, 1), (512, 2), (512, 1), (512, 1),
                             (512, 1), (512, 1), (512, 1), (1024, 2),
@@ -403,6 +408,10 @@ class ImageClassifier(ImageModel):
             source = source or infer_source(pretrained)
             if source == "torchvision" and model_name.startswith("resnet"):
                 self._kw["conv_padding"] = "torch"
+            if source == "keras" and model_name == "mobilenet":
+                # keras-applications MobileNet weights were trained
+                # with relu6
+                self._kw["activation"] = "relu6"
         super().__init__(config)
         if pretrained is not None:
             from analytics_zoo_tpu.models.image.imageclassification \

@@ -173,6 +173,13 @@ def _install(model, groups: List[Group]) -> None:
 
     for i, ((skind, layer), (gkind, g)) in enumerate(zip(slots, groups)):
         name = layer.name
+        if skind == "dense" and gkind == "conv" \
+                and tuple(np.shape(g["kernel"])[:2]) == (1, 1):
+            # keras-applications MobileNet-style classifier: a 1x1
+            # conv on the pooled (1,1,C) map IS a Dense over C
+            g = dict(g, kernel=np.reshape(
+                g["kernel"], np.shape(g["kernel"])[2:]))
+            gkind = "dense"
         if skind != gkind:
             raise ValueError(
                 f"layer {name} is a {skind} but checkpoint module "
@@ -301,9 +308,14 @@ def pretrained_configure(
     if source == "torchvision":
         steps.append(ImageChannelNormalize(*_TV_MEAN, *_TV_STD))
     elif source == "keras":
-        # caffe-style: BGR order, mean subtraction only (VGG lineage)
-        steps.append(ImageChannelOrder())   # RGB -> BGR
-        steps.append(ImageChannelNormalize(*_CAFFE_MEAN_BGR))
+        if model_name.startswith("mobilenet"):
+            # keras "tf" mode: RGB, x/127.5 - 1
+            steps.append(ImageChannelNormalize(127.5, 127.5, 127.5,
+                                               127.5, 127.5, 127.5))
+        else:
+            # caffe-style: BGR order, mean subtraction (VGG lineage)
+            steps.append(ImageChannelOrder())   # RGB -> BGR
+            steps.append(ImageChannelNormalize(*_CAFFE_MEAN_BGR))
     else:
         raise ValueError(f"unknown pretrained source {source!r}")
     return ImageConfigure(preprocessor=ChainedPreprocessing(steps),

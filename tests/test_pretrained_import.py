@@ -207,6 +207,35 @@ def test_imageclassifier_pretrained_pth_roundtrip(f32_policy, tmp_path):
     np.testing.assert_allclose(got2, want, rtol=2e-4, atol=2e-4)
 
 
+def test_keras_mobilenet_import_matches_tf(f32_policy):
+    """MobileNet-v1 from keras-applications: depthwise convs, relu6,
+    and the 1x1-conv classifier mapping onto the Dense head."""
+    tf = pytest.importorskip("tensorflow")
+
+    from analytics_zoo_tpu.models.image.imageclassification.nets import (
+        mobilenet)
+    from analytics_zoo_tpu.models.image.imageclassification.pretrained \
+        import load_keras_model
+
+    src = tf.keras.applications.MobileNet(weights=None, classes=9,
+                                          classifier_activation=None)
+    rs = np.random.RandomState(3)
+    for w in src.weights:
+        arr = rs.randn(*w.shape).astype(np.float32) * 0.05
+        if w.name.endswith("variance") or "variance" in w.name.lower():
+            arr = np.abs(arr) + 0.5
+        w.assign(arr)
+
+    x = rs.rand(1, 224, 224, 3).astype(np.float32)
+    want = src(x, training=False).numpy()
+
+    model = mobilenet(num_classes=9, activation="relu6")
+    load_keras_model(model, src)
+    got = np.asarray(model.predict(x, batch_size=1))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+    assert (got.argmax(-1) == want.argmax(-1)).all()
+
+
 def test_keras_vgg16_import_matches_tf(f32_policy):
     tf = pytest.importorskip("tensorflow")
 
