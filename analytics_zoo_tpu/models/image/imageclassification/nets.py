@@ -275,18 +275,27 @@ def squeezenet(num_classes: int = 1000,
     x = fire(x, 64, 256)
     x = fire(x, 64, 256)
     x = Dropout(0.5)(x)
-    x = Convolution2D(num_classes, 1, 1)(x)
+    # the paper (and torchvision) applies ReLU to conv10 before the
+    # global pool — outputs are non-negative class activations
+    x = Convolution2D(num_classes, 1, 1, activation="relu")(x)
     out = GlobalAveragePooling2D()(x)
     return Model(inp, out)
 
 
 def densenet(depth: int = 121, num_classes: int = 1000,
              input_shape: Tuple[int, int, int] = (224, 224, 3),
-             growth_rate: int = None) -> Model:
+             growth_rate: int = None, blocks: Sequence[int] = None,
+             conv_padding: str = "same") -> Model:
     """DenseNet-121/161/169 (incl. the published "densenet-161"; block
-    configs and growth rates per the DenseNet paper)."""
+    configs and growth rates per the DenseNet paper).  ``blocks``
+    overrides the per-stage layer counts (custom/test-scale configs).
+
+    ``conv_padding="torch"``: explicit symmetric padding on the stem
+    conv + maxpool (the only stride-2 ops with a kernel > 1), matching
+    torchvision checkpoints — every other conv is 1x1 or stride-1
+    3x3/SAME, which already agree."""
     try:
-        blocks, default_growth = {
+        default_blocks, default_growth = {
             121: ((6, 12, 24, 16), 32),
             161: ((6, 12, 36, 24), 48),
             169: ((6, 12, 32, 32), 32),
@@ -294,6 +303,7 @@ def densenet(depth: int = 121, num_classes: int = 1000,
     except KeyError:
         raise ValueError(f"densenet depth must be 121/161/169, "
                          f"got {depth}") from None
+    blocks = tuple(blocks) if blocks is not None else default_blocks
     growth_rate = growth_rate or default_growth
 
     def dense_block(x, n_layers):
@@ -314,10 +324,19 @@ def densenet(depth: int = 121, num_classes: int = 1000,
         x = Convolution2D(out_ch, 1, 1, bias=False)(x)
         return AveragePooling2D(pool_size=(2, 2))(x)
 
+    torch_pad = conv_padding == "torch"
+    if conv_padding not in ("same", "torch"):
+        raise ValueError(f"conv_padding must be 'same' or 'torch', "
+                         f"got {conv_padding!r}")
     inp = Input(shape=input_shape)
-    x = _conv_bn(inp, 2 * growth_rate, 7, 2)
-    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
-                     border_mode="same")(x)
+    x = _conv_bn(inp, 2 * growth_rate, 7, 2, torch_pad=torch_pad)
+    if torch_pad:
+        x = ZeroPadding2D((1, 1))(x)   # post-ReLU: zero pad == -inf pad
+        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                         border_mode="valid")(x)
+    else:
+        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                         border_mode="same")(x)
     ch = 2 * growth_rate
     for i, n_layers in enumerate(blocks):
         x = dense_block(x, n_layers)
@@ -406,7 +425,8 @@ class ImageClassifier(ImageModel):
             # source must be known BEFORE build: torchvision resnets
             # need the torch padding alignment in the graph
             source = source or infer_source(pretrained)
-            if source == "torchvision" and model_name.startswith("resnet"):
+            if source == "torchvision" and model_name.startswith(
+                    ("resnet", "densenet")):
                 self._kw["conv_padding"] = "torch"
             if source == "keras" and model_name == "mobilenet":
                 # keras-applications MobileNet weights were trained

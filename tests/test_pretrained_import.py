@@ -207,6 +207,143 @@ def test_imageclassifier_pretrained_pth_roundtrip(f32_policy, tmp_path):
     np.testing.assert_allclose(got2, want, rtol=2e-4, atol=2e-4)
 
 
+class _TorchSqueezeNet(nn.Module):
+    """torchvision squeezenet1_1 module order (features then the conv
+    classifier), built from the public architecture."""
+
+    class Fire(nn.Module):
+        def __init__(self, cin, s, e):
+            super().__init__()
+            self.squeeze = nn.Conv2d(cin, s, 1)
+            self.expand1x1 = nn.Conv2d(s, e, 1)
+            self.expand3x3 = nn.Conv2d(s, e, 3, padding=1)
+
+        def forward(self, x):
+            x = torch.relu(self.squeeze(x))
+            return torch.cat([torch.relu(self.expand1x1(x)),
+                              torch.relu(self.expand3x3(x))], dim=1)
+
+    def __init__(self, num_classes):
+        super().__init__()
+        F = self.Fire
+        self.features = nn.Sequential(
+            nn.Conv2d(3, 64, 3, stride=2), nn.ReLU(),
+            nn.MaxPool2d(3, 2), F(64, 16, 64), F(128, 16, 64),
+            nn.MaxPool2d(3, 2), F(128, 32, 128), F(256, 32, 128),
+            nn.MaxPool2d(3, 2), F(256, 48, 192), F(384, 48, 192),
+            F(384, 64, 256), F(512, 64, 256))
+        self.classifier = nn.Conv2d(512, num_classes, 1)
+
+    def forward(self, x):
+        x = torch.relu(self.classifier(self.features(x)))
+        return x.mean(dim=(2, 3))
+
+
+def test_torchvision_squeezenet_import_matches_torch(f32_policy):
+    """SqueezeNet v1.1: an all-conv torchvision family imports through
+    the positional mapper with no padding variant needed (stem conv is
+    VALID, stride-1 pad-1 expands match SAME)."""
+    from analytics_zoo_tpu.models.image.imageclassification.nets import (
+        squeezenet)
+    from analytics_zoo_tpu.models.image.imageclassification.pretrained \
+        import load_torch_state_dict
+
+    oracle = _TorchSqueezeNet(num_classes=6)
+    torch.manual_seed(4)
+    with torch.no_grad():
+        for m in oracle.modules():
+            if isinstance(m, nn.Conv2d):
+                m.weight.normal_(0, (2.0 / m.weight[0].numel()) ** 0.5)
+                m.bias.normal_(0, 0.05)
+    oracle.eval()
+
+    rs = np.random.RandomState(2)
+    x = rs.rand(2, 224, 224, 3).astype(np.float32)
+    with torch.no_grad():
+        want = oracle(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+
+    model = squeezenet(num_classes=6)
+    load_torch_state_dict(model, oracle.state_dict())
+    got = np.asarray(model.predict(x, batch_size=2))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+    assert (got.argmax(-1) == want.argmax(-1)).all()
+
+
+class _TorchDenseNet(nn.Module):
+    """torchvision densenet121 module order (features: conv0/norm0/
+    pool0, denseblocks + transitions, norm5; then classifier)."""
+
+    def __init__(self, num_classes, growth=32,
+                 blocks=(6, 12, 24, 16)):
+        super().__init__()
+        self.conv0 = nn.Conv2d(3, 2 * growth, 7, 2, 3, bias=False)
+        self.norm0 = nn.BatchNorm2d(2 * growth)
+        self.pool0 = nn.MaxPool2d(3, 2, 1)
+        layers = []
+        ch = 2 * growth
+        for bi, n in enumerate(blocks):
+            block = []
+            for _ in range(n):
+                block.append(nn.ModuleDict({
+                    "norm1": nn.BatchNorm2d(ch),
+                    "conv1": nn.Conv2d(ch, 4 * growth, 1, bias=False),
+                    "norm2": nn.BatchNorm2d(4 * growth),
+                    "conv2": nn.Conv2d(4 * growth, growth, 3,
+                                       padding=1, bias=False)}))
+                ch += growth
+            layers.append(nn.ModuleList(block))
+            if bi < len(blocks) - 1:
+                ch2 = ch // 2
+                layers.append(nn.ModuleDict({
+                    "norm": nn.BatchNorm2d(ch),
+                    "conv": nn.Conv2d(ch, ch2, 1, bias=False)}))
+                ch = ch2
+        self.layers = nn.ModuleList(layers)
+        self.norm5 = nn.BatchNorm2d(ch)
+        self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.pool0(torch.relu(self.norm0(self.conv0(x))))
+        for layer in self.layers:
+            if isinstance(layer, nn.ModuleList):      # dense block
+                for dl in layer:
+                    y = dl["conv1"](torch.relu(dl["norm1"](x)))
+                    y = dl["conv2"](torch.relu(dl["norm2"](y)))
+                    x = torch.cat([x, y], dim=1)
+            else:                                     # transition
+                x = layer["conv"](torch.relu(layer["norm"](x)))
+                x = torch.nn.functional.avg_pool2d(x, 2, 2)
+        x = torch.relu(self.norm5(x))
+        return self.classifier(x.mean(dim=(2, 3)))
+
+
+def test_torchvision_densenet_import_matches_torch(f32_policy):
+    """DenseNet-121 (smaller growth for test speed): concatenative
+    feature reuse, BN-first ordering, torch stem padding."""
+    from analytics_zoo_tpu.models.image.imageclassification.nets import (
+        densenet)
+    from analytics_zoo_tpu.models.image.imageclassification.pretrained \
+        import load_torch_state_dict
+
+    growth, blocks = 8, (2, 3, 4, 2)
+    oracle = _TorchDenseNet(num_classes=5, growth=growth, blocks=blocks)
+    _randomize(oracle, seed=9)
+    oracle.eval()
+
+    rs = np.random.RandomState(6)
+    x = rs.rand(2, 64, 64, 3).astype(np.float32)
+    with torch.no_grad():
+        want = oracle(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+
+    model = densenet(121, num_classes=5, input_shape=(64, 64, 3),
+                     growth_rate=growth, blocks=blocks,
+                     conv_padding="torch")
+    load_torch_state_dict(model, oracle.state_dict())
+    got = np.asarray(model.predict(x, batch_size=2))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+    assert (got.argmax(-1) == want.argmax(-1)).all()
+
+
 def test_keras_mobilenet_import_matches_tf(f32_policy):
     """MobileNet-v1 from keras-applications: depthwise convs, relu6,
     and the 1x1-conv classifier mapping onto the Dense head."""
