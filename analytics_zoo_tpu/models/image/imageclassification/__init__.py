@@ -2,6 +2,10 @@ from analytics_zoo_tpu.models.image.imageclassification.nets import (
     ImageClassifier, alexnet, densenet, inception_v1, lenet, mobilenet,
     resnet, squeezenet, vgg,
 )
+from analytics_zoo_tpu.models.image.imageclassification.pretrained import (
+    load_pretrained, pretrained_configure,
+)
 
 __all__ = ["ImageClassifier", "alexnet", "densenet", "inception_v1",
-           "lenet", "mobilenet", "resnet", "squeezenet", "vgg"]
+           "lenet", "load_pretrained", "mobilenet", "pretrained_configure",
+           "resnet", "squeezenet", "vgg"]
