@@ -113,7 +113,11 @@ def _torch_groups(state_dict) -> List[Group]:
                 ng["bias"] = g["bias"]
             out.append(("conv", ng))
         elif g["weight"].ndim == 2:
-            ng = {"kernel": g["weight"].T, "__name__": prefix}
+            # __cmajor__: torch flattens conv maps C-major (C,H,W);
+            # this framework flattens channels-last (H,W,C) — the
+            # installer permutes the first dense after a conv
+            ng = {"kernel": g["weight"].T, "__name__": prefix,
+                  "__cmajor__": True}
             if "bias" in g:
                 ng["bias"] = g["bias"]
             out.append(("dense", ng))
@@ -167,9 +171,10 @@ def _install(model, groups: List[Group]) -> None:
             f"checkpoint has {len(groups)} weight modules but the model "
             f"has {len(slots)} weight layers — architectures differ")
 
-    model.init()
+    # lazy init (get_variables inits only when the model has none yet)
     variables = model.get_variables()
     params, state = variables["params"], variables["state"]
+    flatten_shapes = _flatten_fed_denses(model)
 
     for i, ((skind, layer), (gkind, g)) in enumerate(zip(slots, groups)):
         name = layer.name
@@ -185,7 +190,23 @@ def _install(model, groups: List[Group]) -> None:
                 f"layer {name} is a {skind} but checkpoint module "
                 f"{g['__name__']!r} is a {gkind}")
         if skind in ("conv", "dense"):
-            _assign(params, name, "kernel", g["kernel"])
+            kernel = g["kernel"]
+            if skind == "dense" and g.get("__cmajor__") \
+                    and name in flatten_shapes:
+                # a Dense fed by Flatten(H, W, C): reorder its input
+                # features from torch's C-major (C, H, W) flatten —
+                # shapes match either way, so skipping this would be
+                # SILENTLY wrong (post-GAP heads have no Flatten and
+                # need no permute)
+                _b, h, w, c = flatten_shapes[name]
+                if kernel.shape[0] != h * w * c:
+                    raise ValueError(
+                        f"{name}: dense input {kernel.shape[0]} != "
+                        f"flattened ({h},{w},{c}) feature map")
+                kernel = kernel.reshape(c, h, w, -1) \
+                    .transpose(1, 2, 0, 3) \
+                    .reshape(h * w * c, kernel.shape[1])
+            _assign(params, name, "kernel", kernel)
             if "bias" in g:
                 if "bias" in params[name]:
                     _assign(params, name, "bias", g["bias"])
@@ -212,6 +233,31 @@ def _install(model, groups: List[Group]) -> None:
             var = g["moving_var"] + (g["epsilon"] - layer.epsilon)
             _assign(state, name, "moving_var", var)
     model.set_variables({"params": params, "state": state})
+
+
+def _flatten_fed_denses(model) -> Dict[str, Tuple[int, ...]]:
+    """Map each Dense fed (directly, through weightless layers) by a
+    4-D Flatten to that Flatten's built input shape (b, H, W, C)."""
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        BatchNormalization, Dense, Flatten)
+    from analytics_zoo_tpu.pipeline.api.keras.layers.conv import _ConvND
+
+    out: Dict[str, Tuple[int, ...]] = {}
+    last_flat = None
+    for l in model.layers:
+        if isinstance(l, Flatten):
+            try:
+                shape = tuple(l.get_input_shape())
+            except ValueError:
+                shape = ()
+            last_flat = shape if len(shape) == 4 else None
+        elif isinstance(l, Dense):
+            if last_flat is not None:
+                out[l.name] = last_flat
+            last_flat = None     # only the FIRST dense sees raw H*W*C
+        elif isinstance(l, (_ConvND, BatchNormalization)):
+            last_flat = None
+    return out
 
 
 def _assign(tree, layer_name: str, key: str, value: np.ndarray) -> None:

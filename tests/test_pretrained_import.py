@@ -373,6 +373,80 @@ def test_keras_mobilenet_import_matches_tf(f32_policy):
     assert (got.argmax(-1) == want.argmax(-1)).all()
 
 
+class _TorchVGG16(nn.Module):
+    """torchvision vgg16 module order (features, then the 3-linear
+    classifier; torch flattens C-major — the import must reorder the
+    first linear's input features to this framework's (H, W, C))."""
+
+    def __init__(self, num_classes):
+        super().__init__()
+        cfg = (2, 2, 3, 3, 3)
+        layers, cin, ch = [], 3, 64
+        for n in cfg:
+            for _ in range(n):
+                layers += [nn.Conv2d(cin, ch, 3, padding=1), nn.ReLU()]
+                cin = ch
+            layers.append(nn.MaxPool2d(2, 2))
+            ch = min(ch * 2, 512)
+        self.features = nn.Sequential(*layers)
+        self.classifier = nn.Sequential(
+            nn.Linear(512 * 7 * 7, 64), nn.ReLU(), nn.Dropout(),
+            nn.Linear(64, 64), nn.ReLU(), nn.Dropout(),
+            nn.Linear(64, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        return self.classifier(torch.flatten(x, 1))
+
+
+def test_torchvision_vgg16_import_matches_torch(f32_policy):
+    """A torch VGG .pth: conv weights map directly, and the FIRST
+    linear's 25088 input features get reordered from torch's C-major
+    flatten to channels-last — without this the shapes still match and
+    the import would be silently wrong."""
+    from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D, Dense, Dropout, Flatten, MaxPooling2D)
+    from analytics_zoo_tpu.models.image.imageclassification.pretrained \
+        import load_torch_state_dict
+
+    oracle = _TorchVGG16(num_classes=5)
+    torch.manual_seed(8)
+    with torch.no_grad():
+        for m in oracle.modules():
+            if isinstance(m, (nn.Conv2d, nn.Linear)):
+                m.weight.normal_(0, (1.0 / m.weight[0].numel()) ** 0.5)
+                m.bias.normal_(0, 0.02)
+    oracle.eval()
+
+    # narrow-FC variant of the vgg() builder graph (fc width 64 keeps
+    # the oracle fast; the flatten-reorder logic is width-independent)
+    inp = Input(shape=(224, 224, 3))
+    x, filters = inp, 64
+    for n_convs in (2, 2, 3, 3, 3):
+        for _ in range(n_convs):
+            x = Convolution2D(filters, 3, 3, border_mode="same",
+                              activation="relu")(x)
+        x = MaxPooling2D(pool_size=(2, 2))(x)
+        filters = min(filters * 2, 512)
+    x = Flatten()(x)
+    x = Dense(64, activation="relu")(x)
+    x = Dropout(0.5)(x)
+    x = Dense(64, activation="relu")(x)
+    x = Dropout(0.5)(x)
+    model = Model(inp, Dense(5)(x))
+
+    load_torch_state_dict(model, oracle.state_dict())
+    rs = np.random.RandomState(4)
+    x_in = rs.rand(1, 224, 224, 3).astype(np.float32)
+    with torch.no_grad():
+        want = oracle(torch.from_numpy(
+            x_in.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(model.predict(x_in, batch_size=1))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+    assert (got.argmax(-1) == want.argmax(-1)).all()
+
+
 def test_keras_vgg16_import_matches_tf(f32_policy):
     tf = pytest.importorskip("tensorflow")
 
