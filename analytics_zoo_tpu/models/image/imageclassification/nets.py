@@ -27,13 +27,14 @@ from analytics_zoo_tpu.pipeline.api.keras.layers import (
 
 def _conv_bn(x, filters, k, stride=1, act=True, border="same",
              torch_pad=False):
-    """Conv→BN→ReLU.  ``torch_pad`` reproduces the torch/Caffe lineage's
-    explicit SYMMETRIC padding (pad (k-1)//2 on both sides, then a
-    valid conv): XLA's SAME pads asymmetrically under stride 2 (e.g.
-    0/1 for k=3), which samples different pixel positions — imported
-    torchvision checkpoints are only numerically faithful with the
-    source's alignment.  For stride 1 the two are identical, so SAME
-    is kept (one op instead of two)."""
+    """Conv→BN→activation.  ``act``: True = relu, a string = that
+    activation, False = none.  ``torch_pad`` reproduces the torch/Caffe
+    lineage's explicit SYMMETRIC padding (pad (k-1)//2 on both sides,
+    then a valid conv): XLA's SAME pads asymmetrically under stride 2
+    (e.g. 0/1 for k=3), which samples different pixel positions —
+    imported torchvision checkpoints are only numerically faithful
+    with the source's alignment.  For stride 1 the two are identical,
+    so SAME is kept (one op instead of two)."""
     if torch_pad and stride > 1 and k > 1:
         p = (k - 1) // 2
         x = ZeroPadding2D((p, p))(x)
@@ -42,8 +43,28 @@ def _conv_bn(x, filters, k, stride=1, act=True, border="same",
                       border_mode=border, bias=False)(x)
     x = BatchNormalization()(x)
     if act:
-        x = Activation("relu")(x)
+        x = Activation("relu" if act is True else act)(x)
     return x
+
+
+def _check_conv_padding(conv_padding: str) -> bool:
+    """Validate the conv_padding option; returns the torch_pad flag."""
+    if conv_padding not in ("same", "torch"):
+        raise ValueError(f"conv_padding must be 'same' or 'torch', "
+                         f"got {conv_padding!r}")
+    return conv_padding == "torch"
+
+
+def _stem_pool(x, torch_pad: bool):
+    """The 3x3/stride-2 stem maxpool shared by the conv7 families:
+    torch alignment = zero-pad(1,1) + valid pool (post-ReLU inputs are
+    >= 0, so zero padding never wins the max)."""
+    if torch_pad:
+        x = ZeroPadding2D((1, 1))(x)
+        return MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                            border_mode="valid")(x)
+    return MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+                        border_mode="same")(x)
 
 
 # ------------------------------------------------------------------ LeNet
@@ -112,10 +133,7 @@ def resnet(depth: int = 50, num_classes: int = 1000,
     (fewer ops, identical capacity).
     """
     block, reps = _RESNET_SPECS[depth]
-    torch_pad = conv_padding == "torch"
-    if conv_padding not in ("same", "torch"):
-        raise ValueError(f"conv_padding must be 'same' or 'torch', "
-                         f"got {conv_padding!r}")
+    torch_pad = _check_conv_padding(conv_padding)
     inp = Input(shape=input_shape)
     if stem == "space_to_depth":
         x = SpaceToDepth2D(2)(inp)
@@ -125,15 +143,7 @@ def resnet(depth: int = 50, num_classes: int = 1000,
     else:
         raise ValueError(f"unknown stem {stem!r}; "
                          "expected 'conv7' or 'space_to_depth'")
-    if torch_pad:
-        # zero-pad then valid pool == torch's pad-1 maxpool (post-ReLU
-        # activations are >= 0, so zero padding never wins the max)
-        x = ZeroPadding2D((1, 1))(x)
-        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
-                         border_mode="valid")(x)
-    else:
-        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
-                         border_mode="same")(x)
+    x = _stem_pool(x, torch_pad)
     filters = 64
     for stage, n in enumerate(reps):
         for i in range(n):
@@ -211,10 +221,7 @@ def mobilenet(num_classes: int = 1000,
 
     inp = Input(shape=input_shape)
     ch = int(32 * alpha)
-    x = Convolution2D(ch, 3, 3, subsample=(2, 2), border_mode="same",
-                      bias=False)(inp)
-    x = BatchNormalization()(x)
-    x = Activation(activation)(x)
+    x = _conv_bn(inp, ch, 3, 2, act=activation)
     for filters, stride in ((64, 1), (128, 2), (128, 1), (256, 2),
                             (256, 1), (512, 2), (512, 1), (512, 1),
                             (512, 1), (512, 1), (512, 1), (1024, 2),
@@ -324,19 +331,10 @@ def densenet(depth: int = 121, num_classes: int = 1000,
         x = Convolution2D(out_ch, 1, 1, bias=False)(x)
         return AveragePooling2D(pool_size=(2, 2))(x)
 
-    torch_pad = conv_padding == "torch"
-    if conv_padding not in ("same", "torch"):
-        raise ValueError(f"conv_padding must be 'same' or 'torch', "
-                         f"got {conv_padding!r}")
+    torch_pad = _check_conv_padding(conv_padding)
     inp = Input(shape=input_shape)
     x = _conv_bn(inp, 2 * growth_rate, 7, 2, torch_pad=torch_pad)
-    if torch_pad:
-        x = ZeroPadding2D((1, 1))(x)   # post-ReLU: zero pad == -inf pad
-        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
-                         border_mode="valid")(x)
-    else:
-        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
-                         border_mode="same")(x)
+    x = _stem_pool(x, torch_pad)
     ch = 2 * growth_rate
     for i, n_layers in enumerate(blocks):
         x = dense_block(x, n_layers)

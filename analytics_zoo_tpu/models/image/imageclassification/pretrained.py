@@ -246,10 +246,10 @@ def _flatten_fed_denses(model) -> Dict[str, Tuple[int, ...]]:
     last_flat = None
     for l in model.layers:
         if isinstance(l, Flatten):
-            try:
-                shape = tuple(l.get_input_shape())
-            except ValueError:
-                shape = ()
+            # the model is built by the time the installer runs, so an
+            # unknown shape must RAISE — silently skipping the permute
+            # would corrupt the import with no error
+            shape = tuple(l.get_input_shape())
             last_flat = shape if len(shape) == 4 else None
         elif isinstance(l, Dense):
             if last_flat is not None:
