@@ -344,6 +344,47 @@ def test_torchvision_densenet_import_matches_torch(f32_policy):
     assert (got.argmax(-1) == want.argmax(-1)).all()
 
 
+def test_checkpoint_dict_wrapper_and_mismatch_errors(f32_policy):
+    """Conventional {'state_dict': ...} checkpoint wrappers unwrap;
+    architecture mismatches raise with the offending slot named."""
+    from analytics_zoo_tpu.models.image.imageclassification.nets import (
+        resnet)
+    from analytics_zoo_tpu.models.image.imageclassification.pretrained \
+        import load_torch_state_dict
+
+    oracle = _TorchResNet(_BasicBlock, (2, 2, 2, 2), num_classes=3)
+    _randomize(oracle, seed=1)
+    oracle.eval()
+    wrapped = {"epoch": 90, "best_acc1": 0.76,
+               "state_dict": oracle.state_dict()}
+
+    model = resnet(18, num_classes=3, input_shape=(64, 64, 3),
+                   conv_padding="torch")
+    load_torch_state_dict(model, wrapped)   # unwraps transparently
+    rs = np.random.RandomState(0)
+    x = rs.rand(1, 64, 64, 3).astype(np.float32)
+    with torch.no_grad():
+        want = oracle(torch.from_numpy(
+            x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(model.predict(x, batch_size=1))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    # wrong class count -> loud shape error, not silent truncation
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+    Layer.reset_name_counters()
+    wrong = resnet(18, num_classes=7, input_shape=(64, 64, 3),
+                   conv_padding="torch")
+    with pytest.raises(ValueError, match="shape"):
+        load_torch_state_dict(wrong, oracle.state_dict())
+
+    # wrong depth -> module/layer count mismatch error
+    Layer.reset_name_counters()
+    deeper = resnet(34, num_classes=3, input_shape=(64, 64, 3),
+                    conv_padding="torch")
+    with pytest.raises(ValueError, match="architectures differ"):
+        load_torch_state_dict(deeper, oracle.state_dict())
+
+
 def test_keras_mobilenet_import_matches_tf(f32_policy):
     """MobileNet-v1 from keras-applications: depthwise convs, relu6,
     and the 1x1-conv classifier mapping onto the Dense head."""
