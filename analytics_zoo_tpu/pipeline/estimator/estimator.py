@@ -53,8 +53,12 @@ def predict_in_batches(run_batch, x, batch_size: int):
                     [a, np.zeros((batch_size - real,) + a.shape[1:],
                                  a.dtype)]), xb)
         out = run_batch(xb)
-        out = jax.tree_util.tree_map(lambda o: o[:real], out)
-        outs.append(jax.device_get(out))
+        # keep results ON DEVICE: a device_get here would sync every
+        # batch (one tunnel round trip each), serializing the loop —
+        # async dispatch pipelines all batches, and the single fetch
+        # below pays one transfer after everything is in flight
+        outs.append(jax.tree_util.tree_map(lambda o: o[:real], out))
+    outs = jax.device_get(outs)
     return jax.tree_util.tree_map(
         lambda *parts: np.concatenate(parts), *outs)
 
