@@ -460,6 +460,27 @@ def bench_serving(n_records: int = 2048, batch_size: int = 32):
 
     out_q = OutputQueue(broker=broker2)
     sample = out_q.query("rec-0")
+
+    # int8 weight-only pass (the reference's OpenVINO-int8 serving
+    # role): same stream, quantized backend
+    im8 = InferenceModel().load_zoo(model, quantize=True)
+    broker3 = EmbeddedBroker()
+    serving3 = ClusterServing(
+        im8, ServingConfig(batch_size=batch_size, top_n=5),
+        broker=broker3)
+    inq3 = InputQueue(broker=broker3)
+    for i in range(n_records):
+        inq3.enqueue_image(f"rec-{i}", jpegs[i])
+    t = threading.Thread(target=serving3.run, kwargs={"poll_ms": 10})
+    t0 = time.time()
+    t.start()
+    while serving3.total_records < n_records and time.time() - t0 < 300:
+        time.sleep(0.02)
+    int8_wall = time.time() - t0
+    serving3.stop()
+    t.join(timeout=10)
+    int8_stats = serving3.stats()
+
     dev = jax.devices()[0]
     return {
         "metric": "cluster_serving_throughput",
@@ -469,11 +490,14 @@ def bench_serving(n_records: int = 2048, batch_size: int = 32):
         "workload": "serving",
         "n_records": n_records,
         "batch_size": batch_size,
+        "pipeline_depth": ServingConfig().pipeline_depth,
         "sequential_rps": round(seq_records / max(wall, 1e-9), 1),
         "pipelined_rps": round(n_records / pipe_wall, 1),
         "latency_p50_ms": round(stats["latency_p50_ms"], 2),
         "latency_p95_ms": round(stats["latency_p95_ms"], 2),
         "latency_p99_ms": round(stats["latency_p99_ms"], 2),
+        "int8_rps": round(n_records / int8_wall, 1),
+        "int8_latency_p50_ms": round(int8_stats["latency_p50_ms"], 2),
         "result_sample_ok": bool(sample),
         "device": str(dev),
         "device_kind": getattr(dev, "device_kind", "?"),
