@@ -105,6 +105,7 @@ class DistributedTrainer:
         self.donate = bool(cfg.get("train.donate"))
         self.grad_sync_dtype = str(cfg.get("train.grad_sync_dtype"))
         self._train_step = None
+        self._train_step_at = None
         self._eval_step = None
         self._predict_step = None
         self._rep = mesh_lib.replicated(self.mesh)
@@ -243,6 +244,23 @@ class DistributedTrainer:
         if self._train_step is None:
             self._train_step = self._build_train_step()
         return self._train_step(params, opt_state, state, batch, rng)
+
+    def train_step_at(self, params, opt_state, state, batch, rng, step):
+        """``train_step`` with the per-step rng derived IN-JIT:
+        equivalent to ``train_step(..., fold_in(rng, step))`` but
+        without dispatching a separate fold_in op per step (one extra
+        round trip each over a tunneled backend).  ``step`` must be a
+        numpy scalar (traced arg — a Python int would retrace)."""
+        if self._train_step_at is None:
+            donate = (0, 1, 2) if self.donate else ()
+            self._train_step_at = jax.jit(
+                lambda p, o, s, b, r, i: self._step_core(
+                    p, o, s, b, jax.random.fold_in(r, i)),
+                out_shardings=(self._param_shardings, None, self._rep,
+                               self._rep),
+                donate_argnums=donate)
+        return self._train_step_at(params, opt_state, state, batch,
+                                   rng, step)
 
     # ------------------------------------------------- device-resident epoch
     def epoch_scan_fn(self, num_batches: int, batch_size: int,
