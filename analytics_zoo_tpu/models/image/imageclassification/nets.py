@@ -350,9 +350,42 @@ def densenet(depth: int = 121, num_classes: int = 1000,
 
 
 def alexnet(num_classes: int = 1000,
-            input_shape: Tuple[int, int, int] = (227, 227, 3)) -> Model:
+            input_shape: Tuple[int, int, int] = (227, 227, 3),
+            variant: str = "zoo") -> Model:
     """AlexNet (published "alexnet"; LRN replaced by BN, the modern
-    equivalent)."""
+    equivalent).
+
+    ``variant="torchvision"`` builds torchvision's exact graph instead
+    (224 input, pad-2 stem, no norm layers, dropout-first classifier)
+    so published ``alexnet .pth`` checkpoints import faithfully."""
+    if variant == "torchvision":
+        if input_shape == (227, 227, 3):
+            input_shape = (224, 224, 3)    # torchvision's input size
+        inp = Input(shape=input_shape)
+        x = ZeroPadding2D((2, 2))(inp)
+        x = Convolution2D(64, 11, 11, subsample=(4, 4),
+                          activation="relu")(x)
+        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+        x = Convolution2D(192, 5, 5, border_mode="same",
+                          activation="relu")(x)
+        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+        x = Convolution2D(384, 3, 3, border_mode="same",
+                          activation="relu")(x)
+        x = Convolution2D(256, 3, 3, border_mode="same",
+                          activation="relu")(x)
+        x = Convolution2D(256, 3, 3, border_mode="same",
+                          activation="relu")(x)
+        x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+        x = Flatten()(x)
+        x = Dropout(0.5)(x)
+        x = Dense(4096, activation="relu")(x)
+        x = Dropout(0.5)(x)
+        x = Dense(4096, activation="relu")(x)
+        out = Dense(num_classes)(x)
+        return Model(inp, out)
+    if variant != "zoo":
+        raise ValueError(f"variant must be 'zoo' or 'torchvision', "
+                         f"got {variant!r}")
     inp = Input(shape=input_shape)
     x = Convolution2D(96, 11, 11, subsample=(4, 4),
                       activation="relu")(inp)
@@ -426,6 +459,8 @@ class ImageClassifier(ImageModel):
             if source == "torchvision" and model_name.startswith(
                     ("resnet", "densenet")):
                 self._kw["conv_padding"] = "torch"
+            if source == "torchvision" and model_name == "alexnet":
+                self._kw["variant"] = "torchvision"
             if source == "keras" and model_name == "mobilenet":
                 # keras-applications MobileNet weights were trained
                 # with relu6

@@ -157,9 +157,11 @@ def bench_ncf():
     warm = 5
     it = train_set.epoch_batches(0, batch_size, train=True)
     t_compile = time.time()
+    step_no = 0
     for i, batch in enumerate(trainer.prefetch(it)):
-        params, opt_state, state, loss = trainer.train_step(
-            params, opt_state, state, batch, rng)
+        params, opt_state, state, loss = trainer.train_step_at(
+            params, opt_state, state, batch, rng, np.int32(step_no))
+        step_no += 1
         if i == 0:
             float(loss)
             compile_s = time.time() - t_compile
@@ -172,15 +174,16 @@ def bench_ncf():
     t0 = time.time()
     for batch in trainer.prefetch(
             train_set.epoch_batches(1, batch_size, train=True)):
-        params, opt_state, state, loss = trainer.train_step(
-            params, opt_state, state, batch, rng)
+        params, opt_state, state, loss = trainer.train_step_at(
+            params, opt_state, state, batch, rng, np.int32(step_no))
+        step_no += 1
         timed_steps += 1
         last_batch = batch
     float(loss)
     step_wall = time.time() - t0
     step_tput = timed_steps * batch_size / step_wall
-    flops = compiled_flops(trainer._train_step, params, opt_state, state,
-                           last_batch, rng)
+    flops = compiled_flops(trainer._train_step_at, params, opt_state,
+                           state, last_batch, rng, np.int32(step_no))
 
     # ---- path C: chunked dispatch (k steps / lax.scan dispatch) ------
     # what fit() users get by default (train.steps_per_dispatch=16)

@@ -344,6 +344,82 @@ def test_torchvision_densenet_import_matches_torch(f32_policy):
     assert (got.argmax(-1) == want.argmax(-1)).all()
 
 
+class _TorchAlexNet(nn.Module):
+    """torchvision alexnet module order (features then classifier;
+    classifier linears flatten C-major — exercised via the Flatten
+    permute, since 256*6*6 != 256)."""
+
+    def __init__(self, num_classes):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2d(3, 64, 11, 4, 2), nn.ReLU(),
+            nn.MaxPool2d(3, 2),
+            nn.Conv2d(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2d(3, 2),
+            nn.Conv2d(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2d(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2d(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2d(3, 2))
+        self.classifier = nn.Sequential(
+            nn.Dropout(), nn.Linear(256 * 6 * 6, 64), nn.ReLU(),
+            nn.Dropout(), nn.Linear(64, 64), nn.ReLU(),
+            nn.Linear(64, num_classes))
+
+    def forward(self, x):
+        return self.classifier(torch.flatten(self.features(x), 1))
+
+
+def test_torchvision_alexnet_import_matches_torch(f32_policy):
+    from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Convolution2D, Dense, Dropout, Flatten, MaxPooling2D,
+        ZeroPadding2D)
+    from analytics_zoo_tpu.models.image.imageclassification.pretrained \
+        import load_torch_state_dict
+
+    oracle = _TorchAlexNet(num_classes=4)
+    torch.manual_seed(12)
+    with torch.no_grad():
+        for m in oracle.modules():
+            if isinstance(m, (nn.Conv2d, nn.Linear)):
+                m.weight.normal_(0, (1.0 / m.weight[0].numel()) ** 0.5)
+                m.bias.normal_(0, 0.02)
+    oracle.eval()
+
+    # narrow-FC alexnet torchvision-variant graph (same shape logic as
+    # alexnet(variant="torchvision"), fc width 64 for test speed)
+    inp = Input(shape=(224, 224, 3))
+    x = ZeroPadding2D((2, 2))(inp)
+    x = Convolution2D(64, 11, 11, subsample=(4, 4),
+                      activation="relu")(x)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = Convolution2D(192, 5, 5, border_mode="same",
+                      activation="relu")(x)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = Convolution2D(384, 3, 3, border_mode="same",
+                      activation="relu")(x)
+    x = Convolution2D(256, 3, 3, border_mode="same",
+                      activation="relu")(x)
+    x = Convolution2D(256, 3, 3, border_mode="same",
+                      activation="relu")(x)
+    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2))(x)
+    x = Flatten()(x)
+    x = Dropout(0.5)(x)
+    x = Dense(64, activation="relu")(x)
+    x = Dropout(0.5)(x)
+    x = Dense(64, activation="relu")(x)
+    model = Model(inp, Dense(4)(x))
+
+    load_torch_state_dict(model, oracle.state_dict())
+    rs = np.random.RandomState(13)
+    x_in = rs.rand(1, 224, 224, 3).astype(np.float32)
+    with torch.no_grad():
+        want = oracle(torch.from_numpy(
+            x_in.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(model.predict(x_in, batch_size=1))
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
 def test_checkpoint_dict_wrapper_and_mismatch_errors(f32_policy):
     """Conventional {'state_dict': ...} checkpoint wrappers unwrap;
     architecture mismatches raise with the offending slot named."""
