@@ -230,10 +230,18 @@ class DistributedTrainer:
         new_params = mask_frozen_params(model, params, new_params)
         return new_params, new_opt_state, new_state, loss
 
-    def _build_train_step(self):
+    def _build_train_step(self, fold_rng: bool = False):
+        """One source of truth for the train-step jit spec; with
+        ``fold_rng`` the program takes (.., rng, step) and derives the
+        per-step rng in-jit."""
         donate = (0, 1, 2) if self.donate else ()
+        if fold_rng:
+            fn = lambda p, o, s, b, r, i: self._step_core(  # noqa: E731
+                p, o, s, b, jax.random.fold_in(r, i))
+        else:
+            fn = self._step_core
         return jax.jit(
-            self._step_core,
+            fn,
             out_shardings=(self._param_shardings, None, self._rep,
                            self._rep),
             donate_argnums=donate)
@@ -252,13 +260,7 @@ class DistributedTrainer:
         round trip each over a tunneled backend).  ``step`` must be a
         numpy scalar (traced arg — a Python int would retrace)."""
         if self._train_step_at is None:
-            donate = (0, 1, 2) if self.donate else ()
-            self._train_step_at = jax.jit(
-                lambda p, o, s, b, r, i: self._step_core(
-                    p, o, s, b, jax.random.fold_in(r, i)),
-                out_shardings=(self._param_shardings, None, self._rep,
-                               self._rep),
-                donate_argnums=donate)
+            self._train_step_at = self._build_train_step(fold_rng=True)
         return self._train_step_at(params, opt_state, state, batch,
                                    rng, step)
 

@@ -42,7 +42,13 @@ def predict_in_batches(run_batch, x, batch_size: int):
     n = len(leaves[0]) if leaves else 0
     if n == 0:
         raise ValueError("predict called with an empty input")
-    outs = []
+    # Pipelined fetch: a device_get per batch would sync every batch
+    # (one tunnel round trip each), serializing the loop; keeping ALL
+    # results on device until the end risks HBM exhaustion for large
+    # outputs. A sliding window keeps `window` batches in flight —
+    # dispatch runs ahead while older results stream to host.
+    window = 8
+    outs, in_flight = [], []
     for b in range(math.ceil(n / batch_size)):
         lo, hi = b * batch_size, min((b + 1) * batch_size, n)
         xb = jax.tree_util.tree_map(lambda a: a[lo:hi], x)
@@ -53,12 +59,10 @@ def predict_in_batches(run_batch, x, batch_size: int):
                     [a, np.zeros((batch_size - real,) + a.shape[1:],
                                  a.dtype)]), xb)
         out = run_batch(xb)
-        # keep results ON DEVICE: a device_get here would sync every
-        # batch (one tunnel round trip each), serializing the loop —
-        # async dispatch pipelines all batches, and the single fetch
-        # below pays one transfer after everything is in flight
-        outs.append(jax.tree_util.tree_map(lambda o: o[:real], out))
-    outs = jax.device_get(outs)
+        in_flight.append(jax.tree_util.tree_map(lambda o: o[:real], out))
+        if len(in_flight) >= window:
+            outs.append(jax.device_get(in_flight.pop(0)))
+    outs.extend(jax.device_get(in_flight))
     return jax.tree_util.tree_map(
         lambda *parts: np.concatenate(parts), *outs)
 

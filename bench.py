@@ -442,64 +442,62 @@ def bench_serving(n_records: int = 2048, batch_size: int = 32):
     wall = time.time() - t0
     seq_records = serving.total_records - warm_records
 
-    # pipelined pass over a fresh copy of the stream
-    broker2 = EmbeddedBroker()
-    serving2 = ClusterServing(
-        im, ServingConfig(batch_size=batch_size, top_n=5),
-        broker=broker2)
-    inq2 = InputQueue(broker=broker2)
-    for i in range(n_records):
-        inq2.enqueue_image(f"rec-{i}", jpegs[i])
-    import threading
-    t = threading.Thread(target=serving2.run, kwargs={"poll_ms": 10})
-    t0 = time.time()
-    t.start()
-    while serving2.total_records < n_records and time.time() - t0 < 300:
-        time.sleep(0.02)
-    pipe_wall = time.time() - t0
-    serving2.stop()
-    t.join(timeout=10)
-    stats = serving2.stats()
+    def pipelined_pass(im_pass):
+        """One timed pipelined pass over a fresh copy of the stream.
+        The padded-batch executable must already be warm — compile
+        time inside the window would bias rps low.  Returns (rps,
+        stats, served, broker)."""
+        import threading
+        broker_p = EmbeddedBroker()
+        serving_p = ClusterServing(
+            im_pass, ServingConfig(batch_size=batch_size, top_n=5),
+            broker=broker_p)
+        inq_p = InputQueue(broker=broker_p)
+        for i in range(n_records):
+            inq_p.enqueue_image(f"rec-{i}", jpegs[i])
+        t = threading.Thread(target=serving_p.run, kwargs={"poll_ms": 10})
+        t0 = time.time()
+        t.start()
+        while serving_p.total_records < n_records \
+                and time.time() - t0 < 300:
+            time.sleep(0.02)
+        wall_p = time.time() - t0
+        serving_p.stop()
+        t.join(timeout=10)
+        served = serving_p.total_records   # rps over records actually
+        return (served / max(wall_p, 1e-9), serving_p.stats(),
+                served, broker_p)
+
+    pipe_rps, stats, pipe_served, broker2 = pipelined_pass(im)
+
+    # int8 weight-only pass (the reference's OpenVINO-int8 serving
+    # role): same stream, quantized backend — warmed explicitly (its
+    # executable has never compiled)
+    im8 = InferenceModel().load_zoo(model, quantize=True)
+    im8.predict(np.zeros((batch_size, 64, 64, 3), np.float32))
+    int8_rps, int8_stats, int8_served, _b3 = pipelined_pass(im8)
 
     out_q = OutputQueue(broker=broker2)
     sample = out_q.query("rec-0")
 
-    # int8 weight-only pass (the reference's OpenVINO-int8 serving
-    # role): same stream, quantized backend
-    im8 = InferenceModel().load_zoo(model, quantize=True)
-    broker3 = EmbeddedBroker()
-    serving3 = ClusterServing(
-        im8, ServingConfig(batch_size=batch_size, top_n=5),
-        broker=broker3)
-    inq3 = InputQueue(broker=broker3)
-    for i in range(n_records):
-        inq3.enqueue_image(f"rec-{i}", jpegs[i])
-    t = threading.Thread(target=serving3.run, kwargs={"poll_ms": 10})
-    t0 = time.time()
-    t.start()
-    while serving3.total_records < n_records and time.time() - t0 < 300:
-        time.sleep(0.02)
-    int8_wall = time.time() - t0
-    serving3.stop()
-    t.join(timeout=10)
-    int8_stats = serving3.stats()
-
     dev = jax.devices()[0]
     return {
         "metric": "cluster_serving_throughput",
-        "value": round(n_records / pipe_wall, 1),
+        "value": round(pipe_rps, 1),
         "unit": "records/sec/chip",
         "vs_baseline": None,
         "workload": "serving",
         "n_records": n_records,
+        "records_served": pipe_served,
         "batch_size": batch_size,
         "pipeline_depth": ServingConfig().pipeline_depth,
         "sequential_rps": round(seq_records / max(wall, 1e-9), 1),
-        "pipelined_rps": round(n_records / pipe_wall, 1),
+        "pipelined_rps": round(pipe_rps, 1),
         "latency_p50_ms": round(stats["latency_p50_ms"], 2),
         "latency_p95_ms": round(stats["latency_p95_ms"], 2),
         "latency_p99_ms": round(stats["latency_p99_ms"], 2),
-        "int8_rps": round(n_records / int8_wall, 1),
+        "int8_rps": round(int8_rps, 1),
+        "int8_records_served": int8_served,
         "int8_latency_p50_ms": round(int8_stats["latency_p50_ms"], 2),
         "result_sample_ok": bool(sample),
         "device": str(dev),
