@@ -87,6 +87,12 @@ def test_predict_handles_partial_batches():
     model.compile(optimizer="sgd", loss="mse")
     preds = model.predict(x, batch_size=64)
     assert preds.shape == (130, 1)
+    # > 8 batches exercises the sliding in-flight window in
+    # predict_in_batches (pop-and-fetch path), and row order must
+    # survive the windowed fetch
+    small = model.predict(x, batch_size=8)   # 17 batches
+    np.testing.assert_allclose(np.asarray(small), np.asarray(preds),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_checkpoint_resume(tmp_path):
