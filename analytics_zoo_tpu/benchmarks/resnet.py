@@ -33,7 +33,8 @@ def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
     import jax
     import jax.numpy as jnp
 
-    from analytics_zoo_tpu.benchmarks import mfu_estimate
+    from analytics_zoo_tpu.benchmarks import (
+        calibrate_chip, cost_of_compiled, mfu_estimate)
     from analytics_zoo_tpu.models.image.imageclassification import resnet
     from analytics_zoo_tpu.ops import dtypes
     from analytics_zoo_tpu.parallel import mesh as mesh_lib
@@ -87,15 +88,12 @@ def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
     t_compile = time.time()
     compiled = epoch_fn.lower(params, opt_state, state, x_dev, y_dev,
                               rng).compile()
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0)) or None
-    except Exception:
-        flops = None
+
+    flops, hbm_bytes = cost_of_compiled(compiled)
     if flops:
         flops /= unroll        # unrolled scan body holds `unroll` steps
+    if hbm_bytes:
+        hbm_bytes /= unroll
 
     # first execution (donates params/opt_state/state); the first
     # post-compile run over the tunneled backend is ~10x slower than
@@ -119,6 +117,27 @@ def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
     step_ms = wall / scan_steps * 1e3
     mfu = mfu_estimate(flops, wall / scan_steps, device)
 
+    # Calibrate what the chip delivers RIGHT NOW (shared/tunneled
+    # hardware can throttle well below nominal peak), then place the
+    # measured step on the chip's own roofline: nominal MFU alone
+    # cannot distinguish "model leaves the MXU idle" from "the
+    # platform only delivers half its spec sheet".
+
+    calib = calibrate_chip()
+    mfu_deliverable = roofline_ms = roofline_frac = None
+    if not calib.get("error"):
+        if flops and calib.get("deliverable_tflops"):
+            mfu_deliverable = round(
+                flops / (wall / scan_steps)
+                / (calib["deliverable_tflops"] * 1e12), 3)
+        if hbm_bytes and calib.get("hbm_gbps"):
+            # bandwidth-roofline step time: every byte the compiled
+            # program touches (XLA's own counter), streamed at the
+            # measured rate — the floor for an HBM-bound program
+            roofline_ms = round(
+                hbm_bytes / (calib["hbm_gbps"] * 1e9) * 1e3, 2)
+            roofline_frac = round(roofline_ms / step_ms, 3)
+
     return {
         "metric": "resnet50_imagenet_train_throughput",
         "value": round(imgs_per_sec, 1),
@@ -136,7 +155,12 @@ def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
         "stem": stem,
         "final_loss": loss_val,
         "xla_flops_per_step": flops,
+        "xla_bytes_per_step": hbm_bytes,
         "mfu_est": mfu,
+        "calibration": calib,
+        "mfu_vs_deliverable": mfu_deliverable,
+        "hbm_roofline_step_ms": roofline_ms,
+        "roofline_attainment": roofline_frac,
         "device": str(device),
         "device_kind": getattr(device, "device_kind", "?"),
     }

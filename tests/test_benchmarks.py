@@ -1,0 +1,110 @@
+"""Bench harness units: chip calibration and the merging artifact
+writer (bench_results_*.json survives partial reruns and keeps the
+best number per workload on a shared chip)."""
+
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+import bench  # noqa: E402  (repo-root module)
+
+from analytics_zoo_tpu.benchmarks import calibrate_chip, mfu_estimate
+
+
+def test_calibrate_chip_runs_on_cpu():
+    # conftest forces JAX_PLATFORMS=cpu -> toy sizes, seconds not
+    # minutes; the shape of the answer is platform-independent
+    r = calibrate_chip(repeats=1)
+    assert "error" not in r, r
+    assert r["deliverable_tflops"] > 0
+    assert r["hbm_gbps"] > 0
+    # CPU device kind is unknown to the nominal-peak table
+    assert r["nominal_tflops"] is None
+    assert r["deliverable_frac_of_nominal"] is None
+
+
+def test_mfu_estimate_known_and_unknown_kind():
+    class Dev:
+        def __init__(self, kind):
+            self.device_kind = kind
+
+    # 98.5 TFLOP/s of work on a 197-peak chip -> 0.5
+    assert mfu_estimate(98.5e12, 1.0, Dev("TPU v5 lite")) == 0.5
+    assert mfu_estimate(98.5e12, 1.0, Dev("warp9 accelerator")) is None
+    assert mfu_estimate(None, 1.0, Dev("TPU v5 lite")) is None
+    assert mfu_estimate(1e12, 0.0, Dev("TPU v5 lite")) is None
+
+
+def test_artifact_merge_keeps_best_value_per_metric(tmp_path, monkeypatch):
+    path = tmp_path / "bench_results_test.json"
+    monkeypatch.setattr(bench, "ARTIFACT_PATH", str(path))
+
+    bench._write_artifact(
+        [{"metric": "a", "value": 5}, {"metric": "b", "value": 7}],
+        {"run": 1})
+    # a failed rerun (value 0 + error) must not displace a number
+    bench._write_artifact(
+        [{"metric": "a", "value": 0, "error": "crash"}], {"run": 2})
+    # a better rerun supersedes, a worse one does not
+    bench._write_artifact(
+        [{"metric": "b", "value": 9}, {"metric": "a", "value": 3}],
+        {"run": 3})
+
+    d = json.loads(path.read_text())
+    assert {r["metric"]: r["value"] for r in d["results"]} == \
+        {"a": 5, "b": 9}
+    assert d["meta"] == {"run": 3}
+    # every distinct run's meta is preserved for provenance
+    assert d["runs"] == [{"run": 1}, {"run": 2}, {"run": 3}]
+    # displaced runs stay auditable on the winning entry
+    a = next(r for r in d["results"] if r["metric"] == "a")
+    assert [s["value"] for s in a["superseded"]] == [0, 3]
+    assert a["superseded"][0]["error"] == "crash"
+    b = next(r for r in d["results"] if r["metric"] == "b")
+    assert [s["value"] for s in b["superseded"]] == [7]
+    assert all("recorded_unix" in r for r in d["results"])
+
+
+def test_artifact_incremental_writes_do_not_self_supersede(
+        tmp_path, monkeypatch):
+    """main() re-writes the cumulative results list after every
+    workload; an entry must never appear in its own audit trail."""
+    path = tmp_path / "bench_results_test.json"
+    monkeypatch.setattr(bench, "ARTIFACT_PATH", str(path))
+
+    results = []
+    meta = {"started_unix": 111.0}
+    for i, (metric, value) in enumerate(
+            [("a", 5), ("b", 7), ("c", 2)]):
+        results.append({"metric": metric, "value": value})
+        bench._write_artifact(results, meta)
+
+    d = json.loads(path.read_text())
+    assert {r["metric"]: r["value"] for r in d["results"]} == \
+        {"a": 5, "b": 7, "c": 2}
+    assert not any("superseded" in r for r in d["results"])
+    # one run -> one runs entry, not one per incremental write
+    assert d["runs"] == [meta]
+
+    # a genuine lower-value rerun is recorded exactly once even if
+    # the rerun also write-per-workloads its cumulative list
+    rerun = [{"metric": "a", "value": 4}]
+    bench._write_artifact(rerun, {"started_unix": 222.0})
+    bench._write_artifact(rerun, {"started_unix": 222.0})
+    d = json.loads(path.read_text())
+    a = next(r for r in d["results"] if r["metric"] == "a")
+    assert a["value"] == 5
+    assert [s["value"] for s in a["superseded"]] == [4]
+    assert [m["started_unix"] for m in d["runs"]] == [111.0, 222.0]
+
+
+def test_artifact_merge_tolerates_corrupt_prior(tmp_path, monkeypatch):
+    path = tmp_path / "bench_results_test.json"
+    path.write_text("{not json")
+    monkeypatch.setattr(bench, "ARTIFACT_PATH", str(path))
+    bench._write_artifact([{"metric": "a", "value": 1}], {})
+    d = json.loads(path.read_text())
+    assert len(d["results"]) == 1
+    assert d["results"][0]["metric"] == "a"
+    assert d["results"][0]["value"] == 1
