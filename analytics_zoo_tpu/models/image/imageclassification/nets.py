@@ -55,6 +55,15 @@ def _check_conv_padding(conv_padding: str) -> bool:
     return conv_padding == "torch"
 
 
+def _check_variant(variant: str) -> bool:
+    """Validate a 'zoo' | 'torchvision' variant option; returns True
+    for the torchvision graph variant."""
+    if variant not in ("zoo", "torchvision"):
+        raise ValueError(f"variant must be 'zoo' or 'torchvision', "
+                         f"got {variant!r}")
+    return variant == "torchvision"
+
+
 def _stem_pool(x, torch_pad: bool):
     """The 3x3/stride-2 stem maxpool shared by the conv7 families:
     torch alignment = zero-pad(1,1) + valid pool (post-ReLU inputs are
@@ -156,10 +165,10 @@ def resnet(depth: int = 50, num_classes: int = 1000,
 
 
 # ------------------------------------------------------------ Inception-v1
-def _inception_module(x, f1, f3r, f3, f5r, f5, proj):
+def _inception_module(x, f1, f3r, f3, f5r, f5, proj, b5_k=5):
     b1 = _conv_bn(x, f1, 1)
     b3 = _conv_bn(_conv_bn(x, f3r, 1), f3, 3)
-    b5 = _conv_bn(_conv_bn(x, f5r, 1), f5, 5)
+    b5 = _conv_bn(_conv_bn(x, f5r, 1), f5, b5_k)
     bp = MaxPooling2D(pool_size=(3, 3), strides=(1, 1),
                       border_mode="same")(x)
     bp = _conv_bn(bp, proj, 1)
@@ -167,33 +176,65 @@ def _inception_module(x, f1, f3r, f3, f5r, f5, proj):
 
 
 def inception_v1(num_classes: int = 1000,
-                 input_shape: Tuple[int, int, int] = (224, 224, 3)
-                 ) -> Model:
+                 input_shape: Tuple[int, int, int] = (224, 224, 3),
+                 variant: str = "zoo") -> Model:
     """GoogLeNet / Inception-v1 (examples/inception/Train.scala:31
-    workload)."""
+    workload).
+
+    ``variant="torchvision"`` reproduces torchvision's ``googlenet``
+    graph exactly so published checkpoints import faithfully: the
+    explicit pad-3 stem alignment, and a 3x3 kernel on the "5x5"
+    branch (torchvision inherited that substitution from the TF-slim
+    checkpoint it ported; the published weights have 3x3 shapes).
+    The stride-2 maxpools stay ``same`` — on this net's even extents
+    SAME's right-only padding selects the same windows as
+    torchvision's ceil_mode, and zero padding never wins a max over
+    post-ReLU inputs.  The aux towers are inference-irrelevant and
+    not built; the importer skips their checkpoint modules."""
+    tv = _check_variant(variant)
+    if tv and (input_shape[0] % 32 or input_shape[1] % 32):
+        # the SAME-pool == ceil_mode-pool equivalence (docstring) holds
+        # only while every stride-2 stage sees an even extent; 5
+        # halvings -> multiples of 32 keep the whole stack even
+        raise ValueError(
+            "variant='torchvision' needs input height/width divisible "
+            f"by 32 for checkpoint-faithful pooling; got "
+            f"{tuple(input_shape[:2])}")
+    b5_k = 3 if tv else 5
     inp = Input(shape=input_shape)
-    x = _conv_bn(inp, 64, 7, 2)
+    x = _conv_bn(inp, 64, 7, 2, torch_pad=tv)
     x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
                      border_mode="same")(x)
     x = _conv_bn(x, 64, 1)
     x = _conv_bn(x, 192, 3)
     x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
                      border_mode="same")(x)
-    x = _inception_module(x, 64, 96, 128, 16, 32, 32)     # 3a
-    x = _inception_module(x, 128, 128, 192, 32, 96, 64)   # 3b
+    x = _inception_module(x, 64, 96, 128, 16, 32, 32,
+                          b5_k=b5_k)                      # 3a
+    x = _inception_module(x, 128, 128, 192, 32, 96, 64,
+                          b5_k=b5_k)                      # 3b
     x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
                      border_mode="same")(x)
-    x = _inception_module(x, 192, 96, 208, 16, 48, 64)    # 4a
-    x = _inception_module(x, 160, 112, 224, 24, 64, 64)   # 4b
-    x = _inception_module(x, 128, 128, 256, 24, 64, 64)   # 4c
-    x = _inception_module(x, 112, 144, 288, 32, 64, 64)   # 4d
-    x = _inception_module(x, 256, 160, 320, 32, 128, 128)  # 4e
-    x = MaxPooling2D(pool_size=(3, 3), strides=(2, 2),
+    x = _inception_module(x, 192, 96, 208, 16, 48, 64,
+                          b5_k=b5_k)                      # 4a
+    x = _inception_module(x, 160, 112, 224, 24, 64, 64,
+                          b5_k=b5_k)                      # 4b
+    x = _inception_module(x, 128, 128, 256, 24, 64, 64,
+                          b5_k=b5_k)                      # 4c
+    x = _inception_module(x, 112, 144, 288, 32, 64, 64,
+                          b5_k=b5_k)                      # 4d
+    x = _inception_module(x, 256, 160, 320, 32, 128, 128,
+                          b5_k=b5_k)                      # 4e
+    # torchvision's maxpool4 is kernel-2/stride-2 (not 3x3)
+    pool4 = (2, 2) if tv else (3, 3)
+    x = MaxPooling2D(pool_size=pool4, strides=(2, 2),
                      border_mode="same")(x)
-    x = _inception_module(x, 256, 160, 320, 32, 128, 128)  # 5a
-    x = _inception_module(x, 384, 192, 384, 48, 128, 128)  # 5b
+    x = _inception_module(x, 256, 160, 320, 32, 128, 128,
+                          b5_k=b5_k)                      # 5a
+    x = _inception_module(x, 384, 192, 384, 48, 128, 128,
+                          b5_k=b5_k)                      # 5b
     x = GlobalAveragePooling2D()(x)
-    x = Dropout(0.4)(x)
+    x = Dropout(0.2 if tv else 0.4)(x)
     out = Dense(num_classes)(x)
     return Model(inp, out)
 
@@ -358,7 +399,7 @@ def alexnet(num_classes: int = 1000,
     ``variant="torchvision"`` builds torchvision's exact graph instead
     (224 input, pad-2 stem, no norm layers, dropout-first classifier)
     so published ``alexnet .pth`` checkpoints import faithfully."""
-    if variant == "torchvision":
+    if _check_variant(variant):
         if input_shape == (227, 227, 3):
             input_shape = (224, 224, 3)    # torchvision's input size
         inp = Input(shape=input_shape)
@@ -383,9 +424,6 @@ def alexnet(num_classes: int = 1000,
         x = Dense(4096, activation="relu")(x)
         out = Dense(num_classes)(x)
         return Model(inp, out)
-    if variant != "zoo":
-        raise ValueError(f"variant must be 'zoo' or 'torchvision', "
-                         f"got {variant!r}")
     inp = Input(shape=input_shape)
     x = Convolution2D(96, 11, 11, subsample=(4, 4),
                       activation="relu")(inp)
@@ -459,7 +497,8 @@ class ImageClassifier(ImageModel):
             if source == "torchvision" and model_name.startswith(
                     ("resnet", "densenet")):
                 self._kw["conv_padding"] = "torch"
-            if source == "torchvision" and model_name == "alexnet":
+            if source == "torchvision" and model_name in (
+                    "alexnet", "inception-v1"):
                 self._kw["variant"] = "torchvision"
             if source == "keras" and model_name == "mobilenet":
                 # keras-applications MobileNet weights were trained
@@ -469,7 +508,14 @@ class ImageClassifier(ImageModel):
         if pretrained is not None:
             from analytics_zoo_tpu.models.image.imageclassification \
                 .pretrained import load_pretrained, pretrained_configure
-            load_pretrained(self.model, pretrained, source=source)
+            torch_kw = {}
+            if source == "torchvision" and model_name == "inception-v1":
+                # torchvision googlenet: BN eps 1e-3 (not torch's 1e-5
+                # default) and training-only aux towers in the ckpt
+                torch_kw = dict(bn_eps=1e-3,
+                                skip_prefixes=("aux1.", "aux2."))
+            load_pretrained(self.model, pretrained, source=source,
+                            **torch_kw)
             if config is None:
                 self.config = pretrained_configure(
                     model_name, source, input_shape=input_shape)

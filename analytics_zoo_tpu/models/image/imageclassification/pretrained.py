@@ -80,14 +80,24 @@ def _to_numpy(t) -> np.ndarray:
     return np.asarray(t)
 
 
-def _torch_groups(state_dict) -> List[Group]:
+def _torch_groups(state_dict, bn_eps: float = _TORCH_BN_EPS,
+                  skip_prefixes: Tuple[str, ...] = ()) -> List[Group]:
     """Normalise a torch ``state_dict`` (insertion-ordered = module
-    definition order) into canonical groups."""
+    definition order) into canonical groups.
+
+    ``bn_eps``: the source model's BatchNorm2d epsilon — NOT stored in
+    the state_dict, so families that deviate from torch's 1e-5 default
+    (e.g. googlenet's 1e-3) must say so or the fold into moving_var is
+    silently off.  ``skip_prefixes`` drops checkpoint modules the
+    target intentionally does not build (e.g. googlenet's aux towers,
+    which only exist for training-time loss shaping)."""
     grouped: Dict[str, Dict[str, np.ndarray]] = {}
     order: List[str] = []
     for key, tensor in state_dict.items():
         prefix, _, leaf = key.rpartition(".")
         if leaf == "num_batches_tracked":
+            continue
+        if any(key.startswith(p) for p in skip_prefixes):
             continue
         if prefix not in grouped:
             grouped[prefix] = {}
@@ -102,7 +112,7 @@ def _torch_groups(state_dict) -> List[Group]:
                 "gamma": g["weight"], "beta": g["bias"],
                 "moving_mean": g["running_mean"],
                 "moving_var": g["running_var"],
-                "epsilon": _TORCH_BN_EPS, "__name__": prefix}))
+                "epsilon": bn_eps, "__name__": prefix}))
         elif g["weight"].ndim == 4:
             # OIHW -> HWIO; also correct for grouped/depthwise convs
             # (torch (C,1,kh,kw) -> (kh,kw,1,C), I = in/groups)
@@ -272,17 +282,21 @@ def _assign(tree, layer_name: str, key: str, value: np.ndarray) -> None:
 
 
 # --------------------------------------------------------------- entries
-def load_torch_state_dict(model, state_dict) -> None:
+def load_torch_state_dict(model, state_dict,
+                          bn_eps: float = _TORCH_BN_EPS,
+                          skip_prefixes: Tuple[str, ...] = ()) -> None:
     """Import a torchvision-layout state_dict into ``model`` in place.
 
     ``state_dict`` may be the dict itself or a checkpoint dict holding
-    one under the conventional ``"state_dict"`` key.
+    one under the conventional ``"state_dict"`` key.  ``bn_eps`` /
+    ``skip_prefixes``: see ``_torch_groups``.
     """
     inner = state_dict.get("state_dict") \
         if isinstance(state_dict, dict) else None
     if isinstance(inner, dict):
         state_dict = inner
-    _install(model, _torch_groups(state_dict))
+    _install(model, _torch_groups(state_dict, bn_eps=bn_eps,
+                                  skip_prefixes=skip_prefixes))
 
 
 def load_keras_model(model, keras_model) -> None:
@@ -313,16 +327,22 @@ def infer_source(src) -> Optional[str]:
     return None
 
 
-def load_pretrained(model, src, source: Optional[str] = None) -> None:
+def load_pretrained(model, src, source: Optional[str] = None,
+                    **torch_kw) -> None:
     """Dispatch on ``source`` ('torchvision' | 'keras') or the file
-    extension (.pth/.pt vs .h5/.keras)."""
+    extension (.pth/.pt vs .h5/.keras).  ``torch_kw`` forwards
+    family-specific import options (``bn_eps``, ``skip_prefixes``) to
+    ``load_torch_state_dict``."""
     source = source or infer_source(src)
     if source == "torchvision":
         if isinstance(src, (str, os.PathLike)):
             import torch
             src = torch.load(src, map_location="cpu", weights_only=True)
-        load_torch_state_dict(model, src)
+        load_torch_state_dict(model, src, **torch_kw)
     elif source == "keras":
+        if torch_kw:
+            raise ValueError("bn_eps/skip_prefixes only apply to "
+                             "torchvision checkpoints")
         load_keras_model(model, src)
     else:
         raise ValueError(
@@ -352,7 +372,14 @@ def pretrained_configure(
     steps = [ImageResize(resize_h, resize_w),
              ImageCenterCrop(crop_h, crop_w)]
     if source == "torchvision":
-        steps.append(ImageChannelNormalize(*_TV_MEAN, *_TV_STD))
+        if model_name == "inception-v1":
+            # torchvision googlenet weights were ported from TF-slim;
+            # its transform_input undoes the standard normalize and
+            # applies (x/255 - 0.5)/0.5 — fold that straight in
+            steps.append(ImageChannelNormalize(127.5, 127.5, 127.5,
+                                               127.5, 127.5, 127.5))
+        else:
+            steps.append(ImageChannelNormalize(*_TV_MEAN, *_TV_STD))
     elif source == "keras":
         if model_name.startswith("mobilenet"):
             # keras "tf" mode: RGB, x/127.5 - 1

@@ -586,3 +586,143 @@ def test_keras_vgg16_import_matches_tf(f32_policy):
     got = np.asarray(model.predict(x, batch_size=1))
     np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
     assert (got.argmax(-1) == want.argmax(-1)).all()
+
+
+class _TorchGoogLeNet(nn.Module):
+    """torchvision ``googlenet`` module order, built from the public
+    architecture: BasicConv2d(conv+BN eps=1e-3), the 3x3 "5x5" branch
+    the published weights actually carry, kernel-2 maxpool4, and the
+    training-only aux towers (present in the checkpoint, skipped by
+    the importer)."""
+
+    class BasicConv2d(nn.Module):
+        def __init__(self, cin, cout, **kw):
+            super().__init__()
+            self.conv = nn.Conv2d(cin, cout, bias=False, **kw)
+            self.bn = nn.BatchNorm2d(cout, eps=1e-3)
+
+        def forward(self, x):
+            return torch.relu(self.bn(self.conv(x)))
+
+    class Inception(nn.Module):
+        def __init__(self, cin, c1, c3r, c3, c5r, c5, proj):
+            super().__init__()
+            B = _TorchGoogLeNet.BasicConv2d
+            self.branch1 = B(cin, c1, kernel_size=1)
+            self.branch2 = nn.Sequential(
+                B(cin, c3r, kernel_size=1),
+                B(c3r, c3, kernel_size=3, padding=1))
+            self.branch3 = nn.Sequential(
+                B(cin, c5r, kernel_size=1),
+                B(c5r, c5, kernel_size=3, padding=1))
+            self.branch4 = nn.Sequential(
+                nn.MaxPool2d(3, stride=1, padding=1, ceil_mode=True),
+                B(cin, proj, kernel_size=1))
+
+        def forward(self, x):
+            return torch.cat([self.branch1(x), self.branch2(x),
+                              self.branch3(x), self.branch4(x)], 1)
+
+    class InceptionAux(nn.Module):
+        def __init__(self, cin, num_classes):
+            super().__init__()
+            self.conv = _TorchGoogLeNet.BasicConv2d(cin, 128,
+                                                    kernel_size=1)
+            self.fc1 = nn.Linear(2048, 1024)
+            self.fc2 = nn.Linear(1024, num_classes)
+
+    def __init__(self, num_classes):
+        super().__init__()
+        B, I = self.BasicConv2d, self.Inception
+        self.conv1 = B(3, 64, kernel_size=7, stride=2, padding=3)
+        self.maxpool1 = nn.MaxPool2d(3, stride=2, ceil_mode=True)
+        self.conv2 = B(64, 64, kernel_size=1)
+        self.conv3 = B(64, 192, kernel_size=3, padding=1)
+        self.maxpool2 = nn.MaxPool2d(3, stride=2, ceil_mode=True)
+        self.inception3a = I(192, 64, 96, 128, 16, 32, 32)
+        self.inception3b = I(256, 128, 128, 192, 32, 96, 64)
+        self.maxpool3 = nn.MaxPool2d(3, stride=2, ceil_mode=True)
+        self.inception4a = I(480, 192, 96, 208, 16, 48, 64)
+        self.inception4b = I(512, 160, 112, 224, 24, 64, 64)
+        self.inception4c = I(512, 128, 128, 256, 24, 64, 64)
+        self.inception4d = I(512, 112, 144, 288, 32, 64, 64)
+        self.inception4e = I(528, 256, 160, 320, 32, 128, 128)
+        self.maxpool4 = nn.MaxPool2d(2, stride=2, ceil_mode=True)
+        self.inception5a = I(832, 256, 160, 320, 32, 128, 128)
+        self.inception5b = I(832, 384, 192, 384, 48, 128, 128)
+        self.aux1 = self.InceptionAux(512, num_classes)
+        self.aux2 = self.InceptionAux(528, num_classes)
+        self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.maxpool1(self.conv1(x))
+        x = self.maxpool2(self.conv3(self.conv2(x)))
+        x = self.maxpool3(self.inception3b(self.inception3a(x)))
+        x = self.inception4e(self.inception4d(self.inception4c(
+            self.inception4b(self.inception4a(x)))))
+        x = self.maxpool4(x)
+        x = self.inception5b(self.inception5a(x))
+        return self.fc(x.mean(dim=(2, 3)))
+
+
+def test_torchvision_googlenet_import_matches_torch(f32_policy):
+    """GoogLeNet / Inception-v1: aux-tower modules in the checkpoint
+    are skipped, the 1e-3 BN epsilon is folded, and the torchvision
+    graph variant (3x3 "5x5" branch, pad-3 stem, k2 maxpool4)
+    reproduces the oracle's logits."""
+    from analytics_zoo_tpu.models.image.imageclassification.nets import (
+        inception_v1)
+    from analytics_zoo_tpu.models.image.imageclassification.pretrained \
+        import load_torch_state_dict
+
+    oracle = _TorchGoogLeNet(num_classes=6)
+    _randomize(oracle, seed=11)
+    oracle.eval()
+
+    rs = np.random.RandomState(2)
+    x = rs.rand(2, 64, 64, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        want = oracle(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+
+    model = inception_v1(num_classes=6, input_shape=(64, 64, 3),
+                         variant="torchvision")
+    load_torch_state_dict(model, oracle.state_dict(), bn_eps=1e-3,
+                          skip_prefixes=("aux1.", "aux2."))
+    got = np.asarray(model.predict(x, batch_size=2))
+    np.testing.assert_allclose(got, want, rtol=1e-3,
+                               atol=1e-3 * np.abs(want).max())
+
+
+def test_imageclassifier_googlenet_journey(f32_policy, tmp_path):
+    """ImageClassifier(model_name='inception-v1', pretrained=.pth):
+    the family wiring picks the torchvision variant, aux skipping,
+    BN epsilon, and the TF-style (x-127.5)/127.5 preprocess that
+    torchvision's transform_input corresponds to."""
+    from analytics_zoo_tpu.feature.image import ImageChannelNormalize
+    from analytics_zoo_tpu.models.image.imageclassification import (
+        ImageClassifier)
+
+    oracle = _TorchGoogLeNet(num_classes=4)
+    _randomize(oracle, seed=12)
+    oracle.eval()
+    path = tmp_path / "googlenet.pth"
+    torch.save(oracle.state_dict(), str(path))
+
+    clf = ImageClassifier(model_name="inception-v1", num_classes=4,
+                          input_shape=(64, 64, 3),
+                          pretrained=str(path))
+    rs = np.random.RandomState(3)
+    x = rs.rand(2, 64, 64, 3).astype(np.float32)
+    with torch.no_grad():
+        want = oracle(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(clf.predict(x, batch_size=2))
+    np.testing.assert_allclose(got, want, rtol=1e-3,
+                               atol=1e-3 * np.abs(want).max())
+    assert (got.argmax(-1) == want.argmax(-1)).all()
+
+    # preprocess: TF-style 127.5 scaling, not the standard normalize
+    norm = [s for s in clf.config.preprocessor.stages
+            if isinstance(s, ImageChannelNormalize)]
+    assert len(norm) == 1
+    np.testing.assert_array_equal(norm[0].mean, [127.5] * 3)
+    np.testing.assert_array_equal(norm[0].std, [127.5] * 3)
