@@ -46,6 +46,13 @@ _DEFAULTS: Dict[str, Any] = {
     # per-step host/dispatch overhead drops ~k-fold while HBM holds
     # only k x batch rows. 1 = classic per-step dispatch.
     "train.steps_per_dispatch": 16,
+    # HBM epoch-cache budget (MB): when a FeatureSet's whole epoch
+    # (source + one permuted copy, so 2x its nbytes) fits this budget,
+    # fit() places the data on device ONCE and reshuffles it on-device
+    # per epoch — zero per-epoch H2D — instead of re-transferring every
+    # epoch through the chunked/per-step paths. The device tier of the
+    # reference's cache hierarchy (FeatureSet.scala:585-662). 0 = off.
+    "train.hbm_cache_mb": 2048,
     # Input pipeline ---------------------------------------------------
     # Device-batch prefetch depth (background thread overlapping host
     # batch assembly + H2D copy with device compute); 0 disables.
@@ -116,9 +123,14 @@ class ZooConfig:
                 key = env_key[len(_ENV_PREFIX):].lower().replace("_", ".", 1)
                 # Only the first underscore becomes a dot; the rest stay.
                 self._values[key] = _parse_value(raw)
-        # Layer 4: programmatic overrides.
+        # Layer 4: programmatic overrides. Tracked separately so a
+        # later context (re-)init can carry them into its fresh config
+        # — a user's get_config().set(...) must survive the lazy
+        # init_zoo_context that a first fit() triggers.
+        self._programmatic: Dict[str, Any] = {}
         if overrides:
             self._values.update(overrides)
+            self._programmatic.update(overrides)
 
     def get(self, key: str, default: Any = None) -> Any:
         return self._values.get(key, default)
@@ -131,6 +143,7 @@ class ZooConfig:
 
     def set(self, key: str, value: Any) -> None:
         self._values[key] = value
+        self._programmatic[key] = value
 
     def as_dict(self) -> Dict[str, Any]:
         return dict(self._values)
@@ -144,6 +157,13 @@ def get_config() -> ZooConfig:
     if _global_config is None:
         _global_config = ZooConfig()
     return _global_config
+
+
+def reset_config() -> None:
+    """Drop the global config so the next get_config() starts from
+    defaults/conf/env with no programmatic layer (test helper)."""
+    global _global_config
+    _global_config = None
 
 
 def set_config(cfg: ZooConfig) -> None:

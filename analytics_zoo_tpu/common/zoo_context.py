@@ -72,7 +72,13 @@ def init_zoo_context(conf: Optional[Dict[str, Any]] = None,
     if _context is not None:
         return _context
 
-    config = ZooConfig(conf_file=conf_file, overrides=conf)
+    # Programmatic sets made BEFORE context init (get_config().set)
+    # carry over; explicit init conf wins on conflicts.
+    from analytics_zoo_tpu.common import config as config_mod
+    prior = getattr(config_mod._global_config, "_programmatic", None) \
+        if config_mod._global_config is not None else None
+    merged = {**(prior or {}), **(conf or {})}
+    config = ZooConfig(conf_file=conf_file, overrides=merged or None)
     set_config(config)
 
     logging.basicConfig(level=getattr(logging, str(config.get("log.level")),

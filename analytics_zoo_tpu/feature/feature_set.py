@@ -32,6 +32,17 @@ def _tree_len(tree) -> int:
     return len(jax.tree_util.tree_leaves(tree)[0])
 
 
+def pad_rows(tree, pad: int):
+    """Zero-pad ``pad`` rows onto the leading axis of every leaf —
+    the shared fixed-shape padding used by the eval tail batch, the
+    predict tail batch, and the HBM epoch-cache source."""
+    if pad <= 0:
+        return tree
+    pad_fn = lambda a: np.concatenate(
+        [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+    return jax.tree_util.tree_map(pad_fn, tree)
+
+
 def _tree_take(tree, idx):
     from analytics_zoo_tpu import native
 
@@ -205,11 +216,9 @@ class FeatureSet:
                 mask = np.ones(hi - lo, np.float32)
                 if hi - lo < batch_size:
                     pad = batch_size - (hi - lo)
-                    pad_fn = lambda a: np.concatenate(
-                        [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
-                    xb = jax.tree_util.tree_map(pad_fn, xb)
+                    xb = pad_rows(xb, pad)
                     if yb is not None:
-                        yb = jax.tree_util.tree_map(pad_fn, yb)
+                        yb = pad_rows(yb, pad)
                     mask = np.concatenate([mask, np.zeros(pad, np.float32)])
                 yield (xb, yb, mask)
 

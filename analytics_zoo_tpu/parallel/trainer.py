@@ -108,6 +108,7 @@ class DistributedTrainer:
         self._train_step_at = None
         self._eval_step = None
         self._predict_step = None
+        self._permute_rows = None
         self._rep = mesh_lib.replicated(self.mesh)
         self._param_shardings = None
 
@@ -344,13 +345,67 @@ class DistributedTrainer:
 
         If ``feature_set`` is given, its deterministic per-epoch
         permutation is applied host-side first (one gather per epoch
-        instead of one per step)."""
+        instead of one per step).  Placement goes through
+        ``put_epoch_source`` so ragged row counts pad-and-shard
+        instead of silently replicating; ``epoch_scan_fn`` never
+        reaches the padded rows (its ``num_batches`` covers only whole
+        real batches)."""
         if feature_set is not None and feature_set.shuffle:
             perm = feature_set._epoch_perm(epoch)
             take = lambda a: a[perm]
             x = jax.tree_util.tree_map(take, x)
             y = jax.tree_util.tree_map(take, y) if y is not None else None
+        return self.put_epoch_source(x, y)
+
+    def put_epoch_source(self, x, y):
+        """Place the UNPERMUTED whole dataset on device once — the HBM
+        cache tier of the FeatureSet hierarchy (the reference's DRAM
+        cache, FeatureSet.scala:585-662, promoted into device memory).
+
+        Rows are zero-padded up to a multiple of the data-parallel
+        width so ``put_batch`` SHARDS the source instead of falling
+        back to replication; padded rows are never consumed — every
+        epoch permutation only indexes the real ``n`` rows, and
+        ``epoch_scan_fn``'s ``num_batches`` covers only whole real
+        batches.  Padding applies single-process only: the multi-host
+        ``epoch_scan_fn`` layout reshapes each host block to exactly
+        ``num_batches * batch_size`` rows, which padding would break
+        (multi-host callers already size their rows to the mesh)."""
+        dp = self.mesh.shape[mesh_lib.DATA_AXIS] * \
+            self.mesh.shape[mesh_lib.FSDP_AXIS]
+        from analytics_zoo_tpu.feature.feature_set import pad_rows
+        n = len(jax.tree_util.tree_leaves(x)[0])
+        pad = (-n) % dp if jax.process_count() == 1 else 0
+        if pad:
+            x = pad_rows(x, pad)
+            y = pad_rows(y, pad) if y is not None else None
         return self.put_batch((x, y))
+
+    def permute_rows_fn(self):
+        """Jitted DEVICE-SIDE row gather ``(x, y, perm) -> (x[perm],
+        y[perm])`` with outputs sharded on the data axes.
+
+        One on-device gather per epoch replaces re-transferring the
+        whole (host-permuted) epoch over H2D — the per-epoch cost
+        drops from epoch-bytes over the host link to an int32 index
+        upload. The permutation values come from the FeatureSet's own
+        deterministic per-epoch rng, so batch composition is
+        bit-identical to the per-step / chunked paths."""
+        if self._permute_rows is None:
+            mesh = self.mesh
+
+            def permute(x, y, perm):
+                def take(a):
+                    out = jnp.take(a, perm, axis=0)
+                    return jax.lax.with_sharding_constraint(
+                        out, mesh_lib.data_sharding(mesh, out.ndim))
+                xe = jax.tree_util.tree_map(take, x)
+                ye = jax.tree_util.tree_map(take, y) \
+                    if y is not None else None
+                return xe, ye
+
+            self._permute_rows = jax.jit(permute)
+        return self._permute_rows
 
     # ----------------------------------------------------------- eval step
     def _build_eval_step(self, metrics):

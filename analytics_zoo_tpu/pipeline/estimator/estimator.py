@@ -33,6 +33,11 @@ from analytics_zoo_tpu.utils.summary import TrainSummary, ValidationSummary
 log = logging.getLogger("analytics_zoo_tpu.estimator")
 
 
+class _UnrecoverableTraining(RuntimeError):
+    """Training state was lost (donated to a failed dispatch) with no
+    checkpoint to restore — the retry loop must not spin on it."""
+
+
 def predict_in_batches(run_batch, x, batch_size: int):
     """Fixed-shape batched prediction: zero-pad the tail batch so one
     compiled program serves every batch, slice the padding back off,
@@ -54,10 +59,8 @@ def predict_in_batches(run_batch, x, batch_size: int):
         xb = jax.tree_util.tree_map(lambda a: a[lo:hi], x)
         real = hi - lo
         if real < batch_size:   # pad to keep one compiled shape
-            xb = jax.tree_util.tree_map(
-                lambda a: np.concatenate(
-                    [a, np.zeros((batch_size - real,) + a.shape[1:],
-                                 a.dtype)]), xb)
+            from analytics_zoo_tpu.feature.feature_set import pad_rows
+            xb = pad_rows(xb, batch_size - real)
         out = run_batch(xb)
         in_flight.append(jax.tree_util.tree_map(lambda o: o[:real], out))
         if len(in_flight) >= window:
@@ -147,6 +150,12 @@ class Estimator:
                 log.info("resumed from checkpoint at epoch %d iter %d",
                          ts.epoch, ts.iteration)
 
+        # iteration count at entry to THIS call — "no step committed
+        # yet" for the HBM-cache recovery below means no step beyond
+        # this point, not zero lifetime iterations (a second train()
+        # call starts with the previous call's counter)
+        start_iteration = ts.iteration
+
         eval_runner = None
         if validation_set is not None and validation_method:
             eval_runner = trainer.make_eval_runner(validation_method)
@@ -186,6 +195,34 @@ class Estimator:
                       and isinstance(checkpoint_trigger, EveryEpoch))
         chunk_fns: Dict[int, object] = {}
 
+        # HBM epoch cache (train.hbm_cache_mb): under the same
+        # semantics-preserving conditions as chunking, if the WHOLE
+        # epoch (source + one permuted copy) fits the budget, place it
+        # on device ONCE and reshuffle it on-device each epoch with the
+        # FeatureSet's own deterministic permutation — zero per-epoch
+        # H2D, one dispatch per epoch. This is the device tier of the
+        # reference's cache hierarchy (FeatureSet.scala:585-662) made
+        # automatic. Single-process only: multi-host placement treats
+        # host arrays as per-process shards, which put_epoch_source
+        # does not model.
+        hbm_src = None
+        hbm_mb = float(get_config().get("train.hbm_cache_mb"))
+        if use_chunks and hbm_mb > 0 and jax.process_count() == 1:
+            nbytes = sum(
+                a.nbytes for a in jax.tree_util.tree_leaves(
+                    (train_set.x, train_set.y)))
+            if 2 * nbytes <= hbm_mb * (1 << 20):
+                nb_epoch = train_set.size // batch_size
+                epoch_rows = nb_epoch * batch_size
+                hbm_src = trainer.put_epoch_source(train_set.x,
+                                                   train_set.y)
+                hbm_permute = trainer.permute_rows_fn()
+                hbm_scan = trainer.epoch_scan_fn(nb_epoch, batch_size)
+                log.info(
+                    "HBM epoch cache active: %.1f MB on device, %d "
+                    "steps/epoch in one dispatch, on-device reshuffle",
+                    nbytes / (1 << 20), nb_epoch)
+
         def log_loss_crossing(loss, k):
             """Sync + log when the iteration counter crosses a
             20-multiple (same cadence as the per-step path, without a
@@ -203,7 +240,85 @@ class Estimator:
             loss = None
             num_slices = getattr(train_set, "num_slices", 1)
             try:
-                if use_chunks:
+                if hbm_src is not None:
+                    try:
+                        xs, ys = hbm_src
+                        if train_set.shuffle:
+                            perm = train_set._epoch_perm(
+                                ts.epoch)[:epoch_rows].astype(np.int32)
+                            xe, ye = hbm_permute(xs, ys, perm)
+                        else:
+                            # unshuffled: the scan slices the source
+                            # in order; no gather, no second copy
+                            xe, ye = xs, ys
+                        params, opt_state, state, loss = hbm_scan(
+                            params, opt_state, state, xe, ye, rng,
+                            np.int32(ts.iteration))
+                        # drop the permuted copy eagerly: holding it
+                        # across epochs would put THREE epoch-sized
+                        # buffers live at the next permute (source +
+                        # old + new) — the budget gate accounts for two
+                        del xe, ye
+                    except Exception:
+                        # The budget gate knows the dataset size, not
+                        # free HBM: a model whose params/activations
+                        # nearly fill the device can OOM here. The
+                        # epoch is ONE dispatch, so no step committed —
+                        # but params/opt_state/state were DONATED to
+                        # the failed dispatch and may be deleted, so
+                        # recovery must re-place them (never continue
+                        # with the old references). Release every
+                        # epoch-sized device buffer first: the chunked
+                        # retry below must not inherit the memory
+                        # pressure that caused the failure.
+                        hbm_src = xs = ys = xe = ye = None  # noqa: F841
+                        restored = ckpt.restore_latest(
+                            {"params": params, "state": state,
+                             "opt_state": opt_state, "epoch": 0,
+                             "iteration": 0}) if ckpt is not None \
+                            else None
+                        if restored is not None:
+                            log.warning(
+                                "HBM epoch cache failed (likely OOM); "
+                                "restored checkpoint, falling back to "
+                                "chunked dispatch", exc_info=True)
+                            params = trainer.place_params(
+                                restored["params"])
+                            state = trainer.replicate(restored["state"])
+                            opt_state = trainer.init_opt_state(params)
+                            opt_state = trainer.place_like(
+                                restored["opt_state"], opt_state)
+                            ts.epoch = int(restored["epoch"])
+                            ts.iteration = int(restored["iteration"])
+                            continue
+                        if ts.iteration == start_iteration:
+                            # nothing learned THIS call: rebuild from
+                            # the entry-time host copy, retry chunked
+                            log.warning(
+                                "HBM epoch cache failed (likely OOM) "
+                                "before any step; falling back to "
+                                "chunked dispatch", exc_info=True)
+                            params = trainer.place_params(
+                                self.variables["params"])
+                            state = trainer.replicate(
+                                self.variables["state"])
+                            opt_state = trainer.init_opt_state(params)
+                            continue
+                        # steps committed, no snapshot to restore:
+                        # the donated training state is unrecoverable
+                        # (near-unreachable: EveryEpoch + model_dir
+                        # snapshots every completed epoch)
+                        raise _UnrecoverableTraining(
+                            f"HBM epoch cache failed at iteration "
+                            f"{ts.iteration} with no checkpoint to "
+                            "restore; set model_dir or "
+                            "train.hbm_cache_mb=0")
+                    ts.iteration += nb_epoch
+                    seen += epoch_rows
+                    log_loss_crossing(loss, nb_epoch)
+                    if end_trigger(ts):
+                        stop = True
+                elif use_chunks:
                     global_rows = mesh_lib.global_batch_rows(
                         trainer.mesh, batch_size)
                     gen = ((x, y) for x, y, _ in train_set.epoch_chunks(
@@ -262,6 +377,8 @@ class Estimator:
                                 break
                         if stop:
                             break
+            except _UnrecoverableTraining:
+                raise
             except Exception:   # noqa: BLE001 — retry loop, ref :1179-1261
                 now = time.time()
                 if now - last_failure_time > retry_window:

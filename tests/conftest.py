@@ -30,8 +30,13 @@ def _fresh_context():
     from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
     Layer.reset_name_counters()
     yield
+    from analytics_zoo_tpu.common.config import reset_config
     from analytics_zoo_tpu.common.zoo_context import reset_zoo_context
     reset_zoo_context()
+    # also drop the config: programmatic sets now survive context
+    # re-init by design, which across TESTS would leak one test's
+    # knobs into the next
+    reset_config()
 
 
 @pytest.fixture

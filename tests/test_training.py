@@ -177,48 +177,155 @@ def test_epoch_chunks_match_epoch_batches():
     assert [c[2] for c in chunks] == [4, 4, 2]   # 10 batches -> 4+4+2
 
 
-def test_chunked_dispatch_is_a_pure_performance_knob():
-    """The chunked fit path (train.steps_per_dispatch>1) is SEMANTICS-
-    PRESERVING vs per-step dispatch: same step count, same rng stream
-    (fold_in by the global iteration — verified with a Dropout model,
-    which consumes rng every step), same final params."""
+def _fit_with_engine(x, y, steps_per_dispatch, hbm_cache_mb,
+                     epochs=4, batch_size=16, expect_fallback=False):
+    """Train the same Dropout model through one of the three dispatch
+    engines: per-step (steps_per_dispatch=1), chunked scan, or the HBM
+    epoch cache (hbm_cache_mb>0 + chunk conditions).
+
+    Asserts via the estimator's own log that the REQUESTED engine
+    actually ran — the HBM path falls back to chunked on device
+    failure, which would otherwise make engine-equivalence tests
+    vacuously pass."""
+    import logging
+
     from analytics_zoo_tpu.common.config import get_config
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
     from analytics_zoo_tpu.pipeline.api.keras.layers import Dropout
     from analytics_zoo_tpu.pipeline.api.keras.optimizers import SGD
 
+    Layer.reset_name_counters()
+    cfg = get_config()
+    old_k = cfg.get("train.steps_per_dispatch")
+    old_mb = cfg.get("train.hbm_cache_mb")
+    cfg.set("train.steps_per_dispatch", steps_per_dispatch)
+    cfg.set("train.hbm_cache_mb", hbm_cache_mb)
+
+    logger = logging.getLogger("analytics_zoo_tpu.estimator")
+    records = []
+
+    class _Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    cap = _Capture(level=logging.DEBUG)
+    old_level = logger.level
+    logger.addHandler(cap)
+    logger.setLevel(logging.DEBUG)
+    try:
+        m = Sequential()
+        m.add(Dense(8, activation="relu", input_shape=(6,)))
+        m.add(Dropout(0.25))
+        m.add(Dense(1))
+        est = Estimator(m, optim_method=SGD(learning_rate=0.05))
+        est.train(FeatureSet.from_ndarrays(x, y), "mse",
+                  end_trigger=MaxEpoch(epochs), batch_size=batch_size)
+    finally:
+        logger.removeHandler(cap)
+        logger.setLevel(old_level)
+        # re-fetch: est.train's lazy context init REPLACES the global
+        # config (carrying programmatic sets), so restoring onto the
+        # stale `cfg` object would be a no-op on the live one
+        live = get_config()
+        live.set("train.steps_per_dispatch", old_k)
+        live.set("train.hbm_cache_mb", old_mb)
+
+    hbm_requested = hbm_cache_mb > 0 and steps_per_dispatch > 1
+    assert any("HBM epoch cache active" in r
+               for r in records) == hbm_requested, records
+    fell_back = any("falling back to chunked" in r for r in records)
+    assert fell_back == expect_fallback, records
+    return est
+
+
+def _dropout_problem(n=320):
     rs = np.random.RandomState(0)
-    x = rs.randn(320, 6).astype(np.float32)
+    x = rs.randn(n, 6).astype(np.float32)
     w = rs.randn(6, 1).astype(np.float32)
-    y = (x @ w).astype(np.float32)
+    return x, (x @ w).astype(np.float32)
 
-    def fit(steps_per_dispatch):
-        from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
-        Layer.reset_name_counters()
-        cfg = get_config()
-        old = cfg.get("train.steps_per_dispatch")
-        cfg.set("train.steps_per_dispatch", steps_per_dispatch)
-        try:
-            m = Sequential()
-            m.add(Dense(8, activation="relu", input_shape=(6,)))
-            m.add(Dropout(0.25))
-            m.add(Dense(1))
-            est = Estimator(m, optim_method=SGD(learning_rate=0.05))
-            est.train(FeatureSet.from_ndarrays(x, y), "mse",
-                      end_trigger=MaxEpoch(4), batch_size=16)
-            return est
-        finally:
-            cfg.set("train.steps_per_dispatch", old)
 
-    chunked = fit(8)
-    stepped = fit(1)
-    assert chunked.train_state.iteration == \
-        stepped.train_state.iteration == 4 * (320 // 16)
-    c_leaves = jax.tree_util.tree_leaves(chunked.variables["params"])
+def test_dispatch_engines_are_pure_performance_knobs():
+    """All three dispatch engines — per-step, chunked scan, and the
+    device-resident HBM epoch cache — are SEMANTICS-PRESERVING: same
+    step count, same rng stream (fold_in by the global iteration —
+    verified with a Dropout model, which consumes rng every step),
+    same final params."""
+    x, y = _dropout_problem()
+    stepped = _fit_with_engine(x, y, 1, 0)        # per-step dispatch
+    chunked = _fit_with_engine(x, y, 8, 0)        # chunked lax.scan
+    cached = _fit_with_engine(x, y, 8, 2048)      # HBM epoch cache
+    assert stepped.train_state.iteration == \
+        chunked.train_state.iteration == \
+        cached.train_state.iteration == 4 * (320 // 16)
     s_leaves = jax.tree_util.tree_leaves(stepped.variables["params"])
-    for c, s in zip(c_leaves, s_leaves):
-        np.testing.assert_allclose(np.asarray(c), np.asarray(s),
-                                   rtol=1e-5, atol=1e-6)
+    for est in (chunked, cached):
+        for c, s in zip(
+                jax.tree_util.tree_leaves(est.variables["params"]),
+                s_leaves):
+            np.testing.assert_allclose(np.asarray(c), np.asarray(s),
+                                       rtol=1e-5, atol=1e-6)
     # reported loss granularity differs by design (chunk mean vs last
     # batch); the optimizer trajectory — the semantics — is identical
-    assert np.isfinite(chunked.train_state.last_loss)
-    assert np.isfinite(stepped.train_state.last_loss)
+    for est in (stepped, chunked, cached):
+        assert np.isfinite(est.train_state.last_loss)
+
+
+def test_programmatic_config_survives_lazy_context_init():
+    """get_config().set(...) made BEFORE the context exists must
+    survive the lazy init_zoo_context a first fit() triggers (it
+    rebuilds the config from defaults/conf/env and used to discard
+    the programmatic layer)."""
+    from analytics_zoo_tpu.common.config import get_config
+    from analytics_zoo_tpu.common.zoo_context import (
+        get_zoo_context, reset_zoo_context)
+
+    reset_zoo_context()
+    get_config().set("train.steps_per_dispatch", 7)
+    try:
+        get_zoo_context()    # lazy init rebuilds the config
+        assert get_config().get("train.steps_per_dispatch") == 7
+    finally:
+        get_config().set("train.steps_per_dispatch", 16)
+
+
+def test_hbm_cache_falls_back_to_chunked_on_device_failure(monkeypatch):
+    """If the HBM epoch path fails at dispatch (e.g. device OOM — the
+    budget gate can't see free HBM), fit() falls back to chunked
+    dispatch and still trains to the same result."""
+    from analytics_zoo_tpu.parallel.trainer import DistributedTrainer
+
+    def broken_permute(self):
+        def boom(*a, **k):
+            raise RuntimeError("synthetic RESOURCE_EXHAUSTED")
+        return boom
+
+    monkeypatch.setattr(DistributedTrainer, "permute_rows_fn",
+                        broken_permute)
+    x, y = _dropout_problem()
+    fell_back = _fit_with_engine(x, y, 8, 2048, expect_fallback=True)
+    monkeypatch.undo()
+    chunked = _fit_with_engine(x, y, 8, 0)
+    assert fell_back.train_state.iteration == \
+        chunked.train_state.iteration == 4 * (320 // 16)
+    for c, s in zip(
+            jax.tree_util.tree_leaves(fell_back.variables["params"]),
+            jax.tree_util.tree_leaves(chunked.variables["params"])):
+        np.testing.assert_allclose(np.asarray(c), np.asarray(s),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_hbm_cache_pads_ragged_rows_to_the_mesh():
+    """HBM-cache path with a row count that tiles neither the batch
+    nor the 8-device data axis: the source pads to shard, the epoch
+    drops the remainder, and the result still bit-matches per-step."""
+    x, y = _dropout_problem(103)   # 103 rows, dp=8, batch 16 -> 6 steps
+    cached = _fit_with_engine(x, y, 8, 2048, epochs=3)
+    stepped = _fit_with_engine(x, y, 1, 0, epochs=3)
+    assert cached.train_state.iteration == \
+        stepped.train_state.iteration == 3 * (103 // 16)
+    for c, s in zip(
+            jax.tree_util.tree_leaves(cached.variables["params"]),
+            jax.tree_util.tree_leaves(stepped.variables["params"])):
+        np.testing.assert_allclose(np.asarray(c), np.asarray(s),
+                                   rtol=1e-5, atol=1e-6)
