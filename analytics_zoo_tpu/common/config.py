@@ -53,6 +53,11 @@ _DEFAULTS: Dict[str, Any] = {
     # epoch through the chunked/per-step paths. The device tier of the
     # reference's cache hierarchy (FeatureSet.scala:585-662). 0 = off.
     "train.hbm_cache_mb": 2048,
+    # Rematerialise the forward pass in the backward (jax.checkpoint):
+    # trades ~33% more forward FLOPs for not storing/re-reading most
+    # activations — a win when the step is HBM-bandwidth-bound, and
+    # the standard lever for fitting longer sequences / bigger batches.
+    "train.remat": False,
     # Input pipeline ---------------------------------------------------
     # Device-batch prefetch depth (background thread overlapping host
     # batch assembly + H2D copy with device compute); 0 disables.

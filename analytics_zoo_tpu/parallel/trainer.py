@@ -103,6 +103,7 @@ class DistributedTrainer:
         self.optim_groups = optim_groups  # {name: (OptimMethod, layer_names)}
         cfg = get_config()
         self.donate = bool(cfg.get("train.donate"))
+        self.remat = bool(cfg.get("train.remat"))
         self.grad_sync_dtype = str(cfg.get("train.grad_sync_dtype"))
         self._train_step = None
         self._train_step_at = None
@@ -219,6 +220,10 @@ class DistributedTrainer:
             reg = model.regularization_loss(p)
             return loss + reg, (new_state, loss)
 
+        if self.remat:
+            # recompute the forward during the backward instead of
+            # storing activations (train.remat) — see config.py
+            objective = jax.checkpoint(objective)
         grads, (new_state, loss) = jax.grad(
             objective, has_aux=True)(params)
         if self.grad_sync_dtype == "bfloat16":

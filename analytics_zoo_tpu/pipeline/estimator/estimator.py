@@ -212,16 +212,27 @@ class Estimator:
                 a.nbytes for a in jax.tree_util.tree_leaves(
                     (train_set.x, train_set.y)))
             if 2 * nbytes <= hbm_mb * (1 << 20):
+                # size guard at entry ensures nb_epoch >= 1
                 nb_epoch = train_set.size // batch_size
                 epoch_rows = nb_epoch * batch_size
-                hbm_src = trainer.put_epoch_source(train_set.x,
-                                                   train_set.y)
-                hbm_permute = trainer.permute_rows_fn()
-                hbm_scan = trainer.epoch_scan_fn(nb_epoch, batch_size)
-                log.info(
-                    "HBM epoch cache active: %.1f MB on device, %d "
-                    "steps/epoch in one dispatch, on-device reshuffle",
-                    nbytes / (1 << 20), nb_epoch)
+                try:
+                    hbm_src = trainer.put_epoch_source(train_set.x,
+                                                       train_set.y)
+                    hbm_permute = trainer.permute_rows_fn()
+                    hbm_scan = trainer.epoch_scan_fn(nb_epoch,
+                                                     batch_size)
+                except Exception:
+                    # the budget gate can't see free HBM — if the
+                    # placement itself OOMs, train chunked instead
+                    hbm_src = None
+                    log.warning(
+                        "HBM epoch cache placement failed; falling "
+                        "back to chunked dispatch", exc_info=True)
+                else:
+                    log.info(
+                        "HBM epoch cache active: %.1f MB on device, "
+                        "%d steps/epoch in one dispatch, on-device "
+                        "reshuffle", nbytes / (1 << 20), nb_epoch)
 
         def log_loss_crossing(loss, k):
             """Sync + log when the iteration counter crosses a
