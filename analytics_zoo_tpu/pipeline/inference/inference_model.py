@@ -176,6 +176,12 @@ class InferenceModel:
                 out, _ = model.apply(params, x, state=state,
                                      training=False)
                 return out
+        # place the weights on device ONCE: host-numpy params passed
+        # into the jit would re-upload the whole parameter tree on
+        # EVERY predict call — devastating over a tunneled backend
+        # (resnet-18 f32 is ~46 MB/call; the serving loop pays it per
+        # batch)
+        self._variables = jax.device_put(self._variables)
         self._predict_fn = jax.jit(fn)
         return self
 

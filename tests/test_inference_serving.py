@@ -40,6 +40,19 @@ class TestInferenceModel:
         np.testing.assert_allclose(out, np.asarray(ref), rtol=5e-3,
                                    atol=5e-3)
 
+    def test_weights_are_device_resident_after_load(self):
+        """load_zoo must device_put the weights ONCE — host-numpy
+        params passed into the jit would re-upload the whole tree on
+        every predict call (catastrophic over a tunneled backend)."""
+        import jax
+
+        for quantize in (False, True):
+            im = InferenceModel().load_zoo(small_classifier(),
+                                           quantize=quantize)
+            leaves = jax.tree_util.tree_leaves(im._variables)
+            assert leaves and all(
+                isinstance(l, jax.Array) for l in leaves), quantize
+
     def test_quantized_close_to_f32(self):
         m = Sequential()
         m.add(Dense(64, input_shape=(32,), activation="relu"))
