@@ -461,6 +461,32 @@ class Estimator:
             self._cached_eval_runners = {}
         return self._cached_infer_trainer
 
+    def _infer_placed(self, trainer):
+        """Device-resident (params, state) for evaluate/predict,
+        cached across calls: re-uploading the weight tree per call is
+        the dominant cost of repeated inference over a tunneled
+        backend.
+
+        Invalidation keys on the identity of every leaf, so any path
+        that swaps arrays — set_variables, set_weights, per-layer
+        weight grafts — invalidates; the cache pins the keyed LEAF
+        OBJECTS themselves (not just the enclosing dict, which
+        set_weights mutates in place) so a freed leaf's id can't be
+        reused by a new array and fake a hit.  Only mutating a numpy
+        leaf's BUFFER in place would go stale, and no framework path
+        does that."""
+        variables = self.model.get_variables()
+        leaves = jax.tree_util.tree_leaves(variables)
+        key = (id(variables),) + tuple(id(l) for l in leaves)
+        cached = getattr(self, "_placed_infer", None)
+        if cached is not None and cached[0] == key:
+            return cached[1], cached[2]
+        params = trainer.place_params(variables["params"])
+        state = trainer.replicate(variables["state"])
+        # leaves pinned alongside: their ids stay unique while cached
+        self._placed_infer = (key, params, state, leaves)
+        return params, state
+
     def evaluate(self, data_set, criterion=None, validation_method=None,
                  batch_size: int = 32) -> Dict[str, float]:
         from analytics_zoo_tpu.pipeline.api.keras import metrics as met
@@ -468,9 +494,7 @@ class Estimator:
         if criterion is not None:
             methods = [met.Loss(criterion)] + methods
         trainer = self._infer_trainer()
-        variables = self.model.get_variables()
-        params = trainer.place_params(variables["params"])
-        state = trainer.replicate(variables["state"])
+        params, state = self._infer_placed(trainer)
         key = tuple(id(m) for m in methods)
         runner = self._cached_eval_runners.get(key)
         if runner is None:
@@ -482,9 +506,7 @@ class Estimator:
     # -------------------------------------------------------------- predict
     def predict(self, x, batch_size: int = 256):
         trainer = self._infer_trainer()
-        variables = self.model.get_variables()
-        params = trainer.place_params(variables["params"])
-        state = trainer.replicate(variables["state"])
+        params, state = self._infer_placed(trainer)
         fn = trainer.predict_fn()
         nproc = jax.process_count()
 

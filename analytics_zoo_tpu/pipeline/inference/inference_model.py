@@ -136,6 +136,11 @@ class InferenceModel:
         ranges over the calibration set, then run matmul/conv as
         int8 x int8 -> int32 with f32 rescale
         (doLoadTFAsCalibratedOpenVINO, InferenceModel.scala:400-421).
+
+        The weights are SNAPSHOTTED onto the device at load time (all
+        paths — quantized always was; f32 now too so predict never
+        re-uploads the tree).  Later ``model.set_weights`` calls are
+        not seen; call ``load_zoo`` again to pick up new weights.
         """
         from analytics_zoo_tpu.models.common import ZooModel
         if isinstance(model, ZooModel):

@@ -275,6 +275,39 @@ def test_dispatch_engines_are_pure_performance_knobs():
         assert np.isfinite(est.train_state.last_loss)
 
 
+def test_infer_placement_cache_reuses_and_invalidates():
+    """Repeated predict() reuses the device-placed weights (no
+    re-upload per call); swapping weights via set_weights invalidates
+    the cache and predictions change accordingly."""
+    from analytics_zoo_tpu.parallel.trainer import DistributedTrainer
+
+    m = Sequential()
+    m.add(Dense(4, input_shape=(6,)))
+    m.compile("sgd", "mse")
+    x = np.random.RandomState(0).randn(32, 6).astype(np.float32)
+
+    calls = []
+    orig = DistributedTrainer.place_params
+
+    def counting(self, params):
+        calls.append(1)
+        return orig(self, params)
+
+    DistributedTrainer.place_params = counting
+    try:
+        p1 = m.predict(x, batch_size=16)
+        p2 = m.predict(x, batch_size=16)
+        assert len(calls) == 1          # second call hit the cache
+        np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+        zeros = [np.zeros_like(w) for w in m.get_weights()]
+        m.set_weights(zeros)
+        p3 = m.predict(x, batch_size=16)
+        assert len(calls) == 2          # set_weights invalidated
+        np.testing.assert_allclose(np.asarray(p3), 0.0, atol=1e-6)
+    finally:
+        DistributedTrainer.place_params = orig
+
+
 def test_remat_is_numerically_transparent():
     """train.remat (jax.checkpoint around the objective) recomputes
     the forward in the backward — same math, same final params."""
