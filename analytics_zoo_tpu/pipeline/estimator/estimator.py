@@ -233,6 +233,55 @@ class Estimator:
                         "HBM epoch cache active: %.1f MB on device, "
                         "%d steps/epoch in one dispatch, on-device "
                         "reshuffle", nbytes / (1 << 20), nb_epoch)
+        hbm_train_bytes = 2 * nbytes if hbm_src is not None else 0
+
+        # Eval-batch HBM cache: eval iterates the SAME epoch-0 batches
+        # every time (ordered, no shuffle), so when they fit the budget
+        # ALONGSIDE the train cache they are placed on device once and
+        # reused — validation stops re-uploading its dataset every
+        # epoch. Single-process only (same reason as the train cache);
+        # `None` in the holder = stream from host.
+        eval_cache_holder = [None]
+        if (eval_runner is not None and hbm_mb > 0
+                and jax.process_count() == 1
+                and type(validation_set) is FeatureSet):
+            # exact-class check like the train cache: subclasses may
+            # override epoch_batches with per-call semantics (fresh
+            # augmentation, changing source) that freezing would break
+            val_bytes = sum(
+                a.nbytes for a in jax.tree_util.tree_leaves(
+                    (validation_set.x, validation_set.y)))
+            if val_bytes + hbm_train_bytes <= hbm_mb * (1 << 20):
+                try:
+                    eval_cache_holder[0] = [
+                        trainer.put_batch(b) for b in
+                        validation_set.epoch_batches(
+                            0, batch_size, train=False)]
+                    log.info("eval-batch HBM cache active: %.1f MB "
+                             "on device", val_bytes / (1 << 20))
+                except Exception:
+                    eval_cache_holder[0] = None
+                    log.warning("eval-batch HBM cache placement "
+                                "failed; streaming per epoch",
+                                exc_info=True)
+
+        def run_eval(params, state):
+            """Eval with the cached device batches when available; on
+            a dispatch failure (e.g. OOM from the added resident HBM)
+            release the cache and retry streaming from host."""
+            if eval_cache_holder[0] is not None:
+                try:
+                    return eval_runner(params, state,
+                                       eval_cache_holder[0])
+                except Exception:
+                    eval_cache_holder[0] = None
+                    log.warning(
+                        "eval failed with cached batches; released "
+                        "the cache, retrying streamed", exc_info=True)
+            return eval_runner(
+                params, state,
+                validation_set.epoch_batches(0, batch_size,
+                                             train=False))
 
         def log_loss_crossing(loss, k):
             """Sync + log when the iteration counter crosses a
@@ -283,6 +332,7 @@ class Estimator:
                         # retry below must not inherit the memory
                         # pressure that caused the failure.
                         hbm_src = xs = ys = xe = ye = None  # noqa: F841
+                        eval_cache_holder[0] = None
                         restored = ckpt.restore_latest(
                             {"params": params, "state": state,
                              "opt_state": opt_state, "epoch": 0,
@@ -428,9 +478,7 @@ class Estimator:
                     "Throughput", throughput, ts.iteration)
 
             if eval_runner is not None:
-                scores = eval_runner(
-                    params, state,
-                    validation_set.epoch_batches(0, batch_size, train=False))
+                scores = run_eval(params, state)
                 record["val"] = scores
                 ts.last_score = next(iter(scores.values()), None)
                 if self._val_summary is not None:

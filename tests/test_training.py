@@ -275,6 +275,59 @@ def test_dispatch_engines_are_pure_performance_knobs():
         assert np.isfinite(est.train_state.last_loss)
 
 
+def test_eval_batch_hbm_cache_matches_streaming():
+    """Validation scores are identical whether the eval set streams
+    host->device every epoch or is placed once under the HBM budget."""
+    from analytics_zoo_tpu.common.config import get_config
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+    from analytics_zoo_tpu.pipeline.api.keras.metrics import MAE
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import SGD
+
+    import logging
+
+    x, y = _dropout_problem(160)
+    vx, vy = x[:48], y[:48]
+
+    def fit(cache_mb):
+        Layer.reset_name_counters()
+        get_config().set("train.hbm_cache_mb", cache_mb)
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        logger = logging.getLogger("analytics_zoo_tpu.estimator")
+        cap = _Capture(level=logging.DEBUG)
+        old_level = logger.level
+        logger.addHandler(cap)
+        logger.setLevel(logging.DEBUG)
+        try:
+            m = Sequential()
+            m.add(Dense(4, input_shape=(6,)))
+            m.add(Dense(1))
+            est = Estimator(m, optim_method=SGD(learning_rate=0.05))
+            est.train(FeatureSet.from_ndarrays(x, y), "mse",
+                      end_trigger=MaxEpoch(3), batch_size=16,
+                      validation_set=FeatureSet.from_ndarrays(vx, vy),
+                      validation_method=[MAE()])
+        finally:
+            logger.removeHandler(cap)
+            logger.setLevel(old_level)
+            get_config().set("train.hbm_cache_mb", 2048)
+        engaged = any("eval-batch HBM cache active" in r
+                      for r in records)
+        return [r["val"] for r in est.history], engaged
+
+    cached, cached_engaged = fit(2048)
+    streamed, streamed_engaged = fit(0)
+    assert cached_engaged and not streamed_engaged
+    assert len(cached) == len(streamed) == 3
+    for c, s in zip(cached, streamed):
+        for k in c:
+            np.testing.assert_allclose(c[k], s[k], rtol=1e-6)
+
+
 def test_infer_placement_cache_reuses_and_invalidates():
     """Repeated predict() reuses the device-placed weights (no
     re-upload per call); swapping weights via set_weights invalidates
