@@ -99,6 +99,37 @@ def test_artifact_incremental_writes_do_not_self_supersede(
     assert [m["started_unix"] for m in d["runs"]] == [111.0, 222.0]
 
 
+def test_all_runs_resnet_first_and_reemits_it_last(tmp_path,
+                                                   monkeypatch):
+    """`--workload all` banks the north-star resnet50 number FIRST (so
+    an impatient caller killing the run can't lose it) while the tail
+    line the driver parses is still resnet50's."""
+    import io
+    import sys as _sys
+
+    monkeypatch.setattr(bench, "ARTIFACT_PATH",
+                        str(tmp_path / "art.json"))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda *a, **k: (True, None))
+    ran = []
+
+    def fake_run_child(name, timeout):
+        ran.append(name)
+        return {"metric": bench.METRIC_NAMES[name], "value": 1.0,
+                "unit": "x", "vs_baseline": None,
+                "workload": name}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    out = io.StringIO()
+    monkeypatch.setattr(_sys, "stdout", out)
+    rc = bench.main(["--workload", "all"])
+    assert rc == 0
+    assert ran[0] == "resnet50" and len(ran) == len(bench.WORKLOADS)
+    lines = [l for l in out.getvalue().splitlines() if l.strip()]
+    tail = json.loads(lines[-1])
+    assert tail["workload"] == "resnet50"
+
+
 def test_artifact_merge_tolerates_corrupt_prior(tmp_path, monkeypatch):
     path = tmp_path / "bench_results_test.json"
     path.write_text("{not json")
