@@ -46,7 +46,9 @@ class LocalEstimator:
 
     # ------------------------------------------------------------- compile
     def _build_step(self):
+        from analytics_zoo_tpu.common.config import get_config
         model, loss_fn, optim = self.model, self.loss_fn, self.optim
+        remat = bool(get_config().get("train.remat"))
 
         def step(params, opt_state, state, x, y, rng):
             def objective(p):
@@ -55,6 +57,8 @@ class LocalEstimator:
                 loss = loss_fn(y, out)
                 return loss + model.regularization_loss(p), (new_state, loss)
 
+            if remat:   # same knob as the distributed engine
+                objective = jax.checkpoint(objective)
             grads, (new_state, loss) = jax.grad(
                 objective, has_aux=True)(params)
             import optax
