@@ -641,7 +641,7 @@ def main(argv=None):
     # rounds 1 and 3) — the probe is deadline-based: keep probing with
     # exponential backoff until ~25 min of wall clock is spent.  A bench
     # that can't outlast contention is a bench that records zeros.
-    ap.add_argument("--probe-budget", type=float, default=2400.0)
+    ap.add_argument("--probe-budget", type=float, default=3600.0)
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--run-timeout", type=float, default=900.0)
     ap.add_argument("--child", action="store_true",
