@@ -130,6 +130,194 @@ def test_all_runs_resnet_first_and_reemits_it_last(tmp_path,
     assert tail["workload"] == "resnet50"
 
 
+def _seed_artifact(path, entries):
+    path.write_text(json.dumps({
+        "meta": {}, "runs": [],
+        "results": [
+            {"metric": bench.METRIC_NAMES[w], "value": v, "unit": "x",
+             "vs_baseline": None, "workload": w, "recorded_unix": 1.0,
+             "superseded": [{"value": 0}]}
+            for w, v in entries.items()]}))
+
+
+def _run_main(monkeypatch, argv):
+    import io
+    import sys as _sys
+
+    out = io.StringIO()
+    monkeypatch.setattr(_sys, "stdout", out)
+    rc = bench.main(argv)
+    lines = [json.loads(l) for l in out.getvalue().splitlines()
+             if l.strip().startswith("{")]
+    return rc, lines
+
+
+def test_cached_lines_emitted_before_probe_and_on_probe_failure(
+        tmp_path, monkeypatch):
+    """The round-4 failure mode: driver killed a silent process ->
+    empty artifact.  Now cached numbers hit stdout BEFORE any probe,
+    and a failed probe re-emits them (resnet50 last) so the driver's
+    tail parse always lands on a real, labeled number."""
+    path = tmp_path / "art.json"
+    all_cached = {w: 100.0 + i for i, w in enumerate(bench.WORKLOADS)}
+    all_cached["resnet50"] = 2690.9
+    _seed_artifact(path, all_cached)
+    monkeypatch.setattr(bench, "ARTIFACT_PATH", str(path))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda *a, **k: (False, "contended"))
+    rc, lines = _run_main(monkeypatch, ["--workload", "all"])
+    # every workload covered by a labeled cached number -> rc 0
+    assert rc == 0
+    # startup block: every cached workload, labeled, resnet50 last
+    startup = [l for l in lines if l.get("provenance") == "cached"
+               and "probe_failed" not in l]
+    assert {l["workload"] for l in startup} == set(bench.WORKLOADS)
+    assert startup[-1]["workload"] == "resnet50"
+    assert all("superseded" not in l for l in startup)
+    # tail line = cached resnet50 flagged probe_failed, value intact
+    tail = lines[-1]
+    assert tail["workload"] == "resnet50"
+    assert tail["provenance"] == "cached"
+    assert tail["probe_failed"] is True
+    assert tail["value"] == 2690.9
+    # the zero diagnostic lines are still present for the audit trail
+    zeros = [l for l in lines if l.get("value") == 0]
+    assert len(zeros) == len(bench.WORKLOADS)
+    # ... and a probe failure leaves the committed artifact UNTOUCHED
+    # (it measures nothing; zero entries and run meta would otherwise
+    # pile up every contended window)
+    d = json.loads(path.read_text())
+    assert all((r.get("value") or 0) > 0 for r in d["results"])
+    assert d["runs"] == []
+
+
+def test_probe_failure_partial_cache_keeps_resnet_tail(tmp_path,
+                                                       monkeypatch):
+    """Cached coverage of SOME workloads must not let another
+    workload's number land in the tail slot (the driver would record
+    it as the north-star) nor turn the run into a success."""
+    path = tmp_path / "art.json"
+    _seed_artifact(path, {"ncf": 812443.8})   # no resnet50 record
+    monkeypatch.setattr(bench, "ARTIFACT_PATH", str(path))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda *a, **k: (False, "contended"))
+    rc, lines = _run_main(monkeypatch, ["--workload", "all"])
+    assert rc == 1
+    tail = lines[-1]
+    assert tail["workload"] == "resnet50"
+    assert tail["value"] == 0 and tail["error"]
+
+
+def test_probe_failure_with_no_cache_is_an_error(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "ARTIFACT_PATH",
+                        str(tmp_path / "missing.json"))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda *a, **k: (False, "contended"))
+    rc, lines = _run_main(monkeypatch, ["--workload", "resnet50"])
+    assert rc == 1
+    assert lines and lines[-1]["value"] == 0
+    assert lines[-1]["error"]
+    assert lines[-1]["workload"] == "resnet50"
+
+
+def test_all_live_resnet_failure_no_cache_still_tails_resnet(
+        tmp_path, monkeypatch):
+    """Live path: resnet50 crashes, others succeed, no artifact —
+    the tail line must still be resnet50's (error) line, not the last
+    workload that happened to run."""
+    monkeypatch.setattr(bench, "ARTIFACT_PATH",
+                        str(tmp_path / "missing.json"))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda *a, **k: (True, None))
+
+    def fake_run_child(name, t):
+        if name == "resnet50":
+            return None, "child rc=1, no JSON line"
+        return {"metric": bench.METRIC_NAMES[name], "value": 1.0,
+                "unit": "x", "vs_baseline": None,
+                "workload": name}, None
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    rc, lines = _run_main(monkeypatch, ["--workload", "all"])
+    assert rc == 1
+    assert lines[-1]["workload"] == "resnet50"
+    assert lines[-1]["value"] == 0 and lines[-1]["error"]
+
+
+def test_live_failure_reemits_cached_line(tmp_path, monkeypatch):
+    """A workload that crashes live must not leave a zero as its last
+    word when the artifact holds a real number."""
+    path = tmp_path / "art.json"
+    _seed_artifact(path, {"serving": 152.3})
+    monkeypatch.setattr(bench, "ARTIFACT_PATH", str(path))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda *a, **k: (True, None))
+    monkeypatch.setattr(bench, "_run_child",
+                        lambda name, t: (None, "child rc=1, no JSON line"))
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    rc, lines = _run_main(monkeypatch, ["--workload", "serving"])
+    assert rc == 1
+    tail = lines[-1]
+    assert tail["provenance"] == "cached"
+    assert tail["value"] == 152.3
+    assert "live_error" in tail
+
+
+def test_fresh_results_are_labeled(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "ARTIFACT_PATH",
+                        str(tmp_path / "art.json"))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda *a, **k: (True, None))
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda name, t: ({"metric": bench.METRIC_NAMES[name],
+                          "value": 5.0, "unit": "x", "vs_baseline": None,
+                          "workload": name}, None))
+    rc, lines = _run_main(monkeypatch, ["--workload", "ncf"])
+    assert rc == 0
+    assert lines[-1]["provenance"] == "fresh"
+
+
+def test_default_probe_budget_inside_driver_timeout(tmp_path,
+                                                    monkeypatch):
+    """Round-4 regression guard: the DEFAULT probe budget must stay
+    well inside the driver's observed command timeout (<= 20 min);
+    long waits are opt-in via --probe-budget."""
+    monkeypatch.setattr(bench, "ARTIFACT_PATH",
+                        str(tmp_path / "missing.json"))
+    captured = {}
+
+    def fake_probe(budget_s, probe_timeout_s):
+        captured["budget"] = budget_s
+        return False, "x"
+
+    monkeypatch.setattr(bench, "_probe_backend", fake_probe)
+    _run_main(monkeypatch, ["--workload", "resnet50"])
+    assert captured["budget"] <= 1200.0
+
+
+def test_cached_loader_tolerates_schema_corrupt_artifact(tmp_path,
+                                                         monkeypatch):
+    """A hand-edited / badly-merged artifact must degrade to 'no
+    cache', never crash the bench before its first output line."""
+    path = tmp_path / "art.json"
+    monkeypatch.setattr(bench, "ARTIFACT_PATH", str(path))
+    for payload in (
+            "[1, 2]",                                       # non-dict top
+            json.dumps({"results": [
+                {"metric": bench.METRIC_NAMES["serving"],
+                 "value": "152.3"},                         # str value
+                17,                                         # non-dict row
+                {"metric": bench.METRIC_NAMES["ncf"],
+                 "value": 5.0}]})):
+        path.write_text(payload)
+        cached = bench._load_cached()
+        assert "serving" not in cached
+    # the valid row in the last payload still loads
+    assert cached["ncf"]["value"] == 5.0
+
+
 def test_artifact_merge_tolerates_corrupt_prior(tmp_path, monkeypatch):
     path = tmp_path / "bench_results_test.json"
     path.write_text("{not json")
