@@ -314,6 +314,19 @@ class Estimator:
                         params, opt_state, state, loss = hbm_scan(
                             params, opt_state, state, xe, ye, rng,
                             np.int32(ts.iteration))
+                        # JAX dispatch is async: an execution-time
+                        # failure (OOM) would otherwise surface at a
+                        # LATER sync point (a 20-crossing float, eval,
+                        # or next epoch's permute) — outside this
+                        # recovery scope, after the iteration counter
+                        # had committed for an epoch that never ran.
+                        # Force it to surface HERE with a host read of
+                        # the epoch's loss output (a D2H read cannot
+                        # return before the program completes;
+                        # block_until_ready proved unreliable over the
+                        # tunneled backend). One scalar read per epoch
+                        # on a one-dispatch-per-epoch path.
+                        ts.last_loss = float(loss)
                         # drop the permuted copy eagerly: holding it
                         # across epochs would put THREE epoch-sized
                         # buffers live at the next permute (source +
