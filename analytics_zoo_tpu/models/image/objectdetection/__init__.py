@@ -17,9 +17,17 @@ from analytics_zoo_tpu.models.image.objectdetection.evaluation import (
 from analytics_zoo_tpu.models.image.objectdetection.detector import (
     ObjectDetector,
 )
+from analytics_zoo_tpu.models.image.objectdetection.pretrained import (
+    COCO_91_LABELS, coco_label_map, detection_configure,
+    load_object_detector, load_torch_ssd300, ssd300_vgg16,
+    tv_default_boxes,
+)
 
 __all__ = [
     "decode_boxes", "encode_boxes", "iou_matrix", "nms", "ssd_priors",
     "MultiBoxLoss", "match_priors", "SSDDetector", "ssd_lite",
     "ssd_vgg300", "MeanAveragePrecision", "ObjectDetector",
+    "COCO_91_LABELS", "coco_label_map", "detection_configure",
+    "load_object_detector", "load_torch_ssd300", "ssd300_vgg16",
+    "tv_default_boxes",
 ]

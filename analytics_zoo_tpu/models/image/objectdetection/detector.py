@@ -25,7 +25,18 @@ from analytics_zoo_tpu.models.image.common import (ImageConfigure,
 from analytics_zoo_tpu.models.image.objectdetection.ssd import (
     SSDDetector, ssd_lite, ssd_vgg300)
 
-_ARCHS = {"ssd_lite": ssd_lite, "ssd_vgg300": ssd_vgg300}
+
+def _tv_ssd300_vgg16(num_classes: int = 91):
+    # lazy: pretrained.py pulls in the classification import machinery
+    from analytics_zoo_tpu.models.image.objectdetection.pretrained import (
+        ssd300_vgg16)
+    return ssd300_vgg16(num_classes=num_classes)
+
+
+_ARCHS = {"ssd_lite": ssd_lite, "ssd_vgg300": ssd_vgg300,
+          "ssd300_vgg16": _tv_ssd300_vgg16}
+# architectures whose input size is baked in at 300x300
+_FIXED_300 = ("ssd_vgg300", "ssd300_vgg16")
 
 
 class ObjectDetector(ImageModel):
@@ -54,9 +65,10 @@ class ObjectDetector(ImageModel):
 
     # ------------------------------------------------------------ building
     def build_model(self):
-        if self.model_type == "ssd_vgg300":   # fixed 300x300 input
+        if self.model_type in _FIXED_300:     # fixed 300x300 input
             self.image_size = 300
-            model, self.priors = ssd_vgg300(num_classes=self.num_classes)
+            model, self.priors = _ARCHS[self.model_type](
+                num_classes=self.num_classes)
         else:
             model, self.priors = _ARCHS[self.model_type](
                 num_classes=self.num_classes, image_size=self.image_size)

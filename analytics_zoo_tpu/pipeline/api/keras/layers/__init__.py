@@ -8,7 +8,7 @@ from analytics_zoo_tpu.pipeline.api.keras.layers.embedding import (
 from analytics_zoo_tpu.pipeline.api.keras.layers.merge import Merge, merge
 from analytics_zoo_tpu.pipeline.api.keras.layers.moe import MoE
 from analytics_zoo_tpu.pipeline.api.keras.layers.normalization import (
-    BatchNormalization, L2Normalization, LayerNorm,
+    BatchNormalization, L2Normalization, LayerNorm, NormalizeScale,
 )
 from analytics_zoo_tpu.pipeline.api.keras.layers.recurrent import (
     GRU, LSTM, Bidirectional, SimpleRNN,
@@ -68,6 +68,7 @@ __all__ = [
     "Masking", "MaxoutDense", "Permute", "RepeatVector", "Reshape",
     "SparseDense", "Embedding", "WordEmbedding", "Merge", "merge",
     "BatchNormalization", "L2Normalization", "LayerNorm",
+    "NormalizeScale",
     "GRU", "LSTM", "Bidirectional", "SimpleRNN",
     "AtrousConvolution2D", "Convolution1D", "Convolution2D",
     "Convolution3D", "Conv1D", "Conv2D", "Conv3D",

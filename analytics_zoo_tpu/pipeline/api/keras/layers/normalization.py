@@ -124,3 +124,35 @@ class L2Normalization(Layer):
     def call(self, params, x, training=False, rng=None):
         norm = jnp.linalg.norm(x, axis=self.axis, keepdims=True)
         return x / jnp.maximum(norm, self.epsilon)
+
+
+class NormalizeScale(Layer):
+    """Unit-L2 normalize along the channel axis, then multiply by a
+    LEARNED per-channel scale — the SSD conv4_3 feature rescaler
+    (ref: objectdetection/ssd/SSDGraph.scala:73 ``conv4_3_norm =
+    NormalizeScale(2, scale=normScale)``; torchvision's
+    ``backbone.scale_weight`` plays the same role)."""
+
+    def __init__(self, axis: int = -1, scale_init: float = 20.0,
+                 epsilon: float = 1e-12, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = int(axis)
+        self.scale_init = float(scale_init)
+        self.epsilon = float(epsilon)
+
+    def build(self, rng, input_shape) -> Params:
+        c = input_shape[self.axis]
+        params: Params = {}
+        s = self.scale_init
+        self.add_weight(params, rng, "scale", (c,),
+                        init=lambda rng, shape, dtype:
+                        jnp.full(shape, s, dtype))
+        return params
+
+    def call(self, params, x, training=False, rng=None):
+        norm = jnp.linalg.norm(x, axis=self.axis, keepdims=True)
+        y = x / jnp.maximum(norm, self.epsilon)
+        # broadcast the per-channel scale along self.axis
+        shape = [1] * x.ndim
+        shape[self.axis] = -1
+        return y * params["scale"].reshape(shape)

@@ -1,0 +1,292 @@
+"""Golden tests for the pretrained SSD300-VGG16 import
+(objectdetection/pretrained.py).
+
+The oracle is a hand-built torch ``nn`` SSD with torchvision's exact
+module structure, registration order and state_dict key layout
+(torchvision itself is not installed), run on randomly initialised
+weights: full head outputs and decoded boxes must agree, which is a
+far stronger check than any single-detection comparison.
+
+Ref: ObjectDetectionConfig.scala:31-74 (load-by-name pretrained
+detectors), ObjectDetector.scala ``loadModel``.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow   # 300x300 VGG16 forwards on CPU
+
+torch = pytest.importorskip("torch")
+nn = torch.nn
+F = torch.nn.functional
+
+from analytics_zoo_tpu.models.image.objectdetection.bbox import (  # noqa: E402
+    decode_boxes)
+from analytics_zoo_tpu.models.image.objectdetection.pretrained import (  # noqa: E402
+    _TV_SSD300_ANCHORS, detection_configure, load_torch_ssd300,
+    ssd300_vgg16, tv_default_boxes)
+
+
+# ------------------------------------------------- torchvision-layout oracle
+def _vgg16_features():
+    """torchvision vgg16().features: the conv/relu/pool Sequential
+    whose indices the SSD checkpoint keys reference."""
+    cfg = [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+           512, 512, 512, "M", 512, 512, 512, "M"]
+    layers, cin = [], 3
+    for v in cfg:
+        if v == "M":
+            layers.append(nn.MaxPool2d(2, 2))
+        else:
+            layers += [nn.Conv2d(cin, v, 3, padding=1),
+                       nn.ReLU(inplace=True)]
+            cin = v
+    return nn.Sequential(*layers)
+
+
+class _TVBackbone(nn.Module):
+    """SSDFeatureExtractorVGG: scale_weight registered FIRST, then
+    ``features`` (through conv4_3's relu), then ``extra`` — matching
+    torchvision's registration order so state_dict keys line up."""
+
+    def __init__(self):
+        super().__init__()
+        backbone = _vgg16_features()
+        # maxpool3 (index 16) gains ceil_mode, maxpool4 is index 23
+        backbone[16].ceil_mode = True
+        self.scale_weight = nn.Parameter(torch.ones(512) * 20)
+        self.features = nn.Sequential(*backbone[:23])
+        extra = nn.ModuleList([
+            nn.Sequential(
+                *backbone[23:-1],                       # pool4+conv5_x
+                nn.MaxPool2d(3, 1, 1),                  # pool5
+                nn.Conv2d(512, 1024, 3, padding=6, dilation=6),  # fc6
+                nn.ReLU(inplace=True),
+                nn.Conv2d(1024, 1024, 1),               # fc7
+                nn.ReLU(inplace=True)),
+            nn.Sequential(
+                nn.Conv2d(1024, 256, 1), nn.ReLU(inplace=True),
+                nn.Conv2d(256, 512, 3, padding=1, stride=2),
+                nn.ReLU(inplace=True)),
+            nn.Sequential(
+                nn.Conv2d(512, 128, 1), nn.ReLU(inplace=True),
+                nn.Conv2d(128, 256, 3, padding=1, stride=2),
+                nn.ReLU(inplace=True)),
+            nn.Sequential(
+                nn.Conv2d(256, 128, 1), nn.ReLU(inplace=True),
+                nn.Conv2d(128, 256, 3), nn.ReLU(inplace=True)),
+            nn.Sequential(
+                nn.Conv2d(256, 128, 1), nn.ReLU(inplace=True),
+                nn.Conv2d(128, 256, 3), nn.ReLU(inplace=True)),
+        ])
+        self.extra = extra
+
+    def forward(self, x):
+        x = self.features(x)
+        out = [self.scale_weight.view(1, -1, 1, 1) * F.normalize(x)]
+        for block in self.extra:
+            x = block(x)
+            out.append(x)
+        return out
+
+
+class _TVScoringHead(nn.Module):
+    def __init__(self, in_channels, num_anchors, num_columns):
+        super().__init__()
+        self.module_list = nn.ModuleList([
+            nn.Conv2d(c, a * num_columns, 3, padding=1)
+            for c, a in zip(in_channels, num_anchors)])
+        self.num_columns = num_columns
+
+    def forward(self, feats):
+        outs = []
+        for conv, f in zip(self.module_list, feats):
+            r = conv(f)
+            n, _, h, w = r.shape
+            r = r.view(n, -1, self.num_columns, h, w)
+            r = r.permute(0, 3, 4, 1, 2)
+            outs.append(r.reshape(n, -1, self.num_columns))
+        return torch.cat(outs, dim=1)
+
+
+class _TVHead(nn.Module):
+    def __init__(self, in_channels, num_anchors, num_classes):
+        super().__init__()
+        # torchvision defines classification BEFORE regression
+        self.classification_head = _TVScoringHead(
+            in_channels, num_anchors, num_classes)
+        self.regression_head = _TVScoringHead(in_channels, num_anchors, 4)
+
+
+class _TVSSD300(nn.Module):
+    def __init__(self, num_classes):
+        super().__init__()
+        self.backbone = _TVBackbone()
+        self.head = _TVHead([512, 1024, 512, 256, 256, 256],
+                            list(_TV_SSD300_ANCHORS), num_classes)
+
+    def forward(self, x):
+        feats = self.backbone(x)
+        return (self.head.classification_head(feats),
+                self.head.regression_head(feats))
+
+
+def _tv_oracle_default_boxes():
+    """DefaultBoxGenerator math, straight-line (cx, cy, w, h)."""
+    aspects = [[2], [2, 3], [2, 3], [2, 3], [2], [2]]
+    scales = [0.07, 0.15, 0.33, 0.51, 0.69, 0.87, 1.05]
+    steps = [8, 16, 32, 64, 100, 300]
+    fmaps = [38, 19, 10, 5, 3, 1]
+    boxes = []
+    for k, fk in enumerate(fmaps):
+        s_k, s_k1 = scales[k], scales[k + 1]
+        wh = [[s_k, s_k],
+              [math.sqrt(s_k * s_k1), math.sqrt(s_k * s_k1)]]
+        for ar in aspects[k]:
+            sq = math.sqrt(ar)
+            wh += [[s_k * sq, s_k / sq], [s_k / sq, s_k * sq]]
+        wh = np.clip(np.asarray(wh, np.float32), 0, 1)
+        fx = 300.0 / steps[k]
+        for i in range(fk):
+            cy = (i + 0.5) / fx
+            for j in range(fk):
+                cx = (j + 0.5) / fx
+                for w, h in wh:
+                    boxes.append([cx, cy, w, h])
+    return np.asarray(boxes, np.float32)
+
+
+def _rand_init(module, seed):
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for p in module.parameters():
+            p.copy_(torch.randn(p.shape, generator=g) * 0.05)
+
+
+def test_tv_default_boxes_match_oracle():
+    want = _tv_oracle_default_boxes()
+    want_corner = np.concatenate(
+        [want[:, :2] - want[:, 2:] / 2, want[:, :2] + want[:, 2:] / 2], 1)
+    got = tv_default_boxes()
+    assert got.shape == (8732, 4)
+    np.testing.assert_allclose(got, want_corner, rtol=1e-6, atol=1e-6)
+
+
+def test_ssd300_import_matches_torch_heads_and_boxes(f32_policy):
+    num_classes = 7
+    oracle = _TVSSD300(num_classes)
+    _rand_init(oracle, seed=0)
+    oracle.eval()
+
+    model, priors = ssd300_vgg16(num_classes=num_classes)
+    model.init()
+    load_torch_ssd300(model, oracle.state_dict())
+
+    rs = np.random.RandomState(1)
+    x = rs.rand(2, 300, 300, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        want_cls, want_reg = oracle(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    want_cls, want_reg = want_cls.numpy(), want_reg.numpy()
+
+    v = model.get_variables()
+    (loc, conf), _ = model.apply(v["params"], x, state=v["state"],
+                                 training=False)
+    loc, conf = np.asarray(loc), np.asarray(conf)
+
+    np.testing.assert_allclose(conf, want_cls, rtol=1e-3,
+                               atol=1e-3 * np.abs(want_cls).max())
+    np.testing.assert_allclose(loc, want_reg, rtol=1e-3,
+                               atol=1e-3 * np.abs(want_reg).max())
+
+    # decoded-box parity: our decode (variances 0.1/0.2) vs the
+    # torchvision BoxCoder math (weights 10,10,5,5) on its anchors
+    d = _tv_oracle_default_boxes()
+    cx = want_reg[..., 0] / 10 * d[:, 2] + d[:, 0]
+    cy = want_reg[..., 1] / 10 * d[:, 3] + d[:, 1]
+    with np.errstate(over="ignore"):   # random weights can blow exp;
+        w = np.exp(want_reg[..., 2] / 5) * d[:, 2]   # both sides
+        h = np.exp(want_reg[..., 3] / 5) * d[:, 3]   # overflow alike
+
+    want_boxes = np.clip(np.stack(
+        [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1), 0, 1)
+    got_boxes = np.asarray(decode_boxes(loc, priors))
+    np.testing.assert_allclose(got_boxes, want_boxes, rtol=1e-3,
+                               atol=2e-3)
+
+
+def test_ssd300_import_error_paths(f32_policy):
+    oracle = _TVSSD300(5)
+    model, _ = ssd300_vgg16(num_classes=5)
+    model.init()
+
+    sd = oracle.state_dict()
+    bad = {k: v for k, v in sd.items() if k != "backbone.scale_weight"}
+    with pytest.raises(ValueError, match="scale_weight"):
+        load_torch_ssd300(model, bad)
+
+    extra = dict(sd)
+    extra["bogus.module.weight"] = torch.zeros(3, 3, 1, 1)
+    extra["bogus.module.bias"] = torch.zeros(3)
+    with pytest.raises(ValueError, match="bogus"):
+        load_torch_ssd300(model, extra)
+
+    # class-count mismatch: heads have the wrong channel counts
+    wrong = _TVSSD300(9).state_dict()
+    with pytest.raises(ValueError):
+        load_torch_ssd300(model, wrong)
+
+
+def test_load_object_detector_journey(f32_policy, tmp_path):
+    """load-by-name → detect → label names → save/load roundtrip
+    (the ObjectDetector.loadModel user journey)."""
+    from analytics_zoo_tpu.models.image.objectdetection import (
+        ObjectDetector, load_object_detector)
+
+    oracle = _TVSSD300(91)
+    _rand_init(oracle, seed=3)
+
+    with pytest.raises(ValueError, match="checkpoint required"):
+        load_object_detector("ssd300-vgg16-coco")
+    with pytest.raises(ValueError, match="unknown"):
+        load_object_detector("ssd512", checkpoint={})
+
+    det = load_object_detector("ssd300-vgg16-coco",
+                               checkpoint=oracle.state_dict(),
+                               score_threshold=0.0, max_detections=5)
+    assert det.config.preprocessor is not None
+    assert det.config.label_map["person"] == 1
+
+    img = np.random.RandomState(4).rand(1, 300, 300, 3).astype(
+        np.float32) * 255 - 120
+    boxes, scores, labels = det.detect(img)[0]
+    assert boxes.shape[1] == 4 and len(scores) == len(labels)
+    names = det.label_names(labels[:3])
+    assert all(isinstance(n, str) for n in names)
+
+    # persistence: the imported detector saves and reloads like any
+    # other ObjectDetector artifact
+    p = str(tmp_path / "det.zoo")
+    det.save_model(p)
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+    Layer.reset_name_counters()
+    det2 = ObjectDetector.load_model(p)
+    v1 = det.model.get_variables()["params"]
+    v2 = det2.model.get_variables()["params"]
+    np.testing.assert_allclose(
+        np.asarray(v1["tv_conv4_3"]["kernel"]),
+        np.asarray(v2["tv_conv4_3"]["kernel"]))
+
+
+def test_detection_configure():
+    cfg = detection_configure("ssd300-vgg16-coco")
+    img = (np.random.RandomState(0).rand(123, 77, 3) * 255)
+    out = cfg.preprocessor(img)
+    assert out.shape == (300, 300, 3)
+    # mean-subtraction only (std 1/255 in the 0-1 domain == identity
+    # scale in the 0-255 domain)
+    assert out.min() >= -124.0 and out.max() <= 255.0
+    with pytest.raises(ValueError, match="unknown"):
+        detection_configure("ssd512-vgg16")
