@@ -85,23 +85,63 @@ class TFOptimizer:
                    model_dir=model_dir, **kwargs)
 
     @classmethod
-    def from_train_op(cls, *args, **kwargs):
-        """NOT SUPPORTED — and deliberately not aliased to from_loss.
+    def from_train_op(cls, train_op, loss, sess=None, dataset=None,
+                      metrics=None, updates=None, tensor_with_value=None,
+                      model_dir: Optional[str] = None, **kwargs
+                      ) -> "TFOptimizer":
+        """TF1 ``train_op`` + loss tensor → TFOptimizer, for the
+        CANONICAL ``Optimizer.minimize``/``apply_gradients`` graph
+        shapes only (ref tf_optimizer.py:430).
 
-        The reference's from_train_op (tf_optimizer.py:430) keeps the
-        user's own in-graph update semantics (TFTrainingHelperV2 +
-        FakeOptimMethod apply whatever ops the train_op runs); there is
-        no TF graph here, so silently substituting from_loss would
-        change WHAT update gets applied.  Raise with a migration path
-        instead of lying about semantics."""
-        raise NotImplementedError(
-            "from_train_op couples training to a TF1 in-graph update op, "
-            "which has no equivalent in this TPU-native runtime. Migrate "
-            "to TFOptimizer.from_loss(model, criterion, dataset, "
-            "optim_method=...) — the optimizer is explicit — or, for a "
-            "custom update rule, pass an optax.GradientTransformation "
-            "as optim_method (worked migration: "
-            "examples/tfpark/custom_update_rule.py).")
+        The reference keeps the in-graph update op alive
+        (TFTrainingHelperV2 + FakeOptimMethod); there is no TF session
+        in this runtime's hot loop, so instead the graph is RECOGNIZED:
+        the ``Apply*`` training ops map onto the native OptimMethod
+        with the same update rule and hyperparameters, the loss head
+        (reduce_mean over softmax-CE / sparse-softmax-CE /
+        squared_difference) maps onto the matching objective, and the
+        logits subgraph recompiles op-by-op to jnp (tf1_graph.py).
+        Anything outside those shapes raises with the offending op
+        named — substituting different update semantics silently is
+        exactly what this entry point must never do.  For exotic
+        graphs, migrate to ``from_loss`` (explicit optimizer) or pass
+        an optax.GradientTransformation as optim_method."""
+        if updates is not None or tensor_with_value is not None:
+            raise NotImplementedError(
+                "from_train_op: 'updates' / 'tensor_with_value' carry "
+                "in-graph side effects that do not survive "
+                "recompilation; migrate them into the model or "
+                "from_loss")
+        if metrics is not None:
+            raise NotImplementedError(
+                "from_train_op: 'metrics' are TF tensors in the "
+                "source graph and are not recompiled; pass native "
+                "val_methods to optimize()/Estimator.evaluate instead "
+                "of silently dropping them")
+        if dataset is None:
+            raise ValueError(
+                "from_train_op requires dataset= (a TFDataset, "
+                "FeatureSet or (x, y) tuple); the placeholder-feeding "
+                "dataset cannot be recovered from the graph here")
+        import tensorflow as tf
+
+        from analytics_zoo_tpu.pipeline.api.keras import (Sequential,
+                                                          objectives)
+        from analytics_zoo_tpu.tfpark.tf1_graph import recompile_train_op
+        if sess is None:
+            sess = tf.compat.v1.get_default_session()
+            if sess is None:
+                raise ValueError(
+                    "from_train_op needs the session holding the "
+                    "variable values (pass sess=)")
+        net, criterion, optim = recompile_train_op(train_op, loss, sess)
+        model = Sequential()
+        model.add(net)
+        fs, batch = _dataset_to_featureset(dataset, training=True)
+        return cls(model, objectives.get(criterion), optim, fs,
+                   batch_size=batch,
+                   val_set=getattr(dataset, "val_set", None),
+                   model_dir=model_dir, **kwargs)
 
     # -------------------------------------------------------------- running
     def set_train_summary(self, log_dir: str, app_name: str):

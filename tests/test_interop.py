@@ -298,10 +298,15 @@ class TestFunctionalConversion:
         losses = [h["loss"] for h in history]
         assert float(losses[-1]) < float(losses[0])
 
-    def test_from_train_op_raises(self):
+    def test_from_train_op_guards(self):
+        # the full canonical-graph journey lives in
+        # tests/test_tf1_train_op.py; here just the loud guards
         from analytics_zoo_tpu.tfpark import TFOptimizer
-        with pytest.raises(NotImplementedError, match="from_loss"):
-            TFOptimizer.from_train_op(None, None, None)
+        with pytest.raises(ValueError, match="dataset"):
+            TFOptimizer.from_train_op(None, None)
+        with pytest.raises(NotImplementedError, match="updates"):
+            TFOptimizer.from_train_op(None, None, dataset=([], []),
+                                      updates=["u"])
 
     def test_dot_normalize_and_bn_no_scale(self):
         import tensorflow as tf

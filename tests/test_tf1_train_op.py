@@ -1,0 +1,225 @@
+"""TFOptimizer.from_train_op: canonical TF1 minimize() graphs are
+recognized (optimizer + loss head + logits subgraph recompiled to
+native), exotic graphs refuse loudly.
+
+Ref: pyzoo/zoo/tfpark/tf_optimizer.py:430 (from_train_op).
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow   # builds TF1 graphs + runs fit()
+
+tf = pytest.importorskip("tensorflow")
+
+from analytics_zoo_tpu.tfpark.tf1_graph import (  # noqa: E402
+    recognize_optimizer, split_loss)
+from analytics_zoo_tpu.tfpark.tf_optimizer import TFOptimizer  # noqa: E402
+
+
+def _mlp_graph(optimizer_fn, n_in=8, n_hidden=16, n_out=3, seed=0):
+    """A TF1-style MLP: placeholders + get_variable + minimize()."""
+    g = tf.Graph()
+    with g.as_default():
+        tf.compat.v1.set_random_seed(seed)
+        x = tf.compat.v1.placeholder(tf.float32, [None, n_in], name="x")
+        y = tf.compat.v1.placeholder(tf.int32, [None], name="y")
+        w1 = tf.compat.v1.get_variable("w1", [n_in, n_hidden])
+        b1 = tf.compat.v1.get_variable(
+            "b1", [n_hidden], initializer=tf.zeros_initializer())
+        w2 = tf.compat.v1.get_variable("w2", [n_hidden, n_out])
+        b2 = tf.compat.v1.get_variable(
+            "b2", [n_out], initializer=tf.zeros_initializer())
+        h = tf.nn.relu(tf.nn.bias_add(tf.matmul(x, w1), b1))
+        logits = tf.nn.bias_add(tf.matmul(h, w2), b2)
+        loss = tf.reduce_mean(
+            tf.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=y, logits=logits))
+        train_op = optimizer_fn().minimize(loss)
+        init = tf.compat.v1.global_variables_initializer()
+    sess = tf.compat.v1.Session(graph=g)
+    sess.run(init)
+    return dict(graph=g, sess=sess, x=x, y=y, logits=logits,
+                loss=loss, train_op=train_op)
+
+
+def _toy_data(n=256, n_in=8, n_out=3, seed=1):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, n_in).astype(np.float32)
+    y = (np.abs(x[:, :n_out]).argmax(1)).astype(np.int32)
+    return x, y
+
+
+def test_recognize_adam_and_sgd():
+    env = _mlp_graph(lambda: tf.compat.v1.train.AdamOptimizer(
+        learning_rate=0.0123, beta1=0.8, beta2=0.95, epsilon=1e-5))
+    method, var_ops = recognize_optimizer(env["train_op"], env["sess"])
+    assert method.name == "adam"
+    kw = method._init_kwargs
+    assert kw["lr"] == pytest.approx(0.0123)
+    assert kw["beta_1"] == pytest.approx(0.8)
+    assert kw["beta_2"] == pytest.approx(0.95)
+    assert kw["epsilon"] == pytest.approx(1e-5)
+    assert {op.name for op in var_ops} == {"w1", "b1", "w2", "b2"}
+
+    env2 = _mlp_graph(lambda: tf.compat.v1.train.GradientDescentOptimizer(
+        0.05))
+    m2, _ = recognize_optimizer(env2["train_op"], env2["sess"])
+    assert m2.name == "sgd"
+    assert m2._init_kwargs["learning_rate"] == pytest.approx(0.05)
+
+    env3 = _mlp_graph(lambda: tf.compat.v1.train.MomentumOptimizer(
+        0.01, momentum=0.9, use_nesterov=True))
+    m3, _ = recognize_optimizer(env3["train_op"], env3["sess"])
+    assert m3.name == "sgd"
+    assert m3._init_kwargs["momentum"] == pytest.approx(0.9)
+    assert m3._init_kwargs["nesterov"] is True
+
+
+def test_split_loss_heads():
+    env = _mlp_graph(lambda: tf.compat.v1.train.AdamOptimizer())
+    logits_t, labels_t, crit = split_loss(env["loss"])
+    assert crit == "sparse_categorical_crossentropy_with_logits"
+    assert labels_t.op.name == "y"
+
+    # mse head
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 4], name="x")
+        y = tf.compat.v1.placeholder(tf.float32, [None, 1], name="y")
+        w = tf.compat.v1.get_variable("w", [4, 1])
+        pred = tf.matmul(x, w)
+        loss = tf.reduce_mean(tf.math.squared_difference(pred, y))
+    _, labels_t, crit = split_loss(loss)
+    assert crit == "mse" and labels_t.op.name == "y"
+
+
+def test_exotic_graphs_refuse_loudly():
+    # exotic loss head (reduce_sum)
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 4], name="x")
+        w = tf.compat.v1.get_variable("w", [4, 1])
+        loss = tf.reduce_sum(tf.matmul(x, w))
+    with pytest.raises(NotImplementedError, match="reduce_mean"):
+        split_loss(loss)
+
+    # exotic op inside a custom train path: a raw assign is not a
+    # recognized training op
+    g2 = tf.Graph()
+    with g2.as_default():
+        w = tf.compat.v1.get_variable("w", [4])
+        train_op = tf.compat.v1.assign(w, w * 0.9)
+    with pytest.raises(NotImplementedError, match="Assign"):
+        recognize_optimizer(train_op.op, None)
+
+    # side-effect kwargs refuse
+    env = _mlp_graph(lambda: tf.compat.v1.train.AdamOptimizer())
+    with pytest.raises(NotImplementedError, match="updates"):
+        TFOptimizer.from_train_op(env["train_op"], env["loss"],
+                                  sess=env["sess"], dataset=([], []),
+                                  updates=["x"])
+
+
+def test_transformed_grads_and_schedules_refuse():
+    # clipped gradients through apply_gradients: canonical Apply ops,
+    # but the update semantics differ — must refuse, not substitute
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 4], name="x")
+        y = tf.compat.v1.placeholder(tf.int32, [None], name="y")
+        w = tf.compat.v1.get_variable("w", [4, 3])
+        logits = tf.matmul(x, w)
+        loss = tf.reduce_mean(
+            tf.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=y, logits=logits))
+        opt = tf.compat.v1.train.GradientDescentOptimizer(0.1)
+        gvs = opt.compute_gradients(loss)
+        clipped = [(tf.clip_by_norm(gg, 1.0), vv) for gg, vv in gvs]
+        train_op = opt.apply_gradients(clipped)
+    with pytest.raises(NotImplementedError, match="gradient"):
+        recognize_optimizer(train_op, None)
+
+    # lr schedule: freezing it at step 0 would silently change
+    # training — must refuse
+    g2 = tf.Graph()
+    with g2.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 4], name="x")
+        y = tf.compat.v1.placeholder(tf.int32, [None], name="y")
+        w = tf.compat.v1.get_variable("w", [4, 3])
+        loss = tf.reduce_mean(
+            tf.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=y, logits=tf.matmul(x, w)))
+        step = tf.compat.v1.train.get_or_create_global_step()
+        lr = tf.compat.v1.train.exponential_decay(0.1, step, 100, 0.9)
+        train_op = tf.compat.v1.train.GradientDescentOptimizer(
+            lr).minimize(loss, global_step=step)
+        init2 = tf.compat.v1.global_variables_initializer()
+    sess = tf.compat.v1.Session(graph=g2)
+    sess.run(init2)
+    with pytest.raises(NotImplementedError, match="constant"):
+        recognize_optimizer(train_op, sess)
+
+    env = _mlp_graph(lambda: tf.compat.v1.train.AdamOptimizer())
+    with pytest.raises(NotImplementedError, match="metrics"):
+        TFOptimizer.from_train_op(env["train_op"], env["loss"],
+                                  sess=env["sess"], dataset=([], []),
+                                  metrics={"acc": None})
+
+
+def test_frozen_variables_become_constants(f32_policy):
+    """A trainable=False variable in the logits graph is snapshotted
+    as a constant (same semantics: the train_op never updates it)."""
+    from analytics_zoo_tpu.tfpark.tf1_graph import recompile_train_op
+
+    g = tf.Graph()
+    with g.as_default():
+        x = tf.compat.v1.placeholder(tf.float32, [None, 4], name="x")
+        y = tf.compat.v1.placeholder(tf.int32, [None], name="y")
+        frozen = tf.compat.v1.get_variable(
+            "proj", [4, 6], trainable=False)
+        w = tf.compat.v1.get_variable("w", [6, 3])
+        logits = tf.matmul(tf.matmul(x, frozen), w)
+        loss = tf.reduce_mean(
+            tf.nn.sparse_softmax_cross_entropy_with_logits(
+                labels=y, logits=logits))
+        train_op = tf.compat.v1.train.GradientDescentOptimizer(
+            0.1).minimize(loss)
+        init = tf.compat.v1.global_variables_initializer()
+    sess = tf.compat.v1.Session(graph=g)
+    sess.run(init)
+    net, crit, method = recompile_train_op(train_op, loss, sess)
+    assert "proj" in net._constants and "proj" not in net._values
+    assert "w" in net._values
+
+    xb = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    want = sess.run(logits, {x: xb})
+    params = net.build(None, (None, 4))
+    got = np.asarray(net.call(params, xb))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_from_train_op_end_to_end(f32_policy):
+    """Import parity + the full optimize() journey on a TF1 MLP."""
+    from analytics_zoo_tpu.common.triggers import MaxEpoch
+
+    env = _mlp_graph(lambda: tf.compat.v1.train.AdamOptimizer(1e-2))
+    x, y = _toy_data()
+    opt = TFOptimizer.from_train_op(
+        env["train_op"], env["loss"], sess=env["sess"],
+        dataset=(x, y))
+    opt.batch_size = 64
+
+    # import parity: the recompiled net reproduces the TF graph's
+    # logits on the session's variable values
+    want = env["sess"].run(env["logits"], {env["x"]: x[:32]})
+    got = np.asarray(opt.model.predict(x[:32], batch_size=32))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    # loss before vs after optimize(): training must actually learn
+    before = float(env["sess"].run(
+        env["loss"], {env["x"]: x, env["y"]: y}))
+    history = opt.optimize(end_trigger=MaxEpoch(8))
+    after = float(history[-1]["loss"]) if isinstance(
+        history, list) else float(opt.estimator.history[-1]["loss"])
+    assert after < before * 0.7, (before, after)
