@@ -384,6 +384,87 @@ class TestPipelinedServing:
         assert s["latency_p50_ms"] > 0
         assert s["latency_p95_ms"] >= s["latency_p50_ms"]
 
+    def test_latency_regression_vs_calibrated_bound(self):
+        """p50 serving latency must stay within a small multiple of
+        this host's calibrated decode+predict cost, with the
+        device-resident-weight path engaged.
+
+        Regression guard for the round-4 finding: predict was
+        re-uploading the full parameter tree every batch (~46 MB for
+        resnet-18), inflating serving p50 ~40x over the compute cost.
+        A re-upload-per-batch class regression multiplies per-batch
+        cost well past the 6x headroom here, so it cannot land
+        silently again."""
+        import time as _t
+
+        import cv2
+        import jax
+
+        # a model big enough that a per-batch weight re-upload would
+        # dominate: ~1.5M params through a few convs + dense
+        m = Sequential()
+        m.add(Convolution2D(32, 3, 3, input_shape=(32, 32, 3),
+                            activation="relu"))
+        m.add(Convolution2D(32, 3, 3, activation="relu"))
+        m.add(Flatten())
+        m.add(Dense(64, activation="relu"))
+        m.add(Dense(4))
+        m.init()
+        im = InferenceModel().load_zoo(m)
+        # the device-resident path must be engaged for the bound to
+        # mean anything
+        leaves = jax.tree_util.tree_leaves(im._variables)
+        assert leaves and all(isinstance(l, jax.Array) for l in leaves)
+
+        bs, n_records = 16, 256
+        rs = np.random.RandomState(0)
+        jpegs = []
+        for i in range(n_records):
+            img = (rs.rand(32, 32, 3) * 255).astype(np.uint8)
+            jpegs.append(cv2.imencode(".jpg", img)[1].tobytes())
+
+        # ---- calibrate steady-state per-batch cost on THIS host
+        xb = rs.rand(bs, 32, 32, 3).astype(np.float32)
+        im.predict(xb)                       # compile
+        t0 = _t.time()
+        reps = 5
+        for _ in range(reps):
+            np.asarray(im.predict(xb))
+        pred_ms = (_t.time() - t0) / reps * 1e3
+        t0 = _t.time()
+        for b in jpegs[:bs]:
+            cv2.imdecode(np.frombuffer(b, np.uint8), cv2.IMREAD_COLOR)
+        dec_ms = (_t.time() - t0) * 1e3
+
+        # ---- end-to-end pipelined pass over the embedded broker
+        broker = EmbeddedBroker()
+        serving = ClusterServing(
+            im, ServingConfig(batch_size=bs, top_n=2), broker=broker)
+        inq = InputQueue(broker=broker)
+        for i, b in enumerate(jpegs):
+            inq.enqueue_image(f"rec-{i}", b)
+        serving.run_once(block_ms=0)         # warm the padded program
+        t = threading.Thread(target=serving.run, kwargs={"poll_ms": 5})
+        t0 = _t.time()
+        t.start()
+        while serving.total_records < n_records and _t.time() - t0 < 60:
+            _t.sleep(0.01)
+        serving.stop()
+        t.join(timeout=10)
+        assert serving.total_records >= n_records
+
+        p50 = serving.stats()["latency_p50_ms"]
+        # batch latency = decode + (pipeline in-flight wait) + predict;
+        # 6x the calibrated decode+predict (plus a 25 ms scheduling
+        # floor for noisy CI hosts) is generous headroom for pipeline
+        # queueing while being far below any re-upload-class regression
+        bound = 6.0 * (pred_ms + dec_ms) + 25.0
+        assert p50 < bound, (
+            f"serving p50 {p50:.1f} ms exceeds calibrated bound "
+            f"{bound:.1f} ms (predict {pred_ms:.1f} + decode "
+            f"{dec_ms:.1f} per batch) — is predict re-uploading "
+            "weights per batch?")
+
     def test_poison_records_do_not_kill_worker(self):
         """Poison input must not kill the serving thread with its batch
         un-acked.  Two poison shapes: (a) an undecodable image record —
