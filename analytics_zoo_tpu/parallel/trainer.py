@@ -380,7 +380,30 @@ class DistributedTrainer:
             self.mesh.shape[mesh_lib.FSDP_AXIS]
         from analytics_zoo_tpu.feature.feature_set import pad_rows
         n = len(jax.tree_util.tree_leaves(x)[0])
-        pad = (-n) % dp if jax.process_count() == 1 else 0
+        if jax.process_count() > 1:
+            if mesh_lib.data_split_across_hosts(self.mesh):
+                local_dp = dp // jax.process_count()
+                if n % local_dp:
+                    # multi-host rows must tile the mesh EXACTLY: the
+                    # multi-host epoch_scan_fn layout reshapes each
+                    # host block to num_batches * batch_size rows,
+                    # which padding would break — refuse HERE with
+                    # epoch-level context rather than letting
+                    # put_batch raise its per-batch message deep in
+                    # the placement
+                    raise ValueError(
+                        f"put_epoch_source: this host's {n} rows do "
+                        f"not tile its data-parallel share "
+                        f"({local_dp} of the {dp}-way data axes "
+                        f"across {jax.process_count()} processes); "
+                        f"pad or trim each host's rows to a multiple "
+                        f"of {local_dp} (single-process callers are "
+                        "padded automatically)")
+            # non-split meshes replicate the epoch (put_batch's
+            # replica branch) — no tiling requirement, no padding
+            pad = 0
+        else:
+            pad = (-n) % dp
         if pad:
             x = pad_rows(x, pad)
             y = pad_rows(y, pad) if y is not None else None

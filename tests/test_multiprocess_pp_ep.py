@@ -1,0 +1,63 @@
+"""Real 2-process pipeline- and expert-parallel test.
+
+Round-4 gap closed here: multi-process coverage stopped at data/fsdp
+parallelism — the pp microbatch routing and MoE expert dispatch only
+ever ran single-process.  This launches 2 workers x 4 virtual CPU
+devices via ``ZooCluster`` (gloo collectives) with meshes whose pipe /
+expert axis SPANS the process boundary, asserts loss+grad parity
+against sequential/single-device oracles inside each worker, and
+cross-checks the workers' results here.  Also exercises the
+``put_epoch_source`` multi-host tiling refusal end-to-end.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.parallel.launcher import ZooCluster
+
+pytestmark = pytest.mark.slow   # 2 subprocess jax inits + compiles
+
+WORKER = os.path.join(os.path.dirname(__file__),
+                      "distributed_pp_ep_worker.py")
+
+
+def test_two_process_pipeline_and_expert_parallel(tmp_path):
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(WORKER)))
+    env = {
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "JAX_PLATFORMS": "cpu",
+        "ZOO_TEST_OUT": str(tmp_path),
+        "PYTHONPATH": repo_root + os.pathsep
+        + os.environ.get("PYTHONPATH", ""),
+    }
+    cluster = ZooCluster(num_processes=2, env=env)
+    cluster.start(WORKER)
+    try:
+        codes = cluster.wait(timeout=600)
+    finally:
+        cluster.stop()
+    assert codes == [0, 0], f"worker exit codes {codes}"
+
+    w0 = np.load(tmp_path / "worker0.npz")
+    w1 = np.load(tmp_path / "worker1.npz")
+
+    # pp: both hosts computed the same pipelined loss, equal to the
+    # sequential oracle (each worker also verified its own stage's
+    # grads against the oracle before writing)
+    assert w0["pp_loss"] == w1["pp_loss"]
+    np.testing.assert_allclose(w0["pp_loss"], w0["pp_ref_loss"],
+                               rtol=1e-5, atol=1e-6)
+
+    # ep: the 4-step training trajectory over the cross-process expert
+    # mesh matches the single-device oracle, identically on both hosts
+    np.testing.assert_array_equal(w0["ep_losses"], w1["ep_losses"])
+    np.testing.assert_allclose(w0["ep_losses"], w0["ep_ref_losses"],
+                               rtol=1e-4, atol=1e-5)
+    # training moved: the trajectory is strictly decreasing overall
+    assert w0["ep_losses"][-1] < w0["ep_losses"][0]
+
+    # the multi-host put_epoch_source tiling guard fired on both hosts
+    assert int(w0["guard_raised"]) == 1
+    assert int(w1["guard_raised"]) == 1
