@@ -29,7 +29,8 @@ import time
 def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
                      num_classes: int = 1000, scan_steps: int = 48,
                      repeats: int = 3, compute_dtype: str = "bfloat16",
-                     stem: str = "space_to_depth", unroll: int = 1):
+                     stem: str = "space_to_depth", unroll: int = 1,
+                     trace_dir: str = None):
     import jax
     import jax.numpy as jnp
 
@@ -112,6 +113,19 @@ def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
         loss_val = float(mloss)        # D2H sync
         walls.append(time.time() - t0)
     wall = min(walls)
+
+    if trace_dir:
+        # one profiled epoch AFTER the timed window (profiling adds
+        # overhead; it must never contaminate the recorded walls) —
+        # feeds dev/trace-summary's MXU/HBM/infeed split
+        jax.profiler.start_trace(trace_dir)
+        try:
+            params, opt_state, state, mloss = compiled(
+                params, opt_state, state, x_dev, y_dev,
+                jax.random.fold_in(rng, repeats + 1))
+            float(mloss)
+        finally:
+            jax.profiler.stop_trace()
 
     imgs_per_sec = scan_steps * batch_size / wall
     step_ms = wall / scan_steps * 1e3

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Round-5 on-chip capture chain, run AFTER the bench waiter.
+
+Waits for any running ``bench.py --workload all`` process to finish
+(so the two never contend with each other for the shared chip), then,
+chip permitting:
+
+  1. ``dev/resnet-sweep --remat``  — the remat A-B VERDICT #3 asks for
+  2. a profiled resnet epoch (``trace_dir``) + ``dev/trace-summary``
+     — the MXU/HBM/infeed split of step time
+
+Everything logs to dev/r05_captures/; designed to run detached for
+hours (the chip frees when it frees).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "dev", "r05_captures")
+os.makedirs(OUT, exist_ok=True)
+
+
+def log(msg):
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+
+
+def bench_running() -> bool:
+    try:
+        out = subprocess.run(
+            ["pgrep", "-f", r"bench\.py --workload all"],
+            capture_output=True, text=True)
+        pids = [p for p in out.stdout.split()
+                if p and int(p) != os.getpid()]
+        return bool(pids)
+    except Exception:
+        return False
+
+
+def probe_chip(budget_s: float, timeout_s: float = 90.0) -> bool:
+    sys.path.insert(0, REPO)
+    import bench
+    ok, err = bench._probe_backend(budget_s, timeout_s)
+    if not ok:
+        log(f"chip probe failed: {err and err.splitlines()[0]}")
+    return ok
+
+
+def run_logged(cmd, name, timeout_s):
+    log(f"running {name}: {' '.join(cmd)}")
+    path = os.path.join(OUT, f"{name}.log")
+    with open(path, "w") as f:
+        try:
+            r = subprocess.run(cmd, stdout=f, stderr=subprocess.STDOUT,
+                               timeout=timeout_s, cwd=REPO)
+            log(f"{name}: rc={r.returncode} (log: {path})")
+            return r.returncode == 0
+        except subprocess.TimeoutExpired:
+            log(f"{name}: TIMED OUT after {timeout_s}s")
+            return False
+
+
+def main():
+    # 1. let the bench waiter finish first — up to 8 h
+    t0 = time.time()
+    while bench_running():
+        if time.time() - t0 > 8 * 3600:
+            log("bench waiter still running after 8h; proceeding anyway")
+            break
+        log("bench waiter still running; sleeping 120s")
+        time.sleep(120)
+
+    # 2. chip probe (long budget: contention outlasts hours)
+    if not probe_chip(budget_s=4 * 3600):
+        log("no chip within budget; giving up")
+        return 1
+
+    # 3. remat A-B sweep
+    run_logged([sys.executable, os.path.join(REPO, "dev", "resnet-sweep"),
+                "--remat", "--out",
+                os.path.join(OUT, "resnet_remat_ab.jsonl")],
+               "resnet_remat_ab", timeout_s=3600)
+
+    # 4. profiled epoch + trace summary
+    trace_dir = os.path.join(OUT, "resnet_trace")
+    code = (
+        "import json, jax\n"
+        "from analytics_zoo_tpu.benchmarks.resnet import run_resnet_bench\n"
+        f"r = run_resnet_bench(jax.devices()[0], repeats=2,"
+        f" trace_dir={trace_dir!r})\n"
+        "print(json.dumps(r))\n"
+    )
+    run_logged([sys.executable, "-c", code], "resnet_traced_run",
+               timeout_s=2400)
+    run_logged([sys.executable, os.path.join(REPO, "dev",
+                                             "trace-summary"),
+                trace_dir, "--top", "20"],
+               "trace_summary", timeout_s=600)
+    log("capture chain complete")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
