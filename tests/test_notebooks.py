@@ -1,0 +1,34 @@
+"""Notebook-form tutorials (apps/notebooks/) stay generated, valid,
+and in sync with the scripts they present (reference form parity:
+the reference's apps are Jupyter notebooks)."""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_notebooks_in_sync_with_scripts():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "dev", "make-notebooks"),
+         "--check"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_notebooks_valid_and_code_compiles():
+    paths = sorted(glob.glob(os.path.join(REPO, "apps", "notebooks",
+                                          "*.ipynb")))
+    assert len(paths) >= 16, paths
+    for p in paths:
+        nb = json.load(open(p))
+        assert nb["nbformat"] == 4
+        kinds = [c["cell_type"] for c in nb["cells"]]
+        assert "markdown" in kinds and "code" in kinds, p
+        for c in nb["cells"]:
+            if c["cell_type"] != "code":
+                continue
+            src = "".join(c["source"])
+            compile(src, p, "exec")   # every cell is valid python
