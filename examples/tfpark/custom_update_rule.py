@@ -5,13 +5,19 @@ users wired an arbitrary in-graph update op (their own optimizer
 variant, custom clipping, polyak averaging...) and zoo's
 TFTrainingHelperV2 applied whatever that op did.
 
-There is no TF graph in this runtime, so the same freedom lives one
-level up: ANY ``optax.GradientTransformation`` — including a fully
-hand-written one — passes directly as ``optim_method`` to
-``TFOptimizer.from_loss`` (or to Estimator / model.compile).  This
-example hand-builds the kind of update a from_train_op user typically
-owned: sign-SGD with trust-ratio scaling and decoupled weight decay,
-written from raw optax primitives."""
+CANONICAL ``Optimizer.minimize`` graphs no longer need migrating at
+all: ``TFOptimizer.from_train_op(train_op, loss, dataset=...)``
+recognizes the standard Apply* training ops, maps them to the native
+OptimMethod and recompiles the logits subgraph to jnp
+(tfpark/tf1_graph.py; see tests/test_tf1_train_op.py for the full
+journey).  What still needs migrating is the EXOTIC case — a custom
+in-graph update rule — and that freedom lives one level up here: ANY
+``optax.GradientTransformation`` — including a fully hand-written one
+— passes directly as ``optim_method`` to ``TFOptimizer.from_loss``
+(or to Estimator / model.compile).  This example hand-builds the kind
+of update a from_train_op user typically owned: sign-SGD with
+trust-ratio scaling and decoupled weight decay, written from raw
+optax primitives."""
 
 import argparse
 import os
