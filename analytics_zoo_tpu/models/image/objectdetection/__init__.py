@@ -1,7 +1,9 @@
 from analytics_zoo_tpu.models.image.objectdetection.bbox import (
     decode_boxes, encode_boxes, iou_matrix,
 )
-from analytics_zoo_tpu.models.image.objectdetection.nms import nms
+from analytics_zoo_tpu.models.image.objectdetection.nms import (
+    multiclass_nms, nms,
+)
 from analytics_zoo_tpu.models.image.objectdetection.prior_box import (
     ssd_priors,
 )
@@ -25,7 +27,8 @@ from analytics_zoo_tpu.models.image.objectdetection.pretrained import (
 
 __all__ = [
     "decode_boxes", "encode_boxes", "iou_matrix", "nms", "ssd_priors",
-    "MultiBoxLoss", "match_priors", "SSDDetector", "ssd_lite",
+    "MultiBoxLoss", "match_priors", "multiclass_nms",
+    "SSDDetector", "ssd_lite",
     "ssd_vgg300", "MeanAveragePrecision", "ObjectDetector",
     "COCO_91_LABELS", "coco_label_map", "detection_configure",
     "load_object_detector", "load_torch_ssd300", "ssd300_vgg16",

@@ -47,6 +47,8 @@ class ObjectDetector(ImageModel):
                  score_threshold: float = 0.3,
                  iou_threshold: float = 0.45,
                  max_detections: int = 100,
+                 per_class_nms: bool = False,
+                 topk_per_class: int = 400,
                  label_map: Optional[Dict[str, int]] = None,
                  config: Optional[ImageConfigure] = None):
         if model_type not in _ARCHS:
@@ -58,6 +60,8 @@ class ObjectDetector(ImageModel):
         self.score_threshold = float(score_threshold)
         self.iou_threshold = float(iou_threshold)
         self.max_detections = int(max_detections)
+        self.per_class_nms = bool(per_class_nms)
+        self.topk_per_class = int(topk_per_class)
         self._detector = None
         self._detector_key = None
         super().__init__(config=config or ImageConfigure(
@@ -80,13 +84,16 @@ class ObjectDetector(ImageModel):
         # rebuild when a threshold changed — the jitted postprocess
         # bakes them in, so a stale cache would silently ignore edits
         key = (self.score_threshold, self.iou_threshold,
-               self.max_detections)
+               self.max_detections, self.per_class_nms,
+               self.topk_per_class)
         if self._detector is None or self._detector_key != key:
             self._detector = SSDDetector(
                 self.model, self.priors, num_classes=self.num_classes,
                 score_threshold=self.score_threshold,
                 iou_threshold=self.iou_threshold,
-                max_detections=self.max_detections)
+                max_detections=self.max_detections,
+                per_class_nms=self.per_class_nms,
+                topk_per_class=self.topk_per_class)
             self._detector_key = key
         return self._detector
 
@@ -197,6 +204,8 @@ class ObjectDetector(ImageModel):
             "score_threshold": self.score_threshold,
             "iou_threshold": self.iou_threshold,
             "max_detections": self.max_detections,
+            "per_class_nms": self.per_class_nms,
+            "topk_per_class": self.topk_per_class,
             "label_map": self.config.label_map,
         }
         save_variables(path, {

@@ -39,3 +39,64 @@ def nms(boxes, scores, iou_threshold: float = 0.45,
     _, out_idx, out_valid = jax.lax.fori_loop(
         0, max_output, body, (alive, out_idx, out_valid))
     return out_idx, out_valid
+
+
+def multiclass_nms(boxes, probs, iou_threshold: float = 0.45,
+                   score_threshold: float = 0.01,
+                   topk_per_class: int = 400,
+                   max_detections: int = 200):
+    """Per-class NMS with cross-class results — torchvision SSD
+    postprocess semantics (a location can be detected as SEVERAL
+    classes; best-class-only NMS merges overlapping objects of
+    different classes).
+
+    ``boxes`` (P,4), ``probs`` (P,C) with class 0 = background.
+    Per non-background class: top-``topk_per_class`` candidates by
+    score (bounds the per-class IoU matrix to k², the reason
+    torchvision has the same knob), greedy NMS, then the global
+    top-``max_detections`` across classes by score.
+
+    Returns (boxes (D,4), scores (D,), labels (D,) int32, valid (D,))
+    with D = ``max_detections``; invalid slots carry label 0.
+    """
+    p, c = probs.shape
+    k = min(topk_per_class, p)
+    m = min(max_detections, k)
+
+    def per_class(scores_c):
+        top_scores, top_idx = jax.lax.top_k(scores_c, k)
+        cand = boxes[top_idx]
+        idx, valid = nms(cand, top_scores, iou_threshold, m,
+                         score_threshold)
+        safe = jnp.maximum(idx, 0)
+        return (top_idx[safe], jnp.where(valid, top_scores[safe],
+                                         -jnp.inf), valid)
+
+    # (C-1, m) each; class axis vmapped so the k x k IoU work stays
+    # bounded at (C-1) * k^2
+    sel, sc, valid = jax.vmap(per_class)(probs[:, 1:].T)
+    labels = jnp.broadcast_to(
+        jnp.arange(1, c, dtype=jnp.int32)[:, None], sel.shape)
+
+    flat_scores = sc.reshape(-1)
+    # the candidate pool can be SMALLER than max_detections (few
+    # classes / tiny prior sets): top_k requires k <= pool size, so
+    # take what exists and pad the outputs up to D
+    d = min(max_detections, flat_scores.shape[0])
+    best_scores, order = jax.lax.top_k(flat_scores, d)
+    out_valid = best_scores > -jnp.inf
+    safe = jnp.maximum(order, 0)
+    out_boxes = boxes[sel.reshape(-1)[safe]]
+    out_labels = jnp.where(out_valid, labels.reshape(-1)[safe], 0)
+    out_scores = jnp.where(out_valid, best_scores, 0.0)
+    pad = max_detections - d
+    if pad:
+        out_boxes = jnp.concatenate(
+            [out_boxes, jnp.zeros((pad, 4), out_boxes.dtype)])
+        out_scores = jnp.concatenate(
+            [out_scores, jnp.zeros((pad,), out_scores.dtype)])
+        out_labels = jnp.concatenate(
+            [out_labels, jnp.zeros((pad,), out_labels.dtype)])
+        out_valid = jnp.concatenate(
+            [out_valid, jnp.zeros((pad,), bool)])
+    return out_boxes, out_scores, out_labels.astype(jnp.int32), out_valid

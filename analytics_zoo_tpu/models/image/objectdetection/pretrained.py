@@ -289,7 +289,9 @@ def load_object_detector(name: str = "ssd300-vgg16-coco",
                          checkpoint=None,
                          score_threshold: float = 0.3,
                          iou_threshold: float = 0.45,
-                         max_detections: int = 100):
+                         max_detections: int = 100,
+                         per_class_nms: bool = True,
+                         topk_per_class: int = 400):
     """Load-by-name pretrained detector — the
     ``ObjectDetector.loadModel(name)`` journey
     (ObjectDetectionConfig.scala:31-74).
@@ -298,7 +300,12 @@ def load_object_detector(name: str = "ssd300-vgg16-coco",
     ``.pth`` path to one.  This environment has no network egress, so
     the published weights can't be fetched here — download
     ``ssd300_vgg16_coco-b556d3b4.pth`` from torchvision's model zoo
-    and pass its path."""
+    and pass its path.
+
+    ``per_class_nms=True`` by default: the published COCO detector's
+    postprocess is per-class NMS with cross-class results (torchvision
+    semantics) — best-class-only NMS would merge overlapping objects
+    of different classes."""
     from analytics_zoo_tpu.models.image.objectdetection.detector import (
         ObjectDetector)
     if name != "ssd300-vgg16-coco":
@@ -315,6 +322,7 @@ def load_object_detector(name: str = "ssd300-vgg16-coco",
         model_type="ssd300_vgg16", num_classes=len(COCO_91_LABELS),
         image_size=300, score_threshold=score_threshold,
         iou_threshold=iou_threshold, max_detections=max_detections,
+        per_class_nms=per_class_nms, topk_per_class=topk_per_class,
         label_map=coco_label_map())
     if isinstance(checkpoint, (str, bytes)) or hasattr(checkpoint,
                                                        "__fspath__"):

@@ -15,7 +15,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.models.image.objectdetection.bbox import decode_boxes
-from analytics_zoo_tpu.models.image.objectdetection.nms import nms
+from analytics_zoo_tpu.models.image.objectdetection.nms import (
+    multiclass_nms, nms,
+)
 from analytics_zoo_tpu.models.image.objectdetection.prior_box import (
     num_priors_per_cell, ssd_priors,
 )
@@ -123,24 +125,34 @@ def ssd_lite(num_classes: int = 4, image_size: int = 64
 
 
 class SSDDetector:
-    """Detection wrapper: forward → decode → per-class NMS
-    (the predictImageSet + postprocess role of ImageModel/SSD)."""
+    """Detection wrapper: forward → decode → NMS (the predictImageSet
+    + postprocess role of ImageModel/SSD).
+
+    ``per_class_nms=False`` (default): best-non-background-class NMS —
+    cheap, one NMS pass per image.  ``per_class_nms=True``: the
+    torchvision/COCO postprocess — NMS per class with cross-class
+    results (overlapping objects of DIFFERENT classes both survive),
+    bounded by ``topk_per_class`` candidates per class."""
 
     def __init__(self, model: Model, priors: np.ndarray,
                  num_classes: int, score_threshold: float = 0.3,
-                 iou_threshold: float = 0.45, max_detections: int = 100):
+                 iou_threshold: float = 0.45, max_detections: int = 100,
+                 per_class_nms: bool = False, topk_per_class: int = 400):
         self.model = model
         self.priors = jnp.asarray(priors)
         self.num_classes = num_classes
         self.score_threshold = score_threshold
         self.iou_threshold = iou_threshold
         self.max_detections = max_detections
+        self.per_class_nms = per_class_nms
+        self.topk_per_class = topk_per_class
         self._fn = None
 
     def _build(self):
         model, priors = self.model, self.priors
         k_iou, k_max, k_score = (self.iou_threshold, self.max_detections,
                                  self.score_threshold)
+        per_class, k_topk = self.per_class_nms, self.topk_per_class
 
         def detect(params, state, x):
             (loc, conf), _ = model.apply(params, x, state=state,
@@ -149,6 +161,9 @@ class SSDDetector:
             probs = jax.nn.softmax(conf, axis=-1)      # (B,P,C)
 
             def per_image(b, p):
+                if per_class:
+                    return multiclass_nms(b, p, k_iou, k_score,
+                                          k_topk, k_max)
                 score = jnp.max(p[:, 1:], axis=-1)     # best non-bg
                 label = jnp.argmax(p[:, 1:], axis=-1) + 1
                 idx, valid = nms(b, score, k_iou, k_max, k_score)

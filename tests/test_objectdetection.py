@@ -58,6 +58,93 @@ class TestNMS:
         kept = np.asarray(idx)[np.asarray(valid)]
         assert list(kept) == [0]
 
+    def test_multiclass_keeps_cross_class_overlaps(self):
+        """The torchvision-semantics case best-class NMS gets wrong:
+        two heavily overlapping boxes of DIFFERENT classes must both
+        survive per-class NMS."""
+        from analytics_zoo_tpu.models.image.objectdetection.nms import (
+            multiclass_nms)
+        boxes = jnp.array([[0, 0, 1, 1],
+                           [0.02, 0.02, 1.02, 1.02],    # same spot
+                           [2, 2, 3, 3]], jnp.float32)
+        # class 1 strong on box 0, class 2 strong on box 1 (same spot)
+        probs = jnp.array([[0.05, 0.90, 0.05],
+                           [0.05, 0.05, 0.90],
+                           [0.10, 0.85, 0.05]], jnp.float32)
+        ob, os_, ol, ov = multiclass_nms(boxes, probs,
+                                         iou_threshold=0.5,
+                                         score_threshold=0.01,
+                                         max_detections=4)
+        kept = [(int(l), round(float(s), 2))
+                for l, s, v in zip(ol, os_, ov) if v]
+        # both co-located detections survive (different classes) plus
+        # the distant class-1 box
+        assert (1, 0.9) in kept and (2, 0.9) in kept \
+            and (1, 0.85) in kept, kept
+        # whereas best-class NMS suppresses one of the co-located pair
+        score = jnp.max(probs[:, 1:], axis=-1)
+        idx, valid = nms(boxes, score, 0.5, 3, 0.01)
+        assert np.asarray(valid).sum() == 2
+
+    def test_multiclass_pads_small_candidate_pools(self):
+        """A binary detector / tiny prior set whose candidate pool is
+        smaller than max_detections must pad, not crash top_k."""
+        from analytics_zoo_tpu.models.image.objectdetection.nms import (
+            multiclass_nms)
+        boxes = jnp.array([[0, 0, 1, 1], [2, 2, 3, 3]], jnp.float32)
+        probs = jnp.array([[0.2, 0.8], [0.7, 0.3]], jnp.float32)
+        ob, os_, ol, ov = multiclass_nms(boxes, probs,
+                                         score_threshold=0.25,
+                                         max_detections=100)
+        assert ob.shape == (100, 4) and ov.shape == (100,)
+        kept = [(int(l), round(float(s), 2))
+                for l, s, v in zip(ol, os_, ov) if v]
+        assert kept == [(1, 0.8), (1, 0.3)]
+
+    def test_multiclass_matches_numpy_oracle(self):
+        """Random boxes/scores: jitted multiclass_nms == a
+        straight-line numpy implementation of per-class greedy NMS +
+        global top-k (torchvision postprocess semantics)."""
+        from analytics_zoo_tpu.models.image.objectdetection.bbox import (
+            iou_matrix)
+        from analytics_zoo_tpu.models.image.objectdetection.nms import (
+            multiclass_nms)
+        rs = np.random.RandomState(3)
+        n, c = 40, 5
+        centers = rs.rand(n, 2) * 4
+        wh = rs.rand(n, 2) * 1.5 + 0.2
+        boxes = np.concatenate([centers - wh / 2, centers + wh / 2],
+                               1).astype(np.float32)
+        logits = rs.randn(n, c).astype(np.float32)
+        probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+
+        iou_t, score_t, max_det = 0.45, 0.05, 12
+        iou = np.asarray(iou_matrix(jnp.asarray(boxes),
+                                    jnp.asarray(boxes)))
+        want = []
+        for cls in range(1, c):
+            s = probs[:, cls].copy()
+            alive = s > score_t
+            while alive.any():
+                b = int(np.where(alive, s, -np.inf).argmax())
+                if not alive[b]:
+                    break
+                want.append((cls, float(s[b]), b))
+                alive &= ~(iou[b] >= iou_t)
+                alive[b] = False
+        want.sort(key=lambda t: -t[1])
+        want = want[:max_det]
+
+        ob, os_, ol, ov = jax.jit(
+            lambda b, p: multiclass_nms(b, p, iou_t, score_t,
+                                        topk_per_class=n,
+                                        max_detections=max_det))(
+            jnp.asarray(boxes), jnp.asarray(probs))
+        got = [(int(l), round(float(s), 5))
+               for l, s, v in zip(ol, os_, ov) if v]
+        want_ls = [(cls, round(s, 5)) for cls, s, _ in want]
+        assert got == want_ls, (got, want_ls)
+
 
 class TestMatching:
     def test_forced_match_and_threshold(self):
