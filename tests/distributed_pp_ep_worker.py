@@ -170,6 +170,51 @@ def run_ep(out, mesh_lib):
     out["ep_ref_losses"] = np.asarray(ref_losses, np.float32)
 
 
+def run_sp(out, mesh_lib):
+    """Sequence parallelism: ring attention with the seq axis ACROSS
+    processes — every ring step's ppermute moves K/V blocks over gloo.
+    Loss and q/k/v grads must match dense attention computed locally."""
+    from analytics_zoo_tpu.ops.attention import (
+        scaled_dot_product_attention)
+    from analytics_zoo_tpu.parallel.ring_attention import ring_attention
+
+    mesh = mesh_lib.create_mesh({"seq": 2, "data": 4})
+    rs = np.random.RandomState(21)
+    b, h, t, d = 2, 3, 16, 8
+    q, k, v = (rs.randn(b, h, t, d).astype(np.float32)
+               for _ in range(3))
+    spec = P(None, None, "seq", None)
+    qd, kd, vd = (_put(a, mesh, spec) for a in (q, k, v))
+
+    def loss_fn(qq, kk, vv):
+        out_ = ring_attention(qq, kk, vv, mesh, causal=True)
+        return jnp.mean(out_ ** 2)
+
+    loss, grads = jax.jit(jax.value_and_grad(
+        loss_fn, argnums=(0, 1, 2)))(qd, kd, vd)
+    loss = float(loss)
+
+    def ref_loss_fn(qq, kk, vv):
+        return jnp.mean(scaled_dot_product_attention(
+            qq, kk, vv, causal=True) ** 2)
+
+    ref_loss, ref_grads = jax.value_and_grad(
+        ref_loss_fn, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    assert abs(loss - float(ref_loss)) < 1e-5, (loss, float(ref_loss))
+
+    # each grad is seq-sharded: this process's shard must equal the
+    # dense-attention grad's same global slice
+    for name, g, ref in zip("qkv", grads, ref_grads):
+        shard = g.addressable_shards[0]
+        local = np.asarray(shard.data)
+        want = np.asarray(ref)[tuple(shard.index)]
+        np.testing.assert_allclose(local, want, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"sp grad d{name}")
+    out["sp_loss"] = np.float32(loss)
+    out["sp_ref_loss"] = np.float32(float(ref_loss))
+
+
 def run_put_epoch_guard(out):
     """Multi-host put_epoch_source with non-tiling rows must refuse
     loudly (round-4 weak spot: docstring-only constraint)."""
@@ -221,6 +266,7 @@ def main():
     out = {}
     run_pp(out, mesh_lib)
     run_ep(out, mesh_lib)
+    run_sp(out, mesh_lib)
     run_put_epoch_guard(out)
     np.savez(os.path.join(out_dir, f"worker{pid}.npz"), **out)
     return 0

@@ -58,6 +58,13 @@ def test_two_process_pipeline_and_expert_parallel(tmp_path):
     # training moved: the trajectory is strictly decreasing overall
     assert w0["ep_losses"][-1] < w0["ep_losses"][0]
 
+    # sp: ring attention with the seq axis across processes — loss
+    # matches dense attention, identically on both hosts (each worker
+    # also verified its grad shards against the dense oracle)
+    assert w0["sp_loss"] == w1["sp_loss"]
+    np.testing.assert_allclose(w0["sp_loss"], w0["sp_ref_loss"],
+                               rtol=1e-5, atol=1e-6)
+
     # the multi-host put_epoch_source tiling guard fired on both hosts
     assert int(w0["guard_raised"]) == 1
     assert int(w1["guard_raised"]) == 1
