@@ -24,6 +24,10 @@ from analytics_zoo_tpu.models.image.objectdetection.pretrained import (
     load_object_detector, load_torch_ssd300, ssd300_vgg16,
     tv_default_boxes,
 )
+from analytics_zoo_tpu.models.image.objectdetection.pretrained_ssdlite import (
+    load_torch_ssdlite320, ssdlite320_mobilenet_v3,
+    ssdlite_default_boxes,
+)
 
 __all__ = [
     "decode_boxes", "encode_boxes", "iou_matrix", "nms", "ssd_priors",
@@ -32,5 +36,6 @@ __all__ = [
     "ssd_vgg300", "MeanAveragePrecision", "ObjectDetector",
     "COCO_91_LABELS", "coco_label_map", "detection_configure",
     "load_object_detector", "load_torch_ssd300", "ssd300_vgg16",
-    "tv_default_boxes",
+    "tv_default_boxes", "load_torch_ssdlite320",
+    "ssdlite320_mobilenet_v3", "ssdlite_default_boxes",
 ]

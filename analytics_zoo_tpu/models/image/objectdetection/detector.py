@@ -33,10 +33,20 @@ def _tv_ssd300_vgg16(num_classes: int = 91):
     return ssd300_vgg16(num_classes=num_classes)
 
 
+def _tv_ssdlite320(num_classes: int = 91):
+    from analytics_zoo_tpu.models.image.objectdetection \
+        .pretrained_ssdlite import ssdlite320_mobilenet_v3
+    model, priors, _name_map = ssdlite320_mobilenet_v3(
+        num_classes=num_classes)
+    return model, priors
+
+
 _ARCHS = {"ssd_lite": ssd_lite, "ssd_vgg300": ssd_vgg300,
-          "ssd300_vgg16": _tv_ssd300_vgg16}
-# architectures whose input size is baked in at 300x300
-_FIXED_300 = ("ssd_vgg300", "ssd300_vgg16")
+          "ssd300_vgg16": _tv_ssd300_vgg16,
+          "ssdlite320_mobilenet_v3": _tv_ssdlite320}
+# architectures whose input size is baked into the graph
+_FIXED_SIZE = {"ssd_vgg300": 300, "ssd300_vgg16": 300,
+               "ssdlite320_mobilenet_v3": 320}
 
 
 class ObjectDetector(ImageModel):
@@ -69,8 +79,8 @@ class ObjectDetector(ImageModel):
 
     # ------------------------------------------------------------ building
     def build_model(self):
-        if self.model_type in _FIXED_300:     # fixed 300x300 input
-            self.image_size = 300
+        if self.model_type in _FIXED_SIZE:    # input size baked in
+            self.image_size = _FIXED_SIZE[self.model_type]
             model, self.priors = _ARCHS[self.model_type](
                 num_classes=self.num_classes)
         else:

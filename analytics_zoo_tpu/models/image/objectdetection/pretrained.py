@@ -201,6 +201,38 @@ _TV_SSD300_SLOTS: Dict[str, str] = {
 }
 
 
+def install_by_name(model: Model, state_dict, name_map: Dict[str, str],
+                    bn_eps: float = 1e-5) -> None:
+    """Install a torch ``state_dict`` through an explicit layer-name →
+    checkpoint-module-prefix table.
+
+    The functional graph's topological layer order interleaves heads
+    with backbone stages, so POSITIONAL mapping (the classification
+    importer's contract) would be silently wrong here; name mapping
+    raises with the offender named on any mismatch instead."""
+    groups = _torch_groups(state_dict, bn_eps=bn_eps)
+    by_prefix = {g["__name__"]: (kind, g) for kind, g in groups}
+    slots = _model_slots(model)
+    ordered = []
+    for kind, layer in slots:
+        prefix = name_map.get(layer.name)
+        if prefix is None:
+            raise ValueError(
+                f"model layer {layer.name!r} has no checkpoint mapping "
+                "— was this model built by the matching builder?")
+        entry = by_prefix.pop(prefix, None)
+        if entry is None:
+            raise ValueError(
+                f"checkpoint module {prefix!r} (for layer "
+                f"{layer.name!r}) missing from the state_dict")
+        ordered.append(entry)
+    if by_prefix:
+        raise ValueError(
+            "checkpoint modules with no model layer: "
+            f"{sorted(by_prefix)}")
+    _install(model, ordered)
+
+
 def load_torch_ssd300(model: Model, state_dict) -> None:
     """Import a torchvision ``ssd300_vgg16`` state_dict into a
     ``ssd300_vgg16()`` model in place.
@@ -223,27 +255,7 @@ def load_torch_ssd300(model: Model, state_dict) -> None:
         scale = scale.detach().cpu().numpy()
     scale = np.asarray(scale)
 
-    groups = _torch_groups(sd)
-    by_prefix = {g["__name__"]: (kind, g) for kind, g in groups}
-    slots = _model_slots(model)
-    ordered = []
-    for kind, layer in slots:
-        prefix = _TV_SSD300_SLOTS.get(layer.name)
-        if prefix is None:
-            raise ValueError(
-                f"model layer {layer.name!r} has no checkpoint mapping "
-                "— is this model from ssd300_vgg16()?")
-        entry = by_prefix.pop(prefix, None)
-        if entry is None:
-            raise ValueError(
-                f"checkpoint module {prefix!r} (for layer "
-                f"{layer.name!r}) missing from the state_dict")
-        ordered.append(entry)
-    if by_prefix:
-        raise ValueError(
-            "checkpoint modules with no model layer: "
-            f"{sorted(by_prefix)}")
-    _install(model, ordered)
+    install_by_name(model, sd, _TV_SSD300_SLOTS)
 
     variables = model.get_variables()
     cur = variables["params"][_NORM_LAYER_NAME]["scale"]
@@ -308,19 +320,28 @@ def load_object_detector(name: str = "ssd300-vgg16-coco",
     of different classes."""
     from analytics_zoo_tpu.models.image.objectdetection.detector import (
         ObjectDetector)
-    if name != "ssd300-vgg16-coco":
+    names = {
+        # name -> (model_type, input size, published .pth file)
+        "ssd300-vgg16-coco": (
+            "ssd300_vgg16", 300, "ssd300_vgg16_coco-b556d3b4.pth"),
+        "ssdlite320-mobilenet-v3-coco": (
+            "ssdlite320_mobilenet_v3", 320,
+            "ssdlite320_mobilenet_v3_large_coco-a79551df.pth"),
+    }
+    if name not in names:
         raise ValueError(
             f"unknown pretrained detector {name!r} "
-            "(have: ssd300-vgg16-coco)")
+            f"(have: {', '.join(sorted(names))})")
+    model_type, size, pth = names[name]
     if checkpoint is None:
         raise ValueError(
-            "checkpoint required: pass a torchvision ssd300_vgg16 "
-            "state_dict or a .pth path (e.g. "
-            "ssd300_vgg16_coco-b556d3b4.pth from the torchvision "
-            "model zoo; this environment cannot download it)")
+            f"checkpoint required: pass a torchvision {model_type} "
+            f"state_dict or a .pth path (e.g. {pth} from the "
+            "torchvision model zoo; this environment cannot "
+            "download it)")
     det = ObjectDetector(
-        model_type="ssd300_vgg16", num_classes=len(COCO_91_LABELS),
-        image_size=300, score_threshold=score_threshold,
+        model_type=model_type, num_classes=len(COCO_91_LABELS),
+        image_size=size, score_threshold=score_threshold,
         iou_threshold=iou_threshold, max_detections=max_detections,
         per_class_nms=per_class_nms, topk_per_class=topk_per_class,
         label_map=coco_label_map())
@@ -329,7 +350,13 @@ def load_object_detector(name: str = "ssd300-vgg16-coco",
         import torch
         checkpoint = torch.load(checkpoint, map_location="cpu",
                                 weights_only=True)
-    load_torch_ssd300(det.model, checkpoint)
+    if model_type == "ssd300_vgg16":
+        load_torch_ssd300(det.model, checkpoint)
+    else:
+        from analytics_zoo_tpu.models.image.objectdetection \
+            .pretrained_ssdlite import load_torch_ssdlite320
+        # name_map defaults to the map the builder stamped on the model
+        load_torch_ssdlite320(det.model, checkpoint)
     cfg = detection_configure(name)
     det.config = ImageConfigure(
         preprocessor=cfg.preprocessor,
@@ -347,11 +374,16 @@ def detection_configure(model_name: str = "ssd300-vgg16-coco"
     torchvision's SSD transform resizes to a fixed 300x300 and
     normalizes with mean [0.48235, 0.45882, 0.40784], std 1/255 —
     in the 0-255 domain that is mean subtraction only (the classic
-    Caffe-lineage VGG means, RGB order)."""
+    Caffe-lineage VGG means, RGB order); ssdlite normalizes 0-255 to
+    [-1, 1] at 320x320 (see pretrained_ssdlite.ssdlite_configure)."""
+    if model_name == "ssdlite320-mobilenet-v3-coco":
+        from analytics_zoo_tpu.models.image.objectdetection \
+            .pretrained_ssdlite import ssdlite_configure
+        return ssdlite_configure()
     if model_name not in ("ssd300-vgg16-coco",):
         raise ValueError(
             f"unknown pretrained detector {model_name!r} "
-            "(have: ssd300-vgg16-coco)")
+            "(have: ssd300-vgg16-coco, ssdlite320-mobilenet-v3-coco)")
     return ImageConfigure(
         preprocessor=ChainedPreprocessing([
             ImageResize(300, 300),

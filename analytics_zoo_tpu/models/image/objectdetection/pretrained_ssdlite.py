@@ -1,0 +1,278 @@
+"""Pretrained SSDLite-320 / MobileNetV3-Large import.
+
+The reference's detector zoo names ``ssd-mobilenet-300x300``
+(ObjectDetectionConfig.scala:31-74) — the published descendant of that
+recipe is torchvision's ``ssdlite320_mobilenet_v3_large`` COCO
+checkpoint, imported here with the same playbook as the SSD300-VGG16
+import (pretrained.py): the builder reproduces the SOURCE architecture
+exactly so the weights are numerically faithful, and the import maps
+checkpoint modules to layers BY NAME with loud mismatch errors.
+
+Architecture notes (torchvision ssdlite.py + mobilenetv3.py):
+
+* MobileNetV3-Large backbone with the REDUCED tail (the detection
+  builder constructs it with ``reduced_tail=True``: the last three
+  blocks halve to 80/480 channels) and detection BatchNorm
+  (eps=1e-3 — our layer default).
+* The C4 feature taps the EXPANSION conv inside block 13 (672 ch @
+  20x20, MobileNetV3 paper §6.3); C5 is the 480-ch last conv @ 10x10.
+* Four SSDLite extra blocks (1x1 → stride-2 depthwise 3x3 → 1x1, all
+  Conv+BN+ReLU6) give 512@5, 256@3, 256@2, 128@1.
+* Heads are SSDLite heads: depthwise 3x3 Conv+BN+ReLU6 then a biased
+  1x1, 6 anchors per cell at every scale.
+* Anchors: DefaultBoxGenerator(aspect_ratios=[[2,3]]*6) with scales
+  derived from min_ratio=0.2 / max_ratio=0.95 and grid-normalized
+  shifts (steps=None).
+* Every stride-2 conv uses explicit torch-aligned padding
+  (ZeroPadding2D + valid): XLA's SAME pads asymmetrically on even
+  inputs, which would silently sample different pixels.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+from typing import Dict, Tuple
+
+import numpy as np
+
+from analytics_zoo_tpu.feature.common import ChainedPreprocessing
+from analytics_zoo_tpu.feature.image import (
+    ImageChannelNormalize, ImageResize)
+from analytics_zoo_tpu.models.image.common import ImageConfigure
+from analytics_zoo_tpu.pipeline.api.keras import Input, Model
+from analytics_zoo_tpu.pipeline.api.keras.layers import (
+    Activation, BatchNormalization, Convolution2D,
+    GlobalAveragePooling2D, Lambda, Merge, Reshape, ZeroPadding2D,
+)
+
+# MobileNetV3-Large inverted-residual plan, REDUCED tail (the
+# detection builder's reduced_tail=True halves the last stage):
+# (kernel, expanded, out, use_se, activation, stride)
+_MBV3_LARGE_REDUCED = (
+    (3, 16, 16, False, "relu", 1),
+    (3, 64, 24, False, "relu", 2),      # C1
+    (3, 72, 24, False, "relu", 1),
+    (5, 72, 40, True, "relu", 2),       # C2
+    (5, 120, 40, True, "relu", 1),
+    (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hard_swish", 2),   # C3
+    (3, 200, 80, False, "hard_swish", 1),
+    (3, 184, 80, False, "hard_swish", 1),
+    (3, 184, 80, False, "hard_swish", 1),
+    (3, 480, 112, True, "hard_swish", 1),
+    (3, 672, 112, True, "hard_swish", 1),
+    (5, 672, 80, True, "hard_swish", 2),    # C4 (reduced: 160//2)
+    (5, 480, 80, True, "hard_swish", 1),
+    (5, 480, 80, True, "hard_swish", 1),
+)
+_C4_BLOCK = 12            # index into the plan above (0-based)
+_LAST_CONV = 480          # 6 * 80 (reduced tail)
+
+_SSDLITE_FMAPS = (20, 10, 5, 3, 2, 1)
+_SSDLITE_CHANNELS = (672, 480, 512, 256, 256, 128)
+_SSDLITE_ANCHORS = 6      # 2 + 2*len([2, 3]) per cell, every scale
+
+
+def _make_divisible(v, divisor: int = 8):
+    """torchvision _make_divisible (channel rounding)."""
+    new_v = max(divisor, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+def ssdlite320_mobilenet_v3(num_classes: int = 91
+                            ) -> Tuple[Model, np.ndarray, Dict[str, str]]:
+    """Build the torchvision-exact SSDLite320 graph (NHWC).
+
+    Returns (model, priors, name_map) — ``name_map`` maps each
+    weight-bearing layer name to its checkpoint module prefix and is
+    what ``load_torch_ssdlite320`` installs through."""
+    slots: Dict[str, str] = {}
+    ctr = itertools.count()
+
+    def cna(x, f, k, prefix, stride=1, groups=1, act="hard_swish"):
+        """Conv2dNormActivation: conv(bias=False) + BN(eps 1e-3) +
+        activation, torch-aligned padding under stride 2."""
+        name = f"sl{next(ctr):03d}"
+        border = "same"
+        if stride > 1 and k > 1:
+            p = (k - 1) // 2
+            x = ZeroPadding2D((p, p), name=name + "_pad")(x)
+            border = "valid"
+        x = Convolution2D(f, k, k, subsample=(stride, stride),
+                          border_mode=border, bias=False, groups=groups,
+                          name=name)(x)
+        slots[name] = prefix + ".0"
+        x = BatchNormalization(name=name + "_bn")(x)   # eps 1e-3 default
+        slots[name + "_bn"] = prefix + ".1"
+        if act:
+            x = Activation(act, name=name + "_act")(x)
+        return x
+
+    def se_block(x, channels, prefix):
+        """SqueezeExcitation: gap → fc1(relu) → fc2(hardsigmoid) →
+        channel scale.  fc1/fc2 are biased 1x1 convs in the
+        checkpoint."""
+        name = f"sl{next(ctr):03d}"
+        sq = _make_divisible(channels // 4)
+        s = GlobalAveragePooling2D(name=name + "_gap")(x)
+        s = Reshape((1, 1, channels), name=name + "_rs")(s)
+        s = Convolution2D(sq, 1, 1, bias=True, activation="relu",
+                          name=name + "_fc1")(s)
+        slots[name + "_fc1"] = prefix + ".fc1"
+        s = Convolution2D(channels, 1, 1, bias=True,
+                          activation="hard_sigmoid_torch",
+                          name=name + "_fc2")(s)
+        slots[name + "_fc2"] = prefix + ".fc2"
+        return Merge(mode="mul", name=name + "_scale")([x, s])
+
+    def inverted_residual(x, in_ch, cfg, prefix):
+        """torchvision InvertedResidual: [expand] → depthwise → [SE] →
+        project, residual when stride 1 and in == out."""
+        k, exp, out, use_se, act, stride = cfg
+        h = x
+        j = 0
+        if exp != in_ch:
+            h = cna(h, exp, 1, f"{prefix}.block.{j}", act=act)
+            j += 1
+        h = cna(h, exp, k, f"{prefix}.block.{j}", stride=stride,
+                groups=exp, act=act)
+        j += 1
+        if use_se:
+            h = se_block(h, exp, f"{prefix}.block.{j}")
+            j += 1
+        h = cna(h, out, 1, f"{prefix}.block.{j}", act=None)
+        if stride == 1 and in_ch == out:
+            h = Merge(mode="sum")([h, x])
+        return h
+
+    inp = Input(shape=(320, 320, 3), name="ssdlite_input")
+    # ---- features.0: stem + blocks 0..11 + block 12's EXPAND conv
+    x = cna(inp, 16, 3, "backbone.features.0.0", stride=2)   # 160
+    in_ch = 16
+    for i, cfg in enumerate(_MBV3_LARGE_REDUCED[:_C4_BLOCK]):
+        x = inverted_residual(x, in_ch, cfg,
+                              f"backbone.features.0.{i + 1}")
+        in_ch = cfg[2]
+    # block 12 split at its expansion (the C4 tap, paper §6.3): the
+    # expand conv is features.0's LAST member…
+    k, exp, out, use_se, act, stride = _MBV3_LARGE_REDUCED[_C4_BLOCK]
+    c4 = cna(x, exp, 1, f"backbone.features.0.{_C4_BLOCK + 1}",
+             act=act)                                         # 672@20
+    # …and the rest of block 12 opens features.1 as ONE nested module:
+    # torchvision slices the block (``backbone[c4_pos].block[1:]``)
+    # and nn.Sequential slicing PRESERVES child names — so the
+    # depthwise/SE/project live at features.1.0.{1,2,3}, not
+    # re-indexed from 0
+    h = cna(c4, exp, k, "backbone.features.1.0.1", stride=stride,
+            groups=exp, act=act)                              # 10x10
+    h = se_block(h, exp, "backbone.features.1.0.2")
+    h = cna(h, out, 1, "backbone.features.1.0.3", act=None)
+    in_ch = out
+    for i, cfg in enumerate(_MBV3_LARGE_REDUCED[_C4_BLOCK + 1:]):
+        h = inverted_residual(h, in_ch, cfg,
+                              f"backbone.features.1.{i + 1}")
+        in_ch = cfg[2]
+    c5 = cna(h, _LAST_CONV, 1,
+             f"backbone.features.1.{len(_MBV3_LARGE_REDUCED) - _C4_BLOCK}")
+
+    # ---- SSDLite extras: 1x1 → s2 depthwise → 1x1 (all +BN+ReLU6)
+    feats = [c4, c5]
+    for i, out_ch in enumerate(_SSDLITE_CHANNELS[2:]):
+        mid = out_ch // 2
+        e = cna(feats[-1], mid, 1, f"backbone.extra.{i}.0", act="relu6")
+        e = cna(e, mid, 3, f"backbone.extra.{i}.1", stride=2,
+                groups=mid, act="relu6")
+        e = cna(e, out_ch, 1, f"backbone.extra.{i}.2", act="relu6")
+        feats.append(e)
+
+    # ---- SSDLite heads: dw 3x3 (+BN+ReLU6) then biased 1x1; channel
+    # blocks anchor-major so the channels-last reshape to (B, HWA, K)
+    # reproduces torchvision's view/permute ordering
+    locs, confs = [], []
+    for i, (f, ch) in enumerate(zip(feats, _SSDLITE_CHANNELS)):
+        for head, k_cols, coll in (
+                ("classification_head", num_classes, confs),
+                ("regression_head", 4, locs)):
+            prefix = f"head.{head}.module_list.{i}"
+            y = cna(f, ch, 3, f"{prefix}.0", groups=ch, act="relu6")
+            name = f"sl{next(ctr):03d}"
+            y = Convolution2D(_SSDLITE_ANCHORS * k_cols, 1, 1,
+                              bias=True, name=name)(y)
+            slots[name] = f"{prefix}.1"
+            coll.append(Lambda(
+                lambda t, c=k_cols: t.reshape(t.shape[0], -1, c),
+                name=name + "_flat")(y))
+    loc = Merge(mode="concat", concat_axis=1, name="ssdlite_loc")(locs)
+    conf = Merge(mode="concat", concat_axis=1,
+                 name="ssdlite_conf")(confs)
+    model = Model(inp, [loc, conf])
+    # the map rides on the model so load-by-name callers that only
+    # hold the built model can still import by name
+    model._ssdlite_name_map = dict(slots)
+    return model, ssdlite_default_boxes(), slots
+
+
+def ssdlite_default_boxes() -> np.ndarray:
+    """torchvision DefaultBoxGenerator for ssdlite320: aspect ratios
+    [2, 3] at every scale, scales from min_ratio 0.2 / max_ratio 0.95
+    (+1.0 for the geometric mean at the last level), steps=None so
+    shifts normalize by the grid size.  Corner form for
+    ``decode_boxes`` (variances 0.1/0.2 == BoxCoder 10,10,5,5)."""
+    n = len(_SSDLITE_FMAPS)
+    scales = [0.2 + (0.95 - 0.2) * k / (n - 1.0) for k in range(n)]
+    scales.append(1.0)
+    out = []
+    for k, fk in enumerate(_SSDLITE_FMAPS):
+        s_k = scales[k]
+        s_pk = math.sqrt(s_k * scales[k + 1])
+        wh = [[s_k, s_k], [s_pk, s_pk]]
+        for ar in (2.0, 3.0):
+            sq = math.sqrt(ar)
+            wh.append([s_k * sq, s_k / sq])
+            wh.append([s_k / sq, s_k * sq])
+        wh = np.clip(np.asarray(wh, np.float32), 0.0, 1.0)
+        shifts = (np.arange(fk, dtype=np.float32) + 0.5) / fk
+        sy, sx = np.meshgrid(shifts, shifts, indexing="ij")
+        centers = np.stack([sx.reshape(-1), sy.reshape(-1)], -1)
+        cxcy = np.repeat(centers, len(wh), axis=0)
+        whs = np.tile(wh, (fk * fk, 1))
+        out.append(np.concatenate(
+            [cxcy - whs / 2, cxcy + whs / 2], axis=1))
+    return np.concatenate(out, axis=0)
+
+
+def load_torch_ssdlite320(model: Model, state_dict,
+                          name_map: Dict[str, str] = None) -> None:
+    """Import a torchvision ``ssdlite320_mobilenet_v3_large``
+    state_dict into a ``ssdlite320_mobilenet_v3()`` model in place
+    (name-mapped; loud on any mismatch).  ``name_map`` defaults to the
+    map the builder stamped on the model.  All BNs carry the detection
+    norm-layer epsilon 1e-3 — same as the layers' default, so the eps
+    fold is the identity."""
+    from analytics_zoo_tpu.models.image.objectdetection.pretrained \
+        import install_by_name
+    if name_map is None:
+        name_map = getattr(model, "_ssdlite_name_map", None)
+        if name_map is None:
+            raise ValueError(
+                "no name_map given and the model carries none — was "
+                "it built by ssdlite320_mobilenet_v3()?")
+    inner = state_dict.get("state_dict") \
+        if isinstance(state_dict, dict) else None
+    if isinstance(inner, dict):
+        state_dict = inner
+    install_by_name(model, dict(state_dict), name_map, bn_eps=1e-3)
+
+
+def ssdlite_configure() -> ImageConfigure:
+    """torchvision ssdlite transform: fixed 320x320 resize,
+    mean/std 0.5 — in the 0-255 domain, x/127.5 - 1."""
+    return ImageConfigure(
+        preprocessor=ChainedPreprocessing([
+            ImageResize(320, 320),
+            ImageChannelNormalize(127.5, 127.5, 127.5,
+                                  127.5, 127.5, 127.5)]),
+        batch_per_partition=2)

@@ -38,6 +38,17 @@ def hard_sigmoid(x):
     return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
 
 
+def hard_sigmoid_torch(x):
+    # torch nn.Hardsigmoid: relu6(x + 3) / 6 — DIFFERENT slope from
+    # the Keras-1 hard_sigmoid above; MobileNetV3 lineage uses this
+    return jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+def hard_swish(x):
+    # torch nn.Hardswish: x * relu6(x + 3) / 6 (MobileNetV3)
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
 def softmax(x):
     return jax.nn.softmax(x, axis=-1)
 
@@ -87,6 +98,9 @@ _REGISTRY = {
     "tanh": tanh,
     "sigmoid": sigmoid,
     "hard_sigmoid": hard_sigmoid,
+    "hard_sigmoid_torch": hard_sigmoid_torch,
+    "hard_swish": hard_swish,
+    "hardswish": hard_swish,
     "softmax": softmax,
     "log_softmax": log_softmax,
     "softplus": softplus,

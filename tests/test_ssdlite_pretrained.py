@@ -1,0 +1,289 @@
+"""Golden tests for the pretrained SSDLite320-MobileNetV3 import
+(objectdetection/pretrained_ssdlite.py).
+
+Oracle: a hand-built torch ``nn`` model with torchvision's exact
+module structure and state_dict key layout for
+``ssdlite320_mobilenet_v3_large`` (torchvision itself is not
+installed), randomly initialised INCLUDING BatchNorm running stats.
+Head outputs must agree end-to-end — 168 weight modules through
+inverted residuals, squeeze-excitation, hardswish and the SSDLite
+extras/heads.
+
+Ref: ObjectDetectionConfig.scala:31-74 (``ssd-mobilenet-300x300``).
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+torch = pytest.importorskip("torch")
+nn = torch.nn
+
+from analytics_zoo_tpu.models.image.objectdetection.pretrained_ssdlite \
+    import (  # noqa: E402
+        _MBV3_LARGE_REDUCED, _make_divisible, load_torch_ssdlite320,
+        ssdlite320_mobilenet_v3, ssdlite_configure,
+        ssdlite_default_boxes)
+
+_BN = lambda c: nn.BatchNorm2d(c, eps=0.001, momentum=0.03)
+
+
+def _cna(cin, cout, k, stride=1, groups=1, act=nn.Hardswish):
+    layers = [nn.Conv2d(cin, cout, k, stride, (k - 1) // 2,
+                        groups=groups, bias=False), _BN(cout)]
+    if act is not None:
+        layers.append(act())
+    return nn.Sequential(*layers)
+
+
+class _SE(nn.Module):
+    def __init__(self, channels):
+        super().__init__()
+        sq = _make_divisible(channels // 4)
+        self.avgpool = nn.AdaptiveAvgPool2d(1)
+        self.fc1 = nn.Conv2d(channels, sq, 1)
+        self.fc2 = nn.Conv2d(sq, channels, 1)
+        self.activation = nn.ReLU()
+        self.scale_activation = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.avgpool(x)
+        s = self.activation(self.fc1(s))
+        return x * self.scale_activation(self.fc2(s))
+
+
+class _InvRes(nn.Module):
+    def __init__(self, cin, cfg):
+        super().__init__()
+        k, exp, out, use_se, act, stride = cfg
+        a = nn.Hardswish if act == "hard_swish" else nn.ReLU
+        layers = []
+        if exp != cin:
+            layers.append(_cna(cin, exp, 1, act=a))
+        layers.append(_cna(exp, exp, k, stride=stride, groups=exp,
+                           act=a))
+        if use_se:
+            layers.append(_SE(exp))
+        layers.append(_cna(exp, out, 1, act=None))
+        self.block = nn.Sequential(*layers)
+        self.use_res = stride == 1 and cin == out
+
+    def forward(self, x):
+        y = self.block(x)
+        return x + y if self.use_res else y
+
+
+class _TVBackboneLite(nn.Module):
+    """SSDLiteFeatureExtractorMobileNet: features = (through the C4
+    expansion conv, the rest), then the 4 extra blocks."""
+
+    def __init__(self):
+        super().__init__()
+        c4 = 12
+        first = [_cna(3, 16, 3, stride=2)]
+        cin = 16
+        for cfg in _MBV3_LARGE_REDUCED[:c4]:
+            first.append(_InvRes(cin, cfg))
+            cin = cfg[2]
+        k, exp, out, use_se, act, stride = _MBV3_LARGE_REDUCED[c4]
+        first.append(_cna(cin, exp, 1))               # C4 expand
+        # torchvision: features.1[0] = backbone[c4].block[1:], a
+        # SLICED Sequential whose children keep their original names
+        # ("1", "2", "3") — reproduce that exactly so state_dict keys
+        # match the published checkpoint layout
+        import collections
+        sliced = nn.Sequential(collections.OrderedDict([
+            ("1", _cna(exp, exp, k, stride=stride, groups=exp)),
+            ("2", _SE(exp)),
+            ("3", _cna(exp, out, 1, act=None)),
+        ]))
+        second = [sliced]
+        cin = out
+        for cfg in _MBV3_LARGE_REDUCED[c4 + 1:]:
+            second.append(_InvRes(cin, cfg))
+            cin = cfg[2]
+        second.append(_cna(cin, 480, 1))              # last conv
+        self.features = nn.Sequential(nn.Sequential(*first),
+                                      nn.Sequential(*second))
+
+        def extra_block(cin, cout):
+            mid = cout // 2
+            return nn.Sequential(
+                _cna(cin, mid, 1, act=nn.ReLU6),
+                _cna(mid, mid, 3, stride=2, groups=mid, act=nn.ReLU6),
+                _cna(mid, cout, 1, act=nn.ReLU6))
+
+        self.extra = nn.ModuleList([
+            extra_block(480, 512), extra_block(512, 256),
+            extra_block(256, 256), extra_block(256, 128)])
+
+    def forward(self, x):
+        c4 = self.features[0](x)
+        out = [c4, self.features[1](c4)]
+        for block in self.extra:
+            out.append(block(out[-1]))
+        return out
+
+
+class _TVLiteScoringHead(nn.Module):
+    def __init__(self, in_channels, num_anchors, num_columns):
+        super().__init__()
+        self.module_list = nn.ModuleList([
+            nn.Sequential(_cna(c, c, 3, groups=c, act=nn.ReLU6),
+                          nn.Conv2d(c, num_anchors * num_columns, 1))
+            for c in in_channels])
+        self.num_columns = num_columns
+
+    def forward(self, feats):
+        outs = []
+        for conv, f in zip(self.module_list, feats):
+            r = conv(f)
+            n, _, h, w = r.shape
+            r = r.view(n, -1, self.num_columns, h, w)
+            r = r.permute(0, 3, 4, 1, 2)
+            outs.append(r.reshape(n, -1, self.num_columns))
+        return torch.cat(outs, dim=1)
+
+
+class _TVSSDLite(nn.Module):
+    def __init__(self, num_classes):
+        super().__init__()
+        self.backbone = _TVBackboneLite()
+        chans = [672, 480, 512, 256, 256, 128]
+
+        class Head(nn.Module):
+            def __init__(self):
+                super().__init__()
+                self.classification_head = _TVLiteScoringHead(
+                    chans, 6, num_classes)
+                self.regression_head = _TVLiteScoringHead(chans, 6, 4)
+        self.head = Head()
+
+    def forward(self, x):
+        feats = self.backbone(x)
+        return (self.head.classification_head(feats),
+                self.head.regression_head(feats))
+
+
+def _rand_init(module, seed):
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for p in module.parameters():
+            p.copy_(torch.randn(p.shape, generator=g) * 0.05)
+        for m in module.modules():
+            if isinstance(m, nn.BatchNorm2d):
+                m.running_mean.copy_(
+                    torch.randn(m.running_mean.shape, generator=g)
+                    * 0.05)
+                m.running_var.copy_(
+                    torch.rand(m.running_var.shape, generator=g)
+                    * 0.5 + 0.75)
+                m.weight.copy_(torch.rand(m.weight.shape,
+                                          generator=g) * 0.5 + 0.75)
+
+
+def test_torch_sequential_slicing_preserves_child_names():
+    """The checkpoint layout depends on this torch behavior:
+    torchvision builds features.1[0] as ``block[1:]`` and nn.Sequential
+    slicing KEEPS the original child names, so the depthwise/SE/project
+    of the split C4 block live at ...1.0.{1,2,3}."""
+    s = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2), nn.Linear(2, 2))
+    keys = list(s[1:].state_dict().keys())
+    assert keys == ["1.weight", "1.bias", "2.weight", "2.bias"], keys
+
+
+def test_ssdlite_default_boxes_shape_and_scales():
+    d = ssdlite_default_boxes()
+    assert d.shape == (3234, 4)
+    # first cell's first box is the 0.2-scale square at cell center
+    cx = (0 + 0.5) / 20
+    want = [cx - 0.1, cx - 0.1, cx + 0.1, cx + 0.1]
+    np.testing.assert_allclose(d[0], want, atol=1e-6)
+    # last level's geometric-mean box: sqrt(0.95 * 1.0) square
+    s = math.sqrt(0.95)
+    np.testing.assert_allclose(d[-5][2] - d[-5][0], min(s, 1.0),
+                               atol=1e-5)
+
+
+def test_ssdlite_import_matches_torch_heads(f32_policy):
+    num_classes = 7
+    oracle = _TVSSDLite(num_classes)
+    _rand_init(oracle, seed=5)
+    oracle.eval()
+
+    model, priors, name_map = ssdlite320_mobilenet_v3(
+        num_classes=num_classes)
+    model.init()
+    load_torch_ssdlite320(model, oracle.state_dict(), name_map)
+
+    rs = np.random.RandomState(6)
+    x = rs.rand(1, 320, 320, 3).astype(np.float32) * 2 - 1
+    with torch.no_grad():
+        want_cls, want_reg = oracle(
+            torch.from_numpy(x.transpose(0, 3, 1, 2)))
+    want_cls, want_reg = want_cls.numpy(), want_reg.numpy()
+
+    v = model.get_variables()
+    (loc, conf), _ = model.apply(v["params"], x, state=v["state"],
+                                 training=False)
+    loc, conf = np.asarray(loc), np.asarray(conf)
+    assert conf.shape == want_cls.shape == (1, 3234, num_classes)
+    np.testing.assert_allclose(conf, want_cls, rtol=1e-3,
+                               atol=1e-3 * np.abs(want_cls).max())
+    np.testing.assert_allclose(loc, want_reg, rtol=1e-3,
+                               atol=1e-3 * np.abs(want_reg).max())
+
+
+def test_ssdlite_import_error_paths(f32_policy):
+    oracle = _TVSSDLite(5)
+    model, _, name_map = ssdlite320_mobilenet_v3(num_classes=5)
+    model.init()
+    sd = oracle.state_dict()
+    extra = dict(sd)
+    extra["bogus.weight"] = torch.zeros(3, 3, 1, 1)
+    with pytest.raises(ValueError, match="bogus"):
+        load_torch_ssdlite320(model, extra, name_map)
+    wrong = _TVSSDLite(9).state_dict()
+    with pytest.raises(ValueError):
+        load_torch_ssdlite320(model, wrong, name_map)
+
+
+def test_ssdlite_load_by_name_journey(f32_policy, tmp_path):
+    from analytics_zoo_tpu.models.image.objectdetection import (
+        ObjectDetector, load_object_detector)
+
+    oracle = _TVSSDLite(91)
+    _rand_init(oracle, seed=9)
+    det = load_object_detector("ssdlite320-mobilenet-v3-coco",
+                               checkpoint=oracle.state_dict(),
+                               score_threshold=0.0, max_detections=5,
+                               topk_per_class=20)
+    assert det.image_size == 320
+    assert det.config.label_map["person"] == 1
+
+    img = np.random.RandomState(10).rand(1, 320, 320, 3).astype(
+        np.float32) * 2 - 1
+    boxes, scores, labels = det.detect(img)[0]
+    assert boxes.shape[1] == 4 and len(scores) == len(labels)
+
+    p = str(tmp_path / "det.zoo")
+    det.save_model(p)
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+    Layer.reset_name_counters()
+    det2 = ObjectDetector.load_model(p)
+    assert det2.model_type == "ssdlite320_mobilenet_v3"
+    v1 = det.model.get_variables()["params"]
+    v2 = det2.model.get_variables()["params"]
+    np.testing.assert_allclose(np.asarray(v1["sl000"]["kernel"]),
+                               np.asarray(v2["sl000"]["kernel"]))
+
+
+def test_ssdlite_configure():
+    cfg = ssdlite_configure()
+    img = np.random.RandomState(0).rand(100, 160, 3) * 255
+    out = cfg.preprocessor(img)
+    assert out.shape == (320, 320, 3)
+    assert -1.01 <= out.min() and out.max() <= 1.01
