@@ -19,6 +19,18 @@ Steps:
    of the first detection (the notebook draws with OpenCV).
 
 Run: ``python apps/object_detection/object_detection.py``
+
+The original notebook's "load a PUBLISHED model" journey is the
+load-by-name pretrained path (needs a downloaded torchvision COCO
+checkpoint — this tutorial stays zero-download, so it trains instead):
+
+    from analytics_zoo_tpu.models.image.objectdetection import (
+        load_object_detector)
+    det = load_object_detector(
+        "ssd300-vgg16-coco",              # or ssdlite320-mobilenet-v3-coco
+        checkpoint="ssd300_vgg16_coco-b556d3b4.pth")
+    dets = det.predict_image_set(image_set)   # preprocess baked in
+    names = det.label_names(labels)           # COCO 91-id space
 """
 
 import argparse
