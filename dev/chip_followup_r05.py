@@ -22,6 +22,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(REPO, "dev", "r05_captures")
 os.makedirs(OUT, exist_ok=True)
+_T0 = time.time()
 
 
 def log(msg):
@@ -78,6 +79,26 @@ def main():
     if not probe_chip(budget_s=4 * 3600):
         log("no chip within budget; giving up")
         return 1
+
+    # 2b. if the bench waiter never landed a fresh capture (its probe
+    # budget expired before the chip freed), run the full bench now —
+    # fresh numbers into bench_results.json come FIRST, sweeps second
+    fresh = False
+    try:
+        with open(os.path.join(REPO, "bench_results.json")) as f:
+            art = json.load(f)
+        # "fresh" = anything recorded this round (the followup starts
+        # minutes into the round; r04 entries are a day old)
+        cutoff = _T0 - 3 * 3600
+        fresh = any((r.get("recorded_unix") or 0) >= cutoff
+                    for r in art.get("results", []))
+    except Exception:
+        pass
+    if not fresh:
+        run_logged([sys.executable, os.path.join(REPO, "bench.py"),
+                    "--workload", "all", "--probe-budget", "600",
+                    "--run-timeout", "1500"],
+                   "bench_all_retry", timeout_s=4 * 3600)
 
     # 3. remat A-B sweep
     run_logged([sys.executable, os.path.join(REPO, "dev", "resnet-sweep"),
