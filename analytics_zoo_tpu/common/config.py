@@ -67,6 +67,16 @@ _DEFAULTS: Dict[str, Any] = {
     "checkpoint.keep": 5,
     # Logging ----------------------------------------------------------
     "log.level": "INFO",
+    # Observability ----------------------------------------------------
+    # Span-tracer ring buffer size (complete events kept in memory for
+    # /trace and export_chrome_trace).
+    "observability.trace_events": 200000,
+    # Record the global L2 grad norm as a gauge each step (adds an
+    # in-jit norm + a host callback per step — opt-in).
+    "observability.grad_norm": False,
+    # Background device-telemetry sampling period for long-running
+    # services (serving); one-shot samples are free-form.
+    "observability.telemetry_interval_s": 10.0,
 }
 
 _ENV_PREFIX = "ZOO_TPU_"

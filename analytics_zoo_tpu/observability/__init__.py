@@ -1,0 +1,60 @@
+"""Unified observability layer: metrics registry, span tracing, device
+telemetry, and a scrape endpoint.
+
+The measurement substrate for the ROADMAP north-star "runs as fast as
+the hardware allows": one process-wide :class:`MetricsRegistry` that
+training, serving, and inference all instrument into; a
+:class:`Tracer` whose ``span("name")`` blocks export as Chrome-trace
+JSON (Perfetto); :func:`sample_device_telemetry` pulling
+``device.memory_stats()`` into gauges; and :class:`MetricsServer`
+exposing it all over HTTP ``/metrics`` (Prometheus text exposition)
+without any third-party dependency.
+
+Quick use::
+
+    from analytics_zoo_tpu.observability import (
+        get_registry, span, start_metrics_server)
+
+    reqs = get_registry().counter("my_requests_total", "requests")
+    with span("handle", route="/predict"):
+        reqs.inc()
+    start_metrics_server(port=9090)   # scrape :9090/metrics
+"""
+
+from analytics_zoo_tpu.observability.metrics import (
+    DEFAULT_BUCKETS,
+    EPOCH_BUCKETS,
+    MetricsRegistry,
+    get_registry,
+    reset_registry,
+)
+from analytics_zoo_tpu.observability.tracing import (
+    Tracer,
+    get_tracer,
+    reset_tracer,
+    span,
+)
+from analytics_zoo_tpu.observability.telemetry import (
+    TelemetrySampler,
+    sample_device_telemetry,
+)
+from analytics_zoo_tpu.observability.exporter import (
+    MetricsServer,
+    start_metrics_server,
+)
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "EPOCH_BUCKETS",
+    "MetricsRegistry",
+    "get_registry",
+    "reset_registry",
+    "Tracer",
+    "get_tracer",
+    "reset_tracer",
+    "span",
+    "TelemetrySampler",
+    "sample_device_telemetry",
+    "MetricsServer",
+    "start_metrics_server",
+]

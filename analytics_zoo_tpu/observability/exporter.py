@@ -1,0 +1,124 @@
+"""Scrape endpoint: a stdlib ``http.server`` serving the registry in
+Prometheus text exposition plus JSON snapshot and Chrome-trace views.
+
+Routes:
+    /metrics        Prometheus text exposition 0.0.4 (scrape target)
+    /metrics.json   registry snapshot as JSON
+    /trace          Chrome-trace JSON of the span tracer (Perfetto)
+    /healthz        200 "ok"
+
+Port 0 binds an ephemeral port (``server.port`` has the real one) —
+what tests and multi-worker hosts use to avoid collisions.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from analytics_zoo_tpu.observability.metrics import (
+    MetricsRegistry, get_registry)
+from analytics_zoo_tpu.observability.tracing import Tracer, get_tracer
+
+log = logging.getLogger("analytics_zoo_tpu.observability")
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "zoo-tpu-metrics/1.0"
+
+    def _respond(self, body: bytes, content_type: str,
+                 status: int = 200) -> None:
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        path = self.path.split("?", 1)[0]
+        try:
+            if path in ("/metrics", "/"):
+                body = self.server.registry.prometheus_text().encode()
+                self._respond(body, PROM_CONTENT_TYPE)
+            elif path == "/metrics.json":
+                body = json.dumps(self.server.registry.snapshot(),
+                                  indent=2).encode()
+                self._respond(body, "application/json")
+            elif path == "/trace":
+                body = json.dumps(
+                    self.server.tracer.chrome_trace()).encode()
+                self._respond(body, "application/json")
+            elif path == "/healthz":
+                self._respond(b"ok", "text/plain")
+            else:
+                self._respond(b"not found", "text/plain", 404)
+        except Exception:  # a scrape must never kill the server thread
+            log.exception("metrics request failed: %s", self.path)
+            try:
+                self._respond(b"internal error", "text/plain", 500)
+            except Exception:
+                pass
+
+    def log_message(self, fmt, *args):  # scrapes are periodic; stay quiet
+        log.debug("metrics http: " + fmt, *args)
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class MetricsServer:
+    """Owns the HTTP listener + its serve thread.  ``start`` is
+    idempotent; ``stop`` releases the port."""
+
+    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+                 registry: Optional[MetricsRegistry] = None,
+                 tracer: Optional[Tracer] = None):
+        self._requested = (host, int(port))
+        self.registry = registry if registry is not None \
+            else get_registry()
+        self.tracer = tracer if tracer is not None else get_tracer()
+        self._httpd: Optional[_Server] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> Optional[int]:
+        return self._httpd.server_address[1] if self._httpd else None
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        self._httpd = _Server(self._requested, _Handler)
+        self._httpd.registry = self.registry
+        self._httpd.tracer = self.tracer
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"zoo-metrics-http:{self.port}")
+        self._thread.start()
+        log.info("metrics endpoint listening on :%d/metrics", self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._httpd = None
+        self._thread = None
+
+
+def start_metrics_server(port: int = 0, host: str = "0.0.0.0",
+                         registry: Optional[MetricsRegistry] = None,
+                         tracer: Optional[Tracer] = None) -> MetricsServer:
+    """Build + start in one call; returns the server (``.port`` holds
+    the bound port when ``port=0``)."""
+    return MetricsServer(port=port, host=host, registry=registry,
+                         tracer=tracer).start()

@@ -1,0 +1,364 @@
+"""Process-wide metrics registry: Counter / Gauge / Histogram with
+labels, Prometheus text exposition, and JSONL snapshots.
+
+Reference posture: BigDL's driver printed a per-interval phase table
+(the Metrics breakdown) and pushed Train/Validation scalars to
+TensorBoard; operability lived in logs.  Here every subsystem shares
+ONE registry so a single scrape (``/metrics``) or snapshot shows the
+whole pipeline — training step latency, serving request latency, HBM
+in use — in one place.
+
+Dependency-free by design (no prometheus_client): the exposition
+format is a few lines of text framing, and serving must not grow a
+client-library dependency the container may not have.
+
+Thread-safety: every mutation takes the owning metric's lock.  The
+hot-path cost is one lock + float add, far below the dispatch cost of
+any step it instruments.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Prometheus' default bucket ladder, widened down to 100us: TPU predict
+# steps on a warm executable can sit well under 5ms.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    .0001, .00025, .0005, .001, .0025, .005, .01, .025, .05, .1, .25,
+    .5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+# Ladder for epoch/long-job durations (sub-second to an hour) — shared
+# by every train_epoch_seconds registration site.
+EPOCH_BUCKETS: Tuple[float, ...] = (
+    .1, .25, .5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0,
+    1800.0, 3600.0)
+
+
+def _escape_label_value(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace("\n", r"\n")
+            .replace('"', r'\"'))
+
+
+def _format_labels(names: Sequence[str], values: Sequence[str],
+                   extra: Optional[Tuple[str, str]] = None) -> str:
+    pairs = [f'{n}="{_escape_label_value(v)}"'
+             for n, v in zip(names, values)]
+    if extra is not None:
+        pairs.append(f'{extra[0]}="{extra[1]}"')
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _format_value(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    if v == float("-inf"):
+        return "-Inf"
+    # integers print bare (Prometheus accepts either; bare reads better)
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Child:
+    """One labeled time series of a metric family."""
+
+    __slots__ = ("_lock",)
+
+    def __init__(self):
+        self._lock = threading.Lock()
+
+
+class _CounterChild(_Child):
+    __slots__ = ("value",)
+
+    def __init__(self):
+        super().__init__()
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters can only increase")
+        with self._lock:
+            self.value += amount
+
+
+class _GaugeChild(_Child):
+    __slots__ = ("value",)
+
+    def __init__(self):
+        super().__init__()
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self.value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+
+class _HistogramChild(_Child):
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets: Tuple[float, ...]):
+        super().__init__()
+        self.buckets = buckets
+        self.counts = [0] * len(buckets)   # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            if i < len(self.counts):
+                self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def cumulative(self) -> List[int]:
+        out, acc = [], 0
+        with self._lock:
+            for c in self.counts:
+                acc += c
+                out.append(acc)
+        return out
+
+    def percentile(self, p: float) -> float:
+        """Approximate percentile from bucket upper bounds (the bound
+        of the first cumulative bucket covering p of the count)."""
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+        if total == 0:
+            return 0.0
+        target = p / 100.0 * total
+        acc = 0
+        for bound, c in zip(self.buckets, counts):
+            acc += c
+            if acc >= target:
+                return bound
+        return self.buckets[-1] if self.buckets else 0.0
+
+
+_KIND_CHILD = {"counter": _CounterChild, "gauge": _GaugeChild}
+
+
+class _Family:
+    """A named metric with a fixed label-name schema and one child per
+    label-value combination."""
+
+    def __init__(self, name: str, help: str, kind: str,
+                 label_names: Tuple[str, ...],
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help
+        self.kind = kind
+        self.label_names = label_names
+        self.buckets = tuple(sorted(buckets))
+        self._children: Dict[Tuple[str, ...], _Child] = {}
+        self._lock = threading.Lock()
+        if not label_names:
+            # label-free series exist at zero from registration, so a
+            # scrape before the first sample still shows them (rate()/
+            # absent() alerting needs the series present) — matching
+            # prometheus_client; labeled children appear on first use
+            self.labels()
+
+    def labels(self, *values, **kw):
+        if kw:
+            values = tuple(str(kw[n]) for n in self.label_names)
+        else:
+            values = tuple(str(v) for v in values)
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {values}")
+        child = self._children.get(values)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(
+                    values,
+                    _HistogramChild(self.buckets)
+                    if self.kind == "histogram"
+                    else _KIND_CHILD[self.kind]())
+        return child
+
+    def _default(self):
+        """The unlabeled child (only valid for label-free families)."""
+        return self.labels()
+
+    # convenience passthroughs so label-free metrics read naturally
+    def inc(self, amount: float = 1.0) -> None:
+        self._default().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._default().dec(amount)
+
+    def set(self, value: float) -> None:
+        self._default().set(value)
+
+    def observe(self, value: float) -> None:
+        self._default().observe(value)
+
+    @property
+    def value(self):
+        return self._default().value
+
+    def items(self) -> List[Tuple[Tuple[str, ...], _Child]]:
+        with self._lock:
+            return list(self._children.items())
+
+
+class MetricsRegistry:
+    """Ordered collection of metric families with exposition/snapshot.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create: calling
+    twice with the same name returns the same family (kind and label
+    schema must match), so instrumentation sites never need to
+    coordinate registration order.
+    """
+
+    def __init__(self):
+        self._families: Dict[str, _Family] = {}
+        self._lock = threading.Lock()
+
+    def _get_or_create(self, name: str, help: str, kind: str,
+                       label_names: Iterable[str],
+                       buckets: Tuple[float, ...] = DEFAULT_BUCKETS
+                       ) -> _Family:
+        label_names = tuple(label_names)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, help, kind, label_names, buckets)
+                self._families[name] = fam
+                return fam
+        if fam.kind != kind or fam.label_names != label_names:
+            raise ValueError(
+                f"metric {name!r} re-registered as {kind}"
+                f"{label_names}, existing is {fam.kind}"
+                f"{fam.label_names}")
+        if kind == "histogram" and fam.buckets != tuple(sorted(buckets)):
+            # a silently-discarded bucket ladder would misreport every
+            # later observation — fail as loudly as a kind mismatch
+            raise ValueError(
+                f"histogram {name!r} re-registered with buckets "
+                f"{tuple(sorted(buckets))}, existing has {fam.buckets}")
+        return fam
+
+    def counter(self, name: str, help: str = "",
+                labels: Iterable[str] = ()) -> _Family:
+        return self._get_or_create(name, help, "counter", labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Iterable[str] = ()) -> _Family:
+        return self._get_or_create(name, help, "gauge", labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Iterable[str] = (),
+                  buckets: Tuple[float, ...] = DEFAULT_BUCKETS
+                  ) -> _Family:
+        return self._get_or_create(name, help, "histogram", labels,
+                                   buckets)
+
+    # -------------------------------------------------------- exposition
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        with self._lock:
+            families = sorted(self._families.values(),
+                              key=lambda f: f.name)
+        for fam in families:
+            items = fam.items()
+            if not items:
+                continue
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for values, child in sorted(items):
+                if fam.kind == "histogram":
+                    cum = child.cumulative()
+                    for bound, c in zip(fam.buckets, cum):
+                        lab = _format_labels(
+                            fam.label_names, values,
+                            ("le", _format_value(bound)))
+                        lines.append(f"{fam.name}_bucket{lab} {c}")
+                    lab = _format_labels(fam.label_names, values,
+                                         ("le", "+Inf"))
+                    lines.append(
+                        f"{fam.name}_bucket{lab} {child.count}")
+                    plain = _format_labels(fam.label_names, values)
+                    lines.append(f"{fam.name}_sum{plain} "
+                                 f"{_format_value(child.sum)}")
+                    lines.append(f"{fam.name}_count{plain} "
+                                 f"{child.count}")
+                else:
+                    lab = _format_labels(fam.label_names, values)
+                    lines.append(f"{fam.name}{lab} "
+                                 f"{_format_value(child.value)}")
+        return "\n".join(lines) + "\n"
+
+    # ---------------------------------------------------------- snapshot
+    def snapshot(self) -> Dict:
+        """JSON-friendly snapshot: counters/gauges as values, histograms
+        as count/sum/percentile summaries (compact enough to embed in a
+        bench artifact)."""
+        out: Dict[str, Dict] = {"counters": {}, "gauges": {},
+                                "histograms": {}}
+        with self._lock:
+            families = list(self._families.values())
+        for fam in families:
+            for values, child in fam.items():
+                key = fam.name
+                if values:
+                    key += _format_labels(fam.label_names, values)
+                if fam.kind == "counter":
+                    out["counters"][key] = child.value
+                elif fam.kind == "gauge":
+                    out["gauges"][key] = child.value
+                else:
+                    out["histograms"][key] = {
+                        "count": child.count,
+                        "sum": round(child.sum, 6),
+                        "p50": child.percentile(50),
+                        "p95": child.percentile(95),
+                        "p99": child.percentile(99),
+                    }
+        return out
+
+    def write_jsonl(self, path: str) -> None:
+        """Append one timestamped snapshot line (crash-safe scrape log,
+        same shape as utils/summary.py's JSONL scalars)."""
+        rec = {"wall_time": time.time(), "metrics": self.snapshot()}
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+_global_registry: Optional[MetricsRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry every subsystem instruments into."""
+    global _global_registry
+    if _global_registry is None:
+        with _registry_lock:
+            if _global_registry is None:
+                _global_registry = MetricsRegistry()
+    return _global_registry
+
+
+def reset_registry() -> None:
+    """Drop the process-wide registry (test helper)."""
+    global _global_registry
+    with _registry_lock:
+        _global_registry = None

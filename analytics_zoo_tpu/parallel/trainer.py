@@ -23,6 +23,7 @@ parameter exchange).
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -32,7 +33,20 @@ import numpy as np
 import optax
 
 from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.observability import get_registry, get_tracer
 from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+
+def _record_grad_norm(gnorm) -> None:
+    """Host callback target: surface the in-jit global grad norm as a
+    gauge (debug.callback delivers a host copy after the step runs)."""
+    try:
+        get_registry().gauge(
+            "train_grad_norm",
+            "global L2 gradient norm (observability.grad_norm=true)"
+        ).set(float(gnorm))
+    except Exception:
+        pass
 
 
 @dataclasses.dataclass
@@ -112,6 +126,24 @@ class DistributedTrainer:
         self._permute_rows = None
         self._rep = mesh_lib.replicated(self.mesh)
         self._param_shardings = None
+        # observability: shared-registry instruments for the hot path.
+        # Per-step latency here is HOST dispatch-to-dispatch wall time —
+        # device work is async, but donation + the dispatch queue make
+        # it converge to device step time in steady state.
+        reg = get_registry()
+        self._m_step_latency = reg.histogram(
+            "train_step_latency_seconds",
+            "host wall time per dispatched train step (dispatch-to-"
+            "dispatch; device work is async)", labels=("path",))
+        self._m_steps = reg.counter(
+            "train_steps_total", "train steps dispatched",
+            labels=("path",))
+        self._m_prefetch_depth = reg.gauge(
+            "train_prefetch_queue_depth",
+            "device-placed batches waiting in the prefetch queue")
+        # grad-norm gauge costs an in-jit norm + host callback per step:
+        # opt-in via config (observability.grad_norm)
+        self._obs_grad_norm = bool(cfg.get("observability.grad_norm"))
 
     # ------------------------------------------------------------ sharding
     def param_shardings(self, params):
@@ -226,6 +258,12 @@ class DistributedTrainer:
             objective = jax.checkpoint(objective)
         grads, (new_state, loss) = jax.grad(
             objective, has_aux=True)(params)
+        if self._obs_grad_norm:
+            # surfaces the norm on host after each step without
+            # changing the step's signature; opt-in because the
+            # callback costs a host round trip per step
+            jax.debug.callback(_record_grad_norm,
+                               optax.global_norm(grads))
         if self.grad_sync_dtype == "bfloat16":
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.bfloat16).astype(jnp.float32),
@@ -252,12 +290,24 @@ class DistributedTrainer:
                            self._rep),
             donate_argnums=donate)
 
+    def _dispatch_instrumented(self, fn, *args):
+        """One step dispatch wrapped in a train_step span + the
+        per-step latency histogram and step counter."""
+        with get_tracer().span("train_step"):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            self._m_step_latency.labels("per_step").observe(
+                time.perf_counter() - t0)
+        self._m_steps.labels("per_step").inc()
+        return out
+
     def train_step(self, params, opt_state, state, batch, rng):
         """Run one step; ``batch`` must already be device-placed
         (see ``prefetch``/``put_batch``)."""
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        return self._train_step(params, opt_state, state, batch, rng)
+        return self._dispatch_instrumented(
+            self._train_step, params, opt_state, state, batch, rng)
 
     def train_step_at(self, params, opt_state, state, batch, rng, step):
         """``train_step`` with the per-step rng derived IN-JIT:
@@ -267,8 +317,9 @@ class DistributedTrainer:
         numpy scalar (traced arg — a Python int would retrace)."""
         if self._train_step_at is None:
             self._train_step_at = self._build_train_step(fold_rng=True)
-        return self._train_step_at(params, opt_state, state, batch,
-                                   rng, step)
+        return self._dispatch_instrumented(
+            self._train_step_at, params, opt_state, state, batch, rng,
+            step)
 
     # ------------------------------------------------- device-resident epoch
     def epoch_scan_fn(self, num_batches: int, batch_size: int,
@@ -562,8 +613,12 @@ class DistributedTrainer:
         t = threading.Thread(target=worker, daemon=True)
         t.start()
         while True:
+            # sampled before the dequeue so a full steady-state
+            # pipeline reads `depth`, not depth-1
+            self._m_prefetch_depth.set(q.qsize())
             item = q.get()
             if item is _END:
+                self._m_prefetch_depth.set(0)
                 break
             if isinstance(item, BaseException):
                 raise item

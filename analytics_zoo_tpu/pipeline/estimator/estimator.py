@@ -23,6 +23,8 @@ import jax
 import numpy as np
 
 from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.observability import (
+    EPOCH_BUCKETS, get_registry, get_tracer, sample_device_telemetry)
 from analytics_zoo_tpu.parallel import mesh as mesh_lib
 from analytics_zoo_tpu.common.triggers import (
     EveryEpoch, MaxEpoch, TrainingState, Trigger)
@@ -31,6 +33,37 @@ from analytics_zoo_tpu.utils.serialization import Checkpoint
 from analytics_zoo_tpu.utils.summary import TrainSummary, ValidationSummary
 
 log = logging.getLogger("analytics_zoo_tpu.estimator")
+
+
+def _train_metrics():
+    """Shared-registry instruments for the training loop (get-or-create
+    — cheap to call per train())."""
+    reg = get_registry()
+    return {
+        "epoch_seconds": reg.histogram(
+            "train_epoch_seconds", "wall time per completed epoch",
+            labels=("engine",), buckets=EPOCH_BUCKETS),
+        "samples": reg.counter(
+            "train_samples_total", "training samples consumed"),
+        "throughput": reg.gauge(
+            "train_throughput_samples_per_sec",
+            "most recent epoch's training throughput"),
+        "loss": reg.gauge("train_loss", "most recent sampled loss"),
+        "eval_seconds": reg.histogram(
+            "train_eval_seconds", "wall time per validation pass"),
+        "ckpt_save": reg.counter(
+            "checkpoint_save_total", "checkpoint snapshots written"),
+        "ckpt_restore": reg.counter(
+            "checkpoint_restore_total",
+            "checkpoint restores (resume + failure recovery)"),
+        "retries": reg.counter(
+            "train_retry_total",
+            "training-step failures absorbed by the retry loop"),
+        # same family the per-step path (trainer.py) counts into
+        "steps": reg.counter(
+            "train_steps_total", "train steps dispatched",
+            labels=("path",)),
+    }
 
 
 class _UnrecoverableTraining(RuntimeError):
@@ -137,8 +170,23 @@ class Estimator:
 
         ckpt = Checkpoint(self.model_dir) if self.model_dir else None
         ts = self.train_state
+        met = _train_metrics()
+        tracer = get_tracer()
+
+        def restore_snapshot(like):
+            """ckpt.restore_latest with a span + restore counter (all
+            restore sites — resume, HBM-cache recovery, retry loop —
+            go through here so the counter is a complete record)."""
+            if ckpt is None:
+                return None
+            with tracer.span("checkpoint_restore"):
+                restored = ckpt.restore_latest(like)
+            if restored is not None:
+                met["ckpt_restore"].inc()
+            return restored
+
         if ckpt is not None:
-            restored = ckpt.restore_latest(
+            restored = restore_snapshot(
                 {"params": params, "state": state, "opt_state": opt_state,
                  "epoch": 0, "iteration": 0})
             if restored is not None:
@@ -162,7 +210,9 @@ class Estimator:
 
         retry_times = int(get_config().get("train.retry_times"))
         retries_left = retry_times
-        last_failure_time = 0.0
+        # interval math on the monotonic clock: a wall-clock (NTP)
+        # adjustment must not reset or starve the retry budget
+        last_failure_time: Optional[float] = None
         retry_window = float(get_config().get("train.retry_interval_s"))
 
         # --- epoch loop -----------------------------------------------------
@@ -172,12 +222,16 @@ class Estimator:
             # the coordinator writes the file, like the reference's
             # driver-side snapshot (Topology.scala:1293). Restore assumes
             # model_dir is on a filesystem all hosts can read.
-            payload = {"params": mesh_lib.fetch_global(params),
-                       "state": mesh_lib.fetch_global(state),
-                       "opt_state": mesh_lib.fetch_global(opt_state),
-                       "epoch": ts.epoch, "iteration": ts.iteration}
-            if jax.process_index() == 0:
-                ckpt.save(payload, step=ts.iteration)
+            with tracer.span("checkpoint_save", iteration=ts.iteration):
+                payload = {"params": mesh_lib.fetch_global(params),
+                           "state": mesh_lib.fetch_global(state),
+                           "opt_state": mesh_lib.fetch_global(opt_state),
+                           "epoch": ts.epoch, "iteration": ts.iteration}
+                if jax.process_index() == 0:
+                    ckpt.save(payload, step=ts.iteration)
+                    # counted only where the file is actually written,
+                    # so per-host scrapes reflect per-host truth
+                    met["ckpt_save"].inc()
 
         # Chunked dispatch (train.steps_per_dispatch): fuse k steps into
         # one lax.scan dispatch — per-step host/dispatch overhead (the
@@ -269,19 +323,25 @@ class Estimator:
             """Eval with the cached device batches when available; on
             a dispatch failure (e.g. OOM from the added resident HBM)
             release the cache and retry streaming from host."""
-            if eval_cache_holder[0] is not None:
-                try:
-                    return eval_runner(params, state,
-                                       eval_cache_holder[0])
-                except Exception:
-                    eval_cache_holder[0] = None
-                    log.warning(
-                        "eval failed with cached batches; released "
-                        "the cache, retrying streamed", exc_info=True)
-            return eval_runner(
-                params, state,
-                validation_set.epoch_batches(0, batch_size,
-                                             train=False))
+            t0 = time.perf_counter()
+            try:
+                with tracer.span("eval"):
+                    if eval_cache_holder[0] is not None:
+                        try:
+                            return eval_runner(params, state,
+                                               eval_cache_holder[0])
+                        except Exception:
+                            eval_cache_holder[0] = None
+                            log.warning(
+                                "eval failed with cached batches; "
+                                "released the cache, retrying streamed",
+                                exc_info=True)
+                    return eval_runner(
+                        params, state,
+                        validation_set.epoch_batches(0, batch_size,
+                                                     train=False))
+            finally:
+                met["eval_seconds"].observe(time.perf_counter() - t0)
 
         def log_loss_crossing(loss, k):
             """Sync + log when the iteration counter crosses a
@@ -289,224 +349,251 @@ class Estimator:
             device sync per dispatch)."""
             if (ts.iteration // 20) != ((ts.iteration - k) // 20):
                 ts.last_loss = float(loss)
+                met["loss"].set(ts.last_loss)
                 if self._train_summary is not None:
                     self._train_summary.add_scalar(
                         "Loss", ts.last_loss, ts.iteration)
 
         stop = False
-        while not stop and not end_trigger(ts):
-            epoch_start = time.time()
-            seen = 0
-            loss = None
-            num_slices = getattr(train_set, "num_slices", 1)
-            try:
-                if hbm_src is not None:
-                    try:
-                        xs, ys = hbm_src
-                        if train_set.shuffle:
-                            perm = train_set._epoch_perm(
-                                ts.epoch)[:epoch_rows].astype(np.int32)
-                            xe, ye = hbm_permute(xs, ys, perm)
-                        else:
-                            # unshuffled: the scan slices the source
-                            # in order; no gather, no second copy
-                            xe, ye = xs, ys
-                        params, opt_state, state, loss = hbm_scan(
-                            params, opt_state, state, xe, ye, rng,
-                            np.int32(ts.iteration))
-                        # JAX dispatch is async: an execution-time
-                        # failure (OOM) would otherwise surface at a
-                        # LATER sync point (a 20-crossing float, eval,
-                        # or next epoch's permute) — outside this
-                        # recovery scope, after the iteration counter
-                        # had committed for an epoch that never ran.
-                        # Force it to surface HERE with a host read of
-                        # the epoch's loss output (a D2H read cannot
-                        # return before the program completes;
-                        # block_until_ready proved unreliable over the
-                        # tunneled backend). One scalar read per epoch
-                        # on a one-dispatch-per-epoch path.
-                        ts.last_loss = float(loss)
-                        # drop the permuted copy eagerly: holding it
-                        # across epochs would put THREE epoch-sized
-                        # buffers live at the next permute (source +
-                        # old + new) — the budget gate accounts for two
-                        del xe, ye
-                    except Exception:
-                        # The budget gate knows the dataset size, not
-                        # free HBM: a model whose params/activations
-                        # nearly fill the device can OOM here. The
-                        # epoch is ONE dispatch, so no step committed —
-                        # but params/opt_state/state were DONATED to
-                        # the failed dispatch and may be deleted, so
-                        # recovery must re-place them (never continue
-                        # with the old references). Release every
-                        # epoch-sized device buffer first: the chunked
-                        # retry below must not inherit the memory
-                        # pressure that caused the failure.
-                        hbm_src = xs = ys = xe = ye = None  # noqa: F841
-                        eval_cache_holder[0] = None
-                        restored = ckpt.restore_latest(
-                            {"params": params, "state": state,
-                             "opt_state": opt_state, "epoch": 0,
-                             "iteration": 0}) if ckpt is not None \
-                            else None
-                        if restored is not None:
-                            log.warning(
-                                "HBM epoch cache failed (likely OOM); "
-                                "restored checkpoint, falling back to "
-                                "chunked dispatch", exc_info=True)
-                            params = trainer.place_params(
-                                restored["params"])
-                            state = trainer.replicate(restored["state"])
-                            opt_state = trainer.init_opt_state(params)
-                            opt_state = trainer.place_like(
-                                restored["opt_state"], opt_state)
-                            ts.epoch = int(restored["epoch"])
-                            ts.iteration = int(restored["iteration"])
-                            continue
-                        if ts.iteration == start_iteration:
-                            # nothing learned THIS call: rebuild from
-                            # the entry-time host copy, retry chunked
-                            log.warning(
-                                "HBM epoch cache failed (likely OOM) "
-                                "before any step; falling back to "
-                                "chunked dispatch", exc_info=True)
-                            params = trainer.place_params(
-                                self.variables["params"])
-                            state = trainer.replicate(
-                                self.variables["state"])
-                            opt_state = trainer.init_opt_state(params)
-                            continue
-                        # steps committed, no snapshot to restore:
-                        # the donated training state is unrecoverable
-                        # (near-unreachable: EveryEpoch + model_dir
-                        # snapshots every completed epoch)
-                        raise _UnrecoverableTraining(
-                            f"HBM epoch cache failed at iteration "
-                            f"{ts.iteration} with no checkpoint to "
-                            "restore; set model_dir or "
-                            "train.hbm_cache_mb=0")
-                    ts.iteration += nb_epoch
-                    seen += epoch_rows
-                    log_loss_crossing(loss, nb_epoch)
-                    if end_trigger(ts):
-                        stop = True
-                elif use_chunks:
-                    global_rows = mesh_lib.global_batch_rows(
-                        trainer.mesh, batch_size)
-                    gen = ((x, y) for x, y, _ in train_set.epoch_chunks(
-                        ts.epoch, batch_size, chunk_steps))
-                    for placed in trainer.prefetch(gen):
-                        xc, yc = placed
-                        # chunk length from the placed arrays (single
-                        # source of truth is epoch_chunks' row count)
-                        k = jax.tree_util.tree_leaves(xc)[0].shape[0] \
-                            // global_rows
-                        fn = chunk_fns.get(k)
-                        if fn is None:
-                            fn = trainer.epoch_scan_fn(k, batch_size)
-                            chunk_fns[k] = fn
-                        # same rng stream as per-step dispatch: the fn
-                        # folds rng by (start_step + i) internally
-                        params, opt_state, state, loss = fn(
-                            params, opt_state, state, xc, yc, rng,
-                            np.int32(ts.iteration))
-                        ts.iteration += k
-                        seen += k * batch_size
-                        log_loss_crossing(loss, k)
-                        if ckpt is not None and checkpoint_trigger(ts):
-                            save_snapshot()
+        try:
+            while not stop and not end_trigger(ts):
+                # monotonic clock for the epoch interval: wall-clock
+                # adjustments must not produce negative/garbage durations
+                epoch_start = time.perf_counter()
+                seen = 0
+                loss = None
+                num_slices = getattr(train_set, "num_slices", 1)
+                try:
+                    if hbm_src is not None:
+                        try:
+                            xs, ys = hbm_src
+                            if train_set.shuffle:
+                                perm = train_set._epoch_perm(
+                                    ts.epoch)[:epoch_rows].astype(np.int32)
+                                xe, ye = hbm_permute(xs, ys, perm)
+                            else:
+                                # unshuffled: the scan slices the source
+                                # in order; no gather, no second copy
+                                xe, ye = xs, ys
+                            with tracer.span("train_epoch_scan",
+                                             steps=nb_epoch):
+                                params, opt_state, state, loss = hbm_scan(
+                                    params, opt_state, state, xe, ye, rng,
+                                    np.int32(ts.iteration))
+                            # JAX dispatch is async: an execution-time
+                            # failure (OOM) would otherwise surface at a
+                            # LATER sync point (a 20-crossing float, eval,
+                            # or next epoch's permute) — outside this
+                            # recovery scope, after the iteration counter
+                            # had committed for an epoch that never ran.
+                            # Force it to surface HERE with a host read of
+                            # the epoch's loss output (a D2H read cannot
+                            # return before the program completes;
+                            # block_until_ready proved unreliable over the
+                            # tunneled backend). One scalar read per epoch
+                            # on a one-dispatch-per-epoch path.
+                            ts.last_loss = float(loss)
+                            # drop the permuted copy eagerly: holding it
+                            # across epochs would put THREE epoch-sized
+                            # buffers live at the next permute (source +
+                            # old + new) — the budget gate accounts for two
+                            del xe, ye
+                        except Exception:
+                            # The budget gate knows the dataset size, not
+                            # free HBM: a model whose params/activations
+                            # nearly fill the device can OOM here. The
+                            # epoch is ONE dispatch, so no step committed —
+                            # but params/opt_state/state were DONATED to
+                            # the failed dispatch and may be deleted, so
+                            # recovery must re-place them (never continue
+                            # with the old references). Release every
+                            # epoch-sized device buffer first: the chunked
+                            # retry below must not inherit the memory
+                            # pressure that caused the failure.
+                            hbm_src = xs = ys = xe = ye = None  # noqa: F841
+                            eval_cache_holder[0] = None
+                            restored = restore_snapshot(
+                                {"params": params, "state": state,
+                                 "opt_state": opt_state, "epoch": 0,
+                                 "iteration": 0})
+                            if restored is not None:
+                                log.warning(
+                                    "HBM epoch cache failed (likely OOM); "
+                                    "restored checkpoint, falling back to "
+                                    "chunked dispatch", exc_info=True)
+                                params = trainer.place_params(
+                                    restored["params"])
+                                state = trainer.replicate(restored["state"])
+                                opt_state = trainer.init_opt_state(params)
+                                opt_state = trainer.place_like(
+                                    restored["opt_state"], opt_state)
+                                ts.epoch = int(restored["epoch"])
+                                ts.iteration = int(restored["iteration"])
+                                continue
+                            if ts.iteration == start_iteration:
+                                # nothing learned THIS call: rebuild from
+                                # the entry-time host copy, retry chunked
+                                log.warning(
+                                    "HBM epoch cache failed (likely OOM) "
+                                    "before any step; falling back to "
+                                    "chunked dispatch", exc_info=True)
+                                params = trainer.place_params(
+                                    self.variables["params"])
+                                state = trainer.replicate(
+                                    self.variables["state"])
+                                opt_state = trainer.init_opt_state(params)
+                                continue
+                            # steps committed, no snapshot to restore:
+                            # the donated training state is unrecoverable
+                            # (near-unreachable: EveryEpoch + model_dir
+                            # snapshots every completed epoch)
+                            raise _UnrecoverableTraining(
+                                f"HBM epoch cache failed at iteration "
+                                f"{ts.iteration} with no checkpoint to "
+                                "restore; set model_dir or "
+                                "train.hbm_cache_mb=0")
+                        ts.iteration += nb_epoch
+                        seen += epoch_rows
+                        met["steps"].labels("epoch_scan").inc(nb_epoch)
+                        log_loss_crossing(loss, nb_epoch)
                         if end_trigger(ts):
                             stop = True
-                            break
-                else:
-                    for sl in range(num_slices):
-                        ts.slice_index = sl
-                        if num_slices > 1:
-                            batches = train_set.slice_batches(
-                                ts.epoch, sl, batch_size)
-                        else:
-                            batches = train_set.epoch_batches(
-                                ts.epoch, batch_size, train=True)
-                        for batch in trainer.prefetch(batches):
-                            # rng folded IN-JIT by the step index: no
-                            # extra fold_in dispatch per step
-                            params, opt_state, state, loss = \
-                                trainer.train_step_at(
-                                    params, opt_state, state, batch,
-                                    rng, np.int32(ts.iteration))
-                            ts.iteration += 1
-                            seen += batch_size
-                            # avoid a device sync per step: loss is
-                            # fetched only at logging points
-                            log_loss_crossing(loss, 1)
-                            # iteration-level triggers (MaxIteration,
-                            # SeveralIteration) fire mid-epoch
-                            if ckpt is not None and \
-                                    checkpoint_trigger(ts):
+                    elif use_chunks:
+                        global_rows = mesh_lib.global_batch_rows(
+                            trainer.mesh, batch_size)
+                        gen = ((x, y) for x, y, _ in train_set.epoch_chunks(
+                            ts.epoch, batch_size, chunk_steps))
+                        for placed in trainer.prefetch(gen):
+                            xc, yc = placed
+                            # chunk length from the placed arrays (single
+                            # source of truth is epoch_chunks' row count)
+                            k = jax.tree_util.tree_leaves(xc)[0].shape[0] \
+                                // global_rows
+                            fn = chunk_fns.get(k)
+                            if fn is None:
+                                fn = trainer.epoch_scan_fn(k, batch_size)
+                                chunk_fns[k] = fn
+                            # same rng stream as per-step dispatch: the fn
+                            # folds rng by (start_step + i) internally
+                            with tracer.span("train_dispatch", steps=k):
+                                params, opt_state, state, loss = fn(
+                                    params, opt_state, state, xc, yc, rng,
+                                    np.int32(ts.iteration))
+                            ts.iteration += k
+                            seen += k * batch_size
+                            met["steps"].labels("chunked").inc(k)
+                            log_loss_crossing(loss, k)
+                            if ckpt is not None and checkpoint_trigger(ts):
                                 save_snapshot()
                             if end_trigger(ts):
                                 stop = True
                                 break
-                        if stop:
-                            break
-            except _UnrecoverableTraining:
-                raise
-            except Exception:   # noqa: BLE001 — retry loop, ref :1179-1261
-                now = time.time()
-                if now - last_failure_time > retry_window:
-                    retries_left = retry_times   # time-windowed retry budget
-                last_failure_time = now
-                retries_left -= 1
-                if retries_left < 0 or ckpt is None:
+                    else:
+                        for sl in range(num_slices):
+                            ts.slice_index = sl
+                            if num_slices > 1:
+                                batches = train_set.slice_batches(
+                                    ts.epoch, sl, batch_size)
+                            else:
+                                batches = train_set.epoch_batches(
+                                    ts.epoch, batch_size, train=True)
+                            for batch in trainer.prefetch(batches):
+                                # rng folded IN-JIT by the step index: no
+                                # extra fold_in dispatch per step
+                                params, opt_state, state, loss = \
+                                    trainer.train_step_at(
+                                        params, opt_state, state, batch,
+                                        rng, np.int32(ts.iteration))
+                                ts.iteration += 1
+                                seen += batch_size
+                                # avoid a device sync per step: loss is
+                                # fetched only at logging points
+                                log_loss_crossing(loss, 1)
+                                # iteration-level triggers (MaxIteration,
+                                # SeveralIteration) fire mid-epoch
+                                if ckpt is not None and \
+                                        checkpoint_trigger(ts):
+                                    save_snapshot()
+                                if end_trigger(ts):
+                                    stop = True
+                                    break
+                            if stop:
+                                break
+                except _UnrecoverableTraining:
                     raise
-                log.exception(
-                    "training step failed; restoring latest checkpoint "
-                    "(%d retries left)", retries_left)
-                restored = ckpt.restore_latest(
-                    {"params": params, "state": state,
-                     "opt_state": opt_state, "epoch": 0, "iteration": 0})
-                if restored is not None:
-                    params = trainer.place_params(restored["params"])
-                    state = trainer.replicate(restored["state"])
-                    opt_state = trainer.place_like(restored["opt_state"], opt_state)
-                    ts.epoch = int(restored["epoch"])
-                    ts.iteration = int(restored["iteration"])
-                continue
+                except Exception:   # noqa: BLE001 — retry loop, ref :1179-1261
+                    now = time.perf_counter()
+                    if last_failure_time is None or \
+                            now - last_failure_time > retry_window:
+                        retries_left = retry_times   # time-windowed retry budget
+                    last_failure_time = now
+                    retries_left -= 1
+                    if retries_left < 0 or ckpt is None:
+                        raise
+                    # counted only when the failure IS absorbed —
+                    # re-raised terminal failures are not "retries"
+                    met["retries"].inc()
+                    log.exception(
+                        "training step failed; restoring latest checkpoint "
+                        "(%d retries left)", retries_left)
+                    restored = restore_snapshot(
+                        {"params": params, "state": state,
+                         "opt_state": opt_state, "epoch": 0, "iteration": 0})
+                    if restored is not None:
+                        params = trainer.place_params(restored["params"])
+                        state = trainer.replicate(restored["state"])
+                        opt_state = trainer.place_like(restored["opt_state"], opt_state)
+                        ts.epoch = int(restored["epoch"])
+                        ts.iteration = int(restored["iteration"])
+                    continue
 
-            if loss is not None:
-                ts.last_loss = float(loss)
-            if stop:
-                break
-            ts.epoch += 1
-            ts.slice_index = 0
-            ts.epoch_finished = True
-            wall = time.time() - epoch_start
-            throughput = seen / max(wall, 1e-9)
-            record = {"epoch": ts.epoch, "loss": ts.last_loss,
-                      "throughput": throughput, "wall_s": wall}
-            if self._train_summary is not None:
-                self._train_summary.add_scalar(
-                    "Throughput", throughput, ts.iteration)
+                if loss is not None:
+                    ts.last_loss = float(loss)
+                if stop:
+                    break
+                ts.epoch += 1
+                ts.slice_index = 0
+                ts.epoch_finished = True
+                wall = time.perf_counter() - epoch_start
+                throughput = seen / max(wall, 1e-9)
+                tracer.complete("epoch", epoch_start, wall, epoch=ts.epoch,
+                                samples=seen)
+                met["epoch_seconds"].labels("distributed").observe(wall)
+                met["samples"].inc(seen)
+                met["throughput"].set(throughput)
+                met["loss"].set(ts.last_loss)
+                sample_device_telemetry()
+                record = {"epoch": ts.epoch, "loss": ts.last_loss,
+                          "throughput": throughput, "wall_s": wall}
+                if self._train_summary is not None:
+                    self._train_summary.add_scalar(
+                        "Throughput", throughput, ts.iteration)
 
-            if eval_runner is not None:
-                scores = run_eval(params, state)
-                record["val"] = scores
-                ts.last_score = next(iter(scores.values()), None)
-                if self._val_summary is not None:
-                    for k, v in scores.items():
-                        self._val_summary.add_scalar(k, v, ts.iteration)
-                log.info("epoch %d loss %.4f val %s (%.1f samples/s)",
-                         ts.epoch, ts.last_loss, scores, throughput)
-            else:
-                log.info("epoch %d loss %.4f (%.1f samples/s)",
-                         ts.epoch, ts.last_loss, throughput)
-            self.history.append(record)
+                if eval_runner is not None:
+                    scores = run_eval(params, state)
+                    record["val"] = scores
+                    ts.last_score = next(iter(scores.values()), None)
+                    if self._val_summary is not None:
+                        for k, v in scores.items():
+                            self._val_summary.add_scalar(k, v, ts.iteration)
+                    log.info("epoch %d loss %.4f val %s (%.1f samples/s)",
+                             ts.epoch, ts.last_loss, scores, throughput)
+                else:
+                    log.info("epoch %d loss %.4f (%.1f samples/s)",
+                             ts.epoch, ts.last_loss, throughput)
+                self.history.append(record)
 
-            if ckpt is not None and checkpoint_trigger(ts):
-                save_snapshot()
-            ts.epoch_finished = False
+                if ckpt is not None and checkpoint_trigger(ts):
+                    save_snapshot()
+                ts.epoch_finished = False
+        finally:
+            # summaries hold open file handles (JSONL + tfevents):
+            # close them whether training completed or raised.
+            # _ScalarWriter reopens on the next add_scalar, so a
+            # later train() on this estimator still records.
+            for s in (self._train_summary, self._val_summary):
+                if s is not None:
+                    s.close()
 
         self.variables = {"params": mesh_lib.fetch_global(params),
                           "state": mesh_lib.fetch_global(state)}

@@ -112,17 +112,31 @@ class LocalEstimator:
             self.model.set_variables({"params": jax.device_get(params),
                                       "state": jax.device_get(state)})
 
+        from analytics_zoo_tpu.observability import (
+            EPOCH_BUCKETS, get_registry, get_tracer)
+        reg = get_registry()
+        m_epoch = reg.histogram(
+            "train_epoch_seconds", "wall time per completed epoch",
+            labels=("engine",), buckets=EPOCH_BUCKETS)
+        m_samples = reg.counter("train_samples_total",
+                                "training samples consumed")
+        tracer = get_tracer()
         for epoch in range(epochs):
-            t0 = time.time()
+            # monotonic interval math — wall-clock adjustments must not
+            # yield negative epoch times
+            t0 = time.perf_counter()
             seen = 0
             loss = None
             for bx, by in data.epoch_batches(epoch, batch_size, train=True):
-                params, opt_state, state, loss = self._step(
-                    params, opt_state, state, bx, by,
-                    jax.random.fold_in(rng, it))
+                with tracer.span("train_step"):
+                    params, opt_state, state, loss = self._step(
+                        params, opt_state, state, bx, by,
+                        jax.random.fold_in(rng, it))
                 it += 1
                 seen += batch_size
-            wall = time.time() - t0
+            wall = time.perf_counter() - t0
+            m_epoch.labels("local").observe(wall)
+            m_samples.inc(seen)
             record = {"epoch": epoch + 1, "loss": float(loss),
                       "throughput": seen / max(wall, 1e-9)}
             if validate:   # evaluate() reads the host-side variables

@@ -117,12 +117,25 @@ class InferenceModel:
     """Concurrency-bounded predictor over a loaded model."""
 
     def __init__(self, supported_concurrent_num: int = 1):
+        from analytics_zoo_tpu.observability import get_registry
         self.concurrency = int(supported_concurrent_num)
         self._sem = threading.Semaphore(self.concurrency)
         self._predict_fn = None
         self._variables = None
         self._quantized = False
         self.model = None
+        # metric handles resolved once — predict is the serving hot path
+        reg = get_registry()
+        self._m_latency = reg.histogram(
+            "inference_predict_latency_seconds",
+            "wall time per InferenceModel.predict call",
+            labels=("backend",))
+        self._m_calls = reg.counter(
+            "inference_predict_total", "InferenceModel.predict calls",
+            labels=("backend",))
+        self._m_records = reg.counter(
+            "inference_records_total",
+            "records predicted by InferenceModel", labels=("backend",))
 
     # ------------------------------------------------------------- loaders
     def load_zoo(self, model, quantize: bool = False, calib_set=None,
@@ -224,9 +237,15 @@ class InferenceModel:
     # -------------------------------------------------------------- predict
     def predict(self, x, batch_size: Optional[int] = None):
         """Thread-safe batched prediction (doPredict)."""
+        import time
+
+        from analytics_zoo_tpu.observability import get_tracer
         if self._predict_fn is None:
             raise RuntimeError("no model loaded")
-        with self._sem:
+        backend = "int8" if self._quantized else "f32"
+        t0 = time.perf_counter()
+        with self._sem, get_tracer().span("inference_predict",
+                                          backend=backend):
             leaves = jax.tree_util.tree_leaves(x)
             n = len(leaves[0])
             bs = batch_size or n
@@ -245,7 +264,11 @@ class InferenceModel:
                     self._variables["params"],
                     self._variables["state"], xb)
                 outs.append(np.asarray(out)[:real])
-            return np.concatenate(outs)
+            result = np.concatenate(outs)
+        self._m_latency.labels(backend).observe(time.perf_counter() - t0)
+        self._m_calls.labels(backend).inc()
+        self._m_records.labels(backend).inc(n)
+        return result
 
     @property
     def is_quantized(self) -> bool:

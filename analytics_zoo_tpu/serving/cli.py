@@ -66,6 +66,10 @@ def main(argv=None):
     p.add_argument("--weights", default=None)
     p.add_argument("--redis", default=None, help="host:port")
     p.add_argument("--quantize", action="store_true")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="expose Prometheus /metrics on this port "
+                        "(0 = ephemeral; overrides config "
+                        "params: metrics_port)")
     args = p.parse_args(argv)
 
     import os
@@ -76,6 +80,8 @@ def main(argv=None):
         if os.path.exists(args.config) else ServingConfig()
     if args.redis:
         cfg.redis_url = args.redis
+    if args.metrics_port is not None:
+        cfg.metrics_port = args.metrics_port
 
     if args.command == "init":
         # validate the full setup without serving (ref

@@ -25,6 +25,9 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.observability import (
+    MetricsServer, TelemetrySampler, get_registry, get_tracer)
 from analytics_zoo_tpu.serving.redis_client import connect
 from analytics_zoo_tpu.utils.summary import InferenceSummary
 
@@ -64,12 +67,22 @@ class ServingConfig:
                  consumer_group: Optional[str] = None,
                  consumer_name: str = "worker-0",
                  pipeline_depth: int = 2,
+                 metrics_port: Optional[int] = None,
+                 metrics_host: str = "0.0.0.0",
                  extra: Optional[Dict[str, str]] = None):
         self.redis_url = redis_url
         self.batch_size = int(batch_size)
         self.top_n = int(top_n)
         self.max_stream_len = int(max_stream_len)
         self.log_dir = log_dir
+        # Prometheus scrape endpoint: None = off, 0 = ephemeral port
+        # (tests / multi-worker hosts), N = fixed port.  The endpoint
+        # is UNAUTHENTICATED — on shared networks bind metrics_host to
+        # 127.0.0.1 (or a scrape-only interface) instead of all
+        # interfaces.
+        self.metrics_port = (None if metrics_port is None
+                             else int(metrics_port))
+        self.metrics_host = metrics_host
         # how many batches may be read-ahead into the decode pipeline.
         # Each read-ahead batch waits ~1 predict before its own turn, so
         # depth trades tail latency for decode/predict overlap: 2 keeps
@@ -107,6 +120,10 @@ class ServingConfig:
             consumer_name=cfg.get("params.consumer_name", "worker-0")
             or "worker-0",
             pipeline_depth=int(cfg.get("params.pipeline_depth", 2) or 2),
+            metrics_port=(int(cfg["params.metrics_port"])
+                          if cfg.get("params.metrics_port") not in
+                          (None, "") else None),
+            metrics_host=cfg.get("params.metrics_host") or "0.0.0.0",
             extra=cfg,
         )
 
@@ -135,22 +152,53 @@ class ClusterServing:
         # decode/predict pipeline) — the reclaim pass must not treat
         # them as another worker's stale pending
         self._inflight: set = set()
+        # ---- observability: shared-registry instruments + /metrics --
+        reg = get_registry()
+        self._m_latency = reg.histogram(
+            "serving_request_latency_seconds",
+            "stream-arrival to result-write latency per record")
+        self._m_fill = reg.gauge(
+            "serving_batch_fill_ratio",
+            "real records / batch capacity of the last served batch")
+        self._m_records = reg.counter(
+            "serving_records_total", "records served")
+        self._m_errors = reg.counter(
+            "serving_errors_total",
+            "records acked with an error result (decode/poison)")
+        self._m_queue = reg.gauge(
+            "serving_queue_depth", "input stream length at last poll")
+        self._m_redis_retry = reg.counter(
+            "serving_redis_retry_total",
+            "result-write attempts retried after a broker error")
+        self._m_reclaimed = reg.counter(
+            "serving_reclaimed_total",
+            "stale pending records reclaimed from dead workers")
+        self._tracer = get_tracer()
+        self._telemetry: Optional[TelemetrySampler] = None
+        self.metrics_server: Optional[MetricsServer] = None
+        if self.config.metrics_port is not None:
+            self.metrics_server = MetricsServer(
+                port=self.config.metrics_port,
+                host=self.config.metrics_host).start()
 
     # ------------------------------------------------------------ main loop
     def run_once(self, block_ms: int = 100) -> int:
         """One poll/predict/write cycle; returns #records served."""
-        self._serve_start = self._serve_start or time.time()
+        self._serve_start = self._serve_start or time.perf_counter()
         entries = self._read_entries(self.config.batch_size, block_ms)
         if not entries:
             return 0
-        t0 = time.time()
+        t0 = time.perf_counter()
         real = self._serve_entries(entries, t0)
         if self.summary is not None and real:
-            self.summary.add_scalar("Serving Throughput",
-                                    real / max(time.time() - t0, 1e-9),
-                                    self.total_records)
+            self.summary.add_scalar(
+                "Serving Throughput",
+                real / max(time.perf_counter() - t0, 1e-9),
+                self.total_records)
         # OOM guard (ClusterServing.scala:128-134)
-        if self.broker.xlen(INPUT_STREAM) > self.config.max_stream_len:
+        qlen = self.broker.xlen(INPUT_STREAM)
+        self._m_queue.set(qlen)
+        if qlen > self.config.max_stream_len:
             self.broker.xtrim(INPUT_STREAM, self.config.max_stream_len)
         return real
 
@@ -162,6 +210,7 @@ class ClusterServing:
                 self.broker.hset(RESULT_PREFIX + uri, {"value": value})
                 return
             except Exception:
+                self._m_redis_retry.inc()
                 time.sleep(min(0.1 * (attempt + 1), 2.0))
         raise RuntimeError(f"could not write result for {uri}")
 
@@ -211,7 +260,8 @@ class ClusterServing:
         # a reclaimed batch can be the very poison that killed its
         # original worker — _serve_entries guarantees it cannot kill
         # THIS one too (no crash-loop across reclaiming workers)
-        real = self._serve_entries(entries, time.time())
+        real = self._serve_entries(entries, time.perf_counter())
+        self._m_reclaimed.inc(len(entries))
         log.info("reclaimed %d stale pending records (%d poison)",
                  real, len(entries) - real)
         return real
@@ -275,6 +325,7 @@ class ClusterServing:
                         {"error": f"{type(exc).__name__}: {exc}"}))
             except Exception:
                 log.exception("could not write error result for %s", uri)
+        self._m_errors.inc(len(failed))
         self._ack(entries)
         return real
 
@@ -285,19 +336,23 @@ class ClusterServing:
         bs = self.config.batch_size
         x = np.stack(arrays)
         real = len(arrays)
+        self._m_fill.set(real / bs)
         if real < bs:
             x = np.concatenate(
                 [x, np.zeros((bs - real,) + x.shape[1:], x.dtype)])
-        out = np.asarray(self.model.predict(x))[:real]
+        with self._tracer.span("serving_predict", records=real):
+            out = np.asarray(self.model.predict(x))[:real]
         exp = np.exp(out - out.max(axis=-1, keepdims=True))
         probs = exp / exp.sum(axis=-1, keepdims=True)
         top = np.argsort(-probs, axis=-1)[:, :self.config.top_n]
-        done = time.time()
+        done = time.perf_counter()
         for uri, t, p in zip(uris, top, probs):
             value = json.dumps([[int(i), float(p[i])] for i in t])
             self._write_result(uri, value)
             self.latencies.append(done - t_arrival)
+            self._m_latency.observe(done - t_arrival)
         self.total_records += real
+        self._m_records.inc(real)
         if self.summary is not None:
             self.summary.add_scalar("Total Records Number",
                                     self.total_records,
@@ -311,7 +366,7 @@ class ClusterServing:
         lat = sorted(self.latencies)
         pct = lambda p: (lat[min(int(p / 100 * len(lat)),
                                  len(lat) - 1)] * 1e3) if lat else 0.0
-        wall = (time.time() - self._serve_start) \
+        wall = (time.perf_counter() - self._serve_start) \
             if self._serve_start else 0.0
         return {
             "total_records": self.total_records,
@@ -350,17 +405,25 @@ class ClusterServing:
         log.info("cluster serving started (batch=%d, decode_workers=%d, "
                  "depth=%d)", self.config.batch_size, decode_workers,
                  pipeline_depth)
+        # wall clock for the cross-process stop-signal comparison
+        # (clients stamp STOP_KEY with time.time()); monotonic clock
+        # for every interval below
         started = time.time()
-        self._serve_start = self._serve_start or started
+        self._serve_start = self._serve_start or time.perf_counter()
+        if self.metrics_server is not None:
+            self.metrics_server.start()   # no-op if already listening
+        self._telemetry = TelemetrySampler(
+            float(get_config().get(
+                "observability.telemetry_interval_s", 10.0))).start()
         pool = ThreadPoolExecutor(decode_workers,
                                   thread_name_prefix="serving-decode")
         pending: deque = deque()   # (future, t_arrival, entries)
-        last_reclaim = started
+        last_reclaim = time.perf_counter()
         try:
             while True:
-                if time.time() - last_reclaim > 10.0:
+                if time.perf_counter() - last_reclaim > 10.0:
                     self._reclaim_stale()
-                    last_reclaim = time.time()
+                    last_reclaim = time.perf_counter()
                 # keep the decode pipeline full
                 while len(pending) < pipeline_depth:
                     entries = self._read_entries(
@@ -370,8 +433,8 @@ class ClusterServing:
                         break
                     self._inflight.update(i for i, _ in entries)
                     pending.append((pool.submit(self._decode_batch,
-                                                entries), time.time(),
-                                    entries))
+                                                entries),
+                                    time.perf_counter(), entries))
                 if pending:
                     fut, t_arrival, entries = pending.popleft()
                     self._consume_batch(fut, t_arrival, entries)
@@ -380,8 +443,9 @@ class ClusterServing:
                         self.summary.add_scalar(
                             "Serving Throughput", s["throughput_rps"],
                             self.total_records)
-                    if self.broker.xlen(INPUT_STREAM) \
-                            > self.config.max_stream_len:
+                    qlen = self.broker.xlen(INPUT_STREAM)
+                    self._m_queue.set(qlen)
+                    if qlen > self.config.max_stream_len:
                         self.broker.xtrim(INPUT_STREAM,
                                           self.config.max_stream_len)
                 if self._should_stop(started):
@@ -394,6 +458,7 @@ class ClusterServing:
                     break
         finally:
             pool.shutdown(wait=False)
+            self.close()
 
     def _consume_batch(self, fut, t_arrival, entries) -> None:
         """Serve one pipelined batch whose decode ran in the pool:
@@ -420,3 +485,23 @@ class ClusterServing:
     def stop(self) -> None:
         """(ref ClusterServingManager.listenTermination :335)"""
         self._stop.set()
+
+    def close(self) -> None:
+        """Release held resources: summary file handles, the telemetry
+        sampler, and the /metrics listener.  Idempotent; called by
+        ``run()`` on every exit path.  A closed engine can serve again
+        (summaries reopen on write; ``run()`` restarts the listener)."""
+        if self.summary is not None:
+            self.summary.close()
+        if self._telemetry is not None:
+            self._telemetry.stop()
+            self._telemetry = None
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+
+    def __enter__(self) -> "ClusterServing":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+        self.close()

@@ -4,6 +4,13 @@ Reference posture (SURVEY.md §5): coarse ``Utils.timeIt`` wall timing
 around session runs + per-iteration phase metrics in the driver log.
 TPU version: the same cheap step timers, plus first-class
 ``jax.profiler`` trace capture viewable in TensorBoard / Perfetto.
+
+All interval math uses ``time.perf_counter`` (monotonic): wall-clock
+(NTP) adjustments must never yield negative or garbage durations.
+These helpers are kept API-compatible but are now BACKED by the
+observability registry/tracer (observability/): ``time_it`` records a
+span, ``StepTimer`` feeds per-phase histograms — so existing callers
+show up in ``/metrics`` and Chrome traces for free.
 """
 
 from __future__ import annotations
@@ -15,6 +22,8 @@ from collections import defaultdict
 from typing import Dict, Optional
 
 import jax
+
+from analytics_zoo_tpu.observability import get_registry, get_tracer
 
 log = logging.getLogger("analytics_zoo_tpu.profiling")
 
@@ -43,11 +52,12 @@ def time_it(name: str, sync: bool = False):
             tb.set(model.apply(params, x))
     """
     handle = _TimedBlock()
-    t0 = time.time()
-    yield handle
-    if sync and handle.value is not None:
-        jax.block_until_ready(handle.value)
-    log.info("%s took %.3fs", name, time.time() - t0)
+    with get_tracer().span(name):
+        t0 = time.perf_counter()
+        yield handle
+        if sync and handle.value is not None:
+            jax.block_until_ready(handle.value)
+        log.info("%s took %.3fs", name, time.perf_counter() - t0)
 
 
 @contextlib.contextmanager
@@ -62,21 +72,28 @@ def trace(log_dir: str):
 
 class StepTimer:
     """Aggregate per-phase step timings (the BigDL Metrics table role:
-    driver-side phase breakdown printed per interval)."""
+    driver-side phase breakdown printed per interval).  Each ``stop``
+    also feeds the shared ``step_phase_seconds{phase=...}`` histogram,
+    so phase breakdowns appear in ``/metrics`` without new wiring."""
 
     def __init__(self, report_every: int = 100):
         self.report_every = report_every
         self._acc: Dict[str, float] = defaultdict(float)
         self._count = 0
         self._open: Dict[str, float] = {}
+        self._hist = get_registry().histogram(
+            "step_phase_seconds",
+            "per-phase step timing from StepTimer", labels=("phase",))
 
     def start(self, phase: str) -> None:
-        self._open[phase] = time.time()
+        self._open[phase] = time.perf_counter()
 
     def stop(self, phase: str) -> None:
         t0 = self._open.pop(phase, None)
         if t0 is not None:
-            self._acc[phase] += time.time() - t0
+            dt = time.perf_counter() - t0
+            self._acc[phase] += dt
+            self._hist.labels(phase).observe(dt)
 
     @contextlib.contextmanager
     def phase(self, name: str):

@@ -110,4 +110,5 @@ class TBEventWriter:
         self._f.flush()
 
     def close(self) -> None:
-        self._f.close()
+        if not self._f.closed:
+            self._f.close()

@@ -587,6 +587,30 @@ def _run_child(workload: str, timeout_s: float):
 
 ARTIFACT_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "bench_results.json")
+METRICS_SNAPSHOT_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "bench_metrics.json")
+
+
+def _record_metrics_snapshot(workload, snapshot):
+    """Persist the observability-registry snapshot a child emitted
+    alongside its timing line (per workload, latest wins) — step/request
+    latency histograms and device gauges explain WHY a headline number
+    moved, which the timing alone cannot."""
+    try:
+        data = {}
+        try:
+            with open(METRICS_SNAPSHOT_PATH) as f:
+                data = json.load(f)
+            if not isinstance(data, dict):
+                data = {}
+        except Exception:  # noqa: BLE001 — corrupt file degrades to fresh
+            data = {}
+        data[workload] = {"recorded_unix": round(time.time(), 1),
+                          "metrics": snapshot}
+        with open(METRICS_SNAPSHOT_PATH, "w") as f:
+            json.dump(data, f, indent=2)
+    except Exception:  # noqa: BLE001 — snapshots must never fail the bench
+        pass
 
 
 def _load_cached():
@@ -750,7 +774,15 @@ def main(argv=None):
             ap.error("--child requires a concrete --workload")
         try:
             _apply_platform_env()
-            _emit(WORKLOADS[args.workload]())
+            result = WORKLOADS[args.workload]()
+            try:
+                # observability snapshot rides along on the same JSON
+                # line; the parent strips it into bench_metrics.json
+                from analytics_zoo_tpu.observability import get_registry
+                result["metrics_snapshot"] = get_registry().snapshot()
+            except Exception:  # noqa: BLE001
+                pass
+            _emit(result)
             return 0
         except Exception:
             _emit(dict(diag_for(args.workload), error="workload crashed",
@@ -853,6 +885,9 @@ def main(argv=None):
         if result is None:
             result = dict(diag_for(name), error="workload run failed",
                           error_tail=err)
+        snap = result.pop("metrics_snapshot", None)
+        if snap:
+            _record_metrics_snapshot(name, snap)
         if not result.get("error"):
             result["provenance"] = "fresh"
         results.append(result)
