@@ -1,0 +1,69 @@
+"""``analytics_zoo_tpu.data`` — the deterministic, checkpointable,
+sharded input-pipeline engine (docs/data.md).
+
+Layers, bottom-up::
+
+    Source        random-access records (ArraySource / NpyDirSource /
+                  TFRecordSource)
+    IndexSampler  pure (seed, epoch, step) -> per-shard batch indices
+    Stage         composable host batch transforms (+ WorkerPool)
+    DataPipeline  source + sampler + stages + an explicit, checkpoint-
+                  able (epoch, step) position
+    DeviceLoader  double-buffered H2D placement feeding the trainer
+
+Quick use::
+
+    from analytics_zoo_tpu.data import DataPipeline
+
+    pipe = DataPipeline(x, y, batch_size=128, seed=7).map(normalize)
+    est.train(pipe, "mse", end_trigger=MaxEpoch(5))   # resumable
+
+A checkpointed training run restores mid-epoch on the exact next batch
+(``pipe.state_dict()`` rides inside the Estimator snapshot).
+"""
+
+from analytics_zoo_tpu.data.source import (
+    ArraySource,
+    NpyDirSource,
+    Source,
+    TFRecordSource,
+    as_source,
+)
+from analytics_zoo_tpu.data.sampler import IndexSampler
+from analytics_zoo_tpu.data.stages import (
+    BatchStage,
+    MapStage,
+    PrefetchIterator,
+    Stage,
+    TransformStage,
+    WorkerPool,
+    pad_to_batch,
+    run_stages,
+)
+from analytics_zoo_tpu.data.pipeline import DataPipeline
+from analytics_zoo_tpu.data.device_loader import DeviceLoader
+from analytics_zoo_tpu.data.adapters import (
+    as_data_pipeline,
+    from_feature_set,
+)
+
+__all__ = [
+    "ArraySource",
+    "NpyDirSource",
+    "Source",
+    "TFRecordSource",
+    "as_source",
+    "IndexSampler",
+    "BatchStage",
+    "MapStage",
+    "PrefetchIterator",
+    "Stage",
+    "TransformStage",
+    "WorkerPool",
+    "pad_to_batch",
+    "run_stages",
+    "DataPipeline",
+    "DeviceLoader",
+    "as_data_pipeline",
+    "from_feature_set",
+]

@@ -1,0 +1,188 @@
+"""Data sources — random-access record stores feeding the pipeline.
+
+Reference: the FeatureSet/DataSet backends (zoo/feature/FeatureSet.scala
+partition caches; pyzoo tf_dataset.py factory matrix).  A ``Source`` is
+the TPU-native analogue of Grain's ``RandomAccessDataSource``: a finite,
+indexable store whose row order NEVER changes, so a (seed, epoch, step)
+triple fully determines every batch — the property the checkpointable
+:class:`~analytics_zoo_tpu.data.pipeline.DataPipeline` is built on.
+
+Contract::
+
+    len(source)          -> number of records
+    source[i]            -> one sample pytree (row i)
+    source.gather(idx)   -> batched pytree for an int array of rows
+                            (columnar sources override with a single
+                            vectorised take; the default stacks rows)
+
+Samples are ``(x, y)`` tuples (``y`` may be ``None``) or any pytree a
+model's step accepts; ``gather`` must return the same structure with a
+leading batch axis on every leaf.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+import jax
+
+
+def _tree_rows(tree) -> int:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return len(leaves[0]) if leaves else 0
+
+
+def _tree_take(tree, idx: np.ndarray):
+    from analytics_zoo_tpu import native
+
+    def take(a):
+        if isinstance(a, np.ndarray) and a.ndim >= 1:
+            return native.gather_rows(a, idx)
+        return a[idx]
+
+    return jax.tree_util.tree_map(take, tree)
+
+
+class Source:
+    """Base class / protocol for random-access record stores."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __getitem__(self, i: int):
+        raise NotImplementedError
+
+    def gather(self, idx: np.ndarray):
+        """Batched row gather — default stacks per-row samples."""
+        rows = [self[int(i)] for i in idx]
+        return jax.tree_util.tree_map(
+            lambda *leaves: np.stack([np.asarray(l) for l in leaves]),
+            *rows)
+
+
+class ArraySource(Source):
+    """Columnar in-memory (or memory-mapped) source: ``x``/``y`` are
+    numpy pytrees with a shared leading sample axis — a minibatch is one
+    zero-copy vectorised take per leaf (``native.gather_rows``)."""
+
+    def __init__(self, x, y=None):
+        to_np = lambda t: jax.tree_util.tree_map(np.asarray, t) \
+            if t is not None else None
+        self.x = to_np(x)
+        self.y = to_np(y)
+        self._n = _tree_rows(self.x)
+        if self.y is not None and _tree_rows(self.y) != self._n:
+            raise ValueError(
+                f"x has {self._n} rows, y has {_tree_rows(self.y)}")
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i: int):
+        take = lambda t: jax.tree_util.tree_map(lambda a: a[i], t)
+        return (take(self.x), take(self.y) if self.y is not None else None)
+
+    def gather(self, idx: np.ndarray):
+        return (_tree_take(self.x, idx),
+                _tree_take(self.y, idx) if self.y is not None else None)
+
+    def nbytes(self) -> int:
+        return sum(a.nbytes for a in
+                   jax.tree_util.tree_leaves((self.x, self.y)))
+
+
+class NpyDirSource(ArraySource):
+    """``x.npy`` (+ optional ``y.npy``) directory, memory-mapped by
+    default so bigger-than-RAM data pages on demand — the PMEM tier of
+    the reference's cache hierarchy (FeatureSet.scala:585-662)."""
+
+    def __init__(self, path: str, memory_map: bool = True):
+        mmap = "r" if memory_map else None
+        x = np.load(os.path.join(path, "x.npy"), mmap_mode=mmap)
+        ypath = os.path.join(path, "y.npy")
+        y = np.load(ypath, mmap_mode=mmap) if os.path.exists(ypath) \
+            else None
+        super().__init__(x, y)
+        self.path = path
+
+
+class TFRecordSource(Source):
+    """TFRecord-backed source with random access by byte offset.
+
+    One sequential header scan (``index_tfrecord`` — lengths + crc
+    checks only, no payload parse) builds a ``(file, offset)`` index;
+    ``__getitem__`` then seeks straight to a record, so a shuffled epoch
+    costs one seek+read per record instead of a full-file decode pass.
+
+    ``decode`` maps the raw record bytes to a sample; the default
+    parses a ``tf.train.Example`` into a feature dict (reusing
+    ``feature/tfrecord.py``).
+    """
+
+    def __init__(self, paths, decode: Optional[Callable[[bytes], Any]]
+                 = None, check_crc: bool = True):
+        import glob as _glob
+        import threading
+        from analytics_zoo_tpu.feature.tfrecord import parse_example
+        if isinstance(paths, (str, os.PathLike)):
+            paths = sorted(_glob.glob(str(paths))) or [str(paths)]
+        self.paths: List[str] = [str(p) for p in paths]
+        self.decode = decode if decode is not None else parse_example
+        self.check_crc = check_crc
+        from analytics_zoo_tpu.feature.tfrecord import index_tfrecord
+        self._index: List[tuple] = []   # (path_idx, offset, length)
+        for pi, p in enumerate(self.paths):
+            for off, length in index_tfrecord(p, check_crc=check_crc):
+                self._index.append((pi, off, length))
+        # handles are PER THREAD: reads are seek+read on a shared
+        # position, so one handle used from the WorkerPool's threads
+        # would interleave seeks and hand records across offsets
+        self._local = threading.local()
+        self._all_handles: List[Any] = []
+        self._handles_lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    def _file(self, pi: int):
+        handles: Dict[int, Any] = getattr(self._local, "handles", None)
+        if handles is None:
+            handles = self._local.handles = {}
+        f = handles.get(pi)
+        if f is None or f.closed:
+            f = open(self.paths[pi], "rb")
+            handles[pi] = f
+            with self._handles_lock:
+                self._all_handles.append(f)
+        return f
+
+    def read_record(self, i: int) -> bytes:
+        from analytics_zoo_tpu.feature.tfrecord import read_record_at
+        pi, off, _length = self._index[i]
+        return read_record_at(self._file(pi), off,
+                              check_crc=self.check_crc,
+                              path=self.paths[pi])
+
+    def __getitem__(self, i: int):
+        return self.decode(self.read_record(i))
+
+    def close(self) -> None:
+        with self._handles_lock:
+            handles, self._all_handles = self._all_handles, []
+        for f in handles:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    def __del__(self):  # best-effort handle cleanup
+        self.close()
+
+
+def as_source(data, y=None) -> Source:
+    """Coerce ndarrays / pytrees / an existing Source into a Source."""
+    if isinstance(data, Source):
+        return data
+    return ArraySource(data, y)

@@ -37,29 +37,118 @@ def masked_crc32c(data: bytes) -> int:
     return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
 
 
+class CorruptRecordError(IOError):
+    """A TFRecord frame failed validation: truncated header/payload or
+    a crc mismatch.  Carries the file path and the BYTE OFFSET of the
+    bad frame so a corrupt shard can be repaired / resharded without a
+    hex-dump hunt."""
+
+    def __init__(self, path: str, offset: int, reason: str):
+        super().__init__(f"{path}: corrupt TFRecord at byte offset "
+                         f"{offset}: {reason}")
+        self.path = path
+        self.offset = offset
+        self.reason = reason
+
+
 # ----------------------------------------------------------------- framing
+
+def _read_frame(f, offset: int, path: str, check_crc: bool):
+    """Read one framed record at ``offset`` (file position must already
+    be there).  Returns the payload bytes, or None at clean EOF.
+
+    The length-crc is ALWAYS verified before the length field is
+    trusted: a corrupt 8-byte length would otherwise drive a
+    multi-gigabyte read (or a bogus "truncated" report) from 12 bytes
+    of garbage.  ``check_crc`` gates only the payload crc, whose cost
+    scales with the data.
+    """
+    header = f.read(12)
+    if not header:
+        return None
+    if len(header) < 12:
+        raise CorruptRecordError(
+            path, offset,
+            f"truncated header ({len(header)} of 12 bytes)")
+    length, length_crc = struct.unpack("<QI", header)
+    if masked_crc32c(header[:8]) != length_crc:
+        raise CorruptRecordError(path, offset, "length crc mismatch")
+    data = f.read(length)
+    if len(data) < length:
+        raise CorruptRecordError(
+            path, offset,
+            f"truncated payload ({len(data)} of {length} bytes)")
+    crc_bytes = f.read(4)
+    if len(crc_bytes) < 4:
+        raise CorruptRecordError(
+            path, offset,
+            f"truncated payload crc ({len(crc_bytes)} of 4 bytes)")
+    if check_crc:
+        (data_crc,) = struct.unpack("<I", crc_bytes)
+        if masked_crc32c(data) != data_crc:
+            raise CorruptRecordError(path, offset, "payload crc mismatch")
+    return data
+
 
 def read_tfrecord(path: str, check_crc: bool = True) -> Iterator[bytes]:
     """Yield raw record payloads from one TFRecord file."""
     with open(path, "rb") as f:
+        offset = 0
         while True:
-            header = f.read(12)
-            if not header:
+            data = _read_frame(f, offset, path, check_crc)
+            if data is None:
                 return
-            if len(header) < 12:
-                raise IOError(f"{path}: truncated record header")
-            length, length_crc = struct.unpack("<QI", header)
-            if check_crc and \
-                    masked_crc32c(header[:8]) != length_crc:
-                raise IOError(f"{path}: corrupt length crc")
-            data = f.read(length)
-            crc_bytes = f.read(4)
-            if len(data) < length or len(crc_bytes) < 4:
-                raise IOError(f"{path}: truncated record")
-            (data_crc,) = struct.unpack("<I", crc_bytes)
-            if check_crc and masked_crc32c(data) != data_crc:
-                raise IOError(f"{path}: corrupt data crc")
+            offset += 12 + len(data) + 4
             yield data
+
+
+def index_tfrecord(path: str, check_crc: bool = True
+                   ) -> Iterator[tuple]:
+    """Yield ``(offset, length)`` for every frame in one file — the
+    random-access index for ``data.source.TFRecordSource``.  Walks the
+    framing by seeking over payloads, so indexing cost is header IO
+    only; with ``check_crc`` the payloads are read and verified too
+    (one up-front integrity pass instead of a mid-epoch crash)."""
+    with open(path, "rb") as f:
+        offset = 0
+        size = os.fstat(f.fileno()).st_size
+        while True:
+            if check_crc:
+                data = _read_frame(f, offset, path, True)
+                if data is None:
+                    return
+                length = len(data)
+            else:
+                header = f.read(12)
+                if not header:
+                    return
+                if len(header) < 12:
+                    raise CorruptRecordError(
+                        path, offset,
+                        f"truncated header ({len(header)} of 12 bytes)")
+                length, length_crc = struct.unpack("<QI", header)
+                if masked_crc32c(header[:8]) != length_crc:
+                    raise CorruptRecordError(path, offset,
+                                             "length crc mismatch")
+                end = f.seek(length + 4, os.SEEK_CUR)
+                if end > size:
+                    raise CorruptRecordError(
+                        path, offset,
+                        f"truncated payload (frame ends at {end}, file "
+                        f"is {size} bytes)")
+            yield offset, length
+            offset += 12 + length + 4
+
+
+def read_record_at(f, offset: int, check_crc: bool = True,
+                   path: str = "<tfrecord>") -> bytes:
+    """Random-access read of one frame at a known ``offset`` from an
+    open binary file handle."""
+    f.seek(offset)
+    data = _read_frame(f, offset, path, check_crc)
+    if data is None:
+        raise CorruptRecordError(path, offset, "offset is at/past EOF")
+    return data
 
 
 def write_tfrecord(path: str, records: Sequence[bytes]) -> None:

@@ -120,16 +120,19 @@ class KerasNet(Container):
     def fit(self, x, y=None, batch_size: int = 32, nb_epoch: int = 10,
             validation_data=None, validation_split: float = 0.0,
             shuffle: bool = True, rng=None):
-        """Train on ndarrays or a FeatureSet (Topology.scala:344-492)."""
+        """Train on ndarrays, a FeatureSet, or a resumable DataPipeline
+        (Topology.scala:344-492; docs/data.md)."""
+        from analytics_zoo_tpu.data import DataPipeline
         from analytics_zoo_tpu.pipeline.estimator import Estimator
         from analytics_zoo_tpu.feature.feature_set import FeatureSet
         from analytics_zoo_tpu.common.triggers import MaxEpoch, EveryEpoch
 
-        if isinstance(x, FeatureSet):
+        if isinstance(x, (FeatureSet, DataPipeline)):
             if validation_split:
                 raise ValueError(
                     "validation_split is not supported when x is a "
-                    "FeatureSet; pass validation_data instead")
+                    "FeatureSet/DataPipeline; pass validation_data "
+                    "instead")
             train_set = x
         else:
             x_arr, y_arr = x, y
@@ -146,7 +149,7 @@ class KerasNet(Container):
 
         val_set = None
         if validation_data is not None:
-            if isinstance(validation_data, FeatureSet):
+            if isinstance(validation_data, (FeatureSet, DataPipeline)):
                 val_set = validation_data
             else:
                 vx, vy = validation_data

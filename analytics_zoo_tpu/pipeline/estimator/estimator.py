@@ -71,6 +71,24 @@ class _UnrecoverableTraining(RuntimeError):
     checkpoint to restore — the retry loop must not spin on it."""
 
 
+def eval_batches(data_set, batch_size: int):
+    """Ordered, masked eval batches from either data layer: a
+    ``FeatureSet`` (zero-padded tail + mask) or a ``DataPipeline``
+    built with ``remainder="pad"`` (which yields the identical
+    ``(x, y, mask)`` shape).  The shared entry for evaluate() and the
+    in-training validation pass."""
+    from analytics_zoo_tpu.data import DataPipeline
+    if isinstance(data_set, DataPipeline):
+        if data_set.sampler.remainder != "pad":
+            raise ValueError(
+                "evaluation needs every sample exactly once: build the "
+                "validation DataPipeline with remainder='pad' (and "
+                "shuffle=False) so the tail batch is masked, not "
+                "dropped")
+        return (batch for _step, batch in data_set.iter_epoch(0))
+    return data_set.epoch_batches(0, batch_size, train=False)
+
+
 def predict_in_batches(run_batch, x, batch_size: int):
     """Fixed-shape batched prediction: zero-pad the tail batch so one
     compiled program serves every batch, slice the padding back off,
@@ -139,6 +157,7 @@ class Estimator:
               checkpoint_trigger: Optional[Trigger] = None,
               validation_set=None, validation_method=None,
               batch_size: int = 32, rng=None):
+        from analytics_zoo_tpu.data import DataPipeline, DeviceLoader
         from analytics_zoo_tpu.feature.feature_set import FeatureSet
         assert self.optim_method or self.optim_groups, \
             "Estimator needs an optim_method to train"
@@ -149,13 +168,19 @@ class Estimator:
         rng = rng if rng is not None else jax.random.PRNGKey(
             int(get_config().get("data.shuffle_seed")))
 
+        is_pipeline = isinstance(train_set, DataPipeline)
+        if is_pipeline:
+            # the pipeline owns its batch geometry (it is part of the
+            # checkpointed stream identity) — the argument is ignored
+            batch_size = train_set.batch_size
         trainer = DistributedTrainer(
             self.model, criterion, optim_method=self.optim_method,
             clip=self._clip, optim_groups=self.optim_groups)
         # The global batch must tile the data-parallel mesh (the analogue
         # of BigDL's batchSize % totalCores == 0 requirement).
         mesh_lib.local_batch_size(trainer.mesh, batch_size)
-        if getattr(train_set, "size", batch_size) < batch_size:
+        if not is_pipeline and \
+                getattr(train_set, "size", batch_size) < batch_size:
             raise ValueError(
                 f"batch_size {batch_size} exceeds dataset size "
                 f"{train_set.size}: no full training batch can be formed "
@@ -176,25 +201,58 @@ class Estimator:
         def restore_snapshot(like):
             """ckpt.restore_latest with a span + restore counter (all
             restore sites — resume, HBM-cache recovery, retry loop —
-            go through here so the counter is a complete record)."""
+            go through here so the counter is a complete record).  When
+            training from a DataPipeline, ``like`` carries a ``data``
+            slot; a LEGACY checkpoint (saved before the pipeline layer
+            existed) lacks it, so retry without — the position then
+            stays wherever the pipeline is, matching the old
+            replay-the-epoch semantics."""
             if ckpt is None:
                 return None
             with tracer.span("checkpoint_restore"):
-                restored = ckpt.restore_latest(like)
+                try:
+                    restored = ckpt.restore_latest(like)
+                except (ValueError, KeyError):
+                    if "data" not in like:
+                        raise
+                    like = {k: v for k, v in like.items() if k != "data"}
+                    restored = ckpt.restore_latest(like)
+                    if restored is not None:
+                        log.warning(
+                            "checkpoint has no data-pipeline state "
+                            "(pre-pipeline snapshot); restored model "
+                            "state only — the epoch's batches replay "
+                            "from the pipeline's current position")
             if restored is not None:
                 met["ckpt_restore"].inc()
             return restored
 
+        def snapshot_like():
+            """The restore target, built from the CURRENT device trees
+            (late-bound locals)."""
+            like = {"params": params, "state": state,
+                    "opt_state": opt_state, "epoch": 0, "iteration": 0}
+            if is_pipeline:
+                like["data"] = train_set.state_dict()
+            return like
+
+        def restore_data_state(restored) -> None:
+            """Seek the pipeline to the checkpointed position so the
+            resumed run consumes the exact next batch (no replayed or
+            skipped samples)."""
+            if is_pipeline and restored is not None \
+                    and restored.get("data") is not None:
+                train_set.load_state_dict(restored["data"])
+
         if ckpt is not None:
-            restored = restore_snapshot(
-                {"params": params, "state": state, "opt_state": opt_state,
-                 "epoch": 0, "iteration": 0})
+            restored = restore_snapshot(snapshot_like())
             if restored is not None:
                 params = trainer.place_params(restored["params"])
                 state = trainer.replicate(restored["state"])
                 opt_state = trainer.place_like(restored["opt_state"], opt_state)
                 ts.epoch = int(restored["epoch"])
                 ts.iteration = int(restored["iteration"])
+                restore_data_state(restored)
                 log.info("resumed from checkpoint at epoch %d iter %d",
                          ts.epoch, ts.iteration)
 
@@ -227,6 +285,11 @@ class Estimator:
                            "state": mesh_lib.fetch_global(state),
                            "opt_state": mesh_lib.fetch_global(opt_state),
                            "epoch": ts.epoch, "iteration": ts.iteration}
+                if is_pipeline:
+                    # the pipeline position points at the NEXT batch to
+                    # deliver (committed per consumed batch), so this
+                    # snapshot resumes mid-epoch exactly
+                    payload["data"] = train_set.state_dict()
                 if jax.process_index() == 0:
                     ckpt.save(payload, step=ts.iteration)
                     # counted only where the file is actually written,
@@ -241,6 +304,9 @@ class Estimator:
         # must fire mid-epoch at exact steps), a single slice, and the
         # EXACT FeatureSet class (subclasses may override epoch_batches
         # with streaming/failure semantics that chunking would bypass).
+        device_loader = DeviceLoader(train_set, put_fn=trainer.put_batch) \
+            if is_pipeline else None
+
         chunk_steps = int(get_config().get("train.steps_per_dispatch"))
         use_chunks = (chunk_steps > 1
                       and getattr(train_set, "num_slices", 1) == 1
@@ -338,8 +404,7 @@ class Estimator:
                                 exc_info=True)
                     return eval_runner(
                         params, state,
-                        validation_set.epoch_batches(0, batch_size,
-                                                     train=False))
+                        eval_batches(validation_set, batch_size))
             finally:
                 met["eval_seconds"].observe(time.perf_counter() - t0)
 
@@ -364,7 +429,27 @@ class Estimator:
                 loss = None
                 num_slices = getattr(train_set, "num_slices", 1)
                 try:
-                    if hbm_src is not None:
+                    if is_pipeline:
+                        # resumable engine: the DeviceLoader pulls host
+                        # batches ahead (worker pool + double buffer)
+                        # and commits the pipeline position per batch
+                        # consumed, so any checkpoint below captures
+                        # the exact next batch
+                        for batch in device_loader.epoch():
+                            params, opt_state, state, loss = \
+                                trainer.train_step_at(
+                                    params, opt_state, state, batch,
+                                    rng, np.int32(ts.iteration))
+                            ts.iteration += 1
+                            seen += batch_size
+                            log_loss_crossing(loss, 1)
+                            if ckpt is not None and \
+                                    checkpoint_trigger(ts):
+                                save_snapshot()
+                            if end_trigger(ts):
+                                stop = True
+                                break
+                    elif hbm_src is not None:
                         try:
                             xs, ys = hbm_src
                             if train_set.shuffle:
@@ -536,15 +621,14 @@ class Estimator:
                     log.exception(
                         "training step failed; restoring latest checkpoint "
                         "(%d retries left)", retries_left)
-                    restored = restore_snapshot(
-                        {"params": params, "state": state,
-                         "opt_state": opt_state, "epoch": 0, "iteration": 0})
+                    restored = restore_snapshot(snapshot_like())
                     if restored is not None:
                         params = trainer.place_params(restored["params"])
                         state = trainer.replicate(restored["state"])
                         opt_state = trainer.place_like(restored["opt_state"], opt_state)
                         ts.epoch = int(restored["epoch"])
                         ts.iteration = int(restored["iteration"])
+                        restore_data_state(restored)
                     continue
 
                 if loss is not None:
@@ -648,8 +732,7 @@ class Estimator:
         if runner is None:
             runner = trainer.make_eval_runner(methods)
             self._cached_eval_runners[key] = runner
-        return runner(params, state,
-                      data_set.epoch_batches(0, batch_size, train=False))
+        return runner(params, state, eval_batches(data_set, batch_size))
 
     # -------------------------------------------------------------- predict
     def predict(self, x, batch_size: int = 256):

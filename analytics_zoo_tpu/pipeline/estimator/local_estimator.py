@@ -85,12 +85,19 @@ class LocalEstimator:
     # ----------------------------------------------------------------- fit
     def fit(self, x, y, validation_data=None, batch_size: int = 32,
             epochs: int = 1, rng=None):
+        from analytics_zoo_tpu.data import DataPipeline
         from analytics_zoo_tpu.feature.feature_set import FeatureSet
-        data = x if isinstance(x, FeatureSet) \
-            else FeatureSet.from_ndarrays(x, y)
-        if data.size < batch_size:
-            raise ValueError(
-                f"batch_size {batch_size} exceeds dataset size {data.size}")
+        pipeline = x if isinstance(x, DataPipeline) else None
+        if pipeline is not None:
+            data = pipeline
+            batch_size = pipeline.batch_size
+        else:
+            data = x if isinstance(x, FeatureSet) \
+                else FeatureSet.from_ndarrays(x, y)
+            if data.size < batch_size:
+                raise ValueError(
+                    f"batch_size {batch_size} exceeds dataset size "
+                    f"{data.size}")
         rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         variables = self.model.get_variables()
@@ -127,7 +134,9 @@ class LocalEstimator:
             t0 = time.perf_counter()
             seen = 0
             loss = None
-            for bx, by in data.epoch_batches(epoch, batch_size, train=True):
+            batches = iter(pipeline) if pipeline is not None \
+                else data.epoch_batches(epoch, batch_size, train=True)
+            for bx, by in batches:
                 with tracer.span("train_step"):
                     params, opt_state, state, loss = self._step(
                         params, opt_state, state, bx, by,

@@ -20,12 +20,12 @@ import logging
 import threading
 import time
 from collections import deque
-from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.data.stages import WorkerPool, pad_to_batch
 from analytics_zoo_tpu.observability import (
     MetricsServer, TelemetrySampler, get_registry, get_tracer)
 from analytics_zoo_tpu.serving.redis_client import connect
@@ -337,9 +337,9 @@ class ClusterServing:
         x = np.stack(arrays)
         real = len(arrays)
         self._m_fill.set(real / bs)
-        if real < bs:
-            x = np.concatenate(
-                [x, np.zeros((bs - real,) + x.shape[1:], x.dtype)])
+        # same fixed-shape padding primitive the train pipeline's
+        # pad-remainder mode uses (data/stages.py)
+        x = pad_to_batch(x, bs)
         with self._tracer.span("serving_predict", records=real):
             out = np.asarray(self.model.predict(x))[:real]
         exp = np.exp(out - out.max(axis=-1, keepdims=True))
@@ -415,8 +415,10 @@ class ClusterServing:
         self._telemetry = TelemetrySampler(
             float(get_config().get(
                 "observability.telemetry_interval_s", 10.0))).start()
-        pool = ThreadPoolExecutor(decode_workers,
-                                  thread_name_prefix="serving-decode")
+        # the input-pipeline worker pool (data/stages.py): serving's
+        # decode stage is the same shape of work as a train pipeline's
+        # map stage — CPU-bound host transforms overlapping the chip
+        pool = WorkerPool(decode_workers, name="serving-decode")
         pending: deque = deque()   # (future, t_arrival, entries)
         last_reclaim = time.perf_counter()
         try:

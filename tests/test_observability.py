@@ -391,6 +391,7 @@ class TestTrainingInstrumentation:
 # -------------------------------------------- serving /metrics endpoint
 class TestServingMetrics:
     def test_metrics_endpoint_on_running_engine(self):
+        from analytics_zoo_tpu.observability import reset_registry
         from analytics_zoo_tpu.pipeline.inference import InferenceModel
         from analytics_zoo_tpu.serving.client import (
             InputQueue, OutputQueue)
@@ -400,6 +401,13 @@ class TestServingMetrics:
         from analytics_zoo_tpu.pipeline.api.keras import Sequential
         from analytics_zoo_tpu.pipeline.api.keras.layers import (
             Dense, Flatten)
+        # the registry is process-global and serving counters are
+        # cumulative: any earlier in-process test that served records
+        # leaves serving_records_total > 0, failing the fresh-worker
+        # zero assertion below depending on file selection/order.
+        # This test is about a FRESH worker's exposition, so give it a
+        # fresh registry.
+        reset_registry()
         m = Sequential()
         m.add(Flatten(input_shape=(8, 8, 3)))
         m.add(Dense(4))
