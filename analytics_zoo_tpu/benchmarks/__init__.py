@@ -21,11 +21,15 @@ def _nominal_peak(kind) -> float | None:
     return None
 
 
-def mfu_estimate(flops_per_step, step_time_s, device):
+def mfu_estimate(flops_per_step, step_time_s, device, peak=None):
     """Model FLOPs utilisation vs the chip's bf16 peak; None when the
-    chip generation (or the FLOP count) is unknown."""
-    peak = _nominal_peak(getattr(device, "device_kind", ""))
-    if peak is None or not flops_per_step or step_time_s <= 0:
+    chip generation (or the FLOP count) is unknown.  ``peak`` (FLOP/s)
+    overrides the device-kind lookup — the knob for backends whose
+    nominal peak is unknown (CPU smoke runs) or calibrated hardware
+    (``calibrate_chip``'s ``deliverable_tflops``)."""
+    if peak is None:
+        peak = _nominal_peak(getattr(device, "device_kind", ""))
+    if not peak or not flops_per_step or step_time_s <= 0:
         return None
     return round(flops_per_step / step_time_s / peak, 6)
 

@@ -77,6 +77,45 @@ _DEFAULTS: Dict[str, Any] = {
     # Background device-telemetry sampling period for long-running
     # services (serving); one-shot samples are free-form.
     "observability.telemetry_interval_s": 10.0,
+    # Fold a jnp.isfinite(loss + sum(grads)) reduction into the jitted
+    # train step and surface non-finite steps through a host callback
+    # (the grad-norm callback path) — the watchdog's NaN detector.
+    "observability.check_finite": True,
+    # Training-health watchdog: what to do when an unhealthy signal
+    # (non-finite loss/grad, loss divergence) fires.
+    #   "warn"                log + metrics, keep training
+    #   "checkpoint_and_halt" snapshot via the Estimator's checkpoint
+    #                         machinery, then raise TrainingHalted
+    "observability.watchdog_policy": "warn",
+    # Plateau detection: no new best loss (improvement > min_delta *
+    # max(|best|, 1)) within this many observed losses => plateau.
+    "observability.watchdog_window": 50,
+    "observability.watchdog_min_delta": 1e-4,
+    # Divergence: loss - best > divergence * max(|best|, 1).
+    "observability.watchdog_divergence": 10.0,
+    # Stall heartbeat: flag when no train step completes within this
+    # many seconds (0 = heartbeat thread off).
+    "observability.watchdog_stall_s": 0.0,
+    # CompileMonitor: signatures compiled within the first N calls of a
+    # wrapped function are expected warmup; a NEW abstract signature
+    # after that is recompilation churn (loud structured warning).
+    "observability.compile_warmup_calls": 3,
+    # Pull XLA cost_analysis() FLOPs/bytes for each newly compiled
+    # monitored function into gauges (feeds the live MFU estimate).
+    "observability.cost_analysis": True,
+    # Sample the dispatch->block_until_ready device bracket every N
+    # dispatched steps for step-time attribution + MFU (0 = off; the
+    # sampled step pays one device sync).
+    "observability.device_time_every": 16,
+    # MFU denominator override in FLOP/s (0 = derive from the device
+    # kind via benchmarks.PEAK_FLOPS; set explicitly on backends whose
+    # peak is unknown, e.g. CPU smoke runs).
+    "observability.peak_flops": 0.0,
+    # Serving readiness (/healthz -> 503): input-stream backlog above
+    # which the worker reports not-ready (0 = disabled) and the error
+    # fraction over the most recent records (0 = disabled).
+    "serving.healthz_max_queue": 0,
+    "serving.healthz_max_error_rate": 0.0,
 }
 
 _ENV_PREFIX = "ZOO_TPU_"

@@ -22,6 +22,8 @@ import jax
 from analytics_zoo_tpu.data.pipeline import DataPipeline
 from analytics_zoo_tpu.data.stages import PrefetchIterator
 from analytics_zoo_tpu.observability import get_registry
+from analytics_zoo_tpu.observability.diagnostics import (
+    step_attribution_histogram)
 
 
 def _default_put(batch):
@@ -69,6 +71,9 @@ class DeviceLoader:
         self._m_depth = get_registry().gauge(
             "train_prefetch_queue_depth",
             "device-placed batches waiting in the prefetch queue")
+        # step-time attribution: the loader is the training loop's
+        # data_wait producer on the DataPipeline path
+        self._m_wait = step_attribution_histogram().labels("data_wait")
 
     def epoch(self) -> Iterator[Any]:
         """Yield device batches for the pipeline's current epoch from
@@ -94,8 +99,11 @@ class DeviceLoader:
             for step, batch in placed:
                 # feed the pipeline's own batch counter / wait
                 # histogram — device-fed consumption is still pipeline
-                # consumption
-                pipe._m["wait"].observe(time.perf_counter() - t0)
+                # consumption — plus the step-attribution data_wait
+                # component the diagnostics report reads
+                wait = time.perf_counter() - t0
+                pipe._m["wait"].observe(wait)
+                self._m_wait.observe(wait)
                 pipe._m["batches"].inc()
                 pipe.commit(epoch, step + 1)
                 yield batch

@@ -42,6 +42,19 @@ from analytics_zoo_tpu.observability.exporter import (
     MetricsServer,
     start_metrics_server,
 )
+from analytics_zoo_tpu.observability.diagnostics import (
+    CompileMonitor,
+    get_compile_monitor,
+    publish_mfu,
+    reset_compile_monitor,
+    step_attribution_histogram,
+)
+from analytics_zoo_tpu.observability.watchdog import (
+    TrainingHalted,
+    TrainingWatchdog,
+    get_active_watchdog,
+    set_active_watchdog,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
@@ -57,4 +70,13 @@ __all__ = [
     "sample_device_telemetry",
     "MetricsServer",
     "start_metrics_server",
+    "CompileMonitor",
+    "get_compile_monitor",
+    "reset_compile_monitor",
+    "publish_mfu",
+    "step_attribution_histogram",
+    "TrainingHalted",
+    "TrainingWatchdog",
+    "get_active_watchdog",
+    "set_active_watchdog",
 ]

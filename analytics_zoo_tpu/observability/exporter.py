@@ -5,7 +5,11 @@ Routes:
     /metrics        Prometheus text exposition 0.0.4 (scrape target)
     /metrics.json   registry snapshot as JSON
     /trace          Chrome-trace JSON of the span tracer (Perfetto)
-    /healthz        200 "ok"
+    /healthz        liveness ("ok") — or a READINESS probe when the
+                    owner installed a ``health_check``: 200 JSON when
+                    healthy, 503 JSON naming the reason when not
+                    (serving wires its queue-depth / error-rate
+                    thresholds in here)
 
 Port 0 binds an ephemeral port (``server.port`` has the real one) —
 what tests and multi-worker hosts use to avoid collisions.
@@ -54,7 +58,22 @@ class _Handler(BaseHTTPRequestHandler):
                     self.server.tracer.chrome_trace()).encode()
                 self._respond(body, "application/json")
             elif path == "/healthz":
-                self._respond(b"ok", "text/plain")
+                check = getattr(self.server, "health_check", None)
+                if check is None:
+                    self._respond(b"ok", "text/plain")
+                else:
+                    try:
+                        reason = check()
+                    except Exception:
+                        log.exception("health check raised")
+                        reason = {"reason": "health check raised"}
+                    if reason:
+                        body = json.dumps(
+                            {"ready": False, **reason}).encode()
+                        self._respond(body, "application/json", 503)
+                    else:
+                        self._respond(b'{"ready": true}',
+                                      "application/json")
             else:
                 self._respond(b"not found", "text/plain", 404)
         except Exception:  # a scrape must never kill the server thread
@@ -79,11 +98,15 @@ class MetricsServer:
 
     def __init__(self, port: int = 0, host: str = "0.0.0.0",
                  registry: Optional[MetricsRegistry] = None,
-                 tracer: Optional[Tracer] = None):
+                 tracer: Optional[Tracer] = None,
+                 health_check=None):
         self._requested = (host, int(port))
         self.registry = registry if registry is not None \
             else get_registry()
         self.tracer = tracer if tracer is not None else get_tracer()
+        # readiness probe: a callable returning None (healthy) or a
+        # JSON-able dict naming the reason (-> 503 on /healthz)
+        self.health_check = health_check
         self._httpd: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -97,6 +120,7 @@ class MetricsServer:
         self._httpd = _Server(self._requested, _Handler)
         self._httpd.registry = self.registry
         self._httpd.tracer = self.tracer
+        self._httpd.health_check = self.health_check
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name=f"zoo-metrics-http:{self.port}")
@@ -117,8 +141,9 @@ class MetricsServer:
 
 def start_metrics_server(port: int = 0, host: str = "0.0.0.0",
                          registry: Optional[MetricsRegistry] = None,
-                         tracer: Optional[Tracer] = None) -> MetricsServer:
+                         tracer: Optional[Tracer] = None,
+                         health_check=None) -> MetricsServer:
     """Build + start in one call; returns the server (``.port`` holds
     the bound port when ``port=0``)."""
     return MetricsServer(port=port, host=host, registry=registry,
-                         tracer=tracer).start()
+                         tracer=tracer, health_check=health_check).start()

@@ -53,10 +53,21 @@ def _jit_cache_size() -> Optional[int]:
     return None
 
 
+# devices that have successfully reported memory_stats at least once
+# in this process — a later failed poll on one of these marks its
+# gauges STALE instead of silently freezing them (some backends drop
+# memory_stats mid-run, e.g. across a tunneled-runtime reconnect)
+_reported_devices: set = set()
+
+
 def sample_device_telemetry(registry: Optional[MetricsRegistry] = None
                             ) -> Dict[str, float]:
     """One sampling pass: set the gauges and return what was sampled
-    (a plain dict, handy for logging/tests).  Never raises."""
+    (a plain dict, handy for logging/tests).  Never raises — a backend
+    where ``memory_stats()`` becomes unavailable mid-run degrades to
+    stale-marked gauges (``device_telemetry_stale{device}=1`` while
+    the last good values stay exported) rather than an exception
+    escaping the sampler thread."""
     reg = registry if registry is not None else get_registry()
     sampled: Dict[str, float] = {}
     try:
@@ -71,9 +82,26 @@ def sample_device_telemetry(registry: Optional[MetricsRegistry] = None
             stats = dev.memory_stats()
         except Exception:
             stats = None
-        if not stats:
-            continue
         label = str(getattr(dev, "id", dev))
+        if not stats:
+            if label in _reported_devices:
+                # the device USED to report: keep the last-good gauge
+                # values (scrapes still see them) but flag staleness
+                # so dashboards/alerts don't trust a frozen number
+                reg.gauge(
+                    "device_telemetry_stale",
+                    "1 when the device stopped reporting memory_stats "
+                    "mid-run (its device_* gauges hold last-good "
+                    "values)", labels=("device",)).labels(label).set(1)
+                sampled[f"device_telemetry_stale{{{label}}}"] = 1.0
+            continue
+        if label in _reported_devices:
+            reg.gauge(
+                "device_telemetry_stale",
+                "1 when the device stopped reporting memory_stats "
+                "mid-run (its device_* gauges hold last-good values)",
+                labels=("device",)).labels(label).set(0)
+        _reported_devices.add(label)
         for key, gname in _MEM_KEYS.items():
             if key in stats:
                 reg.gauge(

@@ -1,0 +1,322 @@
+"""Training-health watchdog: non-finite loss/grad detection, loss
+divergence/plateau detection over a sliding window, and a stall
+heartbeat — with a configurable policy.
+
+The reference surfaced run health through driver logs and validation
+summaries; a silently-NaN'd run was only visible when someone read the
+loss curve.  Here the health signals are *first-class*: the jitted
+train step folds a ``jnp.isfinite`` reduction over loss+grads into its
+program and surfaces the flag through a host callback (the grad-norm
+callback path); the driver loop feeds observed losses and heartbeats;
+a background thread flags stalls when no step completes within a
+deadline.  The policy decides what an unhealthy signal does:
+
+* ``warn`` — structured log + metrics, training continues;
+* ``checkpoint_and_halt`` — the Estimator snapshots through its
+  checkpoint machinery and raises :class:`TrainingHalted` (which the
+  failure-retry loop deliberately does NOT absorb — retrying a NaN'd
+  step would replay the same poison).
+
+Plateau and stall are *advisory* (always warn-only): halting a run for
+a plateau would turn early stopping into a crash; a truly stalled loop
+cannot run the halting code anyway, so the heartbeat thread's loud log
+line and health gauge are the honest best-effort.
+
+Metrics: ``train_nonfinite_total{source}``,
+``watchdog_events_total{kind}``, ``train_health_status``
+(0 healthy / 1 warned / 2 halt-pending).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from analytics_zoo_tpu.observability.metrics import (
+    MetricsRegistry, get_registry)
+
+log = logging.getLogger("analytics_zoo_tpu.observability")
+
+HEALTHY, WARNED, HALT_PENDING = 0, 1, 2
+
+
+class TrainingHalted(RuntimeError):
+    """Raised by the ``checkpoint_and_halt`` policy after the halt
+    snapshot is written.  Carries ``issue`` (the triggering event
+    dict) so callers can render the reason without parsing the
+    message."""
+
+    def __init__(self, message: str, issue: Optional[Dict] = None):
+        super().__init__(message)
+        self.issue = issue or {}
+
+
+class TrainingWatchdog:
+    """Aggregates health signals from three producers — the in-jit
+    finite-check callback (any thread), the driver loop
+    (``beat``/``observe_loss``), and the stall monitor thread — into a
+    queue of *issues* the driver polls between steps.
+
+    ``clock`` is injectable for tests (defaults to
+    ``time.monotonic``); all interval math uses it.
+    """
+
+    HALTING_KINDS = ("nonfinite", "divergence")
+
+    def __init__(self, policy: Optional[str] = None,
+                 window: Optional[int] = None,
+                 min_delta: Optional[float] = None,
+                 divergence: Optional[float] = None,
+                 stall_timeout_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: Optional[MetricsRegistry] = None):
+        from analytics_zoo_tpu.common.config import get_config
+        cfg = get_config()
+        self.policy = str(policy if policy is not None else cfg.get(
+            "observability.watchdog_policy", "warn"))
+        if self.policy not in ("warn", "checkpoint_and_halt"):
+            raise ValueError(
+                f"watchdog policy {self.policy!r}: expected 'warn' or "
+                "'checkpoint_and_halt'")
+        self.window = int(window if window is not None else cfg.get(
+            "observability.watchdog_window", 50))
+        self.min_delta = float(
+            min_delta if min_delta is not None
+            else cfg.get("observability.watchdog_min_delta", 1e-4))
+        self.divergence = float(
+            divergence if divergence is not None
+            else cfg.get("observability.watchdog_divergence", 10.0))
+        self.stall_timeout_s = float(
+            stall_timeout_s if stall_timeout_s is not None
+            else cfg.get("observability.watchdog_stall_s", 0.0))
+        self._clock = clock
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._issues: List[Dict] = []
+        self._best = math.inf
+        self._since_improve = 0
+        self._observed = 0
+        self._nonfinite_seen = 0
+        self._diverged_fired = False
+        self._stall_fired = False
+        self._last_beat = clock()
+        self._halted = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._set_status(HEALTHY)
+
+    def _reg(self) -> MetricsRegistry:
+        return self._registry if self._registry is not None \
+            else get_registry()
+
+    def _set_status(self, value: int) -> None:
+        try:
+            self._reg().gauge(
+                "train_health_status",
+                "watchdog verdict: 0 healthy, 1 warned, 2 halt "
+                "pending/halted").set(value)
+        except Exception:
+            pass
+
+    def _push(self, kind: str, **detail) -> None:
+        issue = {"kind": kind, **detail}
+        with self._lock:
+            self._issues.append(issue)
+        try:
+            self._reg().counter(
+                "watchdog_events_total",
+                "training-health events by kind",
+                labels=("kind",)).labels(kind).inc()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------- producers
+    def beat(self) -> None:
+        """A train step completed — feeds the stall deadline.  A beat
+        after a flagged stall ends that episode and re-arms the
+        detector for the next one."""
+        self._last_beat = self._clock()
+        self._stall_fired = False
+
+    def record_nonfinite(self, source: str = "step") -> None:
+        """A non-finite loss/grad was detected (host-callback thread
+        or a driver-side isfinite check).  The counter counts every
+        occurrence; the ISSUE (and its warning log) is throttled —
+        under the warn policy a permanently-NaN run would otherwise
+        log once per step."""
+        try:
+            self._reg().counter(
+                "train_nonfinite_total",
+                "steps whose loss or gradients were non-finite",
+                labels=("source",)).labels(source).inc()
+        except Exception:
+            pass
+        with self._lock:
+            self._nonfinite_seen += 1
+            n = self._nonfinite_seen
+        if n == 1 or n % 100 == 0:
+            self._push("nonfinite", source=source, occurrences=n)
+
+    def observe_loss(self, loss: float) -> None:
+        """Feed a host-synced loss sample (logging crossings / epoch
+        ends — never forces an extra device sync)."""
+        try:
+            loss = float(loss)
+        except (TypeError, ValueError):
+            return
+        if not math.isfinite(loss):
+            self.record_nonfinite("loss_sample")
+            return
+        self._observed += 1
+        scale = max(abs(self._best), 1.0)
+        if not math.isfinite(self._best) \
+                or loss < self._best - self.min_delta * scale:
+            # first finite sample seeds best (inf arithmetic would
+            # otherwise NaN the comparison and freeze it forever)
+            self._best = loss
+            self._since_improve = 0
+            self._diverged_fired = False
+            return
+        self._since_improve += 1
+        if (not self._diverged_fired
+                and math.isfinite(self._best)
+                and loss - self._best > self.divergence * scale):
+            self._diverged_fired = True   # once until a new best
+            self._push("divergence", loss=loss, best=self._best,
+                       factor=self.divergence)
+        if self.window > 0 and self._since_improve >= self.window:
+            self._since_improve = 0       # re-arm: one event per window
+            self._push("plateau", best=self._best, window=self.window,
+                       min_delta=self.min_delta)
+
+    # ---------------------------------------------------- stall monitor
+    def check_stall(self) -> bool:
+        """One stall check against the injectable clock (the heartbeat
+        thread calls this; tests call it directly with a fake clock)."""
+        if self.stall_timeout_s <= 0 or self._stall_fired:
+            return False
+        idle = self._clock() - self._last_beat
+        if idle <= self.stall_timeout_s:
+            return False
+        self._stall_fired = True          # once per stall episode
+        self._push("stall", idle_s=round(idle, 1),
+                   deadline_s=self.stall_timeout_s)
+        log.error(
+            "training stall: no step completed in %.0fs (deadline "
+            "%.0fs) — the loop may be hung in dispatch, a collective, "
+            "or the input pipeline", idle, self.stall_timeout_s)
+        self._set_status(HALT_PENDING if self._halted else WARNED)
+        return True
+
+    def start_stall_monitor(self) -> "TrainingWatchdog":
+        """Daemon heartbeat thread; no-op when the deadline is 0."""
+        if self.stall_timeout_s <= 0 or self._thread is not None:
+            return self
+        # arm the deadline NOW: time between construction and start
+        # (checkpoint restore, cache placement) is setup, not a stall
+        self.beat()
+        self._stop.clear()
+
+        def run():
+            while not self._stop.is_set():
+                try:
+                    self.check_stall()
+                except Exception:
+                    log.exception("stall check failed")
+                self._stop.wait(min(self.stall_timeout_s / 4.0, 10.0))
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="zoo-train-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -------------------------------------------------------- consumer
+    def poll(self) -> Optional[Dict]:
+        """Drain the next pending issue (driver loop, between steps).
+
+        Every issue is logged with structure; the return value is the
+        first HALTING-ELIGIBLE issue when the policy is
+        ``checkpoint_and_halt`` (the caller then snapshots and raises
+        :class:`TrainingHalted`), else None."""
+        halting = None
+        while True:
+            with self._lock:
+                issue = self._issues.pop(0) if self._issues else None
+            if issue is None:
+                break
+            log.warning("training-health event: %s", issue)
+            self._set_status(WARNED)
+            if (halting is None
+                    and self.policy == "checkpoint_and_halt"
+                    and issue["kind"] in self.HALTING_KINDS):
+                halting = issue
+        if halting is not None:
+            self._halted = True
+            self._set_status(HALT_PENDING)
+        return halting
+
+    def halted(self) -> bool:
+        return self._halted
+
+
+# -------------------------------------------------- process-wide hookup
+_active_watchdog: Optional[TrainingWatchdog] = None
+_active_lock = threading.Lock()
+
+
+def set_active_watchdog(wd: Optional[TrainingWatchdog]
+                        ) -> Optional[TrainingWatchdog]:
+    """Install the watchdog the in-jit finite-check callback reports
+    to; returns the previous one (restore it in a ``finally``)."""
+    global _active_watchdog
+    with _active_lock:
+        prev = _active_watchdog
+        _active_watchdog = wd
+    return prev
+
+
+def get_active_watchdog() -> Optional[TrainingWatchdog]:
+    return _active_watchdog
+
+
+def fold_finiteness_check(loss, grads) -> None:
+    """IN-JIT: fold an ``isfinite(loss + Σ grads)`` reduction into the
+    traced step (NaN/Inf propagate through the sums — one add-reduce
+    per grad leaf) and surface the flag through
+    :func:`record_step_finiteness`.  The single implementation both
+    engines' step builders call, so the detection logic cannot
+    diverge between them."""
+    import jax
+    import jax.numpy as jnp
+    total = loss.astype(jnp.float32)
+    for g in jax.tree_util.tree_leaves(grads):
+        total = total + jnp.sum(g).astype(jnp.float32)
+    jax.debug.callback(record_step_finiteness, jnp.isfinite(total))
+
+
+def record_step_finiteness(finite) -> None:
+    """``jax.debug.callback`` target: the jitted step's folded
+    ``isfinite(loss + Σ grads)`` flag lands here on host.  Must never
+    raise (it runs on the callback thread inside the runtime)."""
+    try:
+        if bool(finite):
+            return
+        wd = get_active_watchdog()
+        if wd is not None:
+            wd.record_nonfinite("step")
+        else:
+            get_registry().counter(
+                "train_nonfinite_total",
+                "steps whose loss or gradients were non-finite",
+                labels=("source",)).labels("step").inc()
+    except Exception:
+        pass

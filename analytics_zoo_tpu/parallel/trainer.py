@@ -34,6 +34,10 @@ import optax
 
 from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.observability import get_registry, get_tracer
+from analytics_zoo_tpu.observability.diagnostics import (
+    get_compile_monitor, publish_mfu, step_attribution_histogram)
+from analytics_zoo_tpu.observability.watchdog import (
+    fold_finiteness_check)
 from analytics_zoo_tpu.parallel import mesh as mesh_lib
 
 
@@ -144,6 +148,26 @@ class DistributedTrainer:
         # grad-norm gauge costs an in-jit norm + host callback per step:
         # opt-in via config (observability.grad_norm)
         self._obs_grad_norm = bool(cfg.get("observability.grad_norm"))
+        # training-health diagnostics: in-jit finite check (watchdog
+        # NaN detector), sampled device-step bracket, compile monitor
+        self._obs_check_finite = bool(
+            cfg.get("observability.check_finite"))
+        self._obs_device_every = int(
+            cfg.get("observability.device_time_every") or 0)
+        self._monitor = get_compile_monitor()
+        self._m_step_time = step_attribution_histogram(reg)
+        self._m_device_step = reg.gauge(
+            "train_device_step_seconds",
+            "sampled dispatch->block_until_ready wall of one train "
+            "step (observability.device_time_every)")
+        # registered here so a scrape shows the gauge (at 0) even
+        # before the first computable sample — see publish_mfu
+        reg.gauge(
+            "train_mfu",
+            "model FLOPs utilisation: cost-analysis FLOPs / sampled "
+            "device step time / chip peak (observability.peak_flops "
+            "overrides the denominator)")
+        self._dispatch_count = 0
 
     # ------------------------------------------------------------ sharding
     def param_shardings(self, params):
@@ -264,6 +288,12 @@ class DistributedTrainer:
             # callback costs a host round trip per step
             jax.debug.callback(_record_grad_norm,
                                optax.global_norm(grads))
+        if self._obs_check_finite:
+            # watchdog NaN/Inf detector, folded into the step's
+            # program; the flag surfaces asynchronously through the
+            # same callback path as the grad norm — the driver's
+            # watchdog polls it between steps
+            fold_finiteness_check(loss, grads)
         if self.grad_sync_dtype == "bfloat16":
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.bfloat16).astype(jnp.float32),
@@ -284,20 +314,45 @@ class DistributedTrainer:
                 p, o, s, b, jax.random.fold_in(r, i))
         else:
             fn = self._step_core
-        return jax.jit(
+        jitted = jax.jit(
             fn,
             out_shardings=(self._param_shardings, None, self._rep,
                            self._rep),
             donate_argnums=donate)
+        # compile/recompile accounting + cost-analysis FLOPs for the
+        # live MFU gauge (diagnostics.CompileMonitor)
+        return self._monitor.wrap("train_step", jitted)
 
     def _dispatch_instrumented(self, fn, *args):
         """One step dispatch wrapped in a train_step span + the
-        per-step latency histogram and step counter."""
+        per-step latency histogram and step counter.
+
+        Step-time attribution: every dispatch observes its host wall
+        (``host_dispatch``); every N-th dispatch additionally brackets
+        dispatch→``block_until_ready`` (``device``) — one device sync
+        on the sampled step only — and refreshes the live MFU gauge
+        from the CompileMonitor's cost-analysis FLOPs."""
+        self._dispatch_count += 1
+        sample_device = (self._obs_device_every > 0 and
+                         self._dispatch_count % self._obs_device_every
+                         == 0)
         with get_tracer().span("train_step"):
             t0 = time.perf_counter()
             out = fn(*args)
-            self._m_step_latency.labels("per_step").observe(
-                time.perf_counter() - t0)
+            dispatch_s = time.perf_counter() - t0
+            self._m_step_latency.labels("per_step").observe(dispatch_s)
+            self._m_step_time.labels("host_dispatch").observe(
+                dispatch_s)
+            if sample_device:
+                try:
+                    jax.block_until_ready(out)
+                    device_s = time.perf_counter() - t0
+                except Exception:
+                    device_s = None
+                if device_s is not None:
+                    self._m_step_time.labels("device").observe(device_s)
+                    self._m_device_step.set(device_s)
+                    publish_mfu("train_step", device_s)
         self._m_steps.labels("per_step").inc()
         return out
 
@@ -390,11 +445,14 @@ class DistributedTrainer:
             return params, opt_state, state, losses.mean()
 
         donate = (0, 1, 2) if self.donate else ()
-        return jax.jit(
+        jitted = jax.jit(
             epoch,
             out_shardings=(self._param_shardings, None, self._rep,
                            self._rep),
             donate_argnums=donate)
+        # cost analysis counts the scan BODY once (~ one step), so the
+        # monitor's flops gauge stays per-step-comparable
+        return self._monitor.wrap("train_epoch_scan", jitted)
 
     def put_epoch(self, x, y, epoch: int, feature_set=None):
         """Device-place a whole epoch, sharded on the data axis.
@@ -595,10 +653,20 @@ class DistributedTrainer:
         import threading
         if depth is None:
             depth = int(get_config().get("data.prefetch"))
+        wait_hist = self._m_step_time.labels("data_wait")
         if depth <= 0:
-            for b in batches:
-                yield self.put_batch(b)
-            return
+            it = iter(batches)
+            while True:
+                # data_wait here covers host batch assembly + H2D —
+                # the whole input-side cost the device waits on
+                t0 = time.perf_counter()
+                try:
+                    b = next(it)
+                except StopIteration:
+                    return
+                placed = self.put_batch(b)
+                wait_hist.observe(time.perf_counter() - t0)
+                yield placed
         q: "queue.Queue" = queue.Queue(maxsize=depth)
         _END = object()
 
@@ -616,10 +684,14 @@ class DistributedTrainer:
             # sampled before the dequeue so a full steady-state
             # pipeline reads `depth`, not depth-1
             self._m_prefetch_depth.set(q.qsize())
+            t0 = time.perf_counter()
             item = q.get()
             if item is _END:
                 self._m_prefetch_depth.set(0)
                 break
             if isinstance(item, BaseException):
                 raise item
+            # attribution: how long the consumer stalled waiting for
+            # the next device-placed batch (0 ≈ input keeps up)
+            wait_hist.observe(time.perf_counter() - t0)
             yield item

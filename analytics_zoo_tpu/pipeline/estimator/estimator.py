@@ -16,6 +16,7 @@ counters in one payload so resume is exact.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Dict, List, Optional
 
@@ -25,6 +26,8 @@ import numpy as np
 from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.observability import (
     EPOCH_BUCKETS, get_registry, get_tracer, sample_device_telemetry)
+from analytics_zoo_tpu.observability.watchdog import (
+    TrainingHalted, TrainingWatchdog, set_active_watchdog)
 from analytics_zoo_tpu.parallel import mesh as mesh_lib
 from analytics_zoo_tpu.common.triggers import (
     EveryEpoch, MaxEpoch, TrainingState, Trigger)
@@ -198,6 +201,50 @@ class Estimator:
         met = _train_metrics()
         tracer = get_tracer()
 
+        # training-health watchdog: collects the in-jit finite-check
+        # callbacks (trainer._step_core), the losses observed at sync
+        # points, and the stall heartbeat; health_check() runs between
+        # steps and applies the policy.  (Installed as the ACTIVE
+        # watchdog just before the training loop — see below — so a
+        # failure in restore/cache setup can't leak the thread.)
+        watchdog = TrainingWatchdog()
+        # dedupe loss observations by iteration: several sync points
+        # (logging crossings, dispatch branches, epoch end) may hold
+        # the same already-synced loss — observing it once per
+        # iteration keeps the plateau window meaning what the config
+        # says
+        last_observed_iter = [-1]
+
+        def observe_loss_once(value):
+            if ts.iteration != last_observed_iter[0]:
+                last_observed_iter[0] = ts.iteration
+                watchdog.observe_loss(value)
+
+        def health_check():
+            issue = watchdog.poll()
+            if issue is None:
+                return
+            # checkpoint_and_halt: snapshot through the normal
+            # checkpoint machinery, but into <model_dir>/halt/ — the
+            # halt-time state may itself be poisoned (NaN params), and
+            # a poisoned snapshot.N.ckpt at the HIGHEST step would
+            # shadow the last good periodic snapshot on the next
+            # restore_latest.  Then stop in a way the retry loop will
+            # NOT absorb: retrying a NaN'd step replays the same
+            # poison.
+            log.error("watchdog halting training: %s", issue)
+            if ckpt is not None:
+                halt_dir = os.path.join(self.model_dir, "halt")
+                save_snapshot(target=Checkpoint(halt_dir))
+                log.error(
+                    "halt-time state snapshotted to %s (iteration %d); "
+                    "resume from model_dir restores the last GOOD "
+                    "periodic snapshot", halt_dir, ts.iteration)
+            raise TrainingHalted(
+                f"training halted by watchdog policy "
+                f"'checkpoint_and_halt' at iteration {ts.iteration}: "
+                f"{issue}", issue=issue)
+
         def restore_snapshot(like):
             """ckpt.restore_latest with a span + restore counter (all
             restore sites — resume, HBM-cache recovery, retry loop —
@@ -274,12 +321,14 @@ class Estimator:
         retry_window = float(get_config().get("train.retry_interval_s"))
 
         # --- epoch loop -----------------------------------------------------
-        def save_snapshot():
+        def save_snapshot(target=None):
             # fetch_global is a COLLECTIVE (cross-process allgather for
             # non-addressable shards) — every process must run it; only
             # the coordinator writes the file, like the reference's
             # driver-side snapshot (Topology.scala:1293). Restore assumes
             # model_dir is on a filesystem all hosts can read.
+            # ``target`` overrides the destination Checkpoint (the
+            # watchdog's halt snapshot goes to model_dir/halt/).
             with tracer.span("checkpoint_save", iteration=ts.iteration):
                 payload = {"params": mesh_lib.fetch_global(params),
                            "state": mesh_lib.fetch_global(state),
@@ -291,7 +340,8 @@ class Estimator:
                     # snapshot resumes mid-epoch exactly
                     payload["data"] = train_set.state_dict()
                 if jax.process_index() == 0:
-                    ckpt.save(payload, step=ts.iteration)
+                    (ckpt if target is None else target).save(
+                        payload, step=ts.iteration)
                     # counted only where the file is actually written,
                     # so per-host scrapes reflect per-host truth
                     met["ckpt_save"].inc()
@@ -415,11 +465,18 @@ class Estimator:
             if (ts.iteration // 20) != ((ts.iteration - k) // 20):
                 ts.last_loss = float(loss)
                 met["loss"].set(ts.last_loss)
+                # already-synced loss → watchdog divergence/plateau/
+                # NaN detection at zero extra device cost
+                observe_loss_once(ts.last_loss)
                 if self._train_summary is not None:
                     self._train_summary.add_scalar(
                         "Loss", ts.last_loss, ts.iteration)
 
         stop = False
+        # install the watchdog only now: the finally below is the ONLY
+        # teardown, so nothing may fail between install and the try
+        prev_watchdog = set_active_watchdog(watchdog)
+        watchdog.start_stall_monitor()
         try:
             while not stop and not end_trigger(ts):
                 # monotonic clock for the epoch interval: wall-clock
@@ -443,6 +500,8 @@ class Estimator:
                             ts.iteration += 1
                             seen += batch_size
                             log_loss_crossing(loss, 1)
+                            watchdog.beat()
+                            health_check()
                             if ckpt is not None and \
                                     checkpoint_trigger(ts):
                                 save_snapshot()
@@ -541,6 +600,9 @@ class Estimator:
                         seen += epoch_rows
                         met["steps"].labels("epoch_scan").inc(nb_epoch)
                         log_loss_crossing(loss, nb_epoch)
+                        watchdog.beat()
+                        observe_loss_once(ts.last_loss)
+                        health_check()
                         if end_trigger(ts):
                             stop = True
                     elif use_chunks:
@@ -568,6 +630,8 @@ class Estimator:
                             seen += k * batch_size
                             met["steps"].labels("chunked").inc(k)
                             log_loss_crossing(loss, k)
+                            watchdog.beat()
+                            health_check()
                             if ckpt is not None and checkpoint_trigger(ts):
                                 save_snapshot()
                             if end_trigger(ts):
@@ -594,6 +658,8 @@ class Estimator:
                                 # avoid a device sync per step: loss is
                                 # fetched only at logging points
                                 log_loss_crossing(loss, 1)
+                                watchdog.beat()
+                                health_check()
                                 # iteration-level triggers (MaxIteration,
                                 # SeveralIteration) fire mid-epoch
                                 if ckpt is not None and \
@@ -604,7 +670,9 @@ class Estimator:
                                     break
                             if stop:
                                 break
-                except _UnrecoverableTraining:
+                except (_UnrecoverableTraining, TrainingHalted):
+                    # a watchdog halt is deliberate: retrying would
+                    # replay the same poisoned step
                     raise
                 except Exception:   # noqa: BLE001 — retry loop, ref :1179-1261
                     now = time.perf_counter()
@@ -633,6 +701,8 @@ class Estimator:
 
                 if loss is not None:
                     ts.last_loss = float(loss)
+                    observe_loss_once(ts.last_loss)
+                    health_check()
                 if stop:
                     break
                 ts.epoch += 1
@@ -671,6 +741,8 @@ class Estimator:
                     save_snapshot()
                 ts.epoch_finished = False
         finally:
+            watchdog.stop()
+            set_active_watchdog(prev_watchdog)
             # summaries hold open file handles (JSONL + tfevents):
             # close them whether training completed or raised.
             # _ScalarWriter reopens on the next add_scalar, so a

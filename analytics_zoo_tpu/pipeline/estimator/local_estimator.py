@@ -49,6 +49,7 @@ class LocalEstimator:
         from analytics_zoo_tpu.common.config import get_config
         model, loss_fn, optim = self.model, self.loss_fn, self.optim
         remat = bool(get_config().get("train.remat"))
+        check_finite = bool(get_config().get("observability.check_finite"))
 
         def step(params, opt_state, state, x, y, rng):
             def objective(p):
@@ -61,6 +62,12 @@ class LocalEstimator:
                 objective = jax.checkpoint(objective)
             grads, (new_state, loss) = jax.grad(
                 objective, has_aux=True)(params)
+            if check_finite:
+                # watchdog NaN/Inf detector — the same fold the
+                # distributed engine traces (one shared helper)
+                from analytics_zoo_tpu.observability.watchdog import (
+                    fold_finiteness_check)
+                fold_finiteness_check(loss, grads)
             import optax
             from analytics_zoo_tpu.parallel.trainer import (
                 mask_frozen_params)
@@ -69,7 +76,9 @@ class LocalEstimator:
             new_params = mask_frozen_params(model, params, new_params)
             return new_params, new_opt_state, new_state, loss
 
-        return jax.jit(step, donate_argnums=(0, 1, 2))
+        from analytics_zoo_tpu.observability import get_compile_monitor
+        return get_compile_monitor().wrap(
+            "local_train_step", jax.jit(step, donate_argnums=(0, 1, 2)))
 
     def _current_step(self):
         """The jitted step, rebuilt whenever the model's frozen-layer
@@ -119,44 +128,104 @@ class LocalEstimator:
             self.model.set_variables({"params": jax.device_get(params),
                                       "state": jax.device_get(state)})
 
+        from analytics_zoo_tpu.common.config import get_config
         from analytics_zoo_tpu.observability import (
             EPOCH_BUCKETS, get_registry, get_tracer)
+        from analytics_zoo_tpu.observability.diagnostics import (
+            publish_mfu, step_attribution_histogram)
+        from analytics_zoo_tpu.observability.watchdog import (
+            TrainingHalted, TrainingWatchdog, set_active_watchdog)
         reg = get_registry()
         m_epoch = reg.histogram(
             "train_epoch_seconds", "wall time per completed epoch",
             labels=("engine",), buckets=EPOCH_BUCKETS)
         m_samples = reg.counter("train_samples_total",
                                 "training samples consumed")
+        # step-time attribution + sampled device bracket, same shape
+        # as the distributed engine's (trainer._dispatch_instrumented)
+        m_step_time = step_attribution_histogram(reg)
+        device_every = int(
+            get_config().get("observability.device_time_every") or 0)
         tracer = get_tracer()
-        for epoch in range(epochs):
-            # monotonic interval math — wall-clock adjustments must not
-            # yield negative epoch times
-            t0 = time.perf_counter()
-            seen = 0
-            loss = None
-            batches = iter(pipeline) if pipeline is not None \
-                else data.epoch_batches(epoch, batch_size, train=True)
-            for bx, by in batches:
-                with tracer.span("train_step"):
-                    params, opt_state, state, loss = self._step(
-                        params, opt_state, state, bx, by,
-                        jax.random.fold_in(rng, it))
-                it += 1
-                seen += batch_size
-            wall = time.perf_counter() - t0
-            m_epoch.labels("local").observe(wall)
-            m_samples.inc(seen)
-            record = {"epoch": epoch + 1, "loss": float(loss),
-                      "throughput": seen / max(wall, 1e-9)}
-            if validate:   # evaluate() reads the host-side variables
-                sync_to_host()
-                record["val"] = self.evaluate(
-                    *validation_data, batch_size=batch_size)
-            self.history.append(record)
-            log.info("epoch %d loss %.4f%s (%.1f samples/s)",
-                     epoch + 1, record["loss"],
-                     f" val {record['val']}" if "val" in record else "",
-                     record["throughput"])
+        # training-health watchdog: the local engine has no checkpoint
+        # machinery, so checkpoint_and_halt degrades to halt-only (the
+        # host-side model variables still hold the last synced state)
+        watchdog = TrainingWatchdog()
+        prev_watchdog = set_active_watchdog(watchdog)
+        watchdog.start_stall_monitor()
+
+        def health_check():
+            # poll() returns an issue only under checkpoint_and_halt;
+            # the model deliberately keeps its LAST SYNCED host
+            # variables (the halt-time device state may be poisoned)
+            issue = watchdog.poll()
+            if issue is not None:
+                raise TrainingHalted(
+                    f"local training halted by watchdog at step {it}: "
+                    f"{issue}", issue=issue)
+
+        try:
+            for epoch in range(epochs):
+                # monotonic interval math — wall-clock adjustments must
+                # not yield negative epoch times
+                t0 = time.perf_counter()
+                seen = 0
+                loss = None
+                batches = iter(pipeline) if pipeline is not None \
+                    else data.epoch_batches(epoch, batch_size, train=True)
+                while True:
+                    t_wait = time.perf_counter()
+                    try:
+                        bx, by = next(batches)
+                    except StopIteration:
+                        break
+                    # host batch assembly = the local data_wait
+                    m_step_time.labels("data_wait").observe(
+                        time.perf_counter() - t_wait)
+                    with tracer.span("train_step"):
+                        # t_step, NOT t0: the epoch wall below reads t0
+                        t_step = time.perf_counter()
+                        params, opt_state, state, loss = self._step(
+                            params, opt_state, state, bx, by,
+                            jax.random.fold_in(rng, it))
+                        m_step_time.labels("host_dispatch").observe(
+                            time.perf_counter() - t_step)
+                        if device_every > 0 and \
+                                (it + 1) % device_every == 0:
+                            # sampled dispatch->ready bracket + MFU
+                            try:
+                                jax.block_until_ready(loss)
+                                device_s = time.perf_counter() - t_step
+                            except Exception:
+                                device_s = None
+                            if device_s is not None:
+                                m_step_time.labels("device").observe(
+                                    device_s)
+                                publish_mfu("local_train_step",
+                                            device_s, reg)
+                    it += 1
+                    seen += batch_size
+                    watchdog.beat()
+                    health_check()
+                wall = time.perf_counter() - t0
+                m_epoch.labels("local").observe(wall)
+                m_samples.inc(seen)
+                record = {"epoch": epoch + 1, "loss": float(loss),
+                          "throughput": seen / max(wall, 1e-9)}
+                watchdog.observe_loss(record["loss"])
+                health_check()
+                if validate:   # evaluate() reads the host-side variables
+                    sync_to_host()
+                    record["val"] = self.evaluate(
+                        *validation_data, batch_size=batch_size)
+                self.history.append(record)
+                log.info("epoch %d loss %.4f%s (%.1f samples/s)",
+                         epoch + 1, record["loss"],
+                         f" val {record['val']}" if "val" in record else "",
+                         record["throughput"])
+        finally:
+            watchdog.stop()
+            set_active_watchdog(prev_watchdog)
         if not validate:
             sync_to_host()
         return self

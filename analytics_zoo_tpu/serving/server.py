@@ -69,6 +69,8 @@ class ServingConfig:
                  pipeline_depth: int = 2,
                  metrics_port: Optional[int] = None,
                  metrics_host: str = "0.0.0.0",
+                 healthz_max_queue: Optional[int] = None,
+                 healthz_max_error_rate: Optional[float] = None,
                  extra: Optional[Dict[str, str]] = None):
         self.redis_url = redis_url
         self.batch_size = int(batch_size)
@@ -90,6 +92,20 @@ class ServingConfig:
         # queue-wait p50 of deeper pipelines.  Clamped to >= 1: depth 0
         # would make the run loop read nothing, forever.
         self.pipeline_depth = max(1, int(pipeline_depth))
+        # /healthz readiness thresholds (0 = that check disabled):
+        # the probe flips to 503 when the input-stream backlog exceeds
+        # healthz_max_queue, or when the error fraction over the most
+        # recent records exceeds healthz_max_error_rate — so an
+        # orchestrator stops routing to a drowning/poisoned worker
+        # instead of killing a merely-busy one
+        if healthz_max_queue is None:
+            healthz_max_queue = get_config().get(
+                "serving.healthz_max_queue", 0)
+        if healthz_max_error_rate is None:
+            healthz_max_error_rate = get_config().get(
+                "serving.healthz_max_error_rate", 0.0)
+        self.healthz_max_queue = int(healthz_max_queue or 0)
+        self.healthz_max_error_rate = float(healthz_max_error_rate or 0.0)
         # consumer_group set → multiple workers SHARE the stream, each
         # record served exactly once (the reference parallelizes per
         # Spark partition; redis-native scale-out uses XREADGROUP)
@@ -124,6 +140,10 @@ class ServingConfig:
                           if cfg.get("params.metrics_port") not in
                           (None, "") else None),
             metrics_host=cfg.get("params.metrics_host") or "0.0.0.0",
+            healthz_max_queue=int(
+                cfg.get("params.healthz_max_queue") or 0) or None,
+            healthz_max_error_rate=float(
+                cfg.get("params.healthz_max_error_rate") or 0.0) or None,
             extra=cfg,
         )
 
@@ -175,11 +195,19 @@ class ClusterServing:
             "stale pending records reclaimed from dead workers")
         self._tracer = get_tracer()
         self._telemetry: Optional[TelemetrySampler] = None
+        # readiness window: 1 per recently served record, 0 per record
+        # acked with an error result — the error-rate half of /healthz.
+        # The lock pairs the worker thread's extend with the /healthz
+        # thread's snapshot: list(deque) raises if the deque mutates
+        # mid-iteration, which would flip a healthy worker to 503.
+        self._recent_outcomes: deque = deque(maxlen=200)
+        self._outcomes_lock = threading.Lock()
         self.metrics_server: Optional[MetricsServer] = None
         if self.config.metrics_port is not None:
             self.metrics_server = MetricsServer(
                 port=self.config.metrics_port,
-                host=self.config.metrics_host).start()
+                host=self.config.metrics_host,
+                health_check=self.readiness).start()
 
     # ------------------------------------------------------------ main loop
     def run_once(self, block_ms: int = 100) -> int:
@@ -326,6 +354,9 @@ class ClusterServing:
             except Exception:
                 log.exception("could not write error result for %s", uri)
         self._m_errors.inc(len(failed))
+        # readiness window: successes then failures, per record
+        with self._outcomes_lock:
+            self._recent_outcomes.extend([1] * real + [0] * len(failed))
         self._ack(entries)
         return real
 
@@ -358,6 +389,30 @@ class ClusterServing:
                                     self.total_records,
                                     self.total_records)
         return real
+
+    def readiness(self) -> Optional[Dict[str, Any]]:
+        """The /healthz readiness probe (wired into the
+        MetricsServer): None when ready, else a JSON-able reason dict
+        — the endpoint answers 503 with it.  Thresholds come from
+        config.yaml ``params.healthz_max_queue`` /
+        ``params.healthz_max_error_rate`` (0 = check disabled)."""
+        cfg = self.config
+        if cfg.healthz_max_queue > 0:
+            depth = self._m_queue.value
+            if depth > cfg.healthz_max_queue:
+                return {"reason": "queue_depth",
+                        "queue_depth": int(depth),
+                        "threshold": cfg.healthz_max_queue}
+        if cfg.healthz_max_error_rate > 0 and self._recent_outcomes:
+            with self._outcomes_lock:
+                outcomes = list(self._recent_outcomes)
+            rate = 1.0 - sum(outcomes) / len(outcomes)
+            if rate > cfg.healthz_max_error_rate:
+                return {"reason": "error_rate",
+                        "error_rate": round(rate, 4),
+                        "window": len(outcomes),
+                        "threshold": cfg.healthz_max_error_rate}
+        return None
 
     def stats(self) -> Dict[str, float]:
         """Throughput + latency percentiles over the records served so

@@ -684,11 +684,45 @@ METRICS_SNAPSHOT_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), "bench_metrics.json")
 
 
+def _derive_health_fields(snapshot):
+    """MFU / compile-cost headline fields out of a registry snapshot —
+    the two numbers a regression triage reads first, lifted out of the
+    metric soup (obs_report renders the rest)."""
+    out = {}
+    try:
+        gauges = snapshot.get("gauges", {})
+        counters = snapshot.get("counters", {})
+        mfu = gauges.get("train_mfu")
+        if mfu:
+            out["mfu"] = mfu
+        compile_s = sum(
+            v for k, v in counters.items()
+            if k.startswith("jax_compile_seconds_total"))
+        backend_s = counters.get("jax_backend_compile_seconds_total")
+        if compile_s:
+            out["compile_seconds_total"] = round(compile_s, 3)
+        if backend_s:
+            out["backend_compile_seconds_total"] = round(backend_s, 3)
+        compiles = sum(v for k, v in counters.items()
+                       if k.startswith("jax_compiles_total"))
+        recompiles = sum(v for k, v in counters.items()
+                         if k.startswith("jax_recompiles_total"))
+        if compiles:
+            out["compiles_total"] = int(compiles)
+        if recompiles:
+            out["recompiles_after_warmup"] = int(recompiles)
+    except Exception:  # noqa: BLE001 — derived fields are best-effort
+        pass
+    return out
+
+
 def _record_metrics_snapshot(workload, snapshot):
     """Persist the observability-registry snapshot a child emitted
     alongside its timing line (per workload, latest wins) — step/request
     latency histograms and device gauges explain WHY a headline number
-    moved, which the timing alone cannot."""
+    moved, which the timing alone cannot.  MFU and compile seconds are
+    lifted to top-level fields per workload (render the rest with
+    ``scripts/obs_report.py bench_metrics.json --workload NAME``)."""
     try:
         data = {}
         try:
@@ -698,8 +732,10 @@ def _record_metrics_snapshot(workload, snapshot):
                 data = {}
         except Exception:  # noqa: BLE001 — corrupt file degrades to fresh
             data = {}
-        data[workload] = {"recorded_unix": round(time.time(), 1),
-                          "metrics": snapshot}
+        entry = {"recorded_unix": round(time.time(), 1)}
+        entry.update(_derive_health_fields(snapshot))
+        entry["metrics"] = snapshot
+        data[workload] = entry
         with open(METRICS_SNAPSHOT_PATH, "w") as f:
             json.dump(data, f, indent=2)
     except Exception:  # noqa: BLE001 — snapshots must never fail the bench
@@ -824,10 +860,67 @@ def _write_artifact(results, meta):
         pass           # must never take down the bench itself
 
 
+def _compare_against_baseline(baseline_path, threshold=0.10):
+    """Regression gate: compare the CURRENT artifact's per-metric
+    values against a baseline artifact (either this file's own schema
+    — ``{"results": [...]}`` — or a flat ``{metric: value}`` map).
+    Prints one JSON line; returns 1 when any shared metric dropped
+    more than ``threshold``.  Baseline metrics absent from the current
+    artifact are listed under ``skipped`` but do NOT gate — a
+    single-workload rerun compared against a full-run baseline must
+    not fail on the workloads it didn't run."""
+    try:
+        with open(baseline_path) as f:
+            base_doc = json.load(f)
+    except Exception as e:  # noqa: BLE001
+        _emit({"compare": baseline_path, "ok": False,
+               "error": f"unreadable baseline: {e!r}"})
+        return 1
+    if isinstance(base_doc, dict) and "results" in base_doc:
+        baseline = {r.get("metric"): r.get("value")
+                    for r in base_doc.get("results", [])}
+    elif isinstance(base_doc, dict):
+        baseline = {k: v for k, v in base_doc.items()
+                    if isinstance(v, (int, float))}
+    else:
+        baseline = {}
+    current = {}
+    try:
+        with open(ARTIFACT_PATH) as f:
+            for r in json.load(f).get("results", []):
+                current[r.get("metric")] = r.get("value")
+    except Exception:  # noqa: BLE001
+        pass
+    regressions, skipped, compared = [], [], 0
+    for metric, base_v in sorted(baseline.items()):
+        if not isinstance(base_v, (int, float)) or base_v <= 0:
+            continue
+        cur_v = current.get(metric)
+        if not isinstance(cur_v, (int, float)) or cur_v <= 0:
+            skipped.append({"metric": metric, "baseline": base_v,
+                            "current": cur_v,
+                            "reason": "missing_or_zero"})
+            continue
+        compared += 1
+        if cur_v < base_v * (1.0 - threshold):
+            regressions.append({
+                "metric": metric, "baseline": base_v, "current": cur_v,
+                "change": round(cur_v / base_v - 1.0, 4)})
+    _emit({"compare": baseline_path, "threshold": threshold,
+           "metrics_compared": compared, "regressions": regressions,
+           "skipped": skipped, "ok": not regressions})
+    return 1 if regressions else 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--workload", default="all",
                     choices=sorted(WORKLOADS) + ["all"])
+    # regression gate: after the run, compare the merged artifact
+    # against a baseline artifact; exit non-zero on a >10% throughput
+    # drop in any shared metric
+    ap.add_argument("--compare", metavar="BASELINE.json", default=None)
+    ap.add_argument("--compare-threshold", type=float, default=0.10)
     # a tunneled backend can disappear for MINUTES at a time (observed
     # rounds 1 and 3) — the probe is deadline-based: keep probing with
     # exponential backoff until --probe-budget seconds are spent.  The
@@ -926,7 +1019,11 @@ def main(argv=None):
         # BENCH_rNN.json captures this run's stdout regardless)
         # rc=0 only when every requested workload was covered by a
         # labeled cached number — partial coverage is still a failure
-        return 0 if n_cached == len(names) else 1
+        rc = 0 if n_cached == len(names) else 1
+        if args.compare:
+            rc = max(rc, _compare_against_baseline(
+                args.compare, args.compare_threshold))
+        return rc
 
     # "all" RUNS ResNet-50 first (bank the north-star number early)
     # and re-prints its line last (the driver records the tail line);
@@ -1009,6 +1106,9 @@ def main(argv=None):
                 _emit(err_rn)
     meta["wall_s"] = round(time.time() - t_start, 1)
     _write_artifact(results, meta)
+    if args.compare:
+        rc = max(rc, _compare_against_baseline(
+            args.compare, args.compare_threshold))
     return rc
 
 

@@ -10,7 +10,11 @@ resumed or multi-host training run:
   2. shard h of S sees rows [h*B:(h+1)*B] of every global batch, so
      concatenating all shards reproduces the unsharded stream;
   3. checkpoint at step k => the resumed stream is exactly batches
-     k+1, k+2, ... (no replayed or skipped samples).
+     k+1, k+2, ... (no replayed or skipped samples);
+  4. a fixed-shape jitted step sees ZERO recompilations after its
+     warmup (CompileMonitor smoke — a silent shape/dtype drift would
+     recompile every step on TPU), and a deliberate post-warmup shape
+     change IS flagged as churn.
 
 Prints one JSON line and exits 0 (deterministic) / 1 (regression).
 Pure CPU, a few seconds — run it from CI or the tier-1 wrapper
@@ -95,6 +99,30 @@ def main() -> int:
         failures.append(
             f"resume from step {k} replayed or skipped samples")
 
+    # 4 — zero recompilations after warmup (CompileMonitor smoke)
+    import jax
+    import jax.numpy as jnp
+
+    from analytics_zoo_tpu.observability.diagnostics import CompileMonitor
+    from analytics_zoo_tpu.observability.metrics import MetricsRegistry
+
+    mon = CompileMonitor(warmup_calls=2, registry=MetricsRegistry())
+    step = mon.wrap("det_step",
+                    jax.jit(lambda a: (a * 2.0 + 1.0).sum()))
+    fixed = jnp.ones((BATCH, 8), jnp.float32)
+    for _ in range(6):
+        float(step(fixed))
+    st = mon.stats("det_step")
+    if st.get("compiles") != 1 or st.get("recompiles_after_warmup"):
+        failures.append(
+            f"fixed-shape step recompiled after warmup: {st}")
+    # the detector itself must fire on a real post-warmup shape change
+    float(step(jnp.ones((BATCH * 2, 8), jnp.float32)))
+    st = mon.stats("det_step")
+    if st.get("recompiles_after_warmup") != 1:
+        failures.append(
+            f"post-warmup shape change not flagged as churn: {st}")
+
     out = {
         "check": "input_pipeline_determinism",
         "ok": not failures,
@@ -104,6 +132,11 @@ def main() -> int:
         "batch_size": BATCH,
         "shards_checked": shards,
         "resume_step": k,
+        "compile_monitor": {
+            "compiles": st.get("compiles"),
+            "recompiles_after_warmup": st.get("recompiles_after_warmup"),
+            "compile_seconds": round(st.get("compile_seconds") or 0, 3),
+        },
         "failures": failures,
     }
     print(json.dumps(out))

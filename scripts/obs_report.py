@@ -1,0 +1,352 @@
+#!/usr/bin/env python
+"""obs_report — render an observability snapshot into a human
+training-health report, and diff two snapshots for regression checks.
+
+Input formats (auto-detected):
+
+* a registry JSONL written by ``MetricsRegistry.write_jsonl`` (one
+  ``{"wall_time", "metrics"}`` line per scrape — the LAST line is
+  reported);
+* ``bench_metrics.json`` (``{workload: {..., "metrics": snapshot}}`` —
+  pick one with ``--workload``, default: every workload in the file);
+* a bare registry snapshot dict (``/metrics.json`` saved to a file).
+
+Optionally pair it with a Chrome trace (``--trace trace.json``, from
+``Tracer.export_chrome_trace`` or the ``/trace`` endpoint) for a
+span-aggregation table.
+
+Diff mode: ``obs_report.py CURRENT --diff BASELINE`` compares the two
+snapshots and exits 1 when a higher-is-better metric (throughput, MFU)
+dropped, or a latency p50 rose, by more than ``--threshold`` (default
+10%) — the offline half of ``bench.py --compare``.
+
+Examples::
+
+    python scripts/obs_report.py metrics.jsonl --trace trace.json
+    python scripts/obs_report.py bench_metrics.json --workload ncf
+    python scripts/obs_report.py run2.jsonl --diff run1.jsonl
+
+Pure stdlib + file IO; never imports jax (usable on a laptop against
+artifacts scp'd from the pod).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+# --------------------------------------------------------------- loading
+def _is_snapshot(d) -> bool:
+    return isinstance(d, dict) and (
+        "counters" in d or "gauges" in d or "histograms" in d)
+
+
+def load_snapshots(path: str, workload: Optional[str] = None
+                   ) -> List[Tuple[str, Dict]]:
+    """Return ``[(label, snapshot), ...]`` from any supported file."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if doc is not None:
+        if _is_snapshot(doc):
+            return [(path, doc)]
+        if isinstance(doc, dict) and "metrics" in doc \
+                and _is_snapshot(doc["metrics"]):
+            return [(path, doc["metrics"])]
+        if isinstance(doc, dict):   # bench_metrics.json shape
+            out = []
+            for name, entry in sorted(doc.items()):
+                snap = entry.get("metrics") \
+                    if isinstance(entry, dict) else None
+                if _is_snapshot(snap) and (workload is None
+                                           or name == workload):
+                    out.append((name, snap))
+            if out:
+                return out
+        raise SystemExit(f"{path}: unrecognized snapshot format")
+    # JSONL: report the last parseable line
+    last = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if _is_snapshot(rec):
+            last = rec
+        elif isinstance(rec, dict) and _is_snapshot(rec.get("metrics")):
+            last = rec["metrics"]
+    if last is None:
+        raise SystemExit(f"{path}: no registry snapshot found")
+    return [(path, last)]
+
+
+# ------------------------------------------------------------- selectors
+def _labeled(series: Dict, prefix: str) -> List[Tuple[str, object]]:
+    """Entries of a snapshot section whose key is ``prefix`` or
+    ``prefix{label=...}``; returns (label-or-'', value)."""
+    out = []
+    for key, val in sorted(series.items()):
+        if key == prefix:
+            out.append(("", val))
+        elif key.startswith(prefix + "{"):
+            out.append((key[len(prefix) + 1:-1], val))
+    return out
+
+
+def _fmt_seconds(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    if v >= 1.0:
+        return f"{v:.2f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:.1f}ms"
+    return f"{v * 1e6:.0f}us"
+
+
+def _table(rows: List[List[str]], header: List[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    def fmt(r):
+        return "  ".join(str(c).ljust(w) for c, w in zip(r, widths))
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([fmt(header), sep] + [fmt(r) for r in rows])
+
+
+# --------------------------------------------------------------- report
+def render_report(label: str, snap: Dict,
+                  trace_events: Optional[List[Dict]] = None) -> str:
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+    lines: List[str] = [f"== training-health report: {label} =="]
+
+    # ---- step-time attribution ------------------------------------
+    attr = _labeled(hists, "train_step_time_seconds")
+    if attr:
+        total_time = sum(h["sum"] for _, h in attr) or 1e-12
+        rows = []
+        for lab, h in attr:
+            comp = lab.split("=", 1)[-1].strip('"') if lab else "?"
+            rows.append([
+                comp, h["count"], _fmt_seconds(h["p50"]),
+                _fmt_seconds(h["p95"]), f"{h['sum']:.2f}s",
+                f"{100 * h['sum'] / total_time:.0f}%"])
+        lines += ["", "step-time attribution "
+                  "(device is sampled — compare p50s, not sums):",
+                  _table(rows, ["component", "count", "p50", "p95",
+                                "total", "share"])]
+    step_lat = _labeled(hists, "train_step_latency_seconds")
+    for lab, h in step_lat:
+        lines.append(
+            f"step latency [{lab or 'all'}]: p50 "
+            f"{_fmt_seconds(h['p50'])}  p95 {_fmt_seconds(h['p95'])}  "
+            f"({h['count']} steps)")
+
+    # ---- throughput / MFU -----------------------------------------
+    tput = gauges.get("train_throughput_samples_per_sec")
+    if tput:
+        lines.append(f"throughput: {tput:.1f} samples/s "
+                     f"(last epoch)")
+    mfu = gauges.get("train_mfu")
+    dev_step = gauges.get("train_device_step_seconds")
+    flops = _labeled(gauges, "train_step_flops")
+    if mfu:
+        lines.append(
+            f"MFU: {100 * mfu:.1f}% of chip peak "
+            f"(sampled device step {_fmt_seconds(dev_step)})")
+    elif flops:
+        lines.append(
+            "MFU: not computed (unknown chip peak — set "
+            "observability.peak_flops); cost-analysis FLOPs known: "
+            + ", ".join(f"{lab}={v:.3g}" for lab, v in flops))
+
+    # ---- compilation ----------------------------------------------
+    comp_rows = []
+    for lab, n in _labeled(counters, "jax_compiles_total"):
+        fn = lab.split("=", 1)[-1].strip('"') if lab else "?"
+        secs = dict(_labeled(counters, "jax_compile_seconds_total")
+                    ).get(lab, 0.0)
+        rec = dict(_labeled(counters, "jax_recompiles_total")
+                   ).get(lab, 0)
+        comp_rows.append([fn, int(n), f"{secs:.2f}s", int(rec)])
+    if comp_rows:
+        lines += ["", "compilation (recompiles>0 after warmup = churn "
+                  "— a shape/dtype drifts between steps):",
+                  _table(comp_rows, ["function", "compiles",
+                                     "first-call wall", "recompiles"])]
+    backend_s = counters.get("jax_backend_compile_seconds_total")
+    if backend_s:
+        lines.append(
+            f"backend compile: "
+            f"{int(counters.get('jax_backend_compiles_total', 0))} "
+            f"XLA compilations, {backend_s:.2f}s total")
+
+    # ---- health ----------------------------------------------------
+    nonfinite = _labeled(counters, "train_nonfinite_total")
+    events = _labeled(counters, "watchdog_events_total")
+    status = gauges.get("train_health_status", 0)
+    verdict = {0: "healthy", 1: "warned", 2: "HALTED"}.get(
+        int(status), "?")
+    lines += ["", f"health: {verdict}"]
+    for lab, n in nonfinite:
+        lines.append(f"  non-finite steps [{lab}]: {int(n)}")
+    for lab, n in events:
+        lines.append(f"  watchdog events [{lab}]: {int(n)}")
+    retries = counters.get("train_retry_total")
+    if retries:
+        lines.append(f"  retry-loop restores: {int(retries)}")
+
+    # ---- input pipeline -------------------------------------------
+    waits = _labeled(hists, "data_batch_wait_seconds")
+    for lab, h in waits:
+        lines.append(
+            f"data wait [{lab or 'pipeline'}]: p50 "
+            f"{_fmt_seconds(h['p50'])}  p95 {_fmt_seconds(h['p95'])} "
+            f"({h['count']} batches)")
+
+    # ---- device ----------------------------------------------------
+    in_use = _labeled(gauges, "device_bytes_in_use")
+    limit = dict(_labeled(gauges, "device_bytes_limit"))
+    for lab, v in in_use:
+        cap = limit.get(lab)
+        pct = f" ({100 * v / cap:.0f}% of limit)" if cap else ""
+        lines.append(f"HBM in use [{lab}]: {v / (1 << 30):.2f} GiB{pct}")
+    stale = [lab for lab, v in
+             _labeled(gauges, "device_telemetry_stale") if v]
+    if stale:
+        lines.append(f"  STALE telemetry on device(s): {stale}")
+
+    # ---- trace aggregation ----------------------------------------
+    if trace_events:
+        agg: Dict[str, List[float]] = {}
+        for e in trace_events:
+            if e.get("ph") == "X":
+                agg.setdefault(e["name"], []).append(
+                    e.get("dur", 0.0) / 1e6)
+        rows = [[name, len(durs), _fmt_seconds(sum(durs) / len(durs)),
+                 f"{sum(durs):.2f}s"]
+                for name, durs in sorted(
+                    agg.items(), key=lambda kv: -sum(kv[1]))[:12]]
+        if rows:
+            lines += ["", "trace spans (top by total time):",
+                      _table(rows, ["span", "count", "mean", "total"])]
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- diff
+# (metric selector, direction) pairs the diff gates on; "up" = higher
+# is better (regression when it drops), "down" = lower is better
+_DIFF_KEYS = [
+    ("gauge", "train_throughput_samples_per_sec", "up"),
+    ("gauge", "train_mfu", "up"),
+    ("hist_p50", "train_step_latency_seconds", "down"),
+    ("hist_p50", "train_step_time_seconds", "down"),
+    ("hist_p50", "serving_request_latency_seconds", "down"),
+    ("hist_p50", "data_batch_wait_seconds", "down"),
+]
+
+
+def _diff_values(snap: Dict, kind: str, name: str
+                 ) -> List[Tuple[str, float]]:
+    if kind == "gauge":
+        return [(lab, float(v))
+                for lab, v in _labeled(snap.get("gauges", {}), name)]
+    return [(lab, float(h["p50"]))
+            for lab, h in _labeled(snap.get("histograms", {}), name)
+            if h.get("count")]
+
+
+def render_diff(cur_label: str, cur: Dict, base_label: str, base: Dict,
+                threshold: float) -> Tuple[str, int]:
+    lines = [f"== diff: {cur_label} vs baseline {base_label} "
+             f"(threshold {threshold:.0%}) =="]
+    regressions = 0
+    for kind, name, direction in _DIFF_KEYS:
+        base_vals = dict(_diff_values(base, kind, name))
+        for lab, cur_v in _diff_values(cur, kind, name):
+            base_v = base_vals.get(lab)
+            if base_v is None or base_v <= 0 or cur_v <= 0:
+                continue
+            change = cur_v / base_v - 1.0
+            worse = change < -threshold if direction == "up" \
+                else change > threshold
+            mark = "  REGRESSION" if worse else ""
+            regressions += bool(worse)
+            disp = f"{name}{{{lab}}}" if lab else name
+            if kind != "gauge":
+                disp += " p50"
+            lines.append(f"{disp}: {base_v:.6g} -> {cur_v:.6g} "
+                         f"({change:+.1%}){mark}")
+    if regressions:
+        lines.append(f"{regressions} regression(s) beyond "
+                     f"{threshold:.0%}")
+    else:
+        lines.append("no regressions beyond threshold")
+    return "\n".join(lines), (1 if regressions else 0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a registry snapshot (+ optional Chrome "
+                    "trace) into a training-health report; --diff "
+                    "gates on regressions")
+    ap.add_argument("snapshot", help="registry JSONL / bench_metrics"
+                                     ".json / snapshot JSON")
+    ap.add_argument("--trace", default=None,
+                    help="Chrome-trace JSON (Tracer.export_chrome_"
+                         "trace or /trace)")
+    ap.add_argument("--workload", default=None,
+                    help="bench_metrics.json: report only this "
+                         "workload")
+    ap.add_argument("--diff", metavar="BASELINE", default=None,
+                    help="compare against a baseline snapshot; exit 1 "
+                         "on regression")
+    ap.add_argument("--threshold", type=float, default=0.10)
+    args = ap.parse_args(argv)
+
+    snaps = load_snapshots(args.snapshot, args.workload)
+    trace_events = None
+    if args.trace:
+        with open(args.trace) as f:
+            doc = json.load(f)
+        trace_events = doc.get("traceEvents", doc) \
+            if isinstance(doc, dict) else doc
+
+    rc = 0
+    for label, snap in snaps:
+        print(render_report(label, snap, trace_events))
+        print()
+    if args.diff:
+        base = load_snapshots(args.diff, args.workload)
+        # pair snapshots by label (multi-workload bench_metrics.json:
+        # EVERY shared workload gates, a regression in any of them
+        # fails); fall back to first-vs-first when labels don't
+        # overlap (plain files, whose label is their path)
+        base_map = dict(base)
+        pairs = [(lab, snap, lab, base_map[lab])
+                 for lab, snap in snaps if lab in base_map]
+        if not pairs:
+            pairs = [(snaps[0][0], snaps[0][1], base[0][0], base[0][1])]
+        missing = [lab for lab, _ in snaps
+                   if base_map and lab not in base_map and len(base) > 1]
+        for cur_label, cur, base_label, base_snap in pairs:
+            text, r = render_diff(cur_label, cur, base_label,
+                                  base_snap, args.threshold)
+            print(text)
+            rc = max(rc, r)
+        if missing:
+            print(f"not in baseline (not gated): {missing}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
