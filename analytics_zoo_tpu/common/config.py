@@ -111,6 +111,28 @@ _DEFAULTS: Dict[str, Any] = {
     # kind via benchmarks.PEAK_FLOPS; set explicitly on backends whose
     # peak is unknown, e.g. CPU smoke runs).
     "observability.peak_flops": 0.0,
+    # Interface the /metrics endpoint binds (MetricsServer default).
+    # UNAUTHENTICATED endpoint: on shared networks set 127.0.0.1 or a
+    # scrape-only interface.
+    "observability.bind_host": "0.0.0.0",
+    # Per-metric label-cardinality ceiling: label combinations past
+    # this are accepted but not exported (counted in
+    # zoo_metrics_dropped_series_total) so an unbounded label can
+    # never OOM the exporter.  0 disables the cap.
+    "observability.max_series_per_metric": 1000,
+    # Multi-host: at every sampled device step (device_time_every),
+    # time a cross-host barrier — the wait measures step skew (the
+    # FASTEST host waits longest; the straggler waits ~0).  Feeds
+    # train_barrier_wait_seconds and the aggregator's straggler
+    # attribution.  Single-process runs never pay it.
+    "observability.barrier_probe": True,
+    # Account sharding-implied collective traffic (gradient psum, FSDP
+    # all-gather, pipeline ppermute) into collective_bytes_total{op}.
+    "observability.collectives": True,
+    # Per-link interconnect bandwidth in GB/s used to turn collective
+    # bytes into estimated collective_seconds_total{op}; 0 disables the
+    # time estimate (bytes are still counted).
+    "observability.ici_gbps": 0.0,
     # Serving readiness (/healthz -> 503): input-stream backlog above
     # which the worker reports not-ready (0 = disabled) and the error
     # fraction over the most recent records (0 = disabled).

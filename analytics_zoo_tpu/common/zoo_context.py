@@ -107,6 +107,21 @@ def init_zoo_context(conf: Optional[Dict[str, Any]] = None,
     mesh = mesh_lib.create_mesh(mesh_shape)
 
     _context = ZooContext(config, mesh)
+
+    # Cluster observability plane: when the launcher handed us a run
+    # dir, stamp this worker's immutable host/process_index labels on
+    # the registry, start its metrics endpoint on the injected port,
+    # and (host 0) attach the cluster aggregator.  Best-effort — a
+    # broken metrics port must never stop training.
+    if os.environ.get("ZOO_TPU_RUN_DIR"):
+        try:
+            from analytics_zoo_tpu.observability.aggregator import (
+                init_worker_observability)
+            init_worker_observability(
+                process_index=_context.process_index)
+        except Exception:
+            log.exception("cluster observability bring-up failed")
+
     log.info("%s initialised: %r", name, _context)
     return _context
 

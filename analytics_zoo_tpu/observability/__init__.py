@@ -55,6 +55,20 @@ from analytics_zoo_tpu.observability.watchdog import (
     get_active_watchdog,
     set_active_watchdog,
 )
+from analytics_zoo_tpu.observability.aggregator import (
+    ClusterAggregator,
+    WorkerSource,
+    flush_worker_observability,
+    init_worker_observability,
+    merge_snapshots,
+    merge_traces,
+    reset_worker_observability,
+    straggler_report,
+)
+from analytics_zoo_tpu.observability.collectives import (
+    estimate_train_step_collectives,
+    record_step_collectives,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
@@ -79,4 +93,14 @@ __all__ = [
     "TrainingWatchdog",
     "get_active_watchdog",
     "set_active_watchdog",
+    "ClusterAggregator",
+    "WorkerSource",
+    "flush_worker_observability",
+    "init_worker_observability",
+    "merge_snapshots",
+    "merge_traces",
+    "reset_worker_observability",
+    "straggler_report",
+    "estimate_train_step_collectives",
+    "record_step_collectives",
 ]

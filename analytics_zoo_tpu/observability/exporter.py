@@ -2,17 +2,29 @@
 Prometheus text exposition plus JSON snapshot and Chrome-trace views.
 
 Routes:
-    /metrics        Prometheus text exposition 0.0.4 (scrape target)
-    /metrics.json   registry snapshot as JSON
-    /trace          Chrome-trace JSON of the span tracer (Perfetto)
-    /healthz        liveness ("ok") — or a READINESS probe when the
-                    owner installed a ``health_check``: 200 JSON when
-                    healthy, 503 JSON naming the reason when not
-                    (serving wires its queue-depth / error-rate
-                    thresholds in here)
+    /metrics          Prometheus text exposition 0.0.4 (scrape target)
+    /metrics.json     registry snapshot as JSON
+    /metrics/cluster  federated CLUSTER view (host 0 of a multi-host
+                      run, when a ClusterAggregator is attached):
+                      counters summed across hosts, histograms merged,
+                      gauges as per-host vectors, plus the
+                      cluster_* skew/straggler gauges
+    /metrics/cluster.json  same view as JSON, including the full
+                      straggler/collective report under "cluster"
+    /trace            Chrome-trace JSON of the span tracer (Perfetto)
+    /healthz          liveness ("ok") — or a READINESS probe when the
+                      owner installed a ``health_check``: 200 JSON when
+                      healthy, 503 JSON naming the reason when not
+                      (serving wires its queue-depth / error-rate
+                      thresholds in here)
 
 Port 0 binds an ephemeral port (``server.port`` has the real one) —
 what tests and multi-worker hosts use to avoid collisions.
+
+Bind host: ``host=None`` resolves ``observability.bind_host`` from the
+config (default ``0.0.0.0``).  The endpoint is UNAUTHENTICATED — on a
+shared network set ``observability.bind_host 127.0.0.1`` (or a
+scrape-only interface) and front it with your scrape proxy.
 """
 
 from __future__ import annotations
@@ -53,6 +65,20 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.dumps(self.server.registry.snapshot(),
                                   indent=2).encode()
                 self._respond(body, "application/json")
+            elif path in ("/metrics/cluster", "/metrics/cluster.json"):
+                agg = getattr(self.server, "aggregator", None)
+                if agg is None:
+                    self._respond(
+                        b"no cluster aggregator attached (this is a "
+                        b"worker endpoint; scrape host 0)",
+                        "text/plain", 404)
+                elif path.endswith(".json"):
+                    body = json.dumps(agg.cluster_snapshot(),
+                                      indent=2).encode()
+                    self._respond(body, "application/json")
+                else:
+                    body = agg.prometheus_text().encode()
+                    self._respond(body, PROM_CONTENT_TYPE)
             elif path == "/trace":
                 body = json.dumps(
                     self.server.tracer.chrome_trace()).encode()
@@ -96,10 +122,12 @@ class MetricsServer:
     """Owns the HTTP listener + its serve thread.  ``start`` is
     idempotent; ``stop`` releases the port."""
 
-    def __init__(self, port: int = 0, host: str = "0.0.0.0",
+    def __init__(self, port: int = 0, host: Optional[str] = None,
                  registry: Optional[MetricsRegistry] = None,
                  tracer: Optional[Tracer] = None,
-                 health_check=None):
+                 health_check=None, aggregator=None):
+        if host is None:
+            host = default_bind_host()
         self._requested = (host, int(port))
         self.registry = registry if registry is not None \
             else get_registry()
@@ -107,6 +135,9 @@ class MetricsServer:
         # readiness probe: a callable returning None (healthy) or a
         # JSON-able dict naming the reason (-> 503 on /healthz)
         self.health_check = health_check
+        # host-0 federation point: a ClusterAggregator serving the
+        # /metrics/cluster routes (workers leave this None)
+        self.aggregator = aggregator
         self._httpd: Optional[_Server] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -121,6 +152,7 @@ class MetricsServer:
         self._httpd.registry = self.registry
         self._httpd.tracer = self.tracer
         self._httpd.health_check = self.health_check
+        self._httpd.aggregator = self.aggregator
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True,
             name=f"zoo-metrics-http:{self.port}")
@@ -139,11 +171,24 @@ class MetricsServer:
         self._thread = None
 
 
-def start_metrics_server(port: int = 0, host: str = "0.0.0.0",
+def default_bind_host() -> str:
+    """The configured bind interface (``observability.bind_host``);
+    falls back to all interfaces to preserve the historical default."""
+    try:
+        from analytics_zoo_tpu.common.config import get_config
+        return str(get_config().get("observability.bind_host",
+                                    "0.0.0.0") or "0.0.0.0")
+    except Exception:
+        return "0.0.0.0"
+
+
+def start_metrics_server(port: int = 0, host: Optional[str] = None,
                          registry: Optional[MetricsRegistry] = None,
                          tracer: Optional[Tracer] = None,
-                         health_check=None) -> MetricsServer:
+                         health_check=None,
+                         aggregator=None) -> MetricsServer:
     """Build + start in one call; returns the server (``.port`` holds
     the bound port when ``port=0``)."""
     return MetricsServer(port=port, host=host, registry=registry,
-                         tracer=tracer, health_check=health_check).start()
+                         tracer=tracer, health_check=health_check,
+                         aggregator=aggregator).start()

@@ -21,9 +21,20 @@ from __future__ import annotations
 
 import bisect
 import json
+import logging
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+log = logging.getLogger("analytics_zoo_tpu.observability")
+
+# Per-metric label-cardinality ceiling: a per-request or per-host label
+# exploding into unbounded series is the classic way an exporter OOMs.
+# Children past the cap still accept writes (callers never break) but
+# are not stored/exported; zoo_metrics_dropped_series_total{metric}
+# counts them.  Overridable per registry or via
+# observability.max_series_per_metric.
+DEFAULT_MAX_SERIES = 1000
 
 # Prometheus' default bucket ladder, widened down to 100us: TPU predict
 # steps on a warm executable can sit well under 5ms.
@@ -44,9 +55,10 @@ def _escape_label_value(v: str) -> str:
 
 
 def _format_labels(names: Sequence[str], values: Sequence[str],
-                   extra: Optional[Tuple[str, str]] = None) -> str:
+                   extra: Optional[Tuple[str, str]] = None,
+                   const: Sequence[Tuple[str, str]] = ()) -> str:
     pairs = [f'{n}="{_escape_label_value(v)}"'
-             for n, v in zip(names, values)]
+             for n, v in list(const) + list(zip(names, values))]
     if extra is not None:
         pairs.append(f'{extra[0]}="{extra[1]}"')
     return "{" + ",".join(pairs) + "}" if pairs else ""
@@ -158,12 +170,25 @@ class _Family:
 
     def __init__(self, name: str, help: str, kind: str,
                  label_names: Tuple[str, ...],
-                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+                 buckets: Tuple[float, ...] = DEFAULT_BUCKETS,
+                 max_series: int = DEFAULT_MAX_SERIES,
+                 on_drop=None):
         self.name = name
         self.help = help
         self.kind = kind
         self.label_names = label_names
         self.buckets = tuple(sorted(buckets))
+        self.max_series = int(max_series)
+        self._on_drop = on_drop      # registry callback, called unlocked
+        self._overflow_child: Optional[_Child] = None
+        self._drop_warned = False
+        # label combos already counted as dropped: the counter tracks
+        # COMBINATIONS (what the help text promises), not writes, and
+        # repeat writes to a dropped combo skip the lock/callback.
+        # Bounded so a truly unbounded label can't grow this set either
+        self._dropped_keys: set = set()
+        self._max_dropped_keys = max(10 * self.max_series, 10_000)
+        self._dropped_saturated = False
         self._children: Dict[Tuple[str, ...], _Child] = {}
         self._lock = threading.Lock()
         if not label_names:
@@ -184,13 +209,64 @@ class _Family:
                 f"got {values}")
         child = self._children.get(values)
         if child is None:
+            # known-dropped combo: skip the lock and the drop
+            # accounting entirely (hot-path writes to a capped series
+            # must stay one set lookup, and the drop counter tracks
+            # combinations, not writes).  Once the memo itself
+            # saturates (a label so unbounded even 10x the cap of
+            # combos flowed past), EVERY unknown combo short-circuits:
+            # the counter undercounts beyond the memo bound rather
+            # than reverting to per-write lock traffic — the loud
+            # warning and >=bound counter value are signal enough
+            if self._overflow_child is not None and (
+                    self._dropped_saturated
+                    or values in self._dropped_keys):
+                return self._overflow_child
+            dropped = False
             with self._lock:
-                child = self._children.setdefault(
-                    values,
-                    _HistogramChild(self.buckets)
-                    if self.kind == "histogram"
-                    else _KIND_CHILD[self.kind]())
+                child = self._children.get(values)
+                if child is None:
+                    if (self.max_series > 0
+                            and len(self._children) >= self.max_series):
+                        # cardinality cap: hand back a detached child —
+                        # the caller's inc/observe still work, but the
+                        # series is never stored or exported, so the
+                        # exporter's memory stays bounded
+                        if self._overflow_child is None:
+                            self._overflow_child = self._new_child()
+                        child = self._overflow_child
+                        dropped = values not in self._dropped_keys
+                        if dropped:
+                            if len(self._dropped_keys) < \
+                                    self._max_dropped_keys:
+                                self._dropped_keys.add(values)
+                            else:
+                                self._dropped_saturated = True
+                    else:
+                        child = self._children.setdefault(
+                            values, self._new_child())
+            if dropped:
+                if not self._drop_warned:
+                    self._drop_warned = True
+                    log.warning(
+                        "metric %r exceeded its %d-series label-"
+                        "cardinality cap; further label combinations "
+                        "are accepted but NOT exported (counted in "
+                        "zoo_metrics_dropped_series_total) — an "
+                        "unbounded label (request id? per-host key?) "
+                        "is leaking into this metric",
+                        self.name, self.max_series)
+                if self._on_drop is not None:
+                    try:
+                        self._on_drop(self.name)
+                    except Exception:  # accounting must never raise
+                        pass
         return child
+
+    def _new_child(self) -> _Child:
+        return (_HistogramChild(self.buckets)
+                if self.kind == "histogram"
+                else _KIND_CHILD[self.kind]())
 
     def _default(self):
         """The unlabeled child (only valid for label-free families)."""
@@ -227,9 +303,51 @@ class MetricsRegistry:
     coordinate registration order.
     """
 
-    def __init__(self):
+    def __init__(self, max_series_per_metric: Optional[int] = None):
         self._families: Dict[str, _Family] = {}
         self._lock = threading.Lock()
+        # constant labels stamped on every exported series (host /
+        # process_index identity in multi-host runs); immutable once set
+        self._const_labels: Dict[str, str] = {}
+        if max_series_per_metric is None:
+            try:
+                from analytics_zoo_tpu.common.config import get_config
+                max_series_per_metric = int(get_config().get(
+                    "observability.max_series_per_metric",
+                    DEFAULT_MAX_SERIES))
+            except Exception:
+                max_series_per_metric = DEFAULT_MAX_SERIES
+        self.max_series_per_metric = int(max_series_per_metric)
+
+    # ---------------------------------------------------- const labels
+    def set_const_labels(self, **labels) -> None:
+        """Stamp identity labels (e.g. ``host``/``process_index``) onto
+        every series this registry exports.  IMMUTABLE: re-setting a
+        label to a different value raises — a worker's identity must
+        not drift mid-run (the aggregator keys on it)."""
+        clean = {str(k): str(v) for k, v in labels.items()}
+        with self._lock:
+            for k, v in clean.items():
+                old = self._const_labels.get(k)
+                if old is not None and old != v:
+                    raise ValueError(
+                        f"const label {k!r} already set to {old!r}; "
+                        f"refusing to change it to {v!r} (worker "
+                        "identity labels are immutable)")
+            self._const_labels.update(clean)
+
+    @property
+    def const_labels(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._const_labels)
+
+    def _record_dropped_series(self, metric_name: str) -> None:
+        # called from a family with NO lock held (see _Family.labels)
+        self.counter(
+            "zoo_metrics_dropped_series_total",
+            "label-value combinations dropped by the per-metric "
+            "cardinality cap (observability.max_series_per_metric)",
+            labels=("metric",)).labels(metric_name).inc()
 
     def _get_or_create(self, name: str, help: str, kind: str,
                        label_names: Iterable[str],
@@ -239,7 +357,9 @@ class MetricsRegistry:
         with self._lock:
             fam = self._families.get(name)
             if fam is None:
-                fam = _Family(name, help, kind, label_names, buckets)
+                fam = _Family(name, help, kind, label_names, buckets,
+                              max_series=self.max_series_per_metric,
+                              on_drop=self._record_dropped_series)
                 self._families[name] = fam
                 return fam
         if fam.kind != kind or fam.label_names != label_names:
@@ -277,10 +397,17 @@ class MetricsRegistry:
         with self._lock:
             families = sorted(self._families.values(),
                               key=lambda f: f.name)
+            const = tuple(sorted(self._const_labels.items()))
         for fam in families:
             items = fam.items()
             if not items:
                 continue
+            # a family whose own schema names a const label (e.g. a
+            # "host" label on a metric in a host-labelled registry)
+            # wins: emitting both would be duplicate-label exposition,
+            # which Prometheus rejects for the WHOLE scrape
+            fconst = tuple((k, v) for k, v in const
+                           if k not in fam.label_names)
             if fam.help:
                 lines.append(f"# HELP {fam.name} {fam.help}")
             lines.append(f"# TYPE {fam.name} {fam.kind}")
@@ -290,19 +417,21 @@ class MetricsRegistry:
                     for bound, c in zip(fam.buckets, cum):
                         lab = _format_labels(
                             fam.label_names, values,
-                            ("le", _format_value(bound)))
+                            ("le", _format_value(bound)), const=fconst)
                         lines.append(f"{fam.name}_bucket{lab} {c}")
                     lab = _format_labels(fam.label_names, values,
-                                         ("le", "+Inf"))
+                                         ("le", "+Inf"), const=fconst)
                     lines.append(
                         f"{fam.name}_bucket{lab} {child.count}")
-                    plain = _format_labels(fam.label_names, values)
+                    plain = _format_labels(fam.label_names, values,
+                                           const=fconst)
                     lines.append(f"{fam.name}_sum{plain} "
                                  f"{_format_value(child.sum)}")
                     lines.append(f"{fam.name}_count{plain} "
                                  f"{child.count}")
                 else:
-                    lab = _format_labels(fam.label_names, values)
+                    lab = _format_labels(fam.label_names, values,
+                                         const=fconst)
                     lines.append(f"{fam.name}{lab} "
                                  f"{_format_value(child.value)}")
         return "\n".join(lines) + "\n"
@@ -311,11 +440,19 @@ class MetricsRegistry:
     def snapshot(self) -> Dict:
         """JSON-friendly snapshot: counters/gauges as values, histograms
         as count/sum/percentile summaries (compact enough to embed in a
-        bench artifact)."""
+        bench artifact) plus their cumulative bucket counts (so the
+        cluster aggregator can merge distributions exactly, not just
+        count-weight the percentiles).  When const labels are set the
+        snapshot carries them under a top-level ``"labels"`` key — keys
+        inside the sections stay unprefixed, so single-process
+        consumers are unaffected."""
         out: Dict[str, Dict] = {"counters": {}, "gauges": {},
                                 "histograms": {}}
         with self._lock:
             families = list(self._families.values())
+            const = dict(self._const_labels)
+        if const:
+            out["labels"] = const
         for fam in families:
             for values, child in fam.items():
                 key = fam.name
@@ -332,6 +469,10 @@ class MetricsRegistry:
                         "p50": child.percentile(50),
                         "p95": child.percentile(95),
                         "p99": child.percentile(99),
+                        # finite upper bounds + cumulative counts; the
+                        # +Inf bucket is implicit ("count")
+                        "le": list(fam.buckets),
+                        "cum": child.cumulative(),
                     }
         return out
 

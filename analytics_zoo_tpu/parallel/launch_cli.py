@@ -20,15 +20,30 @@ def main(argv=None):
                    help="host:port of process 0 (default: local free port)")
     p.add_argument("--timeout", type=float, default=None,
                    help="seconds to wait before killing stragglers")
+    p.add_argument("--run-dir", default=None,
+                   help="observability run directory: each worker "
+                        "gets a host-<k>/ metrics slot + port and a "
+                        "shared clock anchor; aggregate with "
+                        "scripts/obs_report.py --merge-hosts")
     p.add_argument("script")
     p.add_argument("args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
 
+    import os
     import subprocess
 
     from analytics_zoo_tpu.parallel.launcher import ZooCluster
+    # CLI convenience (the spark-submit --py-files role): python puts
+    # the SCRIPT's dir on a worker's sys.path, not the launch cwd —
+    # propagate the cwd so `zoo-launch -n 4 train.py` resolves the
+    # same imports the launcher shell does.  CLI-only: ZooCluster as
+    # a library leaves worker import paths alone.
+    env = {"PYTHONPATH": os.getcwd() + (
+        os.pathsep + os.environ["PYTHONPATH"]
+        if os.environ.get("PYTHONPATH") else "")}
     cluster = ZooCluster(num_processes=args.num_processes,
-                         coordinator=args.coordinator)
+                         coordinator=args.coordinator,
+                         run_dir=args.run_dir, env=env)
     cluster.start(args.script, args.args)
     try:
         codes = cluster.wait(timeout=args.timeout)
@@ -43,6 +58,10 @@ def main(argv=None):
         print(f"workers exited with codes {codes}", file=sys.stderr)
         return 1
     print(f"{args.num_processes} workers completed")
+    if args.run_dir:
+        print(f"observability run dir: {args.run_dir} — merge with "
+              f"`python scripts/obs_report.py --merge-hosts "
+              f"{args.run_dir}`")
     return 0
 
 

@@ -11,12 +11,24 @@ machine), injects the coordinator env that ``init_zoo_context`` consumes
 (ZOO_TPU_COORDINATOR / NUM_PROCESSES / PROCESS_ID), and guards children
 with PR_SET_PDEATHSIG so they die with the launcher, plus atexit
 cleanup.
+
+Observability plane: passing ``run_dir`` makes the launcher the
+cluster's rendezvous for fleet-level metrics — it creates one
+``host-<k>/`` slot per worker, pre-allocates a metrics port each,
+broadcasts a shared clock anchor (so per-host Chrome traces align on
+one epoch), and writes a ``cluster.json`` manifest that host 0's
+aggregator and ``obs_report.py --merge-hosts`` both read.  Workers
+pick the contract up from ZOO_TPU_RUN_DIR / ZOO_TPU_METRICS_DIR /
+ZOO_TPU_METRICS_PORT / ZOO_TPU_CLOCK_ANCHOR via
+``observability.aggregator.init_worker_observability`` (called by
+``init_zoo_context``).
 """
 
 from __future__ import annotations
 
 import atexit
 import ctypes
+import json
 import os
 import signal
 import socket
@@ -80,12 +92,48 @@ class ZooCluster:
 
     def __init__(self, num_processes: int,
                  coordinator: Optional[str] = None,
-                 env: Optional[Dict[str, str]] = None):
+                 env: Optional[Dict[str, str]] = None,
+                 run_dir: Optional[str] = None):
         self.num_processes = int(num_processes)
         self.coordinator = coordinator or \
             f"localhost:{_free_port()}"
         self.extra_env = env or {}
         self.monitor = ProcessMonitor()
+        # observability plane: per-worker metrics slots + ports and a
+        # shared clock anchor, manifested in run_dir/cluster.json
+        self.run_dir = run_dir
+        self.clock_anchor: Optional[float] = None
+        self.worker_ports: Dict[int, int] = {}
+        if run_dir:
+            self._prepare_run_dir(run_dir)
+
+    def _prepare_run_dir(self, run_dir: str) -> None:
+        # imported lazily: the supervisor process doesn't need the
+        # observability submodules loaded unless a run dir is in play
+        from analytics_zoo_tpu.observability import (
+            aggregator as agg_lib)
+        self.clock_anchor = time.time()
+        hostname = socket.gethostname()
+        workers = []
+        for pid in range(self.num_processes):
+            wdir = os.path.join(run_dir,
+                                agg_lib.host_dir_name(pid))
+            os.makedirs(wdir, exist_ok=True)
+            self.worker_ports[pid] = _free_port()
+            workers.append({
+                "process_index": pid,
+                "dir": agg_lib.host_dir_name(pid),
+                "hostname": hostname,
+                "metrics_port": self.worker_ports[pid],
+            })
+        with open(os.path.join(run_dir, agg_lib.CLUSTER_FILE),
+                  "w") as f:
+            json.dump({
+                "clock_anchor": self.clock_anchor,
+                "num_processes": self.num_processes,
+                "coordinator": self.coordinator,
+                "workers": workers,
+            }, f, indent=2)
 
     def worker_env(self, process_id: int) -> Dict[str, str]:
         env = dict(os.environ)
@@ -95,6 +143,17 @@ class ZooCluster:
             "ZOO_TPU_NUM_PROCESSES": str(self.num_processes),
             "ZOO_TPU_PROCESS_ID": str(process_id),
         })
+        if self.run_dir:
+            from analytics_zoo_tpu.observability import (
+                aggregator as agg_lib)
+            env.update({
+                agg_lib.ENV_RUN_DIR: self.run_dir,
+                agg_lib.ENV_METRICS_DIR: os.path.join(
+                    self.run_dir, agg_lib.host_dir_name(process_id)),
+                agg_lib.ENV_METRICS_PORT:
+                    str(self.worker_ports[process_id]),
+                agg_lib.ENV_CLOCK_ANCHOR: repr(self.clock_anchor),
+            })
         return env
 
     def start(self, script: str, args: Sequence[str] = ()) -> None:

@@ -102,6 +102,47 @@ def _pipe_broadcast(v, src: int, p: int):
     return out
 
 
+def _record_schedule_metrics(p: int, m: int, x) -> None:
+    """Host-side schedule accounting per ``pipeline_apply``: the GPipe
+    fill/drain bubble is exact from the schedule — ``P-1`` of the
+    ``M+P-1`` ticks per stage are idle — and every tick ppermutes one
+    microbatch of activations over ICI.  Feeds the aggregator's
+    bubble-fraction and collective sections; never raises."""
+    try:
+        from analytics_zoo_tpu.observability import get_registry
+        from analytics_zoo_tpu.observability.collectives import (
+            BYTES_PER_STEP_HELP, estimate_pipeline_ppermute_bytes,
+            record_step_collectives)
+        reg = get_registry()
+        ticks = m + p - 1
+        bubble = (p - 1) / ticks
+        lab = reg.gauge(
+            "pipeline_num_stages",
+            "pipeline stages (pipe mesh axis) of the last apply")
+        lab.set(p)
+        reg.gauge(
+            "pipeline_num_microbatches",
+            "microbatches per pipeline_apply").set(m)
+        reg.gauge(
+            "pipeline_bubble_fraction",
+            "GPipe fill/drain bubble: (P-1)/(M+P-1) of each stage's "
+            "ticks are idle — raise num_microbatches to amortize"
+        ).set(bubble)
+        mb_bytes = (x.size // m) * x.dtype.itemsize
+        ppermute_bytes = estimate_pipeline_ppermute_bytes(mb_bytes, p, m)
+        if isinstance(x, jax.core.Tracer):
+            # under tracing this site runs once per COMPILE, not per
+            # step — counting there would undercount wildly, so only
+            # the per-apply estimate gauge is refreshed
+            reg.gauge("collective_bytes_per_step", BYTES_PER_STEP_HELP,
+                      labels=("op",)).labels("ppermute").set(
+                          ppermute_bytes)
+        else:
+            record_step_collectives({"ppermute": ppermute_bytes})
+    except Exception:
+        pass
+
+
 def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh,
                    num_microbatches: int):
     """Forward through the pipeline; differentiable end-to-end.
@@ -117,6 +158,7 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh,
         params0 = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
         return stage_fn(params0, x)
 
+    _record_schedule_metrics(p, num_microbatches, x)
     fn = functools.partial(_spmd_pipeline, stage_fn, num_stages=p,
                            num_microbatches=num_microbatches)
     pspec_params = jax.tree_util.tree_map(

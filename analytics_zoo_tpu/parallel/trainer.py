@@ -156,6 +156,26 @@ class DistributedTrainer:
             cfg.get("observability.device_time_every") or 0)
         self._monitor = get_compile_monitor()
         self._m_step_time = step_attribution_histogram(reg)
+        # cross-host skew instrumentation: at every sampled device
+        # step on a multi-process run, time an explicit cluster
+        # barrier — the wait is (max_host_step − my_step), so the
+        # straggler reads ~0 while every other host reads the skew.
+        # The aggregator's straggler report consumes this together
+        # with per-host train_step_latency_seconds.
+        self._obs_barrier_probe = bool(
+            cfg.get("observability.barrier_probe", True))
+        self._barrier_supported: Optional[bool] = None
+        self._m_barrier_wait = reg.histogram(
+            "train_barrier_wait_seconds",
+            "sampled cross-host barrier wait after a train step "
+            "(multi-host only): ~0 on the straggler, ~skew on the "
+            "fastest host")
+        # collective accounting: per-step psum/all-gather bytes implied
+        # by the sharding contract (observability/collectives.py),
+        # estimated once per params signature then counted per dispatch
+        self._obs_collectives = bool(
+            cfg.get("observability.collectives", True))
+        self._collective_bytes = None
         self._m_device_step = reg.gauge(
             "train_device_step_seconds",
             "sampled dispatch->block_until_ready wall of one train "
@@ -336,6 +356,8 @@ class DistributedTrainer:
         sample_device = (self._obs_device_every > 0 and
                          self._dispatch_count % self._obs_device_every
                          == 0)
+        if self._collective_bytes is None and args:
+            self._collective_bytes = self._estimate_collectives(args[0])
         with get_tracer().span("train_step"):
             t0 = time.perf_counter()
             out = fn(*args)
@@ -353,8 +375,81 @@ class DistributedTrainer:
                     self._m_step_time.labels("device").observe(device_s)
                     self._m_device_step.set(device_s)
                     publish_mfu("train_step", device_s)
+                self._probe_barrier_wait()
+        if self._collective_bytes:
+            from analytics_zoo_tpu.observability.collectives import (
+                record_step_collectives)
+            record_step_collectives(self._collective_bytes)
         self._m_steps.labels("per_step").inc()
         return out
+
+    def _estimate_collectives(self, params) -> Dict[str, float]:
+        """One-time {op: bytes/step} estimate from the sharding
+        contract; {} disables the per-dispatch accounting."""
+        if not self._obs_collectives:
+            return {}
+        try:
+            from analytics_zoo_tpu.observability.collectives import (
+                estimate_train_step_collectives)
+            return estimate_train_step_collectives(
+                params, self.mesh, self.grad_sync_dtype)
+        except Exception:
+            return {}
+
+    def account_collectives(self, params, steps: int) -> None:
+        """Collective accounting for a FUSED dispatch of ``steps``
+        steps (the chunked / epoch-scan paths, which bypass
+        ``_dispatch_instrumented``): the per-step traffic is identical
+        regardless of dispatch shape, so the counters stay comparable
+        across engines.  Never raises."""
+        if self._collective_bytes is None:
+            self._collective_bytes = self._estimate_collectives(params)
+        if self._collective_bytes and steps > 0:
+            from analytics_zoo_tpu.observability.collectives import (
+                record_step_collectives)
+            record_step_collectives(self._collective_bytes,
+                                    steps=steps)
+
+    def _probe_barrier_wait(self) -> None:
+        """Time a cross-host barrier on the sampled step (multi-host
+        only): my wait = slowest host's remaining step time, the
+        direct skew signal the aggregator attributes stragglers from.
+        Piggybacks on the device-sample cadence so every process hits
+        the barrier on the same dispatch count."""
+        if not self._obs_barrier_probe or jax.process_count() <= 1 \
+                or self._barrier_supported is False:
+            return
+        if self._barrier_supported is None:
+            # capability gate, decided at the FIRST sampled step only:
+            # every host reaches it at the same dispatch count, and a
+            # does-this-backend-support-it failure is symmetric, so
+            # all hosts disable together — participation stays in
+            # lockstep
+            try:
+                from jax.experimental import multihost_utils
+                t0 = time.perf_counter()
+                multihost_utils.sync_global_devices(
+                    "zoo_obs_barrier_probe")
+                self._m_barrier_wait.observe(time.perf_counter() - t0)
+                self._barrier_supported = True
+            except Exception:
+                self._barrier_supported = False
+                import logging
+                logging.getLogger(
+                    "analytics_zoo_tpu.observability").exception(
+                    "cross-host barrier probe unsupported here; "
+                    "disabling it (straggler attribution loses the "
+                    "barrier-wait signal)")
+            return
+        # past the gate, a failure means the collective fabric broke
+        # mid-run: swallowing it would DESYNC the sampled barrier
+        # (peers park waiting for us → cluster-wide silent hang), so
+        # let it propagate into the step loop like any other
+        # collective failure — the retry/failure machinery owns it
+        from jax.experimental import multihost_utils
+        t0 = time.perf_counter()
+        multihost_utils.sync_global_devices("zoo_obs_barrier_probe")
+        self._m_barrier_wait.observe(time.perf_counter() - t0)
 
     def train_step(self, params, opt_state, state, batch, rng):
         """Run one step; ``batch`` must already be device-placed

@@ -25,7 +25,8 @@ import numpy as np
 
 from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.observability import (
-    EPOCH_BUCKETS, get_registry, get_tracer, sample_device_telemetry)
+    EPOCH_BUCKETS, flush_worker_observability, get_registry,
+    get_tracer, sample_device_telemetry)
 from analytics_zoo_tpu.observability.watchdog import (
     TrainingHalted, TrainingWatchdog, set_active_watchdog)
 from analytics_zoo_tpu.parallel import mesh as mesh_lib
@@ -599,6 +600,7 @@ class Estimator:
                         ts.iteration += nb_epoch
                         seen += epoch_rows
                         met["steps"].labels("epoch_scan").inc(nb_epoch)
+                        trainer.account_collectives(params, nb_epoch)
                         log_loss_crossing(loss, nb_epoch)
                         watchdog.beat()
                         observe_loss_once(ts.last_loss)
@@ -629,6 +631,7 @@ class Estimator:
                             ts.iteration += k
                             seen += k * batch_size
                             met["steps"].labels("chunked").inc(k)
+                            trainer.account_collectives(params, k)
                             log_loss_crossing(loss, k)
                             watchdog.beat()
                             health_check()
@@ -717,6 +720,11 @@ class Estimator:
                 met["throughput"].set(throughput)
                 met["loss"].set(ts.last_loss)
                 sample_device_telemetry()
+                # multi-host runs: land this epoch's snapshot in the
+                # worker's run-dir slot, so offline cluster aggregation
+                # (obs_report --merge-hosts) sees fresh numbers even if
+                # the worker later dies without its atexit flush
+                flush_worker_observability()
                 record = {"epoch": ts.epoch, "loss": ts.last_loss,
                           "throughput": throughput, "wall_s": wall}
                 if self._train_summary is not None:

@@ -10,6 +10,7 @@ import base64
 import io
 import json
 import time
+import uuid
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -22,8 +23,19 @@ class InputQueue:
     def __init__(self, redis_url: Optional[str] = None, broker=None):
         self.broker = broker if broker is not None else connect(redis_url)
 
-    def enqueue_image(self, uri: str, image) -> None:
-        """image: ndarray (HWC uint8) or path or raw JPEG bytes."""
+    @staticmethod
+    def _request_id(request_id: Optional[str]) -> str:
+        # the client half of cross-process tracing: the id rides the
+        # stream record, threads through the server's decode/batch/
+        # predict spans, and is echoed next to the result
+        return request_id if request_id else uuid.uuid4().hex
+
+    def enqueue_image(self, uri: str, image,
+                      request_id: Optional[str] = None) -> str:
+        """image: ndarray (HWC uint8) or path or raw JPEG bytes.
+        Returns the record's ``request_id`` (generated when not
+        given) — correlate it against the server's spans and the
+        ``request_id`` field echoed beside the result."""
         if isinstance(image, str):
             with open(image, "rb") as f:
                 raw = f.read()
@@ -35,15 +47,23 @@ class InputQueue:
             if not ok:
                 raise ValueError("cannot encode image")
             raw = enc.tobytes()
+        rid = self._request_id(request_id)
         self.broker.xadd(INPUT_STREAM, {
-            "uri": uri, "image": base64.b64encode(raw)})
+            "uri": uri, "image": base64.b64encode(raw),
+            "request_id": rid})
+        return rid
 
-    def enqueue(self, uri: str, data: np.ndarray) -> None:
-        """Arbitrary ndarray input (npy-serialized)."""
+    def enqueue(self, uri: str, data: np.ndarray,
+                request_id: Optional[str] = None) -> str:
+        """Arbitrary ndarray input (npy-serialized); returns the
+        record's ``request_id``."""
         buf = io.BytesIO()
         np.save(buf, np.ascontiguousarray(data), allow_pickle=False)
+        rid = self._request_id(request_id)
         self.broker.xadd(INPUT_STREAM, {
-            "uri": uri, "data": base64.b64encode(buf.getvalue())})
+            "uri": uri, "data": base64.b64encode(buf.getvalue()),
+            "request_id": rid})
+        return rid
 
 
 class OutputQueue:
@@ -52,13 +72,23 @@ class OutputQueue:
 
     def query(self, uri: str, timeout_s: float = 0.0):
         """Result for one uri (list of [class, prob]), or None."""
+        meta = self.query_meta(uri, timeout_s)
+        return meta["value"] if meta else None
+
+    def query_meta(self, uri: str, timeout_s: float = 0.0
+                   ) -> Optional[Dict[str, Any]]:
+        """Result plus correlation metadata: ``{"value": ...,
+        "request_id": str | None}`` — the id the server echoed from
+        the matching enqueue."""
         deadline = time.time() + timeout_s
         while True:
             fields = self.broker.hgetall(RESULT_PREFIX + uri)
             if fields:
-                raw = fields.get("value")
-                return json.loads(raw.decode()
-                                  if isinstance(raw, bytes) else raw)
+                def dec(v):
+                    return v.decode() if isinstance(v, bytes) else v
+                rid = fields.get("request_id")
+                return {"value": json.loads(dec(fields.get("value"))),
+                        "request_id": dec(rid) if rid else None}
             if time.time() >= deadline:
                 return None
             time.sleep(0.02)

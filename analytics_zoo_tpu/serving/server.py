@@ -41,20 +41,25 @@ STOP_KEY = "zoo-serving-stop"   # cross-process stop signal
 
 def decode_field(fields: Dict[str, bytes]):
     """Decode one stream record: 'data' (b64 ndarray .npy bytes) or
-    'image' (b64 JPEG) + 'uri'."""
+    'image' (b64 JPEG) + 'uri' [+ optional 'request_id' for
+    cross-process correlation].  Returns ``(uri, array, request_id)``
+    (request_id None for records enqueued without one)."""
     uri = fields["uri"].decode() if isinstance(fields["uri"], bytes) \
         else fields["uri"]
+    rid = fields.get("request_id")
+    if isinstance(rid, bytes):
+        rid = rid.decode()
     if "image" in fields:
         from analytics_zoo_tpu.feature.image import decode_image_bytes
         raw = base64.b64decode(fields["image"])
         # serving consumes BGR, matching the reference's OpenCV path
         # (ImageProcessing.scala:24)
         img = decode_image_bytes(raw, to_rgb=False, context=uri)
-        return uri, img.astype(np.float32)
+        return uri, img.astype(np.float32), rid
     raw = base64.b64decode(fields["data"])
     import io
     arr = np.load(io.BytesIO(raw), allow_pickle=False)
-    return uri, arr
+    return uri, arr, rid
 
 
 class ServingConfig:
@@ -68,7 +73,7 @@ class ServingConfig:
                  consumer_name: str = "worker-0",
                  pipeline_depth: int = 2,
                  metrics_port: Optional[int] = None,
-                 metrics_host: str = "0.0.0.0",
+                 metrics_host: Optional[str] = None,
                  healthz_max_queue: Optional[int] = None,
                  healthz_max_error_rate: Optional[float] = None,
                  extra: Optional[Dict[str, str]] = None):
@@ -81,9 +86,13 @@ class ServingConfig:
         # (tests / multi-worker hosts), N = fixed port.  The endpoint
         # is UNAUTHENTICATED — on shared networks bind metrics_host to
         # 127.0.0.1 (or a scrape-only interface) instead of all
-        # interfaces.
+        # interfaces.  None defers to observability.bind_host.
         self.metrics_port = (None if metrics_port is None
                              else int(metrics_port))
+        if metrics_host is None:
+            from analytics_zoo_tpu.observability.exporter import (
+                default_bind_host)
+            metrics_host = default_bind_host()
         self.metrics_host = metrics_host
         # how many batches may be read-ahead into the decode pipeline.
         # Each read-ahead batch waits ~1 predict before its own turn, so
@@ -139,7 +148,7 @@ class ServingConfig:
             metrics_port=(int(cfg["params.metrics_port"])
                           if cfg.get("params.metrics_port") not in
                           (None, "") else None),
-            metrics_host=cfg.get("params.metrics_host") or "0.0.0.0",
+            metrics_host=cfg.get("params.metrics_host") or None,
             healthz_max_queue=int(
                 cfg.get("params.healthz_max_queue") or 0) or None,
             healthz_max_error_rate=float(
@@ -231,11 +240,17 @@ class ClusterServing:
         return real
 
     def _write_result(self, uri: str, value: str,
-                      retries: int = 100) -> None:
-        # infinite-ish retry backpressure (:254-289)
+                      retries: int = 100,
+                      request_id: Optional[str] = None) -> None:
+        # infinite-ish retry backpressure (:254-289); the request_id
+        # from the matching enqueue is echoed beside the result so a
+        # client can correlate response <-> request across processes
+        fields = {"value": value}
+        if request_id:
+            fields["request_id"] = request_id
         for attempt in range(retries):
             try:
-                self.broker.hset(RESULT_PREFIX + uri, {"value": value})
+                self.broker.hset(RESULT_PREFIX + uri, fields)
                 return
             except Exception:
                 self._m_redis_retry.inc()
@@ -298,25 +313,34 @@ class ClusterServing:
         """Decode one batch of raw stream entries (runs in the decode
         pool — pure CPU, no broker IO, so no connection sharing across
         threads).  Undecodable records are collected into ``failed``
-        (uri, exception) rather than silently dropped — the serve path
-        writes them an error result, because acking consumes the record
-        and a consumed record with no result strands its client."""
-        uris, arrays, failed = [], [], []
+        (uri, request_id, exception) rather than silently dropped —
+        the serve path writes them an error result, because acking
+        consumes the record and a consumed record with no result
+        strands its client."""
+        uris, arrays, rids, failed = [], [], [], []
         for entry_id, fields in entries:
             try:
-                uri, arr = decode_field(fields)
+                uri, arr, rid = decode_field(fields)
             except Exception as e:
                 log.exception("undecodable record %s", entry_id)
-                failed.append((self._uri_of(fields), e))
+                failed.append((self._uri_of(fields),
+                               self._rid_of(fields), e))
                 continue
             uris.append(uri)
             arrays.append(arr)
-        return uris, arrays, failed
+            rids.append(rid)
+        return uris, arrays, failed, rids
 
     @staticmethod
     def _uri_of(fields) -> str:
         uri = fields.get("uri", b"") if hasattr(fields, "get") else b""
         return uri.decode() if isinstance(uri, bytes) else uri
+
+    @staticmethod
+    def _rid_of(fields):
+        rid = fields.get("request_id") if hasattr(fields, "get") \
+            else None
+        return rid.decode() if isinstance(rid, bytes) else rid
 
     def _serve_entries(self, entries, t_arrival: float) -> int:
         """Decode + serve one raw batch with the poison-batch contract
@@ -327,7 +351,8 @@ class ClusterServing:
         except Exception as e:
             log.exception("decode failed for batch (%d records)",
                           len(entries))
-            decoded = ([], [], [(self._uri_of(f), e) for _, f in entries])
+            decoded = ([], [], [(self._uri_of(f), self._rid_of(f), e)
+                                for _, f in entries])
         return self._serve_decoded(decoded, t_arrival, entries)
 
     def _serve_decoded(self, decoded, t_arrival: float, entries) -> int:
@@ -336,21 +361,23 @@ class ClusterServing:
         the worker loop with the batch un-acked), and every record that
         is acked without a prediction gets an explicit ERROR result so
         its client never blocks forever on a consumed record.
-        ``decoded`` is (uris, arrays) or (uris, arrays, failed)."""
+        ``decoded`` is (uris, arrays[, failed[, request_ids]])."""
         uris, arrays, *rest = decoded
         failed = list(rest[0]) if rest else []
+        rids = list(rest[1]) if len(rest) > 1 else [None] * len(uris)
         real = 0
         try:
-            real = self._predict_write(uris, arrays, t_arrival)
+            real = self._predict_write(uris, arrays, t_arrival, rids)
         except Exception as e:
             log.exception("poison batch skipped (%d records)",
                           len(entries))
-            failed += [(u, e) for u in uris]
-        for uri, exc in failed:
+            failed += [(u, r, e) for u, r in zip(uris, rids)]
+        for uri, rid, exc in failed:
             try:
                 if uri:
                     self._write_result(uri, json.dumps(
-                        {"error": f"{type(exc).__name__}: {exc}"}))
+                        {"error": f"{type(exc).__name__}: {exc}"}),
+                        request_id=rid)
             except Exception:
                 log.exception("could not write error result for %s", uri)
         self._m_errors.inc(len(failed))
@@ -360,10 +387,13 @@ class ClusterServing:
         self._ack(entries)
         return real
 
-    def _predict_write(self, uris, arrays, t_arrival: float) -> int:
+    def _predict_write(self, uris, arrays, t_arrival: float,
+                       rids=None) -> int:
         """Pad/predict/top-N/write one decoded batch; returns #served."""
         if not arrays:
             return 0
+        if rids is None:
+            rids = [None] * len(uris)
         bs = self.config.batch_size
         x = np.stack(arrays)
         real = len(arrays)
@@ -371,15 +401,20 @@ class ClusterServing:
         # same fixed-shape padding primitive the train pipeline's
         # pad-remainder mode uses (data/stages.py)
         x = pad_to_batch(x, bs)
-        with self._tracer.span("serving_predict", records=real):
+        # the span carries the batch's request ids, so a trace viewer
+        # (or the merged cluster timeline) can follow one request from
+        # client enqueue through this predict to its result write
+        with self._tracer.span(
+                "serving_predict", records=real,
+                request_ids=[r for r in rids if r][:16]):
             out = np.asarray(self.model.predict(x))[:real]
         exp = np.exp(out - out.max(axis=-1, keepdims=True))
         probs = exp / exp.sum(axis=-1, keepdims=True)
         top = np.argsort(-probs, axis=-1)[:, :self.config.top_n]
         done = time.perf_counter()
-        for uri, t, p in zip(uris, top, probs):
+        for uri, t, p, rid in zip(uris, top, probs, rids):
             value = json.dumps([[int(i), float(p[i])] for i in t])
-            self._write_result(uri, value)
+            self._write_result(uri, value, request_id=rid)
             self.latencies.append(done - t_arrival)
             self._m_latency.observe(done - t_arrival)
         self.total_records += real
@@ -529,7 +564,8 @@ class ClusterServing:
                 log.exception("decode future failed (%d records)",
                               len(entries))
                 decoded = ([], [],
-                           [(self._uri_of(f), e) for _, f in entries])
+                           [(self._uri_of(f), self._rid_of(f), e)
+                            for _, f in entries])
             self._serve_decoded(decoded, t_arrival, entries)
         finally:
             self._inflight.difference_update(i for i, _ in entries)

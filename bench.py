@@ -711,6 +711,16 @@ def _derive_health_fields(snapshot):
             out["compiles_total"] = int(compiles)
         if recompiles:
             out["recompiles_after_warmup"] = int(recompiles)
+        # communication pressure: the sharding-implied collective
+        # traffic per step (observability/collectives.py) — a headline
+        # for "did this change move more bytes over the interconnect"
+        coll = {
+            k.split('op="', 1)[1].rstrip('"}'): v
+            for k, v in gauges.items()
+            if k.startswith("collective_bytes_per_step{")}
+        if coll:
+            out["collective_bytes_per_step"] = {
+                op: round(v, 1) for op, v in sorted(coll.items())}
     except Exception:  # noqa: BLE001 — derived fields are best-effort
         pass
     return out

@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""metrics_lint — Prometheus exposition linter for the zoo registry.
+
+Checks a text-exposition document (a live ``/metrics`` dump, a file,
+or — in-process — a ``MetricsRegistry``) for the mistakes that turn a
+scrape into silent garbage:
+
+* metric names / label names outside the Prometheus charsets
+  (``[a-zA-Z_:][a-zA-Z0-9_:]*`` / ``[a-zA-Z_][a-zA-Z0-9_]*``);
+* duplicate series (same name + label set exposed twice — Prometheus
+  keeps one arbitrarily);
+* counters not following the ``_total`` suffix convention;
+* histogram ``le`` bucket labels out of order or non-numeric;
+* sample lines whose value doesn't parse as a float;
+* ``reserved`` label collisions (``le`` used outside histogram
+  buckets).
+
+A tier-1 test runs this against a LIVE registry dump, so a bad metric
+name added anywhere in the codebase fails CI rather than surfacing as
+a Prometheus scrape error in production.
+
+Usage::
+
+    python scripts/metrics_lint.py metrics.txt
+    curl -s host:9090/metrics | python scripts/metrics_lint.py -
+    python scripts/metrics_lint.py --url http://host:9090/metrics
+
+Exit code 1 when any issue is found.  Pure stdlib.
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+import urllib.request
+from typing import Dict, List, Tuple
+
+METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# one label pair: name="value" with escaped \" \\ \n inside the value
+_LABEL_PAIR_RE = re.compile(
+    r'([a-zA-Z_][a-zA-Z0-9_]*|[^=,{}]+)="((?:[^"\\]|\\.)*)"')
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[^\s{]+)(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)"
+    r"(?:\s+(?P<ts>\S+))?$")
+
+COUNTER_SUFFIX = "_total"
+
+
+def _parse_labels(body: str) -> List[Tuple[str, str]]:
+    return [(m.group(1), m.group(2))
+            for m in _LABEL_PAIR_RE.finditer(body or "")]
+
+
+def lint_exposition(text: str) -> List[str]:
+    """Return a list of human-readable issues ([] = clean)."""
+    issues: List[str] = []
+    types: Dict[str, str] = {}
+    seen_series: Dict[str, int] = {}
+    # histogram bucket ordering state: (series-minus-le) -> last le
+    last_le: Dict[str, float] = {}
+
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4:
+                name, kind = parts[2], parts[3]
+                if kind not in ("counter", "gauge", "histogram",
+                                "summary", "untyped"):
+                    issues.append(
+                        f"line {lineno}: unknown TYPE {kind!r} "
+                        f"for {name}")
+                if name in types:
+                    issues.append(
+                        f"line {lineno}: duplicate TYPE declaration "
+                        f"for {name}")
+                types[name] = kind
+                if kind == "counter" and \
+                        not name.endswith(COUNTER_SUFFIX):
+                    issues.append(
+                        f"line {lineno}: counter {name!r} should end "
+                        f"with '{COUNTER_SUFFIX}' (naming convention)")
+                if not METRIC_NAME_RE.match(name):
+                    issues.append(
+                        f"line {lineno}: invalid metric name {name!r}")
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            issues.append(f"line {lineno}: unparseable sample: "
+                          f"{line[:80]!r}")
+            continue
+        name = m.group("name")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and \
+                    types.get(name[: -len(suffix)]) in ("histogram",
+                                                        "summary"):
+                base = name[: -len(suffix)]
+                break
+        if not METRIC_NAME_RE.match(name):
+            issues.append(f"line {lineno}: invalid metric name "
+                          f"{name!r}")
+        labels = _parse_labels(m.group("labels"))
+        label_names = [k for k, _ in labels]
+        for k in label_names:
+            if not LABEL_NAME_RE.match(k):
+                issues.append(
+                    f"line {lineno}: invalid label name {k!r} on "
+                    f"{name}")
+            if k.startswith("__"):
+                issues.append(
+                    f"line {lineno}: reserved label name {k!r} on "
+                    f"{name}")
+        if len(set(label_names)) != len(label_names):
+            issues.append(
+                f"line {lineno}: repeated label name on {name}")
+        if "le" in label_names and not name.endswith("_bucket"):
+            issues.append(
+                f"line {lineno}: 'le' label outside a histogram "
+                f"bucket on {name}")
+        try:
+            float(m.group("value").replace("+Inf", "inf")
+                  .replace("-Inf", "-inf"))
+        except ValueError:
+            issues.append(
+                f"line {lineno}: non-numeric value "
+                f"{m.group('value')!r} for {name}")
+        # duplicate-series detection (le participates: bucket lines
+        # are distinct series per bound)
+        key = name + "{" + ",".join(
+            f'{k}="{v}"' for k, v in sorted(labels)) + "}"
+        if key in seen_series:
+            issues.append(
+                f"line {lineno}: duplicate series {key} (first at "
+                f"line {seen_series[key]})")
+        else:
+            seen_series[key] = lineno
+        # bucket ordering: le must be non-decreasing within a series
+        if name.endswith("_bucket") and base in types:
+            ld = dict(labels)
+            le = ld.pop("le", None)
+            series = name + repr(sorted(ld.items()))
+            if le is not None:
+                try:
+                    le_f = float(le.replace("+Inf", "inf"))
+                except ValueError:
+                    issues.append(
+                        f"line {lineno}: non-numeric le={le!r} on "
+                        f"{name}")
+                    continue
+                if series in last_le and le_f < last_le[series]:
+                    issues.append(
+                        f"line {lineno}: le buckets out of order on "
+                        f"{name}")
+                last_le[series] = le_f
+    return issues
+
+
+def lint_registry(registry) -> List[str]:
+    """Lint a live ``MetricsRegistry`` (what the tier-1 test calls)."""
+    return lint_exposition(registry.prometheus_text())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="lint a Prometheus text-exposition dump "
+                    "(name/label charsets, duplicate series, counter "
+                    "_total convention, bucket order)")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="exposition file, or '-' for stdin")
+    ap.add_argument("--url", default=None,
+                    help="scrape this /metrics URL instead of a file")
+    args = ap.parse_args(argv)
+
+    if args.url:
+        with urllib.request.urlopen(args.url, timeout=5.0) as resp:
+            text = resp.read().decode()
+    elif args.path in (None, "-"):
+        text = sys.stdin.read()
+    else:
+        with open(args.path) as f:
+            text = f.read()
+
+    issues = lint_exposition(text)
+    for issue in issues:
+        print(issue)
+    if issues:
+        print(f"{len(issues)} issue(s)")
+        return 1
+    print("clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,20 +20,32 @@ snapshots and exits 1 when a higher-is-better metric (throughput, MFU)
 dropped, or a latency p50 rose, by more than ``--threshold`` (default
 10%) — the offline half of ``bench.py --compare``.
 
+Multi-host mode: ``obs_report.py --merge-hosts <run_dir>`` federates a
+launcher run directory (one ``host-<k>/`` slot per worker, written by
+``zoo-launch --run-dir``): per-host step-time skew table, named
+straggler, pipeline bubble fraction, collective byte/time accounting,
+cluster-summed counters, and ONE merged Chrome trace aligned on the
+launcher's clock anchor (``<run_dir>/merged_trace.json``).
+
 Examples::
 
     python scripts/obs_report.py metrics.jsonl --trace trace.json
     python scripts/obs_report.py bench_metrics.json --workload ncf
     python scripts/obs_report.py run2.jsonl --diff run1.jsonl
+    python scripts/obs_report.py --merge-hosts /runs/exp7
 
 Pure stdlib + file IO; never imports jax (usable on a laptop against
-artifacts scp'd from the pod).
+artifacts scp'd from the pod).  The merge logic lives in
+``analytics_zoo_tpu/observability/aggregator.py`` — itself stdlib-only
+— which this script loads DIRECTLY BY FILE PATH so the package (and
+its jax import) never loads.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from typing import Dict, List, Optional, Tuple
 
@@ -242,6 +254,141 @@ def render_report(label: str, snap: Dict,
     return "\n".join(lines)
 
 
+# ------------------------------------------------------------ multi-host
+def _load_aggregator_module():
+    """Load observability/aggregator.py by FILE PATH (not package
+    import): the module is stdlib-only by contract, so the merge works
+    on machines without jax installed."""
+    import importlib.util
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "analytics_zoo_tpu", "observability", "aggregator.py")
+    spec = importlib.util.spec_from_file_location("_zoo_aggregator",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _fmt_bytes(v: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024.0 or unit == "TiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{v:.0f}B"
+        v /= 1024.0
+    return f"{v:.1f}TiB"
+
+
+def render_cluster_report(run_dir: str, agg_mod=None,
+                          merged_trace_out: Optional[str] = None
+                          ) -> Tuple[str, Dict]:
+    """The fleet-level report: skew table, straggler, bubbles,
+    collectives, cluster totals.  Returns (text, merged_snapshot)."""
+    agg = agg_mod if agg_mod is not None else _load_aggregator_module()
+    # offline by definition: a finished run's recorded ports may have
+    # been reused by unrelated processes — never scrape them here
+    aggregator = agg.ClusterAggregator.from_run_dir(run_dir,
+                                                    offline=True)
+    # the same collect/merge/attribute path the live /metrics/cluster
+    # serves, so the offline report and the endpoint can never
+    # disagree about skew gauges or missing-host accounting
+    host_snaps, merged = aggregator.cluster_view()
+    if not host_snaps:
+        raise SystemExit(
+            f"{run_dir}: no worker snapshots found (expected "
+            f"host-<k>/metrics.jsonl slots — launch with "
+            f"zoo-launch --run-dir)")
+    report = merged["cluster"]
+    # persist the federated snapshot so a later run can gate against
+    # it: obs_report.py --merge-hosts RUN_B --diff RUN_A/cluster_
+    # snapshot.json compares cluster views, not one host vs four
+    snap_path = os.path.join(run_dir, "cluster_snapshot.json")
+    try:
+        with open(snap_path, "w") as f:
+            json.dump(merged, f, indent=2)
+    except OSError:
+        snap_path = None
+
+    lines = [f"== cluster report: {run_dir} "
+             f"({len(host_snaps)} hosts) =="]
+    missing = report.get("missing_hosts")
+    if missing:
+        lines.append(
+            f"MISSING: {len(missing)} of {report['expected_hosts']} "
+            f"workers left no snapshot (crashed before first flush?): "
+            f"{missing}")
+
+    # ---- per-host step-time skew ----------------------------------
+    rows = []
+    for host in sorted(report["per_host"]):
+        d = report["per_host"][host]
+        rows.append([
+            host, d["steps"], _fmt_seconds(d["mean_step_s"]),
+            _fmt_seconds(d["p50_step_s"]),
+            _fmt_seconds(d["mean_barrier_wait_s"])])
+    if rows:
+        lines += ["", "per-host step time (barrier wait ~0 on the "
+                  "straggler, ~skew on the fastest host):",
+                  _table(rows, ["host", "steps", "mean", "p50",
+                                "barrier wait"])]
+    if report.get("straggler"):
+        lines.append(
+            f"STRAGGLER: {report['straggler']} "
+            f"(+{report['skew_fraction']:.0%} vs median step time, "
+            f"skew {_fmt_seconds(report['skew_seconds'])})")
+    elif len(host_snaps) >= 2:
+        lines.append(
+            f"no straggler beyond threshold (max-median skew "
+            f"{_fmt_seconds(report.get('skew_seconds', 0.0))}, "
+            f"{report.get('skew_fraction', 0.0):+.0%})")
+
+    # ---- pipeline / collectives -----------------------------------
+    bubble = report.get("pipeline_bubble_fraction")
+    if bubble is not None:
+        lines.append(f"pipeline bubble fraction: {bubble:.2f} "
+                     f"(P-1 of M+P-1 ticks idle — raise "
+                     f"num_microbatches to amortize)")
+    coll = report.get("collectives")
+    if coll:
+        rows = []
+        for op in sorted(coll):
+            d = coll[op]
+            secs = _fmt_seconds(d["seconds"]) if d["seconds"] else "-"
+            rows.append([op, _fmt_bytes(d["bytes"]), secs])
+        lines += ["", "collectives (estimated from sharding specs; "
+                  "time needs observability.ici_gbps):",
+                  _table(rows, ["op", "bytes", "est time"])]
+
+    # ---- cluster-summed counters ----------------------------------
+    totals = [(k, v) for k, v in sorted(merged["counters"].items())
+              if v]
+    if totals:
+        rows = [[k, f"{v:.6g}"] for k, v in totals[:20]]
+        lines += ["", "cluster totals (counters summed across hosts):",
+                  _table(rows, ["counter", "total"])]
+        if len(totals) > 20:
+            lines.append(f"... and {len(totals) - 20} more")
+
+    # ---- merged trace ---------------------------------------------
+    out_path = merged_trace_out or os.path.join(run_dir,
+                                                "merged_trace.json")
+    try:
+        merged_trace = agg.merge_traces(run_dir, out_path)
+        n_ev = len(merged_trace.get("traceEvents", []))
+        if n_ev:
+            lines.append("")
+            lines.append(
+                f"merged trace: {out_path} ({n_ev} events, "
+                f"{merged_trace['otherData']['hosts_merged']} hosts, "
+                f"aligned on the launcher clock anchor — open in "
+                f"https://ui.perfetto.dev)")
+    except Exception as e:   # traces are optional artifacts
+        lines.append(f"(trace merge skipped: {e})")
+    if snap_path:
+        lines.append(f"cluster snapshot: {snap_path} (gate a later "
+                     f"run with --merge-hosts RUN --diff {snap_path})")
+    return "\n".join(lines), merged
+
+
 # ----------------------------------------------------------------- diff
 # (metric selector, direction) pairs the diff gates on; "up" = higher
 # is better (regression when it drops), "down" = lower is better
@@ -298,9 +445,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="render a registry snapshot (+ optional Chrome "
                     "trace) into a training-health report; --diff "
-                    "gates on regressions")
-    ap.add_argument("snapshot", help="registry JSONL / bench_metrics"
-                                     ".json / snapshot JSON")
+                    "gates on regressions; --merge-hosts federates a "
+                    "multi-host run directory")
+    ap.add_argument("snapshot", nargs="?", default=None,
+                    help="registry JSONL / bench_metrics"
+                         ".json / snapshot JSON")
     ap.add_argument("--trace", default=None,
                     help="Chrome-trace JSON (Tracer.export_chrome_"
                          "trace or /trace)")
@@ -311,9 +460,32 @@ def main(argv=None) -> int:
                     help="compare against a baseline snapshot; exit 1 "
                          "on regression")
     ap.add_argument("--threshold", type=float, default=0.10)
+    ap.add_argument("--merge-hosts", metavar="RUN_DIR", default=None,
+                    help="launcher run directory (host-<k>/ slots): "
+                         "render the cluster skew/straggler report, "
+                         "merge the per-host traces, then report the "
+                         "federated snapshot")
+    ap.add_argument("--merged-trace-out", default=None,
+                    help="where --merge-hosts writes the merged "
+                         "Chrome trace (default "
+                         "RUN_DIR/merged_trace.json)")
     args = ap.parse_args(argv)
 
-    snaps = load_snapshots(args.snapshot, args.workload)
+    if args.merge_hosts is None and args.snapshot is None:
+        ap.error("need a snapshot file or --merge-hosts RUN_DIR")
+
+    if args.merge_hosts:
+        text, merged = render_cluster_report(
+            args.merge_hosts, merged_trace_out=args.merged_trace_out)
+        print(text)
+        print()
+        # the federated snapshot then flows through the standard
+        # report (and --diff, e.g. against a previous run's merge)
+        snaps = [("cluster", merged)]
+        if args.snapshot:
+            snaps += load_snapshots(args.snapshot, args.workload)
+    else:
+        snaps = load_snapshots(args.snapshot, args.workload)
     trace_events = None
     if args.trace:
         with open(args.trace) as f:

@@ -1,0 +1,446 @@
+"""Cluster observability plane: snapshot federation math, straggler
+attribution, registry hardening (const labels, cardinality cap),
+/metrics/cluster on a live endpoint, the exposition linter, trace
+merging, and a REAL simulated 4-host launcher run aggregated both
+live (HTTP federation) and offline (obs_report --merge-hosts)."""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import pytest
+
+from analytics_zoo_tpu.observability import (
+    ClusterAggregator, MetricsServer, WorkerSource, get_registry,
+    merge_snapshots, straggler_report)
+from analytics_zoo_tpu.observability import aggregator as agg_lib
+from analytics_zoo_tpu.observability.collectives import (
+    all_gather_bytes, estimate_pipeline_ppermute_bytes,
+    record_step_collectives, ring_all_reduce_bytes)
+from analytics_zoo_tpu.observability.metrics import MetricsRegistry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO_ROOT, "tests", "cluster_obs_worker.py")
+
+
+def _registry(host, pid, step_s, steps=20, barrier_s=0.0):
+    reg = MetricsRegistry()
+    reg.set_const_labels(host=host, process_index=str(pid))
+    c = reg.counter("train_steps_total", "steps", labels=("path",))
+    h = reg.histogram("train_step_latency_seconds", "lat",
+                      labels=("path",))
+    b = reg.histogram("train_barrier_wait_seconds", "barrier")
+    for _ in range(steps):
+        c.labels("per_step").inc()
+        h.labels("per_step").observe(step_s)
+        b.observe(barrier_s)
+    reg.gauge("train_prefetch_queue_depth", "depth").set(pid)
+    return reg
+
+
+# ----------------------------------------------------------- federation
+class TestSnapshotFederation:
+    def _snaps(self):
+        return {
+            "a/0": _registry("a", 0, 0.01, barrier_s=0.02).snapshot(),
+            "b/1": _registry("b", 1, 0.01, barrier_s=0.02).snapshot(),
+            "c/2": _registry("c", 2, 0.03, barrier_s=0.0).snapshot(),
+            "d/3": _registry("d", 3, 0.01, barrier_s=0.02).snapshot(),
+        }
+
+    def test_counters_sum_across_hosts(self):
+        merged = merge_snapshots(self._snaps())
+        assert merged["counters"][
+            'train_steps_total{path="per_step"}'] == 80.0
+
+    def test_gauges_become_per_host_vectors(self):
+        merged = merge_snapshots(self._snaps())
+        for host, depth in (("a/0", 0.0), ("c/2", 2.0)):
+            key = ('train_prefetch_queue_depth'
+                   f'{{host="{host}"}}')
+            assert merged["gauges"][key] == depth
+
+    def test_histograms_merge_bucketwise(self):
+        merged = merge_snapshots(self._snaps())
+        h = merged["histograms"][
+            'train_step_latency_seconds{path="per_step"}']
+        assert h["count"] == 80
+        assert h["sum"] == pytest.approx(
+            60 * 0.01 + 20 * 0.03, rel=1e-6)
+        # 60 of 80 samples land in the 0.01 bucket: the merged p50 is
+        # the 0.01 bound, the p95 the straggler's 0.05 bound — only
+        # bucket-wise merging gets this right (count-weighting the
+        # per-host p50s could not see across hosts)
+        assert h["p50"] == pytest.approx(0.01)
+        assert h["p95"] == pytest.approx(0.05)
+
+    def test_straggler_is_named_with_skew(self):
+        rep = straggler_report(self._snaps())
+        assert rep["straggler"] == "c/2"
+        assert rep["skew_fraction"] == pytest.approx(2.0, rel=1e-6)
+        assert rep["skew_seconds"] == pytest.approx(0.02, rel=1e-6)
+        # barrier signature: ~0 on the straggler, ~skew on the rest
+        assert rep["per_host"]["c/2"]["mean_barrier_wait_s"] == 0.0
+        assert rep["per_host"]["a/0"]["mean_barrier_wait_s"] == \
+            pytest.approx(0.02)
+
+    def test_no_straggler_when_hosts_agree(self):
+        snaps = {
+            "a/0": _registry("a", 0, 0.01).snapshot(),
+            "b/1": _registry("b", 1, 0.0101).snapshot(),
+        }
+        rep = straggler_report(snaps)
+        assert rep["straggler"] is None
+        assert rep["skew_fraction"] < 0.1
+
+    def test_series_key_roundtrip_with_escapes(self):
+        key = agg_lib.format_series_key(
+            "m", (("k", 'a"b\\c\nd'), ("z", "plain")))
+        name, pairs = agg_lib.parse_series_key(key)
+        assert name == "m"
+        assert dict(pairs) == {"k": 'a"b\\c\nd', "z": "plain"}
+
+    def test_merged_exposition_renders_buckets(self):
+        merged = merge_snapshots(self._snaps())
+        text = agg_lib.snapshot_prometheus_text(merged)
+        assert 'train_steps_total{path="per_step"} 80' in text
+        assert 'le="+Inf"} 80' in text
+        assert "train_step_latency_seconds_bucket" in text
+
+
+# --------------------------------------------------- registry hardening
+class TestRegistryHardening:
+    def test_const_labels_in_exposition_and_snapshot(self):
+        reg = _registry("h9", 7, 0.01, steps=1)
+        text = reg.prometheus_text()
+        assert 'host="h9"' in text and 'process_index="7"' in text
+        assert reg.snapshot()["labels"] == {
+            "host": "h9", "process_index": "7"}
+
+    def test_const_labels_immutable(self):
+        reg = MetricsRegistry()
+        reg.set_const_labels(host="a")
+        reg.set_const_labels(host="a", process_index="0")  # same: ok
+        with pytest.raises(ValueError, match="immutable"):
+            reg.set_const_labels(host="b")
+
+    def test_cardinality_cap_drops_loudly(self):
+        reg = MetricsRegistry(max_series_per_metric=5)
+        c = reg.counter("leaky_total", "leaky", labels=("rid",))
+        for i in range(20):
+            c.labels(f"req-{i}").inc()
+        snap = reg.snapshot()
+        exported = [k for k in snap["counters"]
+                    if k.startswith("leaky_total{")]
+        assert len(exported) == 5
+        assert snap["counters"][
+            'zoo_metrics_dropped_series_total{metric="leaky_total"}'] \
+            == 15.0
+        # dropped children still accept writes (callers never break)
+        c.labels("req-19").inc(5)
+
+    def test_existing_series_survive_the_cap(self):
+        reg = MetricsRegistry(max_series_per_metric=2)
+        g = reg.gauge("g", "g", labels=("k",))
+        g.labels("a").set(1)
+        g.labels("b").set(2)
+        g.labels("c").set(3)        # dropped
+        g.labels("a").set(10)       # pre-cap series keeps working
+        assert reg.snapshot()["gauges"]['g{k="a"}'] == 10.0
+        assert 'g{k="c"}' not in reg.snapshot()["gauges"]
+
+
+# ----------------------------------------------------------- collectives
+class TestCollectives:
+    def test_ring_and_gather_identities(self):
+        assert ring_all_reduce_bytes(100.0, 1) == 0.0
+        assert ring_all_reduce_bytes(100.0, 4) == pytest.approx(150.0)
+        assert all_gather_bytes(100.0, 4) == pytest.approx(75.0)
+
+    def test_pipeline_ppermute_estimate(self):
+        # 2 stages, 4 microbatches of 10 bytes: 5 ticks + broadcast of
+        # the 2x4-microbatch output block
+        assert estimate_pipeline_ppermute_bytes(10.0, 2, 4) == \
+            pytest.approx(5 * 10.0 + 2 * 4 * 10.0)
+        assert estimate_pipeline_ppermute_bytes(10.0, 1, 4) == 0.0
+
+    def test_record_step_collectives_counts(self):
+        from analytics_zoo_tpu.observability.metrics import (
+            reset_registry)
+        reset_registry()
+        record_step_collectives({"psum_grads": 1000.0}, ici_gbps=1.0)
+        record_step_collectives({"psum_grads": 1000.0}, ici_gbps=1.0)
+        snap = get_registry().snapshot()
+        assert snap["counters"][
+            'collective_bytes_total{op="psum_grads"}'] == 2000.0
+        assert snap["counters"][
+            'collective_seconds_total{op="psum_grads"}'] == \
+            pytest.approx(2000.0 / 1e9)
+        assert snap["gauges"][
+            'collective_bytes_per_step{op="psum_grads"}'] == 1000.0
+        reset_registry()
+
+    def test_trainer_estimate_covers_dp_psum(self):
+        import jax.numpy as jnp
+        from analytics_zoo_tpu.observability.collectives import (
+            estimate_train_step_collectives)
+        from analytics_zoo_tpu.parallel import mesh as mesh_lib
+        mesh = mesh_lib.create_mesh({"data": 8})
+        params = {"w": jnp.zeros((100, 10), jnp.float32)}
+        est = estimate_train_step_collectives(params, mesh, "float32")
+        assert est["psum_grads"] == pytest.approx(
+            ring_all_reduce_bytes(1000 * 4, 8))
+        # bf16 grad sync halves the payload
+        est16 = estimate_train_step_collectives(params, mesh,
+                                                "bfloat16")
+        assert est16["psum_grads"] == pytest.approx(
+            est["psum_grads"] / 2)
+        # fsdp mesh adds the param all-gather
+        mesh2 = mesh_lib.create_mesh({"data": 2, "fsdp": 4})
+        est2 = estimate_train_step_collectives(params, mesh2,
+                                               "float32")
+        assert "all_gather_params" in est2
+
+
+# ------------------------------------------------------ live federation
+class TestClusterEndpoint:
+    def test_metrics_cluster_serves_federated_view(self):
+        r0 = _registry("w0", 0, 0.01)
+        r1 = _registry("w1", 1, 0.05)
+        s1 = MetricsServer(port=0, host="127.0.0.1",
+                           registry=r1).start()
+        s0 = None
+        try:
+            agg = ClusterAggregator([
+                WorkerSource("w0/0", fetch=r0.snapshot),
+                WorkerSource("w1/1",
+                             url=f"http://127.0.0.1:{s1.port}"),
+            ])
+            s0 = MetricsServer(port=0, host="127.0.0.1", registry=r0,
+                               aggregator=agg).start()
+            base = f"http://127.0.0.1:{s0.port}"
+            text = urllib.request.urlopen(
+                base + "/metrics/cluster", timeout=5).read().decode()
+            assert 'train_steps_total{path="per_step"} 40' in text
+            assert "cluster_step_skew_seconds" in text
+            assert 'cluster_is_straggler{host="w1/1"} 1' in text
+            doc = json.loads(urllib.request.urlopen(
+                base + "/metrics/cluster.json", timeout=5
+            ).read().decode())
+            assert doc["cluster"]["straggler"] == "w1/1"
+            assert doc["counters"][
+                'train_steps_total{path="per_step"}'] == 40.0
+        finally:
+            s1.stop()
+            if s0 is not None:
+                s0.stop()
+
+    def test_worker_endpoint_404s_without_aggregator(self):
+        srv = MetricsServer(port=0, host="127.0.0.1",
+                            registry=MetricsRegistry()).start()
+        try:
+            import urllib.error
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/metrics/cluster",
+                    timeout=5)
+            assert err.value.code == 404
+        finally:
+            srv.stop()
+
+
+# ------------------------------------------------------------- the lint
+class TestMetricsLint:
+    def _lint(self):
+        import importlib.util
+        path = os.path.join(REPO_ROOT, "scripts", "metrics_lint.py")
+        spec = importlib.util.spec_from_file_location("metrics_lint",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_live_registry_dump_is_clean(self):
+        """The tier-1 gate: every metric name/label the platform
+        registers must pass the lint.  Exercise a representative set
+        of real instrument registration sites into the LIVE registry,
+        then lint its dump."""
+        lint = self._lint()
+        from analytics_zoo_tpu.observability.diagnostics import (
+            step_attribution_histogram)
+        from analytics_zoo_tpu.observability.metrics import (
+            reset_registry)
+        reset_registry()   # order-independence: lint OUR names only
+        reg = get_registry()
+        step_attribution_histogram(reg).labels("device").observe(0.01)
+        reg.counter("train_steps_total", "steps",
+                    labels=("path",)).labels("per_step").inc()
+        reg.histogram("serving_request_latency_seconds",
+                      "lat").observe(0.001)
+        reg.gauge("train_mfu", "mfu").set(0.5)
+        record_step_collectives({"psum_grads": 10.0})
+        issues = lint.lint_registry(reg)
+        assert issues == [], "\n".join(issues)
+
+    def test_lint_with_const_labels_is_clean(self):
+        lint = self._lint()
+        reg = _registry("h", 0, 0.01)
+        assert lint.lint_registry(reg) == []
+
+    def test_lint_catches_bad_exposition(self):
+        lint = self._lint()
+        bad = "\n".join([
+            "# TYPE bad-name counter",
+            "# TYPE no_suffix counter",
+            "no_suffix 1",
+            'ok_total{9bad="x"} 1',
+            "dup_series 1",
+            "dup_series 2",
+            "nonnum_value abc",
+        ]) + "\n"
+        issues = lint.lint_exposition(bad)
+        text = "\n".join(issues)
+        assert "invalid metric name 'bad-name'" in text
+        assert "should end with '_total'" in text
+        assert "invalid label name" in text
+        assert "duplicate series" in text
+        assert "non-numeric value" in text
+
+    def test_lint_cli_exit_codes(self, tmp_path, capsys):
+        lint = self._lint()
+        good = tmp_path / "good.txt"
+        good.write_text("# TYPE x_total counter\nx_total 1\n")
+        assert lint.main([str(good)]) == 0
+        bad = tmp_path / "bad.txt"
+        bad.write_text("bad-name 1\n")
+        assert lint.main([str(bad)]) == 1
+
+
+# ------------------------------------------------------- trace merging
+class TestTraceMerge:
+    def _worker_dir(self, run_dir, pid, t0_offset_s, anchor=1000.0):
+        from analytics_zoo_tpu.observability.tracing import Tracer
+        wdir = os.path.join(run_dir, agg_lib.host_dir_name(pid))
+        os.makedirs(wdir, exist_ok=True)
+        tracer = Tracer()
+        with tracer.span("step"):
+            pass
+        doc = tracer.chrome_trace()
+        # simulate this worker starting t0_offset_s after the anchor
+        doc["otherData"]["wall_time_origin"] = anchor + t0_offset_s
+        with open(os.path.join(wdir, agg_lib.TRACE_FILE), "w") as f:
+            json.dump(doc, f)
+        with open(os.path.join(wdir, agg_lib.META_FILE), "w") as f:
+            json.dump({"name": f"h/{pid}", "process_index": pid,
+                       "clock_anchor": anchor}, f)
+
+    def test_traces_align_on_clock_anchor(self, tmp_path):
+        run_dir = str(tmp_path)
+        self._worker_dir(run_dir, 0, t0_offset_s=0.0)
+        self._worker_dir(run_dir, 1, t0_offset_s=2.0)
+        out = os.path.join(run_dir, "merged.json")
+        merged = agg_lib.merge_traces(run_dir, out)
+        assert os.path.exists(out)
+        evs = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+        by_pid = {e["pid"]: e for e in evs}
+        assert set(by_pid) == {0, 1}
+        # worker 1 started 2s after the anchor: its events shift +2s
+        assert by_pid[1]["ts"] - by_pid[0]["ts"] == pytest.approx(
+            2e6, rel=0.5)
+        names = [e for e in merged["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert {n["args"]["name"] for n in names} == {"h/0", "h/1"}
+
+
+# ------------------------------------- the real simulated 4-host run
+class TestFourHostLauncherRun:
+    def test_launcher_run_aggregates_and_names_straggler(self, tmp_path):
+        """Acceptance: a simulated 4-host launcher run produces ONE
+        merged report showing per-host skew, the named straggler,
+        bubble fraction and cluster-summed counters; host 0 serves
+        /metrics/cluster while workers are live."""
+        from analytics_zoo_tpu.parallel.launcher import ZooCluster
+        run_dir = str(tmp_path / "run")
+        env = {
+            "PYTHONPATH": REPO_ROOT + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
+            "JAX_PLATFORMS": "cpu",
+        }
+        cluster = ZooCluster(num_processes=4, env=env, run_dir=run_dir)
+        # manifest written before spawn: ports + anchor + host dirs
+        manifest = json.load(open(os.path.join(run_dir, "cluster.json")))
+        assert len(manifest["workers"]) == 4
+        assert manifest["clock_anchor"] > 0
+        cluster.start(WORKER)
+        stop_file = os.path.join(run_dir, "stop")
+        try:
+            # ---- live federation: poll host 0's /metrics/cluster ----
+            port0 = manifest["workers"][0]["metrics_port"]
+            live = None
+            import time as _t
+            deadline = _t.time() + 45.0
+            while _t.time() < deadline:
+                try:
+                    doc = json.loads(urllib.request.urlopen(
+                        f"http://127.0.0.1:{port0}"
+                        "/metrics/cluster.json", timeout=2
+                    ).read().decode())
+                    if len(doc["cluster"]["hosts"]) == 4 and \
+                            doc["counters"].get(
+                                'train_steps_total{path="per_step"}'
+                            ) == 200.0:
+                        live = doc
+                        break
+                except Exception:
+                    pass
+                _t.sleep(0.2)
+            assert live is not None, \
+                "host 0 never served the full federated view"
+            assert live["cluster"]["straggler"].endswith("/2")
+        finally:
+            open(stop_file, "w").close()
+            codes = cluster.wait(timeout=60)
+            cluster.stop()
+        assert codes == [0, 0, 0, 0], codes
+
+        # ---- offline aggregation over the run dir ------------------
+        agg = ClusterAggregator.from_run_dir(run_dir)
+        host_snaps = agg.collect()
+        assert len(host_snaps) == 4
+        merged = merge_snapshots(host_snaps)
+        assert merged["counters"][
+            'train_steps_total{path="per_step"}'] == 200.0
+        assert merged["counters"][
+            'collective_bytes_total{op="psum_grads"}'] == \
+            4 * 50 * 1_000_000.0
+        # per-host identity labels survived into the snapshots
+        for name, snap in host_snaps.items():
+            assert snap["labels"]["process_index"] == \
+                name.rsplit("/", 1)[-1]
+        rep = straggler_report(host_snaps)
+        assert rep["straggler"].endswith("/2")
+        assert rep["pipeline_bubble_fraction"] == 0.25
+
+        # ---- the merged offline report (obs_report --merge-hosts) --
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "scripts", "obs_report.py"),
+             "--merge-hosts", run_dir],
+            capture_output=True, text=True, timeout=60)
+        out = proc.stdout
+        assert proc.returncode == 0, proc.stderr
+        assert "STRAGGLER" in out and "/2" in out
+        assert "per-host step time" in out
+        assert "pipeline bubble fraction: 0.25" in out
+        assert "cluster totals" in out
+        assert "train_steps_total" in out
+        assert os.path.exists(os.path.join(run_dir,
+                                           "merged_trace.json"))
+        merged_trace = json.load(
+            open(os.path.join(run_dir, "merged_trace.json")))
+        assert merged_trace["otherData"]["hosts_merged"] == 4
+        pids = {e.get("pid") for e in merged_trace["traceEvents"]
+                if e.get("ph") == "X"}
+        assert pids == {0, 1, 2, 3}

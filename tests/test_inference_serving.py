@@ -157,6 +157,46 @@ class TestClusterServing:
         assert outq.query("img-0") is not None
         assert outq.query("img-1") is not None
 
+    def test_request_id_threads_through_to_result(self):
+        """Cross-process correlation: the id stamped at enqueue rides
+        the stream record, lands in the serving_predict span args, and
+        is echoed beside the result."""
+        from analytics_zoo_tpu.observability import get_tracer
+        serving, broker = self._serving()
+        inq = InputQueue(broker=broker)
+        outq = OutputQueue(broker=broker)
+        rid_explicit = inq.enqueue("rid-0",
+                                   np.zeros((8, 8, 3), np.float32),
+                                   request_id="req-abc123")
+        rid_auto = inq.enqueue("rid-1",
+                               np.zeros((8, 8, 3), np.float32))
+        assert rid_explicit == "req-abc123"
+        assert rid_auto and rid_auto != rid_explicit
+        while serving.run_once(block_ms=10):
+            pass
+        meta0 = outq.query_meta("rid-0")
+        assert meta0["request_id"] == "req-abc123"
+        assert meta0["value"]
+        assert outq.query_meta("rid-1")["request_id"] == rid_auto
+        # plain query keeps its historical return shape
+        assert outq.query("rid-0") == meta0["value"]
+        spans = [e for e in get_tracer().events()
+                 if e["name"] == "serving_predict"
+                 and "req-abc123" in e.get("args", {}).get(
+                     "request_ids", [])]
+        assert spans, "predict span did not carry the request id"
+
+    def test_undecodable_record_error_echoes_request_id(self):
+        serving, broker = self._serving()
+        inq = InputQueue(broker=broker)
+        outq = OutputQueue(broker=broker)
+        rid = inq.enqueue_image("poison-rid", b"not-a-jpeg")
+        while serving.run_once(block_ms=10):
+            pass
+        meta = outq.query_meta("poison-rid")
+        assert "error" in meta["value"]
+        assert meta["request_id"] == rid
+
     def test_background_serving_and_stop(self):
         serving, broker = self._serving()
         inq = InputQueue(broker=broker)
