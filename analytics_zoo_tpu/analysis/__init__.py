@@ -1,0 +1,47 @@
+"""zoolint — JAX/TPU-aware static analysis for the platform.
+
+Stdlib-only by contract (``ast`` + ``tokenize``; **never imports
+jax**): ``scripts/zoolint`` loads this package by file path so the
+full pass runs in milliseconds with no accelerator stack present —
+the same offline contract ``scripts/obs_report.py`` keeps.
+
+The correctness-tooling counterpart to the runtime diagnostics layer:
+``observability.diagnostics.CompileMonitor`` can only flag recompile
+churn *after* you have paid for it; these rules flag the pattern
+before the code ever runs.  Rule catalog + workflow:
+docs/static-analysis.md.
+"""
+
+from analytics_zoo_tpu.analysis.baseline import (
+    apply_baseline,
+    count_by_key,
+    diff_findings,
+    load_baseline,
+    write_baseline,
+)
+from analytics_zoo_tpu.analysis.core import (
+    Finding,
+    ModuleContext,
+    Rule,
+    all_rule_classes,
+    analyze_paths,
+    analyze_source,
+    iter_python_files,
+    register_rule,
+)
+
+__all__ = [
+    "Finding",
+    "ModuleContext",
+    "Rule",
+    "all_rule_classes",
+    "analyze_paths",
+    "analyze_source",
+    "iter_python_files",
+    "register_rule",
+    "apply_baseline",
+    "count_by_key",
+    "diff_findings",
+    "load_baseline",
+    "write_baseline",
+]

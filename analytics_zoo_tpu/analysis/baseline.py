@@ -1,0 +1,129 @@
+"""zoolint baseline + diff gating.
+
+The baseline is the repo's **acknowledged debt**: findings that
+predate the linter (or are accepted with reason) keyed by
+:meth:`Finding.key` — file + rule + enclosing symbol + source text —
+so unrelated line drift never invalidates it.  Contract:
+
+- a finding **not** covered by the baseline fails the run (exit 1);
+- a baseline entry **no longer matched** also fails the run — the
+  baseline may only shrink.  Fixing a finding without removing its
+  entry would otherwise leave a slot a future regression could hide
+  in;
+- ``pre_fix_total`` records how many findings the very first run of
+  zoolint saw before this PR fixed the true positives; the tier-1
+  test asserts the checked-in baseline stays strictly below it.
+
+``--diff BASE.json`` is the lighter PR gate: compare against a
+previous ``--json`` dump and fail only on NEW findings — no full
+baseline rewrite needed on a feature branch.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+from analytics_zoo_tpu.analysis.core import Finding
+
+BASELINE_VERSION = 1
+
+
+def count_by_key(findings: List[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        k = f.key()
+        out[k] = out.get(k, 0) + 1
+    return out
+
+
+def load_baseline(path: str) -> Dict:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "findings" not in data:
+        raise ValueError(f"{path}: not a zoolint baseline "
+                         f"(missing 'findings')")
+    return data
+
+
+def write_baseline(path: str, findings: List[Finding],
+                   pre_fix_total: int = None) -> Dict:
+    """Serialize the current findings as the new baseline.  Entries
+    keep a human-readable locator next to each opaque key so a
+    reviewer can see what debt an entry stands for."""
+    keys = count_by_key(findings)
+    where: Dict[str, str] = {}
+    for f in findings:
+        where.setdefault(
+            f.key(), f"{f.path}:{f.symbol or '<module>'}: "
+                     f"{f.rule} {f.snippet[:80]}")
+    data = {
+        "version": BASELINE_VERSION,
+        "pre_fix_total": (pre_fix_total if pre_fix_total is not None
+                          else len(findings)),
+        "total": len(findings),
+        "findings": {k: {"count": n, "where": where[k]}
+                     for k, n in sorted(keys.items(),
+                                        key=lambda kv: where[kv[0]])},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+    return data
+
+
+def _entry_count(entry) -> int:
+    # accept both {"count": n, ...} entries and bare ints
+    if isinstance(entry, dict):
+        return int(entry.get("count", 1))
+    return int(entry)
+
+
+def apply_baseline(findings: List[Finding], baseline: Dict
+                   ) -> Tuple[List[Finding], List[str]]:
+    """Partition current findings against a baseline.
+
+    Returns ``(new_findings, stale_entries)``: findings beyond each
+    key's baselined count, and baseline entries matched by FEWER
+    current findings than recorded (fixed code whose entry must now be
+    dropped — the only-shrink rule)."""
+    allowed = {k: _entry_count(v)
+               for k, v in baseline.get("findings", {}).items()}
+    seen: Dict[str, int] = {}
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        seen[k] = seen.get(k, 0) + 1
+        if seen[k] > allowed.get(k, 0):
+            new.append(f)
+    stale: List[str] = []
+    for k, n in allowed.items():
+        have = seen.get(k, 0)
+        if have < n:
+            entry = baseline["findings"][k]
+            where = entry.get("where", k) if isinstance(entry, dict) \
+                else k
+            stale.append(
+                f"baseline entry no longer matched ({have}/{n} "
+                f"remain): {where}")
+    return new, stale
+
+
+def diff_findings(findings: List[Finding], base_report: Dict
+                  ) -> List[Finding]:
+    """New findings relative to a previous ``--json`` report (the
+    ``--diff BASE.json`` PR gate).  Counted per key, so adding a
+    second identical violation to an already-dirty line still
+    fails."""
+    allowed: Dict[str, int] = {}
+    for item in base_report.get("findings", []):
+        k = item["key"]
+        allowed[k] = allowed.get(k, 0) + 1
+    seen: Dict[str, int] = {}
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        seen[k] = seen.get(k, 0) + 1
+        if seen[k] > allowed.get(k, 0):
+            new.append(f)
+    return new

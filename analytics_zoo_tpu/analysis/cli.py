@@ -1,0 +1,158 @@
+"""zoolint command line.
+
+Usage (see docs/static-analysis.md for the workflow)::
+
+    zoolint analytics_zoo_tpu scripts examples
+    zoolint --baseline .zoolint-baseline.json analytics_zoo_tpu ...
+    zoolint --json pkg/ > report.json
+    zoolint --diff main-report.json pkg/     # PR gate: new findings only
+    zoolint --write-baseline .zoolint-baseline.json pkg/
+    zoolint --list-rules
+
+Exit codes (stable — CI depends on them):
+
+====  ==========================================================
+0     clean (no findings / none beyond the baseline or diff base)
+1     findings (new findings, stale baseline entries, or
+      unparseable files)
+2     bad invocation / unreadable baseline
+====  ==========================================================
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from analytics_zoo_tpu.analysis import baseline as baseline_mod
+from analytics_zoo_tpu.analysis.core import (
+    Finding, all_rule_classes, analyze_paths)
+
+JSON_VERSION = 1
+
+
+def _report_json(findings: List[Finding], errors: List[str]) -> dict:
+    counts: dict = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    return {
+        "version": JSON_VERSION,
+        "tool": "zoolint",
+        "total": len(findings),
+        "counts": counts,
+        "errors": errors,
+        "findings": [f.to_json() for f in findings],
+    }
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="zoolint",
+        description="JAX/TPU-aware static analysis: jit purity, "
+                    "host-sync hygiene, recompile safety, donation, "
+                    "thread safety, PRNG key reuse",
+        epilog="suppress one line with "
+               "'# zoolint: disable=RULE — reason'")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help="files or directories to analyze")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report on stdout")
+    ap.add_argument("--baseline", metavar="FILE", default=None,
+                    help="acknowledged-debt file; findings it covers "
+                         "pass, stale entries fail (only-shrink)")
+    ap.add_argument("--write-baseline", metavar="FILE", default=None,
+                    help="write the current findings as the baseline "
+                         "and exit 0")
+    ap.add_argument("--diff", metavar="BASE.json", default=None,
+                    help="fail only on findings NOT present in a "
+                         "previous --json report (PR gate)")
+    ap.add_argument("--rules", metavar="ID[,ID...]", default=None,
+                    help="run only these rules")
+    ap.add_argument("--root", default=".",
+                    help="directory paths are reported relative to "
+                         "(default: cwd)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for cls in sorted(all_rule_classes(), key=lambda c: c.rule_id):
+            print(f"{cls.rule_id}  {cls.severity:7s}  {cls.doc}")
+        return 0
+    if not args.paths:
+        print("zoolint: no paths given (try: zoolint "
+              "analytics_zoo_tpu scripts examples)", file=sys.stderr)
+        return 2
+
+    rule_ids = ([r.strip() for r in args.rules.split(",") if r.strip()]
+                if args.rules else None)
+    findings, errors = analyze_paths(args.paths, root=args.root,
+                                     rule_ids=rule_ids)
+
+    if args.write_baseline:
+        prev_total = None
+        try:
+            prev = baseline_mod.load_baseline(args.write_baseline)
+            prev_total = prev.get("pre_fix_total")
+        except (OSError, ValueError):
+            pass
+        data = baseline_mod.write_baseline(
+            args.write_baseline, findings, pre_fix_total=prev_total)
+        print(f"zoolint: baseline written to {args.write_baseline} "
+              f"({data['total']} finding(s), pre-fix total "
+              f"{data['pre_fix_total']})")
+        for e in errors:
+            print(f"zoolint: ERROR {e}", file=sys.stderr)
+        return 1 if errors else 0
+
+    stale: List[str] = []
+    shown = findings
+    if args.baseline:
+        try:
+            base = baseline_mod.load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"zoolint: cannot read baseline: {e}",
+                  file=sys.stderr)
+            return 2
+        shown, stale = baseline_mod.apply_baseline(findings, base)
+    elif args.diff:
+        try:
+            with open(args.diff, encoding="utf-8") as f:
+                base_report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"zoolint: cannot read diff base: {e}",
+                  file=sys.stderr)
+            return 2
+        shown = baseline_mod.diff_findings(findings, base_report)
+
+    if args.json:
+        report = _report_json(shown, errors)
+        if stale:
+            report["stale_baseline_entries"] = stale
+        json.dump(report, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for f in shown:
+            print(f.render())
+        for s in stale:
+            print(f"zoolint: {s}")
+        for e in errors:
+            print(f"zoolint: ERROR {e}")
+        n_err = sum(1 for f in shown if f.severity == "error")
+        if shown or stale or errors:
+            print(f"zoolint: {len(shown)} finding(s) "
+                  f"({n_err} error(s)), {len(stale)} stale baseline "
+                  f"entr(y/ies), {len(errors)} unparseable file(s)")
+        else:
+            print("zoolint: clean")
+    return 1 if (shown or stale or errors) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,616 @@
+"""zoolint core — the rule framework and per-module analysis driver.
+
+Static analysis for the JAX/TPU failure modes this platform actually
+has (impure jitted functions, hidden host syncs, recompile churn,
+unlocked shared state under worker threads, PRNG key reuse).  The
+engine is **stdlib-only and never imports jax** — ``scripts/zoolint``
+must run in milliseconds on a laptop and inside CI images that have no
+accelerator stack, the same contract ``scripts/obs_report.py`` keeps.
+
+Architecture:
+
+- :class:`ModuleContext` parses one file and pre-computes the facts
+  every rule needs (import aliases, parent links, enclosing-function
+  chains, the set of jit/trace-compiled functions, hot-path functions,
+  thread usage, module-level mutable globals, suppression comments).
+- :class:`Rule` subclasses register ``visit_<NodeType>`` methods; the
+  driver walks each AST **once**, dispatching every node to every
+  registered rule (classic pylint-style visitor registration).  Rules
+  that need whole-function dataflow (RNG006) implement
+  ``check_module`` instead/additionally.
+- :class:`Finding` carries a stable :meth:`Finding.key` — path + rule
+  + enclosing symbol + normalized source line — so the baseline
+  survives unrelated line drift.
+
+Suppressions: ``# zoolint: disable=RULE[,RULE2] — reason`` on the
+flagged line, or alone on the line directly above it.  ``disable=all``
+silences every rule for that line.  The baseline workflow lives in
+``baseline.py``; the CLI in ``cli.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple, Type
+
+SEVERITIES = ("error", "warning")
+
+# the rule list is comma-separated identifiers; ANY trailing text is
+# the free-form reason ("— why", "# why", or plain words all work)
+_SUPPRESS_RE = re.compile(
+    r"#\s*zoolint:\s*disable\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+# ---------------------------------------------------------------- findings
+
+
+@dataclasses.dataclass
+class Finding:
+    """One diagnostic, pointing at a concrete line of a concrete file."""
+
+    rule: str             # "JIT001"
+    severity: str         # "error" | "warning"
+    path: str             # repo-relative, POSIX separators
+    line: int             # 1-based
+    col: int              # 0-based
+    message: str
+    symbol: str = ""      # enclosing function qualname ("" = module)
+    snippet: str = ""     # stripped source line (stable-key material)
+
+    def key(self) -> str:
+        """Identity that survives unrelated edits: line numbers drift
+        whenever code above moves, so the baseline keys on *what* was
+        flagged (file, rule, enclosing function, source text) instead
+        of *where*.  Identical duplicate lines inside one function are
+        counted, not distinguished (see baseline.py)."""
+        text = "|".join((self.path, self.rule, self.symbol,
+                         self.snippet))
+        return hashlib.sha256(text.encode()).hexdigest()[:16]
+
+    def to_json(self) -> Dict:
+        return {
+            "rule": self.rule, "severity": self.severity,
+            "path": self.path, "line": self.line, "col": self.col,
+            "message": self.message, "symbol": self.symbol,
+            "key": self.key(),
+        }
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return (f"{self.path}:{self.line}:{self.col + 1}: "
+                f"{self.rule} {self.severity}: {self.message}{sym}")
+
+
+# ------------------------------------------------------------ rule registry
+
+
+class Rule:
+    """Base class; subclasses set ``rule_id``/``severity``/``doc`` and
+    implement ``visit_<NodeType>(node, ctx)`` and/or
+    ``check_module(ctx)``, reporting via ``self.report(...)``."""
+
+    rule_id: str = ""
+    severity: str = "warning"
+    doc: str = ""
+
+    def __init__(self) -> None:
+        self._findings: List[Finding] = []
+        self._ctx: Optional["ModuleContext"] = None
+
+    # -- driver hooks ---------------------------------------------------
+    def begin_module(self, ctx: "ModuleContext") -> None:
+        self._ctx = ctx
+
+    def check_module(self, ctx: "ModuleContext") -> None:
+        """Whole-module pass for rules that need dataflow; default
+        no-op (visitor methods usually suffice)."""
+
+    def finish_module(self, ctx: "ModuleContext") -> List[Finding]:
+        out, self._findings = self._findings, []
+        return out
+
+    # -- reporting ------------------------------------------------------
+    def report(self, node: ast.AST, message: str,
+               line: Optional[int] = None) -> None:
+        ctx = self._ctx
+        assert ctx is not None
+        lineno = line if line is not None else getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        self._findings.append(Finding(
+            rule=self.rule_id, severity=self.severity, path=ctx.relpath,
+            line=lineno, col=col, message=message,
+            symbol=ctx.qualname_of(node),
+            snippet=ctx.line_text(lineno).strip()))
+
+
+_RULE_CLASSES: List[Type[Rule]] = []
+
+
+def register_rule(cls: Type[Rule]) -> Type[Rule]:
+    """Class decorator adding a rule to the default set."""
+    assert cls.rule_id and cls.severity in SEVERITIES
+    _RULE_CLASSES.append(cls)
+    return cls
+
+
+def all_rule_classes() -> List[Type[Rule]]:
+    # rules.py registers on import; imported lazily so ``core`` stays
+    # importable standalone (scripts/zoolint file-path loading)
+    if not _RULE_CLASSES:
+        from analytics_zoo_tpu.analysis import rules as _rules  # noqa: F401
+    return list(_RULE_CLASSES)
+
+
+# ------------------------------------------------------- module context
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ModuleContext:
+    """Parsed file + the shared facts rules consume.
+
+    The pre-pass performs the *semantic* work once (alias resolution,
+    jit-function discovery, scope chains) so individual rules stay
+    small pattern matchers.
+    """
+
+    #: callables whose function argument is jit-COMPILED
+    JIT_WRAPPERS = {
+        "jax.jit", "jit", "pjit", "jax.pjit",
+        "jax.experimental.pjit.pjit",
+    }
+    #: callables whose function argument is TRACED (purity contract
+    #: identical to jit even when the wrapper itself isn't jit)
+    TRACE_WRAPPERS = JIT_WRAPPERS | {
+        "jax.grad", "jax.value_and_grad", "jax.vmap", "jax.pmap",
+        "jax.checkpoint", "jax.remat", "jax.lax.scan",
+        "jax.lax.cond", "jax.lax.while_loop", "jax.lax.fori_loop",
+        "jax.lax.map", "jax.custom_vjp", "jax.custom_jvp",
+    }
+    #: function-name pattern for host-side hot paths (train/step/
+    #: predict loops) — SYNC002's scope
+    HOT_NAME_RE = re.compile(
+        r"(?:^|_)(train|step|predict|fit|epoch|serve|dispatch)")
+
+    def __init__(self, path: str, source: str, root: str = "."):
+        self.path = path
+        self.relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressed = self._scan_suppressions(source)
+        self._parents: Dict[int, ast.AST] = {}
+        self._func_of: Dict[int, Optional[ast.AST]] = {}
+        self._qualnames: Dict[int, str] = {}
+        self.aliases: Dict[str, str] = {}
+        self.functions: List[ast.AST] = []   # FunctionDef/Lambda, all
+        self.jit_functions: Set[int] = set()     # id(node), compiled
+        self.traced_functions: Set[int] = set()  # id(node), traced
+        #: dotted callee name -> the keywords of its jit wrapping:
+        #: ``self._step = jax.jit(f, ...)`` / ``g = jax.jit(f)`` /
+        #: ``@jax.jit`` / ``@partial(jax.jit, ...)`` — so call sites
+        #: of compiled callables are recognizable and their
+        #: static_argnums declarations visible (COMPILE003)
+        self.jitted_callables: Dict[str, List[ast.keyword]] = {}
+        self.threaded = False
+        self.thread_evidence = ""
+        self.module_mutables: Dict[str, int] = {}   # name -> def lineno
+        self._index()
+        self._discover_jit()
+        self._discover_threads_and_globals()
+
+    # ---------------------------------------------------------- indexing
+    def _scan_suppressions(self, source: str) -> Dict[int, Set[str]]:
+        """line(1-based) -> set of rule ids disabled there.  A
+        suppression comment alone on a line also covers the next
+        line, so block-style disables read naturally."""
+        out: Dict[int, Set[str]] = {}
+        import io
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in toks:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                rules = {r.strip().upper()
+                         for r in m.group(1).split(",") if r.strip()}
+                lineno = tok.start[0]
+                own_line = tok.string.strip() == \
+                    self.lines[lineno - 1].strip() if \
+                    lineno <= len(self.lines) else False
+                out.setdefault(lineno, set()).update(rules)
+                if own_line:   # standalone comment covers the next line
+                    out.setdefault(lineno + 1, set()).update(rules)
+        except tokenize.TokenizeError:
+            pass
+        return out
+
+    def _index(self) -> None:
+        stack: List[ast.AST] = []
+
+        def walk(node: ast.AST, parent: Optional[ast.AST]) -> None:
+            if parent is not None:
+                self._parents[id(node)] = parent
+            is_func = isinstance(node, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef, ast.Lambda))
+            self._func_of[id(node)] = stack[-1] if stack else None
+            if is_func:
+                self.functions.append(node)
+                name = getattr(node, "name", "<lambda>")
+                outer = [getattr(f, "name", "<lambda>") for f in stack]
+                self._qualnames[id(node)] = ".".join(outer + [name])
+                stack.append(node)
+            elif isinstance(node, ast.ClassDef):
+                stack.append(node)
+            for child in ast.iter_child_nodes(node):
+                walk(child, node)
+            if is_func or isinstance(node, ast.ClassDef):
+                stack.pop()
+
+        walk(self.tree, None)
+        self._collect_aliases()
+
+    def _collect_aliases(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.level == 0:
+                for a in node.names:
+                    self.aliases[a.asname or a.name] = \
+                        f"{node.module}.{a.name}"
+        # normalize the two ubiquitous scientific aliases even when the
+        # import is conventional (import numpy as np)
+        self.aliases.setdefault("np", "numpy")
+        self.aliases.setdefault("jnp", "jax.numpy")
+
+    # ---------------------------------------------------------- lookups
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        """Nearest FunctionDef/Lambda strictly containing ``node``."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            cur = self._parents.get(id(cur))
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return cur
+        return None
+
+    def qualname_of(self, node: ast.AST) -> str:
+        fn = node if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)) \
+            else self.enclosing_function(node)
+        if fn is None:
+            return ""
+        return self._qualnames.get(id(fn), getattr(fn, "name", ""))
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute expression with
+        import aliases applied ('np.random.normal' ->
+        'numpy.random.normal', 'jrandom.split' -> 'jax.random.split',
+        bare 'jit' from ``from jax import jit`` -> 'jax.jit')."""
+        name = _dotted(node)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        full = self.aliases.get(head, head)
+        return f"{full}.{rest}" if rest else full
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        rules = self.suppressed.get(finding.line, set())
+        return finding.rule.upper() in rules or "ALL" in rules
+
+    def in_loop(self, node: ast.AST) -> bool:
+        """Is ``node`` inside a For/While body of its own function
+        (loops in *enclosing* functions don't count)?"""
+        fn = self.enclosing_function(node)
+        cur: Optional[ast.AST] = node
+        while cur is not None and cur is not fn:
+            par = self._parents.get(id(cur))
+            if isinstance(par, (ast.For, ast.AsyncFor, ast.While)) and \
+                    cur is not getattr(par, "iter", None) and \
+                    cur is not getattr(par, "test", None):
+                return True
+            cur = par
+        return False
+
+    def is_hot_function(self, fn: Optional[ast.AST]) -> bool:
+        """Host-side hot path: name matches the train/step/predict
+        family.  Jitted functions are excluded — host-sync calls there
+        are JIT001/trace errors, not hidden syncs."""
+        if fn is None or isinstance(fn, ast.Lambda):
+            return False
+        if id(fn) in self.traced_functions:
+            return False
+        return bool(self.HOT_NAME_RE.search(fn.name.lower()))
+
+    # ----------------------------------------------- jit-function discovery
+    def _local_function_named(self, call: ast.AST,
+                              name: str) -> Optional[ast.AST]:
+        """The FunctionDef ``name`` visible from ``call``'s scope:
+        nearest definition whose enclosing function is an ancestor of
+        (or the same as) the call's."""
+        chain: List[Optional[ast.AST]] = []
+        cur = self.enclosing_function(call)
+        while True:
+            chain.append(cur)
+            if cur is None:
+                break
+            cur = self.enclosing_function(cur)
+        best: Optional[ast.AST] = None
+        best_depth = -1
+        for fn in self.functions:
+            if getattr(fn, "name", None) != name:
+                continue
+            owner = self.enclosing_function(fn)
+            if owner in chain:
+                depth = len(chain) - chain.index(owner)
+                if depth > best_depth:
+                    best, best_depth = fn, depth
+        return best
+
+    def _wrapped_function(self, arg: ast.AST,
+                          origin: ast.AST) -> Optional[ast.AST]:
+        """Resolve the function object an expression denotes: a Lambda
+        inline, a Name bound to a local def, or a functools.partial
+        of either."""
+        if isinstance(arg, ast.Lambda):
+            return arg
+        if isinstance(arg, ast.Name):
+            return self._local_function_named(origin, arg.id)
+        if isinstance(arg, ast.Call) and \
+                self.resolve(arg.func) in ("functools.partial", "partial") \
+                and arg.args:
+            return self._wrapped_function(arg.args[0], origin)
+        return None
+
+    def _discover_jit(self) -> None:
+        roots: List[Tuple[ast.AST, bool]] = []   # (fn, compiled?)
+        for node in ast.walk(self.tree):
+            # f = jax.jit(g) / @jax.jit / @partial(jax.jit, ...)
+            if isinstance(node, ast.Call):
+                fname = self.resolve(node.func)
+                if fname in self.TRACE_WRAPPERS and node.args:
+                    compiled = fname in self.JIT_WRAPPERS
+                    fn = self._wrapped_function(node.args[0], node)
+                    if fn is not None:
+                        roots.append((fn, compiled))
+                    if compiled:
+                        self._record_jitted_target(node)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    dname = self.resolve(dec)
+                    kwargs: List[ast.keyword] = []
+                    if dname is None and isinstance(dec, ast.Call):
+                        dname = self.resolve(dec.func)
+                        kwargs = list(dec.keywords)
+                        if dname in ("functools.partial", "partial") \
+                                and dec.args:
+                            dname = self.resolve(dec.args[0])
+                    if dname in self.TRACE_WRAPPERS:
+                        roots.append(
+                            (node, dname in self.JIT_WRAPPERS))
+                        if dname in self.JIT_WRAPPERS:
+                            # decorator-compiled functions are callable
+                            # by name like assigned jits
+                            self.jitted_callables[node.name] = kwargs
+        # everything defined INSIDE a traced function is traced too
+        for fn, compiled in roots:
+            for sub in ast.walk(fn):
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef, ast.Lambda)):
+                    self.traced_functions.add(id(sub))
+                    if compiled:
+                        self.jit_functions.add(id(sub))
+
+    def _record_jitted_target(self, jit_call: ast.Call) -> None:
+        """Remember ``target = jax.jit(...)`` / ``self.x = jax.jit(..)``
+        so call sites of the compiled callable are recognizable
+        (COMPILE003's static-arg check)."""
+        par = self.parent(jit_call)
+        # unwrap monitor.wrap("name", jax.jit(...))-style passthroughs
+        while isinstance(par, ast.Call):
+            par = self.parent(par)
+        if isinstance(par, ast.Assign):
+            for tgt in par.targets:
+                name = _dotted(tgt)
+                if name:
+                    self.jitted_callables[name] = \
+                        list(jit_call.keywords)
+        elif isinstance(par, (ast.AnnAssign, ast.AugAssign)) and \
+                par.value is not None:
+            name = _dotted(par.target)
+            if name:
+                self.jitted_callables[name] = list(jit_call.keywords)
+
+    # -------------------------------------- threads + module-level globals
+    THREAD_IMPORTS = {"threading", "concurrent.futures", "queue"}
+    THREAD_NAMES = {
+        "threading.Thread", "concurrent.futures.ThreadPoolExecutor",
+        "ThreadPoolExecutor",
+        # the platform's own thread-running machinery: any module that
+        # instantiates these has its code reachable from worker threads
+        "analytics_zoo_tpu.data.stages.WorkerPool",
+        "analytics_zoo_tpu.data.stages.PrefetchIterator",
+        "analytics_zoo_tpu.observability.exporter.MetricsServer",
+        "analytics_zoo_tpu.observability.MetricsServer",
+        "WorkerPool", "PrefetchIterator", "MetricsServer",
+    }
+
+    def _discover_threads_and_globals(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[0] in ("threading",
+                                                "concurrent"):
+                        self.threaded = True
+                        self.thread_evidence = f"import {a.name}"
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.module.split(".")[0] in ("threading",
+                                                 "concurrent"):
+                    self.threaded = True
+                    self.thread_evidence = f"from {node.module} import"
+            elif isinstance(node, ast.Call):
+                fname = self.resolve(node.func)
+                if fname in self.THREAD_NAMES:
+                    self.threaded = True
+                    self.thread_evidence = f"{fname}(...)"
+        for stmt in self.tree.body:
+            targets: List[ast.AST] = []
+            value: Optional[ast.AST] = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            if value is None or not self._is_mutable_container(value):
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    self.module_mutables[tgt.id] = stmt.lineno
+
+    def _is_mutable_container(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            fname = self.resolve(node.func) or ""
+            return fname.split(".")[-1] in (
+                "dict", "list", "set", "deque", "defaultdict",
+                "OrderedDict", "Counter")
+        # ``X = None`` rebound later via ``global X`` counts as shared
+        # state too, but rules detect that from the global-stmt side
+        return False
+
+
+# --------------------------------------------------------------- driver
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/dirs into the python files to analyze: ``*.py``
+    plus extensionless scripts with a python shebang (scripts/zoolint
+    itself, launchers)."""
+    out: List[str] = []
+    seen: Set[str] = set()
+
+    def add(p: str) -> None:
+        rp = os.path.realpath(p)
+        if rp not in seen:
+            seen.add(rp)
+            out.append(p)
+
+    for p in paths:
+        if os.path.isfile(p):
+            add(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in ("__pycache__", ".git", ".ipynb_checkpoints"))
+            for fname in sorted(filenames):
+                full = os.path.join(dirpath, fname)
+                if fname.endswith(".py"):
+                    add(full)
+                elif "." not in fname:
+                    try:
+                        with open(full, "rb") as f:
+                            first = f.readline()
+                        if first.startswith(b"#!") and b"python" in first:
+                            add(full)
+                    except OSError:
+                        pass
+    return out
+
+
+def analyze_source(source: str, path: str = "<string>",
+                   root: str = ".",
+                   rule_ids: Optional[Iterable[str]] = None
+                   ) -> List[Finding]:
+    """Analyze one source string; the unit tests' entry point."""
+    ctx = ModuleContext(path, source, root=root)
+    return _run_rules(ctx, rule_ids)
+
+
+def analyze_paths(paths: Sequence[str], root: str = ".",
+                  rule_ids: Optional[Iterable[str]] = None
+                  ) -> Tuple[List[Finding], List[str]]:
+    """Analyze files/dirs.  Returns (findings, unparseable-file
+    errors).  Unparseable files are surfaced, not silently skipped —
+    a file the linter cannot read is a file it cannot vouch for."""
+    findings: List[Finding] = []
+    errors: List[str] = []
+    for p in paths:
+        if not os.path.exists(p):
+            # a missing target must FAIL, not silently shrink
+            # coverage (a renamed dir or a CI typo would otherwise
+            # turn the gate into a no-op)
+            errors.append(f"{p}: no such file or directory")
+    for fpath in iter_python_files([p for p in paths
+                                    if os.path.exists(p)]):
+        try:
+            with open(fpath, encoding="utf-8", errors="replace") as f:
+                source = f.read()
+        except OSError as e:
+            errors.append(f"{fpath}: unreadable: {e}")
+            continue
+        try:
+            findings.extend(analyze_source(source, path=fpath, root=root,
+                                           rule_ids=rule_ids))
+        except SyntaxError as e:
+            errors.append(f"{fpath}: syntax error: {e}")
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, errors
+
+
+def _run_rules(ctx: ModuleContext,
+               rule_ids: Optional[Iterable[str]] = None) -> List[Finding]:
+    wanted = {r.upper() for r in rule_ids} if rule_ids else None
+    rules = [cls() for cls in all_rule_classes()
+             if wanted is None or cls.rule_id in wanted]
+    if not rules:
+        return []
+    for rule in rules:
+        rule.begin_module(ctx)
+    # one walk, dispatching to every registered visit_<Type> method
+    dispatch: Dict[str, List[Rule]] = {}
+    for rule in rules:
+        for attr in dir(rule):
+            if attr.startswith("visit_"):
+                dispatch.setdefault(attr[6:], []).append(rule)
+    for node in ast.walk(ctx.tree):
+        for rule in dispatch.get(type(node).__name__, ()):
+            getattr(rule, f"visit_{type(node).__name__}")(node, ctx)
+    findings: List[Finding] = []
+    for rule in rules:
+        rule.check_module(ctx)
+        findings.extend(f for f in rule.finish_module(ctx)
+                        if not ctx.is_suppressed(f))
+    return findings

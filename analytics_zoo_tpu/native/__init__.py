@@ -117,6 +117,10 @@ def _py_crc_table():
             for _ in range(8):
                 c = (c >> 1) ^ poly if c & 1 else c >> 1
             table.append(c)
+        # benign race: the table build is deterministic and the rebind
+        # is atomic, so concurrent first calls at worst duplicate the
+        # one-time build; a lock would serialize every cold crc32c call
+        # zoolint: disable=RACE005 — benign idempotent lazy init
         _PY_CRC_TABLE = table
     return _PY_CRC_TABLE
 

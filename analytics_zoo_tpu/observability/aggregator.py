@@ -39,6 +39,7 @@ import json
 import logging
 import os
 import socket
+import threading
 import time
 import urllib.request
 from typing import Callable, Dict, List, Optional, Tuple
@@ -627,7 +628,11 @@ def merge_traces(run_dir: str, out_path: Optional[str] = None) -> Dict:
 
 
 # --------------------------------------------------- worker-side bring-up
+# bring-up state is check-then-act shared between the caller's thread,
+# atexit, and tests' reset — the lock makes init idempotence and
+# init-vs-reset ordering actually atomic
 _worker_state: Dict = {}
+_worker_lock = threading.Lock()
 
 
 def init_worker_observability(run_dir: Optional[str] = None,
@@ -656,64 +661,69 @@ def init_worker_observability(run_dir: Optional[str] = None,
     configured).  Imports the package lazily — this module must stay
     loadable without jax.
     """
-    if _worker_state.get("dir"):
-        return _worker_state["dir"]
-    run_dir = run_dir if run_dir is not None \
-        else os.environ.get(ENV_RUN_DIR)
-    if not run_dir:
-        return None
-    if process_index is None:
-        process_index = int(os.environ.get(ENV_PROCESS_ID, "0"))
-    if metrics_port is None:
-        raw = os.environ.get(ENV_METRICS_PORT)
-        metrics_port = int(raw) if raw else 0
-    anchor = float(os.environ.get(ENV_CLOCK_ANCHOR, time.time()))
-    hostname = socket.gethostname()
-    name = f"{hostname}/{process_index}"
+    with _worker_lock:
+        # idempotence check and the state commit below share the lock:
+        # without it two racing bring-ups both pass the check and both
+        # start a MetricsServer (the loser's listener leaks)
+        if _worker_state.get("dir"):
+            return _worker_state["dir"]
+        run_dir = run_dir if run_dir is not None \
+            else os.environ.get(ENV_RUN_DIR)
+        if not run_dir:
+            return None
+        if process_index is None:
+            process_index = int(os.environ.get(ENV_PROCESS_ID, "0"))
+        if metrics_port is None:
+            raw = os.environ.get(ENV_METRICS_PORT)
+            metrics_port = int(raw) if raw else 0
+        anchor = float(os.environ.get(ENV_CLOCK_ANCHOR, time.time()))
+        hostname = socket.gethostname()
+        name = f"{hostname}/{process_index}"
 
-    wdir = os.environ.get(ENV_METRICS_DIR) or \
-        os.path.join(run_dir, host_dir_name(process_index))
-    os.makedirs(wdir, exist_ok=True)
+        wdir = os.environ.get(ENV_METRICS_DIR) or \
+            os.path.join(run_dir, host_dir_name(process_index))
+        os.makedirs(wdir, exist_ok=True)
 
-    from analytics_zoo_tpu.observability.metrics import get_registry
-    registry = get_registry()
-    registry.set_const_labels(host=hostname,
-                              process_index=str(process_index))
+        from analytics_zoo_tpu.observability.metrics import get_registry
+        registry = get_registry()
+        registry.set_const_labels(host=hostname,
+                                  process_index=str(process_index))
 
-    server = None
-    if start_server:
-        try:
-            from analytics_zoo_tpu.observability.exporter import \
-                MetricsServer
-            aggregator = None
-            if process_index == 0:
-                aggregator = ClusterAggregator.from_run_dir(run_dir)
-                for src in aggregator.sources:
-                    # host 0's own snapshot comes straight from the
-                    # in-process registry — no HTTP round trip to self
-                    if src.name == name:
-                        src._fetch = registry.snapshot
-            server = MetricsServer(port=metrics_port,
-                                   aggregator=aggregator).start()
-            metrics_port = server.port
-        except Exception:
-            log.exception("worker metrics server failed to start")
-            server = None
+        server = None
+        if start_server:
+            try:
+                from analytics_zoo_tpu.observability.exporter import \
+                    MetricsServer
+                aggregator = None
+                if process_index == 0:
+                    aggregator = ClusterAggregator.from_run_dir(run_dir)
+                    for src in aggregator.sources:
+                        # host 0's own snapshot comes straight from the
+                        # in-process registry — no HTTP round trip to
+                        # self
+                        if src.name == name:
+                            src._fetch = registry.snapshot
+                server = MetricsServer(port=metrics_port,
+                                       aggregator=aggregator).start()
+                metrics_port = server.port
+            except Exception:
+                log.exception("worker metrics server failed to start")
+                server = None
 
-    meta = {
-        "name": name,
-        "hostname": hostname,
-        "process_index": int(process_index),
-        "pid": os.getpid(),
-        "metrics_port": metrics_port,
-        "clock_anchor": anchor,
-        "started_unix": time.time(),
-    }
-    with open(os.path.join(wdir, META_FILE), "w") as f:
-        json.dump(meta, f, indent=2)
+        meta = {
+            "name": name,
+            "hostname": hostname,
+            "process_index": int(process_index),
+            "pid": os.getpid(),
+            "metrics_port": metrics_port,
+            "clock_anchor": anchor,
+            "started_unix": time.time(),
+        }
+        with open(os.path.join(wdir, META_FILE), "w") as f:
+            json.dump(meta, f, indent=2)
 
-    _worker_state.update({"dir": wdir, "meta": meta, "server": server,
-                          "run_dir": run_dir})
+        _worker_state.update({"dir": wdir, "meta": meta,
+                              "server": server, "run_dir": run_dir})
     if register_atexit:
         import atexit
         atexit.register(flush_worker_observability)
@@ -744,10 +754,11 @@ def flush_worker_observability() -> Optional[str]:
 
 def reset_worker_observability() -> None:
     """Drop worker bring-up state (test helper); stops the server."""
-    server = _worker_state.get("server")
-    if server is not None:
-        try:
-            server.stop()
-        except Exception:
-            pass
-    _worker_state.clear()
+    with _worker_lock:
+        server = _worker_state.get("server")
+        if server is not None:
+            try:
+                server.stop()
+            except Exception:
+                pass
+        _worker_state.clear()

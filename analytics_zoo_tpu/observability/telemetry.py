@@ -56,8 +56,11 @@ def _jit_cache_size() -> Optional[int]:
 # devices that have successfully reported memory_stats at least once
 # in this process — a later failed poll on one of these marks its
 # gauges STALE instead of silently freezing them (some backends drop
-# memory_stats mid-run, e.g. across a tunneled-runtime reconnect)
+# memory_stats mid-run, e.g. across a tunneled-runtime reconnect).
+# Guarded: the TelemetrySampler thread and direct callers (estimator
+# per-epoch sampling, tests) may run a pass concurrently.
 _reported_devices: set = set()
+_reported_lock = threading.Lock()
 
 
 def sample_device_telemetry(registry: Optional[MetricsRegistry] = None
@@ -83,8 +86,12 @@ def sample_device_telemetry(registry: Optional[MetricsRegistry] = None
         except Exception:
             stats = None
         label = str(getattr(dev, "id", dev))
+        with _reported_lock:
+            reported_before = label in _reported_devices
+            if stats:
+                _reported_devices.add(label)
         if not stats:
-            if label in _reported_devices:
+            if reported_before:
                 # the device USED to report: keep the last-good gauge
                 # values (scrapes still see them) but flag staleness
                 # so dashboards/alerts don't trust a frozen number
@@ -95,13 +102,12 @@ def sample_device_telemetry(registry: Optional[MetricsRegistry] = None
                     "values)", labels=("device",)).labels(label).set(1)
                 sampled[f"device_telemetry_stale{{{label}}}"] = 1.0
             continue
-        if label in _reported_devices:
+        if reported_before:
             reg.gauge(
                 "device_telemetry_stale",
                 "1 when the device stopped reporting memory_stats "
                 "mid-run (its device_* gauges hold last-good values)",
                 labels=("device",)).labels(label).set(0)
-        _reported_devices.add(label)
         for key, gname in _MEM_KEYS.items():
             if key in stats:
                 reg.gauge(

@@ -199,10 +199,15 @@ class Bidirectional(Layer):
         }
 
     def call(self, params, x, training=False, rng=None):
+        # distinct keys per direction: sharing one rng would give the
+        # forward and backward layers IDENTICAL dropout masks
+        f_rng = b_rng = None
+        if rng is not None:
+            f_rng, b_rng = jax.random.split(rng)
         f = self.forward_layer.call(params["forward"], x,
-                                    training=training, rng=rng)
+                                    training=training, rng=f_rng)
         b = self.backward_layer.call(params["backward"], x,
-                                     training=training, rng=rng)
+                                     training=training, rng=b_rng)
         if self.merge_mode == "concat":
             return jnp.concatenate([f, b], axis=-1)
         if self.merge_mode == "sum":

@@ -224,12 +224,21 @@ class KerasNet(Container):
         if rng is None:
             rng = _jax.random.PRNGKey(0)
         variables = self.get_variables()
-        outs = []
+        xd = jnp.asarray(x)
+        # sliding-window fetch (the predict_in_batches idiom): pulling
+        # per iteration would block the dispatch pipeline on every MC
+        # sample, while keeping all n_samples outputs on device risks
+        # HBM for big batches — `window` samples stay in flight
+        window = 8
+        outs, in_flight = [], []
         for i in range(n_samples):
-            out, _ = self.apply(variables["params"], jnp.asarray(x),
+            out, _ = self.apply(variables["params"], xd,
                                 state=variables["state"], training=True,
                                 rng=_jax.random.fold_in(rng, i))
-            outs.append(np.asarray(out))
+            in_flight.append(out)
+            if len(in_flight) >= window:
+                outs.append(_jax.device_get(in_flight.pop(0)))
+        outs.extend(_jax.device_get(in_flight))
         return np.stack(outs)
 
     # -------------------------------------------------------------- summary

@@ -249,7 +249,13 @@ class InferenceModel:
             leaves = jax.tree_util.tree_leaves(x)
             n = len(leaves[0])
             bs = batch_size or n
-            outs = []
+            # Sliding-window fetch (same idiom as estimator.predict_in_
+            # batches): np.asarray per batch would sync the loop on
+            # every dispatch; keeping everything on device risks HBM
+            # for large outputs.  `window` batches stay in flight while
+            # older results stream to host.
+            window = 8
+            outs, in_flight = [], []
             nb = math.ceil(n / bs)
             for b in range(nb):
                 lo, hi = b * bs, min((b + 1) * bs, n)
@@ -263,7 +269,10 @@ class InferenceModel:
                 out = self._predict_fn(
                     self._variables["params"],
                     self._variables["state"], xb)
-                outs.append(np.asarray(out)[:real])
+                in_flight.append(out[:real])
+                if len(in_flight) >= window:
+                    outs.append(jax.device_get(in_flight.pop(0)))
+            outs.extend(jax.device_get(in_flight))
             result = np.concatenate(outs)
         self._m_latency.labels(backend).observe(time.perf_counter() - t0)
         self._m_calls.labels(backend).inc()

@@ -92,13 +92,16 @@ class GANEstimator:
         def d_step(g_params, d_params, g_state, d_state, d_opt_state,
                    real, noise, rng):
             def loss(dp):
+                # one key per stochastic apply: reusing `rng` would
+                # hand G and both D passes identical dropout masks
+                g_key, dr_key, df_key = jax.random.split(rng, 3)
                 fake, _ = gen.apply(g_params, noise, state=g_state,
-                                    training=True, rng=rng)
+                                    training=True, rng=g_key)
                 fake = jax.lax.stop_gradient(fake)
                 real_logits, ds = disc.apply(dp, real, state=d_state,
-                                             training=True, rng=rng)
+                                             training=True, rng=dr_key)
                 fake_logits, _ = disc.apply(dp, fake, state=ds,
-                                            training=True, rng=rng)
+                                            training=True, rng=df_key)
                 return d_loss_fn(real_logits, fake_logits), ds
             (l, new_state), grads = jax.value_and_grad(
                 loss, has_aux=True)(d_params)
@@ -111,10 +114,11 @@ class GANEstimator:
         def g_step(g_params, d_params, g_state, d_state, g_opt_state,
                    noise, rng):
             def loss(gp):
+                g_key, d_key = jax.random.split(rng)
                 fake, gs = gen.apply(gp, noise, state=g_state,
-                                     training=True, rng=rng)
+                                     training=True, rng=g_key)
                 fake_logits, _ = disc.apply(d_params, fake, state=d_state,
-                                            training=True, rng=rng)
+                                            training=True, rng=d_key)
                 return g_loss_fn(fake_logits), gs
             (l, new_state), grads = jax.value_and_grad(
                 loss, has_aux=True)(g_params)
@@ -145,22 +149,31 @@ class GANEstimator:
             ki = iter(keys)
             d_loss = g_loss = None
             for _ in range(self.d_steps):
-                k = next(ki)
-                idx_key, k = jax.random.split(k)
+                # disjoint keys: one for the minibatch gather, one for
+                # the noise draw, one consumed inside the jitted step
+                idx_key, noise_key, step_key = \
+                    jax.random.split(next(ki), 3)
                 idx = jax.random.randint(idx_key, (batch_size,), 0, n)
+                # real_data lives on host, so indexing it needs host
+                # indices — this one device pull per d_step is the
+                # operation, not an accident
+                # zoolint: disable=SYNC002 — host-side minibatch gather
                 real = real_data[np.asarray(idx)]
-                noise = jax.random.normal(k, (batch_size, noise_dim))
+                noise = jax.random.normal(noise_key,
+                                          (batch_size, noise_dim))
                 self.d_params, self.d_state, self.d_opt_state, d_loss = \
                     self._d_step(self.g_params, self.d_params,
                                  self.g_state, self.d_state,
-                                 self.d_opt_state, real, noise, k)
+                                 self.d_opt_state, real, noise,
+                                 step_key)
             for _ in range(self.g_steps):
-                k = next(ki)
-                noise = jax.random.normal(k, (batch_size, noise_dim))
+                noise_key, step_key = jax.random.split(next(ki))
+                noise = jax.random.normal(noise_key,
+                                          (batch_size, noise_dim))
                 self.g_params, self.g_state, self.g_opt_state, g_loss = \
                     self._g_step(self.g_params, self.d_params,
                                  self.g_state, self.d_state,
-                                 self.g_opt_state, noise, k)
+                                 self.g_opt_state, noise, step_key)
             entry = {}
             if d_loss is not None:
                 entry["d_loss"] = float(d_loss)

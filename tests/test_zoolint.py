@@ -1,0 +1,645 @@
+"""zoolint — the static-analysis suite's own tests.
+
+Three layers:
+
+1. per-rule fixtures: each of the six rules has at least one proven
+   TRUE POSITIVE and one proven NON-FINDING (the acceptance contract
+   of ISSUE 5);
+2. framework semantics: inline suppressions, baseline only-shrink,
+   ``--diff`` PR gating, JSON schema, CLI exit codes;
+3. the tier-1 repo gate: the full pass over ``analytics_zoo_tpu``,
+   ``scripts`` and ``examples`` must report ZERO non-baselined
+   findings, and the checked-in baseline must stay strictly below
+   the pre-fix finding count.
+
+The engine is stdlib-only; importing it through the package here is
+fine (tests already run with jax loaded), while ``scripts/zoolint``
+exercises the jax-free file-path loading in the subprocess tests.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from analytics_zoo_tpu.analysis import (
+    analyze_source, apply_baseline, diff_findings, load_baseline,
+    write_baseline)
+from analytics_zoo_tpu.analysis.cli import main as zoolint_main
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO_ROOT, ".zoolint-baseline.json")
+
+
+def lint(src, rules=None):
+    return analyze_source(src, path="snippet.py", rule_ids=rules)
+
+
+def rule_ids(findings):
+    return [f.rule for f in findings]
+
+
+# ================================================================ JIT001
+
+
+class TestJIT001:
+    def test_print_and_clock_and_host_rng_in_jit(self):
+        out = lint(
+            "import time, random, jax\n"
+            "import numpy as np\n"
+            "@jax.jit\n"
+            "def step(p, x):\n"
+            "    print('hi')\n"
+            "    t = time.time()\n"
+            "    r = random.random()\n"
+            "    n = np.random.normal()\n"
+            "    return p * x + t + r + n\n", rules=["JIT001"])
+        assert len(out) == 4
+        assert all(f.rule == "JIT001" and f.severity == "error"
+                   for f in out)
+        assert out[0].symbol == "step"
+
+    def test_closure_and_global_mutation_in_traced_fn(self):
+        out = lint(
+            "import jax\n"
+            "_STATS = {}\n"
+            "def make():\n"
+            "    acc = []\n"
+            "    def step(p, x):\n"
+            "        _STATS['n'] = 1\n"
+            "        acc.append(x)\n"
+            "        return p\n"
+            "    return jax.jit(step)\n", rules=["JIT001"])
+        assert len(out) == 2
+        assert "_STATS" in out[0].message
+        assert ".append" in out[1].message
+
+    def test_global_stmt_in_jitted(self):
+        out = lint(
+            "import jax\n"
+            "N = 0\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    global N\n"
+            "    N = N + 1\n"
+            "    return x\n", rules=["JIT001"])
+        assert any("global 'N'" in f.message for f in out)
+
+    def test_traced_via_grad_and_scan(self):
+        out = lint(
+            "import jax\n"
+            "def train(p, xs):\n"
+            "    def objective(p):\n"
+            "        print('tracing')\n"
+            "        return (p * p).sum()\n"
+            "    return jax.grad(objective)(p)\n", rules=["JIT001"])
+        assert rule_ids(out) == ["JIT001"]
+
+    def test_negative_pure_step_and_debug_callback(self):
+        out = lint(
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "def make():\n"
+            "    def step(p, x):\n"
+            "        jax.debug.print('loss {}', x)\n"
+            "        jax.debug.callback(print, x)\n"
+            "        local = []\n"
+            "        local.append(x)\n"
+            "        k = jax.random.PRNGKey(0)\n"
+            "        noise = jax.random.normal(k, x.shape)\n"
+            "        return p + jnp.sum(x) + noise\n"
+            "    return jax.jit(step, donate_argnums=(0,))\n",
+            rules=["JIT001"])
+        assert out == []
+
+    def test_negative_impure_outside_jit(self):
+        out = lint(
+            "import time\n"
+            "def host_loop():\n"
+            "    print('ok')\n"
+            "    return time.time()\n", rules=["JIT001"])
+        assert out == []
+
+
+# =============================================================== SYNC002
+
+
+class TestSYNC002:
+    HOT_LOOP = (
+        "import jax\n"
+        "import numpy as np\n"
+        "step = jax.jit(lambda p, b: (p, p.sum()))\n"
+        "def train_loop(p, batches):\n"
+        "    for b in batches:\n"
+        "        p, loss = step(p, b)\n"
+        "        {body}\n"
+        "    return p\n")
+
+    def test_float_cast_in_hot_loop(self):
+        out = lint(self.HOT_LOOP.format(body="l = float(loss)"),
+                   rules=["SYNC002"])
+        assert rule_ids(out) == ["SYNC002"]
+        assert "float(loss)" in out[0].message
+
+    def test_item_in_hot_loop(self):
+        out = lint(self.HOT_LOOP.format(body="l = loss.item()"),
+                   rules=["SYNC002"])
+        assert rule_ids(out) == ["SYNC002"]
+
+    def test_asarray_in_hot_loop(self):
+        out = lint(self.HOT_LOOP.format(body="l = np.asarray(loss)"),
+                   rules=["SYNC002"])
+        assert rule_ids(out) == ["SYNC002"]
+
+    def test_branch_on_traced_value_in_hot_loop(self):
+        out = lint(self.HOT_LOOP.format(
+            body="if loss:\n            p = p"), rules=["SYNC002"])
+        assert rule_ids(out) == ["SYNC002"]
+        assert "branching" in out[0].message
+
+    def test_negative_sync_outside_loop(self):
+        out = lint(
+            "import jax\n"
+            "step = jax.jit(lambda p, b: (p, p.sum()))\n"
+            "def train_loop(p, batches):\n"
+            "    for b in batches:\n"
+            "        p, loss = step(p, b)\n"
+            "    return p, float(loss)\n", rules=["SYNC002"])
+        assert out == []
+
+    def test_negative_nested_def_does_not_taint_outer_names(self):
+        # helper's `total = model(x)` is a DIFFERENT scope: the outer
+        # loop's host-literal `total` must not be flagged
+        out = lint(
+            "def train_loop(model, xs):\n"
+            "    def helper(x):\n"
+            "        total = model(x)\n"
+            "        return total\n"
+            "    for x in xs:\n"
+            "        total = 0.0\n"
+            "        v = float(total)\n"
+            "    return v\n", rules=["SYNC002"])
+        assert out == []
+
+    def test_negative_host_values_and_cold_functions(self):
+        out = lint(
+            "import time\n"
+            "def train_loop(xs):\n"
+            "    for x in xs:\n"
+            "        t = time.perf_counter()\n"
+            "        wall = float(t)\n"       # host clock: fine
+            "def helper(xs):\n"               # not a hot name
+            "    for x in xs:\n"
+            "        v = float(x)\n", rules=["SYNC002"])
+        assert out == []
+
+
+# ============================================================ COMPILE003
+
+
+class TestCOMPILE003:
+    def test_jit_inside_loop(self):
+        out = lint(
+            "import jax\n"
+            "def train(xs):\n"
+            "    for x in xs:\n"
+            "        f = jax.jit(lambda a: a + 1)\n"
+            "        f(x)\n", rules=["COMPILE003"])
+        assert rule_ids(out) == ["COMPILE003"]
+        assert "inside a loop" in out[0].message
+
+    def test_fstring_on_traced_value(self):
+        out = lint(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    msg = f'value {x}'\n"
+            "    return x\n", rules=["COMPILE003"])
+        assert rule_ids(out) == ["COMPILE003"]
+        assert "f-string" in out[0].message
+
+    def test_shape_derived_traced_arg(self):
+        out = lint(
+            "import jax\n"
+            "g = jax.jit(lambda a, n: a * n)\n"
+            "def predict(batches):\n"
+            "    for b in batches:\n"
+            "        out = g(b, b.shape[0])\n"
+            "    return out\n", rules=["COMPILE003"])
+        assert rule_ids(out) == ["COMPILE003"]
+        assert "shape-derived" in out[0].message
+
+    def test_shape_derived_arg_to_decorator_jitted(self):
+        out = lint(
+            "import jax\n"
+            "@jax.jit\n"
+            "def g(a, n):\n"
+            "    return a * n\n"
+            "def predict(batches):\n"
+            "    for b in batches:\n"
+            "        out = g(b, b.shape[0])\n"
+            "    return out\n", rules=["COMPILE003"])
+        assert rule_ids(out) == ["COMPILE003"]
+
+    def test_negative_static_argnums_declared(self):
+        out = lint(
+            "import jax\n"
+            "g = jax.jit(lambda a, n: a * n, static_argnums=(1,))\n"
+            "def predict(batches):\n"
+            "    for b in batches:\n"
+            "        out = g(b, b.shape[0])\n"
+            "    return out\n", rules=["COMPILE003"])
+        assert out == []
+
+    def test_negative_jit_at_module_scope(self):
+        out = lint(
+            "import jax\n"
+            "f = jax.jit(lambda a: a + 1)\n"
+            "def train(xs):\n"
+            "    return [f(x) for x in xs]\n", rules=["COMPILE003"])
+        assert out == []
+
+
+# ============================================================= DONATE004
+
+
+class TestDONATE004:
+    def test_train_step_without_donation(self):
+        out = lint(
+            "import jax\n"
+            "def build():\n"
+            "    def step(params, opt_state, batch):\n"
+            "        return params, opt_state\n"
+            "    return jax.jit(step)\n", rules=["DONATE004"])
+        assert rule_ids(out) == ["DONATE004"]
+        assert "donate_argnums" in out[0].message
+
+    def test_decorator_forms(self):
+        out = lint(
+            "import jax\n"
+            "from functools import partial\n"
+            "@jax.jit\n"
+            "def step(params, opt_state, batch):\n"
+            "    return params, opt_state\n"
+            "@partial(jax.jit, static_argnums=(2,))\n"
+            "def step2(params, opt_state, n):\n"
+            "    return params, opt_state\n"
+            "@partial(jax.jit, donate_argnums=(0, 1))\n"
+            "def step3(params, opt_state, batch):\n"
+            "    return params, opt_state\n", rules=["DONATE004"])
+        assert len(out) == 2
+        assert {f.symbol for f in out} == {"step", "step2"}
+
+    def test_negative_donated_and_stateless(self):
+        out = lint(
+            "import jax\n"
+            "def build():\n"
+            "    def step(params, opt_state, batch):\n"
+            "        return params, opt_state\n"
+            "    def eval_step(params, state, batch):\n"
+            "        return params\n"
+            "    return (jax.jit(step, donate_argnums=(0, 1)),\n"
+            "            jax.jit(eval_step))\n", rules=["DONATE004"])
+        assert out == []
+
+
+# =============================================================== RACE005
+
+
+class TestRACE005:
+    THREADED = (
+        "import threading\n"
+        "_CACHE = {}\n"
+        "_LOCK = threading.Lock()\n"
+        "def reader():\n"
+        "    return _CACHE.get('x')\n")
+
+    def test_unlocked_write_in_threaded_module(self):
+        out = lint(self.THREADED +
+                   "def writer(k, v):\n"
+                   "    _CACHE[k] = v\n", rules=["RACE005"])
+        assert rule_ids(out) == ["RACE005"]
+        assert "_CACHE" in out[0].message
+        assert out[0].severity == "error"
+
+    def test_unlocked_global_rebind(self):
+        out = lint(
+            "import threading\n"
+            "_STATE = None\n"
+            "def get_state():\n"
+            "    global _STATE\n"
+            "    if _STATE is None:\n"
+            "        _STATE = object()\n"
+            "    return _STATE\n", rules=["RACE005"])
+        assert rule_ids(out) == ["RACE005"]
+
+    def test_negative_locked_write(self):
+        out = lint(self.THREADED +
+                   "def writer(k, v):\n"
+                   "    with _LOCK:\n"
+                   "        _CACHE[k] = v\n", rules=["RACE005"])
+        assert out == []
+
+    def test_negative_local_shadow_is_not_shared_state(self):
+        out = lint(self.THREADED +
+                   "def shadowing():\n"
+                   "    _CACHE = {}\n"
+                   "    _CACHE['x'] = 1\n"
+                   "    _CACHE['x'] += 1\n"
+                   "    del _CACHE['x']\n"
+                   "    return _CACHE\n", rules=["RACE005"])
+        assert out == []
+
+    def test_negative_unthreaded_module(self):
+        out = lint(
+            "_CACHE = {}\n"
+            "def reader():\n"
+            "    return _CACHE.get('x')\n"
+            "def writer(k, v):\n"
+            "    _CACHE[k] = v\n", rules=["RACE005"])
+        assert out == []
+
+
+# ================================================================ RNG006
+
+
+class TestRNG006:
+    def test_key_consumed_twice(self):
+        out = lint(
+            "import jax\n"
+            "def sample(key):\n"
+            "    a = jax.random.normal(key, (3,))\n"
+            "    b = jax.random.uniform(key, (3,))\n"
+            "    return a + b\n", rules=["RNG006"])
+        assert rule_ids(out) == ["RNG006"]
+        assert "already consumed" in out[0].message
+
+    def test_rng_kwarg_reuse(self):
+        out = lint(
+            "def call(model, x, rng):\n"
+            "    f = model.apply(x, rng=rng)\n"
+            "    b = model.apply(x, rng=rng)\n"
+            "    return f + b\n", rules=["RNG006"])
+        assert rule_ids(out) == ["RNG006"]
+
+    def test_consumption_in_loop_iterable_counts(self):
+        out = lint(
+            "import jax\n"
+            "def sample(key, xs):\n"
+            "    for p in jax.random.permutation(key, xs):\n"
+            "        pass\n"
+            "    return jax.random.normal(key, (3,))\n",
+            rules=["RNG006"])
+        assert rule_ids(out) == ["RNG006"]
+
+    def test_negative_loop_target_rebinds_each_iteration(self):
+        out = lint(
+            "import jax\n"
+            "def sample(key, n):\n"
+            "    out = []\n"
+            "    for k in jax.random.split(key, n):\n"
+            "        out.append(jax.random.normal(k, (3,)))\n"
+            "    return out\n", rules=["RNG006"])
+        assert out == []
+
+    def test_loop_reuse_without_fold_in(self):
+        out = lint(
+            "import jax\n"
+            "def sample(key, xs):\n"
+            "    out = []\n"
+            "    for x in xs:\n"
+            "        out.append(jax.random.normal(key, (3,)))\n"
+            "    return out\n", rules=["RNG006"])
+        assert rule_ids(out) == ["RNG006"]
+
+    def test_negative_split_and_fold_in(self):
+        out = lint(
+            "import jax\n"
+            "def sample(key, xs):\n"
+            "    k1, k2 = jax.random.split(key)\n"
+            "    a = jax.random.normal(k1, (3,))\n"
+            "    b = jax.random.uniform(k2, (3,))\n"
+            "    out = []\n"
+            "    for i, x in enumerate(xs):\n"
+            "        k = jax.random.fold_in(key, i)\n"
+            "        out.append(jax.random.normal(k, (3,)))\n"
+            "    return a + b, out\n", rules=["RNG006"])
+        assert out == []
+
+    def test_subscript_target_is_not_a_rebind(self):
+        # ``out[rng] = a`` READS rng; it must not re-arm the key
+        out = lint(
+            "import jax\n"
+            "def sample(rng, out):\n"
+            "    a = jax.random.normal(rng, (2,))\n"
+            "    out[rng] = a\n"
+            "    b = jax.random.normal(rng, (2,))\n"
+            "    return b\n", rules=["RNG006"])
+        assert rule_ids(out) == ["RNG006"]
+
+    def test_negative_one_use_per_branch(self):
+        out = lint(
+            "import jax\n"
+            "def sample(key, flag):\n"
+            "    if flag:\n"
+            "        return jax.random.normal(key, (3,))\n"
+            "    else:\n"
+            "        return jax.random.uniform(key, (3,))\n",
+            rules=["RNG006"])
+        assert out == []
+
+
+# ====================================================== framework semantics
+
+
+class TestSuppression:
+    SRC = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    print('hi'){suffix}\n"
+        "    return x\n")
+
+    def test_same_line_disable(self):
+        out = lint(self.SRC.format(
+            suffix="   # zoolint: disable=JIT001 — trace-time banner"))
+        assert out == []
+
+    def test_line_above_disable(self):
+        out = lint(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    # zoolint: disable=JIT001 — deliberate\n"
+            "    print('hi')\n"
+            "    return x\n")
+        assert out == []
+
+    def test_disable_all(self):
+        out = lint(self.SRC.format(suffix="  # zoolint: disable=all"))
+        assert out == []
+
+    def test_wrong_rule_does_not_suppress(self):
+        out = lint(self.SRC.format(
+            suffix="  # zoolint: disable=SYNC002"))
+        assert rule_ids(out) == ["JIT001"]
+
+    def test_natural_language_reason_still_suppresses(self):
+        out = lint(self.SRC.format(
+            suffix="  # zoolint: disable=JIT001 because trace banner"))
+        assert out == []
+
+
+DIRTY = (
+    "import jax\n"
+    "@jax.jit\n"
+    "def f(x):\n"
+    "    print('hi')\n"
+    "    return x\n")
+DIRTY_TWICE = DIRTY + (
+    "@jax.jit\n"
+    "def g(x):\n"
+    "    print('ho')\n"
+    "    return x\n")
+
+
+class TestBaseline:
+    def test_baselined_findings_pass_and_shrink_is_enforced(self, tmp_path):
+        baseline = tmp_path / "base.json"
+        findings = lint(DIRTY_TWICE)
+        assert len(findings) == 2
+        write_baseline(str(baseline), findings)
+        data = load_baseline(str(baseline))
+        assert data["pre_fix_total"] == 2
+
+        # unchanged code: everything covered, nothing stale
+        new, stale = apply_baseline(lint(DIRTY_TWICE), data)
+        assert new == [] and stale == []
+
+        # one finding fixed: the baseline entry goes STALE — the run
+        # must fail until the entry is removed (only-shrink)
+        new, stale = apply_baseline(lint(DIRTY), data)
+        assert new == []
+        assert len(stale) == 1 and "no longer matched" in stale[0]
+
+        # a novel finding is never absorbed by old entries
+        novel = DIRTY_TWICE + (
+            "@jax.jit\n"
+            "def h(x):\n"
+            "    print('new')\n"
+            "    return x\n")
+        new, stale = apply_baseline(lint(novel), data)
+        assert len(new) == 1 and new[0].symbol == "h"
+
+    def test_rewritten_baseline_keeps_pre_fix_total(self, tmp_path,
+                                                    capsys):
+        baseline = tmp_path / "base.json"
+        src = tmp_path / "dirty.py"
+        src.write_text(DIRTY_TWICE)
+        assert zoolint_main(["--write-baseline", str(baseline),
+                             str(src)]) == 0
+        assert load_baseline(str(baseline))["pre_fix_total"] == 2
+        # fix one, regenerate: total shrinks, pre_fix_total survives
+        src.write_text(DIRTY)
+        assert zoolint_main(["--write-baseline", str(baseline),
+                             str(src)]) == 0
+        data = load_baseline(str(baseline))
+        assert data["total"] == 1 and data["pre_fix_total"] == 2
+
+
+class TestDiff:
+    def test_diff_reports_only_new_findings(self):
+        old = lint(DIRTY)
+        report = {"findings": [f.to_json() for f in old]}
+        assert diff_findings(lint(DIRTY), report) == []
+        new = diff_findings(lint(DIRTY_TWICE), report)
+        assert len(new) == 1 and new[0].symbol == "g"
+
+
+class TestCLIAndJson:
+    def test_json_schema(self, tmp_path, capsys):
+        src = tmp_path / "dirty.py"
+        src.write_text(DIRTY)
+        rc = zoolint_main(["--json", str(src)])
+        assert rc == 1
+        report = json.loads(capsys.readouterr().out)
+        assert report["version"] == 1
+        assert report["tool"] == "zoolint"
+        assert report["total"] == 1
+        assert report["counts"] == {"JIT001": 1}
+        assert report["errors"] == []
+        (f,) = report["findings"]
+        assert set(f) == {"rule", "severity", "path", "line", "col",
+                          "message", "symbol", "key"}
+        assert f["rule"] == "JIT001" and f["severity"] == "error"
+        assert f["line"] == 4 and f["symbol"] == "f"
+
+    def test_exit_codes(self, tmp_path, capsys):
+        clean = tmp_path / "clean.py"
+        clean.write_text("x = 1\n")
+        assert zoolint_main([str(clean)]) == 0          # clean
+        dirty = tmp_path / "dirty.py"
+        dirty.write_text(DIRTY)
+        assert zoolint_main([str(dirty)]) == 1          # findings
+        assert zoolint_main([]) == 2                    # no paths
+        assert zoolint_main(["--baseline", str(tmp_path / "nope.json"),
+                             str(clean)]) == 2          # bad baseline
+        capsys.readouterr()
+
+    def test_unparseable_file_fails_loudly(self, tmp_path, capsys):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def broken(:\n")
+        assert zoolint_main([str(bad)]) == 1
+        assert "syntax error" in capsys.readouterr().out
+
+    def test_missing_path_fails_loudly(self, tmp_path, capsys):
+        # a typo'd target must not silently shrink coverage
+        assert zoolint_main([str(tmp_path / "no_such_dir")]) == 1
+        assert "no such file" in capsys.readouterr().out
+
+    def test_list_rules_names_all_six(self, capsys):
+        assert zoolint_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("JIT001", "SYNC002", "COMPILE003", "DONATE004",
+                    "RACE005", "RNG006"):
+            assert rid in out
+
+
+# ========================================================= the tier-1 gate
+
+
+class TestRepoIsClean:
+    """The acceptance gate: the shipped tree passes its own linter."""
+
+    def test_full_pass_zero_nonbaselined_findings(self):
+        """``scripts/zoolint analytics_zoo_tpu scripts examples``
+        exits 0 against the checked-in baseline — and does so through
+        the jax-free file-path loader (subprocess)."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                          "zoolint"),
+             "--baseline", BASELINE, "--root", REPO_ROOT,
+             "analytics_zoo_tpu", "scripts", "examples"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, \
+            f"zoolint found regressions:\n{proc.stdout}\n{proc.stderr}"
+
+    def test_baseline_strictly_below_pre_fix_count(self):
+        data = load_baseline(BASELINE)
+        assert data["total"] < data["pre_fix_total"], (
+            "the baseline may only shrink: fix findings, don't "
+            "re-baseline them")
+
+    def test_check_static_entry_point(self):
+        """The folded entry point (zoolint + metrics_lint) is the one
+        CI hook; it must stay green and jax-free."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                          "check_static.py")],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, \
+            f"check_static failed:\n{proc.stdout}\n{proc.stderr}"
+        assert "zoolint" in proc.stdout
+        assert "metrics_lint" in proc.stdout
