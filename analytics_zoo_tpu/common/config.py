@@ -58,6 +58,21 @@ _DEFAULTS: Dict[str, Any] = {
     # activations — a win when the step is HBM-bandwidth-bound, and
     # the standard lever for fitting longer sequences / bigger batches.
     "train.remat": False,
+    # Resilience -------------------------------------------------------
+    # Elastic recovery: on a classified lost-host failure, re-form the
+    # device mesh on the surviving topology, reshard, and resume from
+    # the last snapshot + pipeline position (resilience/recovery.py).
+    # Off = lost-host failures fall back to the plain retry budget.
+    "train.elastic": True,
+    # How many times one train() call may shrink onto a smaller
+    # topology before it degrades to checkpoint-and-queue instead.
+    "train.max_mesh_reformations": 2,
+    # Worker liveness heartbeat (launcher run-dir slots): at most one
+    # heartbeat file write per interval; the launcher flags a host
+    # whose heartbeat is older than the timeout (ZooCluster
+    # .check_health) BEFORE a collective hangs on it.
+    "resilience.heartbeat_interval_s": 5.0,
+    "resilience.heartbeat_timeout_s": 30.0,
     # Input pipeline ---------------------------------------------------
     # Device-batch prefetch depth (background thread overlapping host
     # batch assembly + H2D copy with device compute); 0 disables.
@@ -138,6 +153,10 @@ _DEFAULTS: Dict[str, Any] = {
     # fraction over the most recent records (0 = disabled).
     "serving.healthz_max_queue": 0,
     "serving.healthz_max_error_rate": 0.0,
+    # Result-write backpressure: bounded attempts (exponential backoff
+    # with jitter between them) before a result write is abandoned to
+    # the dead-letter stream instead of crashing the worker loop.
+    "serving.result_write_retries": 8,
 }
 
 _ENV_PREFIX = "ZOO_TPU_"

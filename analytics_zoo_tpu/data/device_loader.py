@@ -24,6 +24,8 @@ from analytics_zoo_tpu.data.stages import PrefetchIterator
 from analytics_zoo_tpu.observability import get_registry
 from analytics_zoo_tpu.observability.diagnostics import (
     step_attribution_histogram)
+from analytics_zoo_tpu.resilience.chaos import (
+    SITE_DATA_BATCH, active_chaos)
 
 
 def _default_put(batch):
@@ -95,8 +97,15 @@ class DeviceLoader:
                 on_depth=self._m_depth.set)
         import time
         t0 = time.perf_counter()
+        chaos = active_chaos()
         try:
             for step, batch in placed:
+                if chaos is not None:
+                    # fault-injection site, keyed on the pipeline's
+                    # epoch step index, tripped BEFORE the position
+                    # commits: an injected input-side failure never
+                    # skips the batch it interrupted
+                    chaos.trip(SITE_DATA_BATCH, step)
                 # feed the pipeline's own batch counter / wait
                 # histogram — device-fed consumption is still pipeline
                 # consumption — plus the step-attribution data_wait

@@ -25,6 +25,11 @@ def main(argv=None):
                         "gets a host-<k>/ metrics slot + port and a "
                         "shared clock anchor; aggregate with "
                         "scripts/obs_report.py --merge-hosts")
+    p.add_argument("--max-degraded", type=int, default=0,
+                   help="exit 0 when at most this many workers exit "
+                        "DEGRADED (code 17: checkpoint-and-queue, a "
+                        "structured partial result) and the rest "
+                        "exit 0")
     p.add_argument("script")
     p.add_argument("args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
@@ -44,19 +49,45 @@ def main(argv=None):
     cluster = ZooCluster(num_processes=args.num_processes,
                          coordinator=args.coordinator,
                          run_dir=args.run_dir, env=env)
+    import json
+
+    from analytics_zoo_tpu.resilience.policy import DEGRADED_EXIT_CODE
     cluster.start(args.script, args.args)
     try:
         codes = cluster.wait(timeout=args.timeout)
     except subprocess.TimeoutExpired:
+        health = cluster.check_health()
         print(f"workers still running after {args.timeout}s; "
               "killing stragglers", file=sys.stderr)
+        # structured record instead of a bare timeout: which host
+        # died first (the cause — the rest is collective collateral)
+        print(json.dumps({"status": "timeout",
+                          "first_failure": health.first_death,
+                          "missing": health.missing,
+                          "alive": health.alive}))
         return 1
     finally:
         cluster.stop()
-    bad = [c for c in codes if c != 0]
+    degraded = [i for i, c in enumerate(codes)
+                if c == DEGRADED_EXIT_CODE]
+    bad = [c for c in codes if c not in (0, DEGRADED_EXIT_CODE)]
     if bad:
-        print(f"workers exited with codes {codes}", file=sys.stderr)
+        print(f"workers exited with codes {list(codes)}; first "
+              f"failure: {codes.first_failure}", file=sys.stderr)
+        print(json.dumps({"status": "failed", "codes": list(codes),
+                          "first_failure": codes.first_failure}))
         return 1
+    if degraded:
+        # checkpoint-and-queue workers (resilience.policy
+        # DEGRADED_EXIT_CODE): a structured partial result, not a
+        # crash — exit 0 within the --max-degraded budget
+        within = len(degraded) <= args.max_degraded
+        print(json.dumps({"status": "degraded",
+                          "degraded_workers": degraded,
+                          "codes": list(codes),
+                          "max_degraded": args.max_degraded,
+                          "within_budget": within}))
+        return 0 if within else 1
     print(f"{args.num_processes} workers completed")
     if args.run_dir:
         print(f"observability run dir: {args.run_dir} — merge with "

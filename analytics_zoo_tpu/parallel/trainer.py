@@ -39,6 +39,8 @@ from analytics_zoo_tpu.observability.diagnostics import (
 from analytics_zoo_tpu.observability.watchdog import (
     fold_finiteness_check)
 from analytics_zoo_tpu.parallel import mesh as mesh_lib
+from analytics_zoo_tpu.resilience.chaos import (
+    SITE_TRAINER_DISPATCH, active_chaos)
 
 
 def _record_grad_norm(gnorm) -> None:
@@ -352,6 +354,13 @@ class DistributedTrainer:
         dispatch→``block_until_ready`` (``device``) — one device sync
         on the sampled step only — and refreshes the live MFU gauge
         from the CompileMonitor's cost-analysis FLOPs."""
+        chaos = active_chaos()
+        if chaos is not None:
+            # fault-injection site, keyed on this trainer's 0-based
+            # dispatch index and tripped BEFORE the dispatch: a fault
+            # at step k leaves exactly k committed steps and donates
+            # no buffer to a doomed dispatch (resilience/chaos.py)
+            chaos.trip(SITE_TRAINER_DISPATCH, self._dispatch_count)
         self._dispatch_count += 1
         sample_device = (self._obs_device_every > 0 and
                          self._dispatch_count % self._obs_device_every

@@ -15,6 +15,7 @@ counters in one payload so resume is exact.
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import time
@@ -33,6 +34,9 @@ from analytics_zoo_tpu.parallel import mesh as mesh_lib
 from analytics_zoo_tpu.common.triggers import (
     EveryEpoch, MaxEpoch, TrainingState, Trigger)
 from analytics_zoo_tpu.parallel.trainer import ClipSpec, DistributedTrainer
+from analytics_zoo_tpu.resilience import (
+    DegradedTraining, HostHeartbeat, RecoveryAction, RecoveryPolicy,
+    RetryBudget)
 from analytics_zoo_tpu.utils.serialization import Checkpoint
 from analytics_zoo_tpu.utils.summary import TrainSummary, ValidationSummary
 
@@ -63,6 +67,18 @@ def _train_metrics():
         "retries": reg.counter(
             "train_retry_total",
             "training-step failures absorbed by the retry loop"),
+        # resilience plane: every mid-training failure by taxonomy
+        # class, and every recovery action the policy engine took
+        # (resilience/policy.py) — degrade/raise outcomes included, so
+        # failures == recoveries + raises always balances
+        "failures": reg.counter(
+            "train_failures_total",
+            "mid-training failures by classified cause",
+            labels=("class",)),
+        "recoveries": reg.counter(
+            "train_recovery_total",
+            "recovery actions taken by the failure policy engine",
+            labels=("action",)),
         # same family the per-step path (trainer.py) counts into
         "steps": reg.counter(
             "train_steps_total", "train steps dispatched",
@@ -128,13 +144,18 @@ def predict_in_batches(run_batch, x, batch_size: int):
 class Estimator:
     def __init__(self, model, optim_method=None,
                  optim_methods: Optional[Dict] = None,
-                 model_dir: Optional[str] = None):
+                 model_dir: Optional[str] = None, mesh=None):
         from analytics_zoo_tpu.pipeline.api.keras import optimizers as opt
         self.model = model
         self.optim_method = opt.get(optim_method) \
             if optim_method is not None else None
         self.optim_groups = optim_methods
         self.model_dir = model_dir
+        # explicit device mesh (default: the live context mesh) —
+        # elastic recovery rebinds this to the re-formed surviving
+        # topology so evaluate/predict after a recovered train() run
+        # on the topology that actually exists
+        self._mesh = mesh
         self._clip: Optional[ClipSpec] = None
         self._train_summary = None
         self._val_summary = None
@@ -179,7 +200,8 @@ class Estimator:
             batch_size = train_set.batch_size
         trainer = DistributedTrainer(
             self.model, criterion, optim_method=self.optim_method,
-            clip=self._clip, optim_groups=self.optim_groups)
+            mesh=self._mesh, clip=self._clip,
+            optim_groups=self.optim_groups)
         # The global batch must tile the data-parallel mesh (the analogue
         # of BigDL's batchSize % totalCores == 0 requirement).
         mesh_lib.local_batch_size(trainer.mesh, batch_size)
@@ -209,6 +231,16 @@ class Estimator:
         # watchdog just before the training loop — see below — so a
         # failure in restore/cache setup can't leak the thread.)
         watchdog = TrainingWatchdog()
+        # worker liveness heartbeat (launcher run-dir contract,
+        # resilience/detector.py): a throttled file write so the
+        # launcher's check_health can tell a slow worker from one
+        # wedged in a dead collective.  None outside a run dir.
+        heartbeat = HostHeartbeat.from_env()
+
+        def beat():
+            watchdog.beat()
+            if heartbeat is not None:
+                heartbeat.beat(ts.iteration)
         # dedupe loss observations by iteration: several sync points
         # (logging crossings, dispatch branches, epoch end) may hold
         # the same already-synced loss — observing it once per
@@ -309,17 +341,29 @@ class Estimator:
         # this point, not zero lifetime iterations (a second train()
         # call starts with the previous call's counter)
         start_iteration = ts.iteration
+        # the pipeline position at entry: the rebuild-from-entry-copy
+        # recovery path must rewind the stream too, or the batches a
+        # doomed dispatch consumed would be silently skipped
+        entry_data_state = train_set.state_dict() if is_pipeline else None
 
         eval_runner = None
         if validation_set is not None and validation_method:
             eval_runner = trainer.make_eval_runner(validation_method)
 
-        retry_times = int(get_config().get("train.retry_times"))
-        retries_left = retry_times
-        # interval math on the monotonic clock: a wall-clock (NTP)
-        # adjustment must not reset or starve the retry budget
-        last_failure_time: Optional[float] = None
-        retry_window = float(get_config().get("train.retry_interval_s"))
+        # failure policy engine (resilience/policy.py): the reference's
+        # time-windowed retry budget (bigdl.failure.retryTimes /
+        # retryTimeInterval, Topology.scala:1179-1261) is the
+        # TRANSIENT branch; classified lost-host failures re-form the
+        # mesh instead, poisoned state always raises.  RetryBudget
+        # runs on the monotonic clock: a wall-clock (NTP) adjustment
+        # must not reset or starve the budget.
+        cfg = get_config()
+        policy = RecoveryPolicy(
+            RetryBudget(int(cfg.get("train.retry_times")),
+                        float(cfg.get("train.retry_interval_s"))),
+            elastic=bool(cfg.get("train.elastic", True)),
+            max_reformations=int(
+                cfg.get("train.max_mesh_reformations", 2)))
 
         # --- epoch loop -----------------------------------------------------
         def save_snapshot(target=None):
@@ -501,7 +545,7 @@ class Estimator:
                             ts.iteration += 1
                             seen += batch_size
                             log_loss_crossing(loss, 1)
-                            watchdog.beat()
+                            beat()
                             health_check()
                             if ckpt is not None and \
                                     checkpoint_trigger(ts):
@@ -602,7 +646,7 @@ class Estimator:
                         met["steps"].labels("epoch_scan").inc(nb_epoch)
                         trainer.account_collectives(params, nb_epoch)
                         log_loss_crossing(loss, nb_epoch)
-                        watchdog.beat()
+                        beat()
                         observe_loss_once(ts.last_loss)
                         health_check()
                         if end_trigger(ts):
@@ -633,7 +677,7 @@ class Estimator:
                             met["steps"].labels("chunked").inc(k)
                             trainer.account_collectives(params, k)
                             log_loss_crossing(loss, k)
-                            watchdog.beat()
+                            beat()
                             health_check()
                             if ckpt is not None and checkpoint_trigger(ts):
                                 save_snapshot()
@@ -661,7 +705,7 @@ class Estimator:
                                 # avoid a device sync per step: loss is
                                 # fetched only at logging points
                                 log_loss_crossing(loss, 1)
-                                watchdog.beat()
+                                beat()
                                 health_check()
                                 # iteration-level triggers (MaxIteration,
                                 # SeveralIteration) fire mid-epoch
@@ -675,31 +719,114 @@ class Estimator:
                                 break
                 except (_UnrecoverableTraining, TrainingHalted):
                     # a watchdog halt is deliberate: retrying would
-                    # replay the same poisoned step
+                    # replay the same poisoned step.  Listed BEFORE the
+                    # policy engine so no classifier bug can ever
+                    # absorb them.
                     raise
-                except Exception:   # noqa: BLE001 — retry loop, ref :1179-1261
-                    now = time.perf_counter()
-                    if last_failure_time is None or \
-                            now - last_failure_time > retry_window:
-                        retries_left = retry_times   # time-windowed retry budget
-                    last_failure_time = now
-                    retries_left -= 1
-                    if retries_left < 0 or ckpt is None:
+                except Exception as exc:   # noqa: BLE001 — policy engine, ref :1179-1261
+                    decision = policy.decide(
+                        exc, have_checkpoint=ckpt is not None)
+                    met["failures"].labels(
+                        decision.failure_class.value).inc()
+                    if decision.action is RecoveryAction.RAISE:
+                        log.error(
+                            "training failure classified %s is not "
+                            "recoverable here: %s",
+                            decision.failure_class.value, decision.reason)
                         raise
-                    # counted only when the failure IS absorbed —
-                    # re-raised terminal failures are not "retries"
-                    met["retries"].inc()
-                    log.exception(
-                        "training step failed; restoring latest checkpoint "
-                        "(%d retries left)", retries_left)
+                    if decision.action is RecoveryAction.DEGRADE:
+                        met["recoveries"].labels("degrade").inc()
+                        self._raise_degraded(
+                            exc, decision, ckpt,
+                            train_set if is_pipeline else None)
+                    reformed = False
+                    if decision.action is RecoveryAction.REFORM_MESH:
+                        from analytics_zoo_tpu.resilience import (
+                            recovery as recovery_lib)
+                        try:
+                            with tracer.span("elastic_recovery",
+                                             iteration=ts.iteration):
+                                survivors = recovery_lib.surviving_devices(
+                                    exc)
+                                new_mesh = recovery_lib.reform_mesh(
+                                    survivors, batch_size=batch_size)
+                        except recovery_lib.NoViableTopology as nv:
+                            met["recoveries"].labels("degrade").inc()
+                            self._raise_degraded(
+                                exc, decision, ckpt,
+                                train_set if is_pipeline else None,
+                                detail=str(nv))
+                        log.exception(
+                            "lost-host failure at iteration %d; mesh "
+                            "re-formed on %d surviving device(s) — "
+                            "restoring the latest snapshot onto the "
+                            "new topology", ts.iteration,
+                            new_mesh.devices.size)
+                        # rebuild every mesh-bound engine artifact: the
+                        # old trainer's jitted programs, shardings and
+                        # placed batches all name dead devices
+                        trainer = DistributedTrainer(
+                            self.model, criterion,
+                            optim_method=self.optim_method,
+                            mesh=new_mesh, clip=self._clip,
+                            optim_groups=self.optim_groups)
+                        self._mesh = new_mesh
+                        self._placed_infer = None
+                        if is_pipeline:
+                            device_loader = DeviceLoader(
+                                train_set, put_fn=trainer.put_batch)
+                        if eval_runner is not None:
+                            eval_runner = trainer.make_eval_runner(
+                                validation_method)
+                        chunk_fns.clear()
+                        hbm_src = None
+                        eval_cache_holder[0] = None
+                        # detach the rng key from the lost topology
+                        rng = np.asarray(rng)  # zoolint: disable=SYNC002 — recovery path, not per-step
+                        reformed = True
+                        met["recoveries"].labels("reform_mesh").inc()
+                    else:   # RETRY — the reference's restore-and-replay
+                        # counted only when the failure IS absorbed —
+                        # re-raised terminal failures are not "retries"
+                        met["retries"].inc()
+                        met["recoveries"].labels("retry").inc()
+                        log.exception(
+                            "training step failed (%s); restoring "
+                            "latest checkpoint (%d retries left)",
+                            decision.failure_class.value,
+                            policy.budget.remaining)
                     restored = restore_snapshot(snapshot_like())
                     if restored is not None:
                         params = trainer.place_params(restored["params"])
                         state = trainer.replicate(restored["state"])
+                        if reformed:
+                            # the held opt_state leaves carry the OLD
+                            # mesh's shardings — re-derive them on the
+                            # new topology before placing the restored
+                            # host arrays
+                            opt_state = trainer.init_opt_state(params)
                         opt_state = trainer.place_like(restored["opt_state"], opt_state)
                         ts.epoch = int(restored["epoch"])
                         ts.iteration = int(restored["iteration"])
                         restore_data_state(restored)
+                    elif reformed:
+                        if ts.iteration != start_iteration:
+                            # steps committed on the lost topology and
+                            # no snapshot to recover them from
+                            raise _UnrecoverableTraining(
+                                f"mesh re-formed at iteration "
+                                f"{ts.iteration} but no snapshot exists "
+                                "to restore the training state lost "
+                                "with the old topology; set model_dir "
+                                "or checkpoint more often") from exc
+                        # nothing learned THIS call: rebuild from the
+                        # entry-time host copy and rewind the stream
+                        params = trainer.place_params(
+                            self.variables["params"])
+                        state = trainer.replicate(self.variables["state"])
+                        opt_state = trainer.init_opt_state(params)
+                        if is_pipeline and entry_data_state is not None:
+                            train_set.load_state_dict(entry_data_state)
                     continue
 
                 if loss is not None:
@@ -764,12 +891,64 @@ class Estimator:
         self.model.set_variables(self.variables)
         return self
 
+    # ----------------------------------------------------------- resilience
+    def _raise_degraded(self, exc, decision, ckpt,
+                        pipeline=None, detail: Optional[str] = None):
+        """Checkpoint-and-queue: end the run DEGRADED instead of
+        hanging or dying empty.  The structured record (the thing
+        bench/CI surface instead of an rc=124 timeout) points at the
+        last good snapshot + data position, so a later run — or a
+        queue consumer watching ``degraded.json`` — resumes exactly
+        where capacity ran out.  Never returns: raises
+        :class:`DegradedTraining` carrying the record."""
+        ts = self.train_state
+        snapshot = ckpt.latest_path() if ckpt is not None else None
+        result = {
+            "status": "degraded",
+            "failure_class": decision.failure_class.value,
+            "reason": detail or decision.reason,
+            "cause": f"{type(exc).__name__}: {exc}",
+            "epoch": ts.epoch,
+            "iteration": ts.iteration,
+            "checkpoint_dir": self.model_dir,
+            "snapshot": snapshot,
+            "data_position": (
+                {"epoch": pipeline.epoch, "step": pipeline.step}
+                if pipeline is not None else None),
+            "recorded_unix": round(time.time(), 1),
+        }
+        if self.model_dir:
+            try:
+                with open(os.path.join(self.model_dir,
+                                       "degraded.json"), "w") as f:
+                    json.dump(result, f, indent=2)
+            except OSError:
+                log.exception("could not write degraded.json")
+        try:
+            get_registry().counter(
+                "train_degraded_total",
+                "training runs that ended degraded "
+                "(checkpoint-and-queue)").inc()
+        except Exception:   # noqa: BLE001 — metrics never block the exit
+            pass
+        log.error("training DEGRADED (checkpoint-and-queue): %s", result)
+        raise DegradedTraining(
+            "no viable topology to continue training; run queued at "
+            f"snapshot {snapshot!r} — resume from model_dir "
+            f"{self.model_dir!r} when capacity returns", result=result
+        ) from exc
+
     # ------------------------------------------------------------ inference
     def _infer_trainer(self) -> DistributedTrainer:
         """Cached trainer for evaluate/predict so the jitted programs
-        compile once per Estimator, not once per call."""
-        if not hasattr(self, "_cached_infer_trainer"):
-            self._cached_infer_trainer = DistributedTrainer(self.model, None)
+        compile once per Estimator, not once per call.  Invalidated
+        when elastic recovery re-formed the mesh mid-train: the cached
+        programs would target lost devices."""
+        cached = getattr(self, "_cached_infer_trainer", None)
+        if cached is None or (self._mesh is not None
+                              and cached.mesh is not self._mesh):
+            self._cached_infer_trainer = DistributedTrainer(
+                self.model, None, mesh=self._mesh)
             self._cached_eval_runners = {}
         return self._cached_infer_trainer
 

@@ -1,0 +1,77 @@
+"""Elastic, preemption-tolerant training: fault injection, failure
+classification, recovery policy, and mesh re-formation.
+
+The reference's production claim is fault-tolerant synchronous SGD —
+failed tasks retried, training converges anyway (PAPERS.md arXiv
+1804.05839, 2204.01715).  This package rebuilds that property
+TPU-natively on the PRs 1-5 substrate:
+
+- :mod:`.chaos`    — deterministic, scriptable fault injection
+                     (kill/hang/slow a worker, poison state, drop a
+                     collective, lose a host) so every recovery path
+                     is testable on CPU in tier-1;
+- :mod:`.detector` — failure taxonomy (transient vs lost-host vs
+                     poisoned), worker exit-code classification, and
+                     run-dir heartbeats feeding
+                     ``cluster_hosts_missing``;
+- :mod:`.policy`   — the policy engine the Estimator's retry loop
+                     dispatches through (the reference's time-windowed
+                     retry budget is the TRANSIENT branch);
+- :mod:`.recovery` — mesh re-formation on the surviving topology and
+                     the no-viable-topology (degraded) exit.
+
+``chaos``/``detector``/``policy`` are importable without jax;
+``recovery`` touches devices and is imported lazily by its callers.
+"""
+
+from analytics_zoo_tpu.resilience.chaos import (
+    ChaosPlan,
+    FaultSpec,
+    InjectedFault,
+    LostHost,
+    PoisonedState,
+    TransientFault,
+    active_chaos,
+    clear_chaos,
+    install_chaos,
+)
+from analytics_zoo_tpu.resilience.detector import (
+    FailureClass,
+    HostHeartbeat,
+    classify_exit,
+    classify_failure,
+    is_preemption_like,
+)
+from analytics_zoo_tpu.resilience.policy import (
+    DEGRADED_EXIT_CODE,
+    DegradedTraining,
+    RecoveryAction,
+    RecoveryDecision,
+    RecoveryPolicy,
+    RetryBudget,
+    degraded_exit,
+)
+
+__all__ = [
+    "ChaosPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "LostHost",
+    "PoisonedState",
+    "TransientFault",
+    "active_chaos",
+    "clear_chaos",
+    "install_chaos",
+    "FailureClass",
+    "HostHeartbeat",
+    "classify_exit",
+    "classify_failure",
+    "is_preemption_like",
+    "DEGRADED_EXIT_CODE",
+    "DegradedTraining",
+    "RecoveryAction",
+    "RecoveryDecision",
+    "RecoveryPolicy",
+    "RetryBudget",
+    "degraded_exit",
+]

@@ -1,0 +1,114 @@
+"""Elastic recovery: re-form the device mesh on the surviving topology.
+
+The reference survived an executor loss because Spark re-ran the lost
+tasks elsewhere and ``AllReduceParameter`` re-partitioned over the
+remaining block managers (PAPERS.md arXiv 1804.05839).  The TPU-native
+equivalent: build a fresh :class:`jax.sharding.Mesh` over the devices
+still reachable, re-apply the sharding specs (a new
+``DistributedTrainer`` re-collects them against the new mesh), re-place
+params/optimizer state from the last host snapshot, and resume from
+the checkpointed PR 2 pipeline position — the Estimator drives those
+steps; this module owns the topology math:
+
+* :func:`surviving_devices` — the device set to rebuild on, from a
+  classified failure (chaos faults carry explicit survivor ids; real
+  failures fall back to what the backend still reports);
+* :func:`viable_data_degree` — graceful degradation: the largest
+  data-parallel degree the surviving devices support *that still tiles
+  the batch* (surplus survivors idle rather than blocking recovery);
+* :func:`reform_mesh` — the new mesh, also installed as the live
+  ``ZooContext`` mesh so later components (inference trainers, device
+  loaders) land on the surviving topology too.
+
+Raises :class:`NoViableTopology` when nothing survives — the policy
+engine turns that into the DEGRADE (checkpoint-and-queue) path.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Sequence
+
+from analytics_zoo_tpu.parallel import mesh as mesh_lib
+
+log = logging.getLogger("analytics_zoo_tpu.resilience")
+
+
+class NoViableTopology(RuntimeError):
+    """No surviving device set can run the job — degrade, don't hang."""
+
+
+def surviving_devices(exc: Optional[BaseException] = None
+                      ) -> List["jax.Device"]:   # noqa: F821
+    """Devices to rebuild on.  A chaos :class:`LostHost` names the
+    survivors by id; otherwise ask the backend what it still sees
+    (best effort — on a really dead slice even this raises, which the
+    caller's degrade path absorbs)."""
+    import jax
+    ids = getattr(exc, "survivors", None)
+    devices = list(jax.devices())
+    if ids is None:
+        return devices
+    keep = set(int(i) for i in ids)
+    return [d for d in devices if d.id in keep]
+
+
+def viable_data_degree(num_devices: int, batch_size: int) -> int:
+    """Largest data-parallel degree ``k <= num_devices`` with
+    ``batch_size % k == 0`` (0 when no device survives).  Using fewer
+    than all survivors is deliberate graceful degradation: a 6-device
+    remnant still trains a batch-32 job 4-wide instead of refusing."""
+    if num_devices <= 0 or batch_size <= 0:
+        return 0
+    for k in range(min(int(num_devices), int(batch_size)), 0, -1):
+        if batch_size % k == 0:
+            return k
+    return 0
+
+
+def reform_mesh(survivors: Sequence["jax.Device"],   # noqa: F821
+                batch_size: int):
+    """Build the post-failure mesh over ``survivors`` and install it
+    as the live context mesh.  Pure data parallelism on the remnant —
+    the failure already proved the fancy topology wrong; TP/pipeline
+    re-spec over a remnant is a (re-)design decision, not a recovery
+    one."""
+    import jax   # noqa: F401 — device objects
+    from analytics_zoo_tpu.common.zoo_context import get_zoo_context
+    survivors = list(survivors)
+    dp = viable_data_degree(len(survivors), batch_size)
+    if dp == 0:
+        raise NoViableTopology(
+            f"no viable topology: {len(survivors)} surviving "
+            f"device(s) for batch size {batch_size}")
+    if dp < len(survivors):
+        log.warning(
+            "degraded topology: using %d of %d surviving devices "
+            "(batch %d must tile the data axis)", dp, len(survivors),
+            batch_size)
+    new_mesh = mesh_lib.create_mesh({mesh_lib.DATA_AXIS: dp},
+                                    devices=survivors[:dp])
+    try:
+        ctx = get_zoo_context()
+        old = dict(ctx.mesh.shape)
+        ctx.mesh = new_mesh
+        log.warning("mesh re-formed: %s -> %s (%d devices lost)",
+                    old, dict(new_mesh.shape),
+                    len(ctx.devices) - len(survivors))
+    except Exception:   # noqa: BLE001 — context update is best-effort
+        log.exception("could not install the re-formed mesh on the "
+                      "zoo context; new trainers may still target the "
+                      "old topology")
+    _count_reformation()
+    return new_mesh
+
+
+def _count_reformation() -> None:
+    try:
+        from analytics_zoo_tpu.observability import get_registry
+        get_registry().counter(
+            "mesh_reformations_total",
+            "elastic recoveries that re-formed the device mesh on a "
+            "surviving topology").inc()
+    except Exception:   # noqa: BLE001 — metrics must never block recovery
+        pass

@@ -37,6 +37,10 @@ INPUT_STREAM = "serving_stream"
 RESULT_PREFIX = "result:"
 STOP_KEY = "zoo-serving-stop"   # cross-process stop signal
                                 # (ClusterServingManager.listenTermination)
+# results whose write was abandoned after the bounded backoff: the
+# request_id/uri land here so an operator (or a replaying client) can
+# find them — losing a result beats losing the worker loop
+DEAD_LETTER_STREAM = "serving_dead_letter"
 
 
 def decode_field(fields: Dict[str, bytes]):
@@ -76,6 +80,7 @@ class ServingConfig:
                  metrics_host: Optional[str] = None,
                  healthz_max_queue: Optional[int] = None,
                  healthz_max_error_rate: Optional[float] = None,
+                 result_write_retries: Optional[int] = None,
                  extra: Optional[Dict[str, str]] = None):
         self.redis_url = redis_url
         self.batch_size = int(batch_size)
@@ -115,6 +120,12 @@ class ServingConfig:
                 "serving.healthz_max_error_rate", 0.0)
         self.healthz_max_queue = int(healthz_max_queue or 0)
         self.healthz_max_error_rate = float(healthz_max_error_rate or 0.0)
+        # bounded result-write backpressure: attempts before a result
+        # is abandoned to the dead-letter stream (never < 1)
+        if result_write_retries is None:
+            result_write_retries = get_config().get(
+                "serving.result_write_retries", 8)
+        self.result_write_retries = max(int(result_write_retries), 1)
         # consumer_group set → multiple workers SHARE the stream, each
         # record served exactly once (the reference parallelizes per
         # Spark partition; redis-native scale-out uses XREADGROUP)
@@ -153,6 +164,8 @@ class ServingConfig:
                 cfg.get("params.healthz_max_queue") or 0) or None,
             healthz_max_error_rate=float(
                 cfg.get("params.healthz_max_error_rate") or 0.0) or None,
+            result_write_retries=int(
+                cfg.get("params.result_write_retries") or 0) or None,
             extra=cfg,
         )
 
@@ -199,6 +212,10 @@ class ClusterServing:
         self._m_redis_retry = reg.counter(
             "serving_redis_retry_total",
             "result-write attempts retried after a broker error")
+        self._m_write_abandoned = reg.counter(
+            "serving_result_write_abandoned_total",
+            "results abandoned (dead-lettered) after the bounded "
+            "write-backoff was exhausted")
         self._m_reclaimed = reg.counter(
             "serving_reclaimed_total",
             "stale pending records reclaimed from dead workers")
@@ -240,22 +257,55 @@ class ClusterServing:
         return real
 
     def _write_result(self, uri: str, value: str,
-                      retries: int = 100,
-                      request_id: Optional[str] = None) -> None:
-        # infinite-ish retry backpressure (:254-289); the request_id
-        # from the matching enqueue is echoed beside the result so a
-        # client can correlate response <-> request across processes
+                      retries: Optional[int] = None,
+                      request_id: Optional[str] = None) -> bool:
+        """Write one result with BOUNDED backpressure (ref :254-289
+        retried "infinite-ish" and then raised, killing the worker
+        loop with the rest of the batch un-acked): exponential backoff
+        with jitter between attempts (jitter de-synchronizes the
+        worker fleet hammering a recovering broker), then the record
+        is ABANDONED — counted, logged, and dead-lettered with its
+        request_id — so one unwritable result can never crash the
+        loop.  The request_id from the matching enqueue is echoed
+        beside the result so a client can correlate response <->
+        request across processes.  Returns True when the write
+        landed."""
         fields = {"value": value}
         if request_id:
             fields["request_id"] = request_id
-        for attempt in range(retries):
+        if retries is None:
+            retries = self.config.result_write_retries
+        attempts = max(int(retries), 1)
+        delay = 0.05
+        last_exc: Optional[Exception] = None
+        for attempt in range(attempts):
             try:
                 self.broker.hset(RESULT_PREFIX + uri, fields)
-                return
-            except Exception:
+                return True
+            except Exception as e:   # noqa: BLE001 — broker flake class
+                last_exc = e
                 self._m_redis_retry.inc()
-                time.sleep(min(0.1 * (attempt + 1), 2.0))
-        raise RuntimeError(f"could not write result for {uri}")
+                if attempt + 1 >= attempts:
+                    break
+                import random
+                time.sleep(delay * (0.5 + random.random()))
+                delay = min(delay * 2.0, 2.0)
+        self._m_write_abandoned.inc()
+        log.error("abandoning result write for %s after %d attempts "
+                  "(%s: %s); dead-lettering", uri, attempts,
+                  type(last_exc).__name__, last_exc)
+        try:
+            self.broker.xadd(DEAD_LETTER_STREAM, {
+                "uri": uri,
+                "request_id": request_id or "",
+                "error": f"{type(last_exc).__name__}: {last_exc}",
+                "abandoned_unix": f"{time.time():.3f}",
+            })
+        except Exception:   # noqa: BLE001 — the broker may be fully down
+            log.exception("dead-letter write failed for %s (broker "
+                          "down?); the request_id above is the only "
+                          "record", uri)
+        return False
 
     # -------------------------------------------------- pipelined serving
     def _read_entries(self, count: int, block_ms: int):
@@ -412,18 +462,33 @@ class ClusterServing:
         probs = exp / exp.sum(axis=-1, keepdims=True)
         top = np.argsort(-probs, axis=-1)[:, :self.config.top_n]
         done = time.perf_counter()
+        written = 0
         for uri, t, p, rid in zip(uris, top, probs, rids):
             value = json.dumps([[int(i), float(p[i])] for i in t])
-            self._write_result(uri, value, request_id=rid)
-            self.latencies.append(done - t_arrival)
-            self._m_latency.observe(done - t_arrival)
+            if self._write_result(uri, value, request_id=rid):
+                written += 1
+                self.latencies.append(done - t_arrival)
+                self._m_latency.observe(done - t_arrival)
+        abandoned = real - written
+        if abandoned:
+            # a dead-lettered result is a FAILURE to error accounting
+            # and the /healthz error-rate window — the old raise made
+            # that implicit; the bounded path must keep the readiness
+            # probe honest during a result-write outage (an orchestrator
+            # should pull a worker whose results never land)
+            self._m_errors.inc(abandoned)
+            with self._outcomes_lock:
+                self._recent_outcomes.extend([0] * abandoned)
+        # total_records counts records PROCESSED (drain/progress
+        # bookkeeping); the return value counts records actually
+        # DELIVERED — the outcome window gets its 1s from the caller
         self.total_records += real
         self._m_records.inc(real)
         if self.summary is not None:
             self.summary.add_scalar("Total Records Number",
                                     self.total_records,
                                     self.total_records)
-        return real
+        return written
 
     def readiness(self) -> Optional[Dict[str, Any]]:
         """The /healthz readiness probe (wired into the

@@ -76,6 +76,40 @@ def _heartbeat(msg):
           file=sys.stderr, flush=True)
 
 
+def _injected_probe_fault():
+    """Deterministic fault injection for the backend probe
+    (resilience/chaos.py, site ``bench.probe``): a scripted fault here
+    simulates chip contention so the degraded-result path is testable
+    in CI without a contended chip.  The chaos module is loaded BY
+    FILE PATH — its stdlib-only contract — because this supervisor
+    process must never import jax (the whole point of the subprocess
+    probe).  Returns the fault description, or None (no chaos)."""
+    try:
+        import importlib.util
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "analytics_zoo_tpu", "resilience", "chaos.py")
+        chaos = sys.modules.get("_zoo_chaos")
+        if chaos is None:
+            spec = importlib.util.spec_from_file_location(
+                "_zoo_chaos", path)
+            chaos = importlib.util.module_from_spec(spec)
+            # registered BEFORE exec: the @dataclass decorator looks
+            # the module up in sys.modules while the body executes
+            sys.modules["_zoo_chaos"] = chaos
+            spec.loader.exec_module(chaos)
+        plan = chaos.active_chaos()
+    except Exception:  # noqa: BLE001 — chaos must never break a real run
+        return None
+    if plan is None:
+        return None
+    try:
+        plan.trip(chaos.SITE_BENCH_PROBE, 0)
+    except Exception as e:  # noqa: BLE001 — the injected fault itself
+        return f"{type(e).__name__}: {e}"
+    return None
+
+
 def _probe_backend(budget_s: float = 1200.0, probe_timeout_s: float = 120.0):
     """Check the accelerator backend is usable BEFORE touching it in
     this process.
@@ -943,6 +977,16 @@ def main(argv=None):
     ap.add_argument("--probe-budget", type=float, default=1200.0)
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument("--run-timeout", type=float, default=900.0)
+    # graceful degradation (the r03/r04 failure mode): when the chip is
+    # contended/unreachable, up to this many workloads may end
+    # "degraded" — a structured partial result with provenance instead
+    # of an empty timeout — and the bench still exits 0, so CI treats
+    # a contended window as a degraded data point, not a failure.
+    ap.add_argument("--max-degraded", type=int, default=0,
+                    help="exit 0 when at most this many workloads end "
+                         "degraded (backend unreachable/contended); "
+                         "each emits a structured status=degraded "
+                         "line (default 0: degradation fails the run)")
     ap.add_argument("--child", action="store_true",
                     help="internal: execute the workload in-process")
     ap.add_argument("--fresh-artifact", action="store_true",
@@ -1005,15 +1049,33 @@ def main(argv=None):
     _heartbeat(f"{n_startup} cached artifact line(s) emitted; "
                "probing backend")
 
-    ok, err = _probe_backend(args.probe_budget, args.probe_timeout)
+    injected = _injected_probe_fault()
+    if injected is not None:
+        _heartbeat(f"chaos: injected probe fault ({injected})")
+        ok, err = False, f"injected chaos fault: {injected}"
+    else:
+        ok, err = _probe_backend(args.probe_budget, args.probe_timeout)
     results = []
     if not ok:
-        # per workload: a zero diagnostic line for the failure record,
+        # per workload: a STRUCTURED degraded diagnostic line (value 0,
+        # status=degraded — the r03/r04 fix: a contended chip leaves a
+        # machine-readable partial record, never an empty timeout),
         # then cached lines again so the TAIL the driver parses is a
-        # real (labeled-cached) number, resnet50 last.  A dead backend
-        # must still leave a complete, honest record.
+        # real (labeled-cached) number, resnet50 last.
         probe_fail = dict(error="backend probe failed within budget",
-                          error_tail=err)
+                          error_tail=err, status="degraded",
+                          degraded_reason="backend_unreachable")
+        # summary FIRST, before every workload line: whatever subset
+        # of diag/cached/fallback lines follows, the driver's tail
+        # parse always lands on a workload line, never on this
+        within_budget = len(names) <= args.max_degraded
+        _emit({"bench_status": "degraded",
+               "reason": "backend_unreachable",
+               "error_tail": (err or "")[-500:],
+               "workloads_degraded": sorted(names),
+               "cached_covered": sum(1 for n in names if n in cached),
+               "max_degraded": args.max_degraded,
+               "within_budget": within_budget})
         for name in sorted(names, key=lambda n: n == "resnet50"):
             results.append(dict(diag_for(name), **probe_fail))
             _emit(results[-1])
@@ -1027,9 +1089,10 @@ def main(argv=None):
         # about any workload, and zero entries / run meta would pile up
         # in the committed file every contended window (the driver's
         # BENCH_rNN.json captures this run's stdout regardless)
-        # rc=0 only when every requested workload was covered by a
-        # labeled cached number — partial coverage is still a failure
-        rc = 0 if n_cached == len(names) else 1
+        # rc=0 when every requested workload was covered by a labeled
+        # cached number, OR the degradation fits the --max-degraded
+        # budget — partial coverage with no budget is still a failure
+        rc = 0 if (n_cached == len(names) or within_budget) else 1
         if args.compare:
             rc = max(rc, _compare_against_baseline(
                 args.compare, args.compare_threshold))
@@ -1045,7 +1108,8 @@ def main(argv=None):
         if backend_down:
             result = dict(diag_for(name),
                           error="backend down (confirmed by re-probe)",
-                          error_tail=err)
+                          error_tail=err, status="degraded",
+                          degraded_reason="backend_unreachable")
             results.append(result)
             _emit(result)
             _emit_cached([name], cached, live_error="backend down")
@@ -1071,7 +1135,8 @@ def main(argv=None):
                     result = dict(diag_for(name),
                                   error="workload hung and backend "
                                         "unreachable on re-probe",
-                                  error_tail=err)
+                                  error_tail=err, status="degraded",
+                                  degraded_reason="backend_unreachable")
                     results.append(result)
                     _emit(result)
                     _write_artifact(results, meta)
@@ -1100,6 +1165,37 @@ def main(argv=None):
                          live_error=str(result.get("error"))[:200])
         _write_artifact(results, meta)
         rc = rc or (1 if result.get("error") else 0)
+    # graceful degradation verdict: when EVERY live failure was a
+    # chip-contention class (status=degraded) and they fit the
+    # --max-degraded budget, the run is a structured partial result,
+    # not a failure (a workload that crashed on its own bug still
+    # fails the run regardless of budget).  Emitted BEFORE the tail
+    # re-emission so the driver's tail parse still sees a workload
+    # line last.
+    errored = [r for r in results if r.get("error")]
+    degraded = sorted({r["workload"] for r in results
+                       if r.get("status") == "degraded"})
+    if rc and errored and degraded:
+        within = (len(degraded) <= args.max_degraded
+                  and all(r.get("status") == "degraded"
+                          for r in errored))
+        _emit({"bench_status": "degraded",
+               "workloads_degraded": degraded,
+               "max_degraded": args.max_degraded,
+               "within_budget": within})
+        if within:
+            rc = 0
+        if args.workload != "all":
+            # single-workload runs skip the resnet50 tail re-emission
+            # below, so re-emit a workload line here — the summary
+            # must never be the line the driver's tail parse lands on
+            if not _emit_cached([args.workload], cached,
+                                live_error="degraded"):
+                last = next((r for r in reversed(results)
+                             if r.get("workload") == args.workload),
+                            None)
+                if last is not None:
+                    _emit(last)
     if args.workload == "all" and len(results) > 1:
         # tail line = the north-star resnet50: fresh if this run
         # produced one, else the cached record, else its (error)

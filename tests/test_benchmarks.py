@@ -327,3 +327,60 @@ def test_artifact_merge_tolerates_corrupt_prior(tmp_path, monkeypatch):
     assert len(d["results"]) == 1
     assert d["results"][0]["metric"] == "a"
     assert d["results"][0]["value"] == 1
+
+
+def test_live_degraded_within_budget_exits_zero_with_workload_tail(
+        tmp_path, monkeypatch):
+    """Probe OK but the workload hangs and the backend dies (the
+    r03/r04 mid-run contention shape): with --max-degraded the run
+    exits 0 with a structured status=degraded line + bench_status
+    summary — and the tail stdout line is still a WORKLOAD line (the
+    driver tail-parse contract), not the summary object."""
+    monkeypatch.setattr(bench, "ARTIFACT_PATH",
+                        str(tmp_path / "art.json"))
+    monkeypatch.setattr(bench, "METRICS_SNAPSHOT_PATH",
+                        str(tmp_path / "met.json"))
+    probes = iter([(True, None), (False, "still contended")])
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda *a, **k: next(probes))
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda name, t: (None, "workload timed out after 1s"))
+    rc, lines = _run_main(monkeypatch, ["--workload", "ncf",
+                                        "--max-degraded", "1"])
+    assert rc == 0
+    deg = [l for l in lines if l.get("status") == "degraded"
+           and l.get("workload") == "ncf"]
+    assert deg and deg[0]["degraded_reason"] == "backend_unreachable"
+    (summary,) = [l for l in lines
+                  if l.get("bench_status") == "degraded"]
+    assert summary["within_budget"] is True
+    # the tail line stays a workload record
+    assert lines[-1].get("workload") == "ncf"
+    assert "bench_status" not in lines[-1]
+    # without the budget the same run fails
+    probes = iter([(True, None), (False, "still contended")])
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda *a, **k: next(probes))
+    rc2, lines2 = _run_main(monkeypatch, ["--workload", "ncf"])
+    assert rc2 == 1
+    assert lines2[-1].get("workload") == "ncf"
+
+
+def test_probe_degraded_no_cache_tail_is_workload_line(
+        tmp_path, monkeypatch):
+    """Probe-failure degradation with an EMPTY cache and a
+    non-north-star workload: the bench_status summary must not be the
+    tail stdout line (the driver tail-parses the last line as a
+    workload record)."""
+    monkeypatch.setattr(bench, "ARTIFACT_PATH",
+                        str(tmp_path / "missing.json"))
+    monkeypatch.setattr(bench, "_probe_backend",
+                        lambda *a, **k: (False, "contended"))
+    rc, lines = _run_main(monkeypatch, ["--workload", "ncf",
+                                        "--max-degraded", "1"])
+    assert rc == 0
+    assert any(ln.get("bench_status") == "degraded" for ln in lines)
+    assert lines[-1].get("workload") == "ncf"
+    assert lines[-1]["value"] == 0
+    assert "bench_status" not in lines[-1]

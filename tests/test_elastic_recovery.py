@@ -1,0 +1,319 @@
+"""Estimator-level fault-injection acceptance: the retry loop as a
+policy engine over classified failures.
+
+The headline test proves the ISSUE-6 acceptance criterion end to end:
+a worker killed mid-epoch (scripted LostHost fault in the trainer
+dispatch path) makes the surviving devices re-form the mesh, restore
+the last snapshot, resume from the checkpointed PR 2 pipeline
+position, and finish with params BIT-IDENTICAL to an uninterrupted
+run over the same global batch order and mesh history (restore point
+onward on the surviving topology) — only possible if recovery skips
+and replays nothing.  The degraded test proves the other half: a
+no-viable-topology event ends in a structured checkpoint-and-queue
+record, not a hang."""
+
+import json
+import os
+import shutil
+
+import jax
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.common.triggers import (
+    MaxEpoch, MaxIteration, SeveralIteration)
+from analytics_zoo_tpu.data import DataPipeline, DeviceLoader
+from analytics_zoo_tpu.feature.feature_set import FeatureSet
+from analytics_zoo_tpu.observability import get_registry
+from analytics_zoo_tpu.observability.watchdog import TrainingHalted
+from analytics_zoo_tpu.parallel.mesh import create_mesh
+from analytics_zoo_tpu.pipeline.api.keras.optimizers import SGD
+from analytics_zoo_tpu.pipeline.estimator import Estimator
+from analytics_zoo_tpu.pipeline.estimator.estimator import (
+    _UnrecoverableTraining)
+from analytics_zoo_tpu.resilience import (
+    ChaosPlan, DegradedTraining, FaultSpec, PoisonedState, clear_chaos,
+    install_chaos)
+from analytics_zoo_tpu.resilience.chaos import (
+    SITE_DATA_BATCH, SITE_TRAINER_DISPATCH)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    clear_chaos()
+    yield
+    clear_chaos()
+
+
+def _problem(n=256):
+    rs = np.random.RandomState(3)
+    x = rs.randn(n, 8).astype(np.float32)
+    w = rs.randn(8, 1).astype(np.float32)
+    return x, (x @ w).astype(np.float32)
+
+
+def _model():
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.engine import Layer
+    from analytics_zoo_tpu.pipeline.api.keras.layers import (
+        Dense, Dropout)
+    Layer.reset_name_counters()
+    m = Sequential()
+    m.add(Dense(16, activation="relu", input_shape=(8,)))
+    m.add(Dropout(0.25))    # consumes rng every step: any data/rng
+    m.add(Dense(1))         # drift after recovery shows immediately
+    return m
+
+
+def _pipe(x, y):
+    return DataPipeline(x, y, batch_size=32, seed=11, name="elastic")
+
+
+def _counter(name, *labels):
+    c = get_registry().counter(
+        name, "", labels=("class",) if name == "train_failures_total"
+        else (("action",) if name == "train_recovery_total" else ()))
+    return c.labels(*labels) if labels else c
+
+
+def _assert_trees_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x1, x2 in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+
+
+class TestElasticRecovery:
+    def test_lost_host_reforms_mesh_and_resumes_bit_exact(
+            self, tmp_path):
+        """Worker killed mid-epoch -> mesh re-formed on the 4
+        surviving devices -> resume from snapshot + pipeline position
+        -> final params bit-identical to an uninterrupted control run
+        with the same global batch order and mesh history."""
+        devices = jax.devices()
+        assert len(devices) == 8
+        survivor_ids = [d.id for d in devices[:4]]
+        x, y = _problem()
+
+        # --- run A: fault at dispatch step 6, snapshot@4 on disk ----
+        d1 = str(tmp_path / "elastic")
+        before = {
+            "lost": _counter("train_failures_total", "lost_host").value,
+            "reform": _counter("train_recovery_total",
+                               "reform_mesh").value,
+            "mesh": _counter("mesh_reformations_total").value,
+        }
+        install_chaos(ChaosPlan([FaultSpec(
+            site=SITE_TRAINER_DISPATCH, at_step=6, kind="lose_host",
+            survivors=survivor_ids)]))
+        est = Estimator(_model(), optim_method=SGD(learning_rate=0.05),
+                        model_dir=d1)
+        pipe = _pipe(x, y)
+        est.train(pipe, "mse", end_trigger=MaxEpoch(2),
+                  checkpoint_trigger=SeveralIteration(4))
+        clear_chaos()
+
+        assert est.train_state.iteration == 16      # 2 epochs x 8 steps
+        assert (pipe.epoch, pipe.step) == (2, 0)
+        assert _counter("train_failures_total", "lost_host").value \
+            == before["lost"] + 1
+        assert _counter("train_recovery_total", "reform_mesh").value \
+            == before["reform"] + 1
+        assert _counter("mesh_reformations_total").value \
+            == before["mesh"] + 1
+        # the estimator now lives on the surviving topology
+        assert est._mesh is not None
+        assert est._mesh.devices.size == 4
+        assert not os.path.exists(os.path.join(d1, "degraded.json"))
+
+        # --- control: same batch order + mesh history, no failure ---
+        # The fault run's snapshot@4 was written pre-fault by the
+        # vanilla checkpoint path; the control resumes from a COPY of
+        # exactly that snapshot on the surviving mesh and trains
+        # uninterrupted.  Identical state + identical batches 4..15 on
+        # an identical 4-device mesh => bitwise-identical params, or
+        # recovery skipped/replayed/corrupted something.
+        d2 = str(tmp_path / "control")
+        os.makedirs(d2)
+        shutil.copy(os.path.join(d1, "snapshot.4.ckpt"), d2)
+        mesh4 = create_mesh({"data": 4}, devices=devices[:4])
+        ctl = Estimator(_model(), optim_method=SGD(learning_rate=0.05),
+                        model_dir=d2, mesh=mesh4)
+        ctl.train(_pipe(x, y), "mse", end_trigger=MaxEpoch(2),
+                  checkpoint_trigger=SeveralIteration(4))
+        assert ctl.train_state.iteration == 16
+
+        _assert_trees_equal(est.variables["params"],
+                            ctl.variables["params"])
+        _assert_trees_equal(est.variables["state"],
+                            ctl.variables["state"])
+
+    def test_no_viable_topology_degrades_with_structured_result(
+            self, tmp_path):
+        """Everything lost -> checkpoint-and-queue: DegradedTraining
+        carrying a structured record that points at the last good
+        snapshot + data position, mirrored to degraded.json (the
+        bench/CI handle for the r03/r04 empty-timeout failure mode)."""
+        x, y = _problem()
+        d = str(tmp_path / "run")
+        degraded0 = get_registry().counter(
+            "train_degraded_total", "").value
+        install_chaos(ChaosPlan([FaultSpec(
+            site=SITE_TRAINER_DISPATCH, at_step=5, kind="lose_host",
+            survivors=[])]))
+        est = Estimator(_model(), optim_method=SGD(learning_rate=0.05),
+                        model_dir=d)
+        with pytest.raises(DegradedTraining) as ei:
+            est.train(_pipe(x, y), "mse", end_trigger=MaxEpoch(2),
+                      checkpoint_trigger=SeveralIteration(2))
+        r = ei.value.result
+        assert r["status"] == "degraded"
+        assert r["failure_class"] == "lost_host"
+        assert "no viable topology" in r["reason"]
+        assert r["iteration"] == 5
+        assert r["snapshot"].endswith("snapshot.4.ckpt")
+        assert r["data_position"]["epoch"] == 0
+        on_disk = json.load(open(os.path.join(d, "degraded.json")))
+        assert on_disk == r
+        assert get_registry().counter(
+            "train_degraded_total", "").value == degraded0 + 1
+        # the queue point is real: a later run resumes from it
+        resumed = Estimator(_model(),
+                            optim_method=SGD(learning_rate=0.05),
+                            model_dir=d)
+        resumed.train(_pipe(x, y), "mse", end_trigger=MaxIteration(6))
+        assert resumed.train_state.iteration == 6
+
+    def test_transient_fault_absorbed_and_bit_exact(self, tmp_path):
+        """A classified-transient injected fault rides the reference's
+        restore-and-replay path; the recovered run's params match a
+        fault-free run bitwise (same mesh throughout)."""
+        x, y = _problem()
+        before_t = _counter("train_failures_total", "transient").value
+        before_r = _counter("train_retry_total").value
+
+        ref = Estimator(_model(), optim_method=SGD(learning_rate=0.05))
+        ref.train(_pipe(x, y), "mse", end_trigger=MaxEpoch(1))
+
+        install_chaos(ChaosPlan([FaultSpec(
+            site=SITE_TRAINER_DISPATCH, at_step=3, kind="raise")]))
+        est = Estimator(_model(), optim_method=SGD(learning_rate=0.05),
+                        model_dir=str(tmp_path))
+        est.train(_pipe(x, y), "mse", end_trigger=MaxEpoch(1),
+                  checkpoint_trigger=SeveralIteration(1))
+        assert _counter("train_failures_total", "transient").value \
+            == before_t + 1
+        assert _counter("train_retry_total").value == before_r + 1
+        _assert_trees_equal(ref.variables["params"],
+                            est.variables["params"])
+
+    def test_poisoned_state_never_retried(self, tmp_path):
+        x, y = _problem()
+        before_r = _counter("train_retry_total").value
+        before_p = _counter("train_failures_total",
+                            "poisoned_state").value
+        install_chaos(ChaosPlan([FaultSpec(
+            site=SITE_TRAINER_DISPATCH, at_step=2, kind="poison")]))
+        est = Estimator(_model(), optim_method=SGD(learning_rate=0.05),
+                        model_dir=str(tmp_path))
+        with pytest.raises(PoisonedState):
+            est.train(_pipe(x, y), "mse", end_trigger=MaxEpoch(1),
+                      checkpoint_trigger=SeveralIteration(1))
+        assert _counter("train_retry_total").value == before_r
+        assert _counter("train_failures_total",
+                        "poisoned_state").value == before_p + 1
+
+    def test_device_loader_injection_site(self):
+        x, y = _problem(64)
+        install_chaos(ChaosPlan([FaultSpec(
+            site=SITE_DATA_BATCH, at_step=1, kind="raise")]))
+        loader = DeviceLoader(_pipe(x, y), depth=0)
+        from analytics_zoo_tpu.resilience import TransientFault
+        it = loader.epoch()
+        next(it)
+        with pytest.raises(TransientFault):
+            next(it)
+
+
+class TestRetryBudgetEdgeCases:
+    """The previously-untested satellite: the time-windowed retry
+    budget in Estimator.train (train.retry_times /
+    train.retry_interval_s).  Window-boundary refill is unit-tested
+    with an injectable clock in test_resilience.py (the Estimator uses
+    the same RetryBudget object); here the estimator-level contracts:
+    exhaustion raising and the never-absorbed exception types."""
+
+    def _data_model(self):
+        x, y = _problem(128)
+        return FeatureSet.from_ndarrays(x, y), _model()
+
+    def test_budget_exhaustion_raises_original_error(self, tmp_path):
+        from analytics_zoo_tpu.common.config import get_config
+        get_config().set("train.retry_times", 1)
+
+        class FailsTwice(FeatureSet):
+            fails = [2]
+
+            def epoch_batches(self, epoch, batch_size, train=True):
+                if train and epoch >= 1 and self.fails[0] > 0:
+                    self.fails[0] -= 1
+                    raise RuntimeError("synthetic repeated failure")
+                return super().epoch_batches(epoch, batch_size,
+                                             train=train)
+
+        x, y = _problem(128)
+        before = _counter("train_retry_total").value
+        est = Estimator(_model(), optim_method=SGD(learning_rate=0.05),
+                        model_dir=str(tmp_path))
+        # failure 1 absorbed (budget 1); failure 2 in the same window
+        # exhausts the budget and re-raises the original error
+        with pytest.raises(RuntimeError,
+                           match="synthetic repeated failure"):
+            est.train(FailsTwice.from_ndarrays(x, y), "mse",
+                      end_trigger=MaxEpoch(3), batch_size=32)
+        assert _counter("train_retry_total").value == before + 1
+
+    @pytest.mark.parametrize("exc_factory", [
+        lambda: TrainingHalted("watchdog said stop"),
+        lambda: _UnrecoverableTraining("state donated and gone"),
+    ])
+    def test_halt_types_never_absorbed(self, tmp_path, exc_factory):
+        """TrainingHalted/_UnrecoverableTraining must surface even
+        with checkpoints on disk and a full retry budget — absorbing
+        them would replay poisoned state or spin on lost state."""
+        exc = exc_factory()
+
+        class RaisesOnce(FeatureSet):
+            armed = [True]
+
+            def epoch_batches(self, epoch, batch_size, train=True):
+                if train and epoch >= 1 and self.armed[0]:
+                    self.armed[0] = False
+                    raise exc
+                return super().epoch_batches(epoch, batch_size,
+                                             train=train)
+
+        x, y = _problem(128)
+        before = _counter("train_retry_total").value
+        est = Estimator(_model(), optim_method=SGD(learning_rate=0.05),
+                        model_dir=str(tmp_path))
+        with pytest.raises(type(exc)):
+            est.train(RaisesOnce.from_ndarrays(x, y), "mse",
+                      end_trigger=MaxEpoch(3), batch_size=32)
+        assert _counter("train_retry_total").value == before
+
+
+class TestHeartbeatWiring:
+    def test_training_writes_heartbeat_under_run_dir(
+            self, tmp_path, monkeypatch):
+        from analytics_zoo_tpu.resilience.detector import (
+            read_heartbeats)
+        slot = tmp_path / "host-0"
+        monkeypatch.setenv("ZOO_TPU_METRICS_DIR", str(slot))
+        x, y = _problem(64)
+        est = Estimator(_model(), optim_method=SGD(learning_rate=0.05))
+        est.train(_pipe(x, y), "mse", end_trigger=MaxIteration(2))
+        beats = read_heartbeats(str(tmp_path))
+        assert 0 in beats
+        assert beats[0]["pid"] == os.getpid()

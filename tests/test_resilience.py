@@ -1,0 +1,658 @@
+"""Resilience-layer unit tests: deterministic chaos injection, failure
+classification, the time-windowed retry budget, the recovery policy
+engine, topology math, launcher death forensics, and serving
+result-write backpressure.
+
+Estimator-level fault-injection acceptance (mesh re-formation,
+bit-exact elastic resume, degraded exit) lives in
+tests/test_elastic_recovery.py; together the two files are the CI
+``chaos`` shard (dev/run-tests chaos)."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.resilience import chaos as chaos_lib
+from analytics_zoo_tpu.resilience.chaos import (
+    ChaosPlan, FaultSpec, LostHost, PoisonedState, TransientFault,
+    active_chaos, clear_chaos, install_chaos)
+from analytics_zoo_tpu.resilience.detector import (
+    FailureClass, HostHeartbeat, classify_exit, classify_failure,
+    is_preemption_like, read_heartbeats, stale_hosts)
+from analytics_zoo_tpu.resilience.policy import (
+    RecoveryAction, RecoveryPolicy, RetryBudget)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    clear_chaos()
+    yield
+    clear_chaos()
+
+
+# ------------------------------------------------------------- chaos
+class TestChaosPlan:
+    def test_raising_kinds(self):
+        for kind, exc_type in (("raise", TransientFault),
+                               ("poison", PoisonedState),
+                               ("lose_host", LostHost)):
+            plan = ChaosPlan([FaultSpec(site="s", at_step=2, kind=kind)])
+            plan.trip("s", 0)
+            plan.trip("s", 1)
+            with pytest.raises(exc_type):
+                plan.trip("s", 2)
+
+    def test_fires_once_then_disarmed(self):
+        """A recovery that restarts a step counter must not re-trip
+        the same fault (that would livelock the retry machinery)."""
+        plan = ChaosPlan([FaultSpec(site="s", at_step=3)])
+        with pytest.raises(TransientFault):
+            plan.trip("s", 3)
+        # replay from 0 passes step 3 cleanly this time
+        for step in range(10):
+            plan.trip("s", step)
+
+    def test_times_fires_consecutive_steps(self):
+        plan = ChaosPlan([FaultSpec(site="s", at_step=1, times=2)])
+        plan.trip("s", 0)
+        with pytest.raises(TransientFault):
+            plan.trip("s", 1)
+        with pytest.raises(TransientFault):
+            plan.trip("s", 2)
+        plan.trip("s", 3)
+
+    def test_site_and_process_filtering(self, monkeypatch):
+        plan = ChaosPlan([FaultSpec(site="a", at_step=0,
+                                    process_index=1)])
+        plan.trip("b", 0)                       # other site: no fire
+        monkeypatch.setenv("ZOO_TPU_PROCESS_ID", "0")
+        plan.trip("a", 0)                       # other process: no fire
+        monkeypatch.setenv("ZOO_TPU_PROCESS_ID", "1")
+        with pytest.raises(TransientFault):
+            plan.trip("a", 0)
+
+    def test_slow_kind_delays_not_raises(self):
+        plan = ChaosPlan([FaultSpec(site="s", at_step=0, kind="slow",
+                                    sleep_s=0.05)])
+        t0 = time.perf_counter()
+        plan.trip("s", 0)
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_lose_host_carries_survivors(self):
+        plan = ChaosPlan([FaultSpec(site="s", at_step=0,
+                                    kind="lose_host",
+                                    survivors=[0, 1, 2])])
+        with pytest.raises(LostHost) as ei:
+            plan.trip("s", 0)
+        assert ei.value.survivors == [0, 1, 2]
+
+    def test_env_round_trip(self, monkeypatch):
+        plan = ChaosPlan([FaultSpec(site="worker.step", at_step=4,
+                                    kind="kill", exit_code=137,
+                                    process_index=0)])
+        monkeypatch.setenv(chaos_lib.ENV_CHAOS, plan.to_json())
+        clear_chaos()                # force the env re-read
+        loaded = active_chaos()
+        assert loaded is not None
+        (f,) = loaded.faults
+        assert (f.site, f.at_step, f.kind, f.exit_code,
+                f.process_index) == ("worker.step", 4, "kill", 137, 0)
+
+    def test_unparseable_env_is_ignored(self, monkeypatch):
+        monkeypatch.setenv(chaos_lib.ENV_CHAOS, "{not json")
+        clear_chaos()
+        assert active_chaos() is None
+
+    def test_install_overrides_env(self, monkeypatch):
+        monkeypatch.setenv(chaos_lib.ENV_CHAOS,
+                           ChaosPlan([FaultSpec("s", 0)]).to_json())
+        clear_chaos()
+        install_chaos(None)
+        assert active_chaos() is None
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultSpec(site="s", at_step=0, kind="meteor")
+
+
+# ---------------------------------------------------- classification
+class TestFailureClassification:
+    @pytest.mark.parametrize("exc,expected", [
+        (TransientFault("x"), FailureClass.TRANSIENT),
+        (LostHost("x"), FailureClass.LOST_HOST),
+        (PoisonedState("x"), FailureClass.POISONED_STATE),
+        (RuntimeError("DEADLINE_EXCEEDED: rpc to worker timed out "
+                      "after 60s"), FailureClass.TRANSIENT),
+        (OSError("Connection reset by peer"), FailureClass.TRANSIENT),
+        (RuntimeError("RESOURCE_EXHAUSTED: out of memory while "
+                      "allocating"), FailureClass.TRANSIENT),
+        (RuntimeError("coordination service: process 3 disconnected"),
+         FailureClass.LOST_HOST),
+        (RuntimeError("host tpu-worker-2 unreachable: deadline "
+                      "exceeded"), FailureClass.LOST_HOST),
+        (RuntimeError("worker preempted by scheduler"),
+         FailureClass.LOST_HOST),
+        (RuntimeError("heartbeat missed for 30s"),
+         FailureClass.LOST_HOST),
+        (FloatingPointError("loss became NaN at step 12"),
+         FailureClass.POISONED_STATE),
+        (ValueError("shapes (3,4) and (5,6) not aligned"),
+         FailureClass.UNKNOWN),
+    ])
+    def test_table(self, exc, expected):
+        assert classify_failure(exc) is expected
+
+    def test_lost_host_outranks_transient(self):
+        # a dead host's symptom usually INCLUDES a timeout; retrying
+        # onto the dead topology would hang, so lost_host must win
+        exc = RuntimeError("worker 5 unreachable (connection reset)")
+        assert classify_failure(exc) is FailureClass.LOST_HOST
+
+    def test_watchdog_types_unrecoverable_by_name(self):
+        from analytics_zoo_tpu.observability.watchdog import (
+            TrainingHalted)
+        from analytics_zoo_tpu.pipeline.estimator.estimator import (
+            _UnrecoverableTraining)
+        assert classify_failure(TrainingHalted("halt")) is \
+            FailureClass.UNRECOVERABLE
+        assert classify_failure(_UnrecoverableTraining("gone")) is \
+            FailureClass.UNRECOVERABLE
+
+    def test_exit_codes(self):
+        assert classify_exit(None) == "running"
+        assert classify_exit(0) == "ok"
+        assert classify_exit(3) == "error(3)"
+        assert classify_exit(-9) == "signal(SIGKILL)"
+        assert classify_exit(137) == "signal(SIGKILL)"   # 128+9
+        assert classify_exit(143) == "signal(SIGTERM)"
+        assert is_preemption_like(classify_exit(137))
+        assert is_preemption_like(classify_exit(-15))
+        assert not is_preemption_like(classify_exit(3))
+        assert not is_preemption_like(classify_exit(0))
+
+
+# -------------------------------------------------------- heartbeats
+class TestHeartbeats:
+    def test_beat_is_throttled_by_interval(self, tmp_path):
+        clk = [0.0]
+        hb = HostHeartbeat(str(tmp_path / "host-0"), interval_s=5.0,
+                           clock=lambda: clk[0])
+        assert hb.beat(step=1) is True
+        assert hb.beat(step=2) is False           # within interval
+        clk[0] = 6.0
+        assert hb.beat(step=3) is True
+        assert hb.beat(step=4, force=True) is True
+
+    def test_read_and_stale(self, tmp_path):
+        run = tmp_path / "run"
+        hb = HostHeartbeat(str(run / "host-0"), interval_s=0.0)
+        hb.beat(step=7)
+        beats = read_heartbeats(str(run))
+        assert beats[0]["step"] == 7
+        assert beats[0]["pid"] == os.getpid()
+        now = beats[0]["time"]
+        # fresh within timeout; host 1 never beat at all
+        assert stale_hosts(str(run), 30.0, expected=2, now=now) == [1]
+        # everyone stale far in the future
+        assert stale_hosts(str(run), 30.0, expected=2,
+                           now=now + 100.0) == [0, 1]
+        # without `expected`, only known slots are judged
+        assert stale_hosts(str(run), 30.0, now=now) == []
+
+
+# ------------------------------------------------------ retry budget
+class TestRetryBudget:
+    def test_consume_and_exhaust(self):
+        clk = [0.0]
+        b = RetryBudget(2, 10.0, clock=lambda: clk[0])
+        assert b.consume() is True
+        assert b.consume() is True
+        assert b.consume() is False          # 3rd failure in window
+
+    def test_refills_past_window_boundary(self):
+        clk = [0.0]
+        b = RetryBudget(1, 10.0, clock=lambda: clk[0])
+        assert b.consume() is True
+        clk[0] = 10.0                         # exactly the boundary:
+        assert b.consume() is False           # NOT yet refilled (>)
+        clk[0] = 20.1                         # past the boundary
+        assert b.consume() is True
+
+    def test_window_measures_between_failures(self):
+        # parity with the reference: the interval is since the LAST
+        # failure, not since the refill — a slow drip of failures
+        # (one per window) never exhausts the budget
+        clk = [0.0]
+        b = RetryBudget(1, 10.0, clock=lambda: clk[0])
+        for t in (0.0, 11.0, 22.0, 33.0):
+            clk[0] = t
+            assert b.consume() is True
+
+
+# ----------------------------------------------------- policy engine
+class TestRecoveryPolicy:
+    def _policy(self, retries=3, elastic=True, max_reformations=2):
+        return RecoveryPolicy(RetryBudget(retries, 100.0),
+                              elastic=elastic,
+                              max_reformations=max_reformations)
+
+    def test_poisoned_always_raises(self):
+        d = self._policy().decide(PoisonedState("nan"),
+                                  have_checkpoint=True)
+        assert d.action is RecoveryAction.RAISE
+        assert d.failure_class is FailureClass.POISONED_STATE
+
+    def test_unrecoverable_always_raises(self):
+        from analytics_zoo_tpu.observability.watchdog import (
+            TrainingHalted)
+        d = self._policy().decide(TrainingHalted("halt"),
+                                  have_checkpoint=True)
+        assert d.action is RecoveryAction.RAISE
+
+    def test_lost_host_reforms_then_degrades(self):
+        p = self._policy(max_reformations=1)
+        d1 = p.decide(LostHost("gone"), have_checkpoint=True)
+        assert d1.action is RecoveryAction.REFORM_MESH
+        d2 = p.decide(LostHost("gone again"), have_checkpoint=True)
+        assert d2.action is RecoveryAction.DEGRADE
+
+    def test_lost_host_without_elastic_uses_retry_budget(self):
+        p = self._policy(retries=1, elastic=False)
+        d1 = p.decide(LostHost("gone"), have_checkpoint=True)
+        assert d1.action is RecoveryAction.RETRY
+        d2 = p.decide(LostHost("gone"), have_checkpoint=True)
+        assert d2.action is RecoveryAction.RAISE
+
+    def test_transient_needs_checkpoint(self):
+        d = self._policy().decide(TransientFault("flake"),
+                                  have_checkpoint=False)
+        assert d.action is RecoveryAction.RAISE
+        assert "model_dir" in d.reason
+
+    def test_transient_budget_exhaustion(self):
+        p = self._policy(retries=1)
+        assert p.decide(TransientFault("a"), True).action \
+            is RecoveryAction.RETRY
+        d = p.decide(TransientFault("b"), True)
+        assert d.action is RecoveryAction.RAISE
+        assert "exhausted" in d.reason
+
+    def test_unknown_treated_like_transient(self):
+        d = self._policy().decide(ValueError("???"),
+                                  have_checkpoint=True)
+        assert d.action is RecoveryAction.RETRY
+        assert d.failure_class is FailureClass.UNKNOWN
+
+
+# ----------------------------------------------------- topology math
+class TestTopology:
+    def test_viable_data_degree(self):
+        from analytics_zoo_tpu.resilience.recovery import (
+            viable_data_degree)
+        assert viable_data_degree(8, 32) == 8
+        assert viable_data_degree(6, 32) == 4    # idle 2 survivors
+        assert viable_data_degree(3, 32) == 2
+        assert viable_data_degree(1, 32) == 1
+        assert viable_data_degree(0, 32) == 0
+        assert viable_data_degree(8, 0) == 0
+        assert viable_data_degree(16, 6) == 6    # capped by batch
+
+    def test_surviving_devices_filters_by_id(self):
+        import jax
+
+        from analytics_zoo_tpu.resilience.recovery import (
+            surviving_devices)
+        ids = [d.id for d in jax.devices()[:3]]
+        got = surviving_devices(LostHost("x", survivors=ids))
+        assert [d.id for d in got] == ids
+        # no explicit survivors: ask the backend
+        assert len(surviving_devices(LostHost("x"))) == \
+            len(jax.devices())
+
+    def test_reform_mesh_and_no_viable(self):
+        import jax
+
+        from analytics_zoo_tpu.common.zoo_context import get_zoo_context
+        from analytics_zoo_tpu.observability import get_registry
+        from analytics_zoo_tpu.resilience.recovery import (
+            NoViableTopology, reform_mesh)
+        before = get_registry().counter(
+            "mesh_reformations_total", "").value
+        mesh = reform_mesh(jax.devices()[:6], batch_size=32)
+        assert mesh.shape["data"] == 4           # largest divisor of 32
+        assert mesh.devices.size == 4
+        # the live context now runs on the surviving topology
+        assert get_zoo_context().mesh is mesh
+        assert get_registry().counter(
+            "mesh_reformations_total", "").value == before + 1
+        with pytest.raises(NoViableTopology):
+            reform_mesh([], batch_size=32)
+
+
+# ------------------------------------------- launcher death forensics
+def _write(path, body):
+    path.write_text(textwrap.dedent(body))
+    return str(path)
+
+
+class TestLauncherForensics:
+    def test_wait_reports_first_failure_not_just_codes(self, tmp_path):
+        from analytics_zoo_tpu.parallel.launcher import ZooCluster
+        script = _write(tmp_path / "w.py", """
+            import os, sys, time
+            pid = int(os.environ["ZOO_TPU_PROCESS_ID"])
+            # worker 1 dies FIRST (and worst); 0 and 2 exit clean
+            # later (margin generous: interpreter startup under a
+            # loaded CI host can add hundreds of ms of skew)
+            time.sleep(0.2 if pid == 1 else 3.0)
+            sys.exit(7 if pid == 1 else 0)
+        """)
+        cluster = ZooCluster(num_processes=3)
+        cluster.start(script)
+        codes = cluster.wait(timeout=30)
+        assert list(codes) == [0, 7, 0]          # old contract intact
+        assert codes.first_failure == {
+            "process_index": 1, "code": 7,
+            "classification": "error(7)"}
+        assert codes.exit_order[0][0] == 1       # died first
+
+    def test_stop_all_escalates_term_to_kill_and_reaps(self, tmp_path):
+        from analytics_zoo_tpu.parallel.launcher import ProcessMonitor
+        script = _write(tmp_path / "stubborn.py", """
+            import signal, time
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)
+            print("armed", flush=True)
+            time.sleep(600)
+        """)
+        mon = ProcessMonitor()
+        procs = [subprocess.Popen([sys.executable, script],
+                                  stdout=subprocess.PIPE)
+                 for _ in range(2)]
+        for i, p in enumerate(procs):
+            p.stdout.readline()       # SIGTERM handler installed
+            mon.register(p, index=i)
+        codes = mon.stop_all(timeout=1.0, kill_grace=10.0)
+        # TERM was ignored; the per-process KILL escalation reaped both
+        assert codes == {0: -signal.SIGKILL, 1: -signal.SIGKILL}
+        assert mon.alive() == 0
+        assert all(p.poll() is not None for p in procs)   # no zombies
+
+    def test_chaos_kill_through_cluster_env(self, tmp_path):
+        """A scripted kill fault rides the ZOO_TPU_CHAOS env into a
+        launched worker and fires at the scripted step in the right
+        process — the launcher-level half of fault injection.  The
+        worker loads chaos by FILE PATH (its stdlib-only contract), so
+        this needs no jax import in the children."""
+        from analytics_zoo_tpu.parallel.launcher import ZooCluster
+        script = _write(tmp_path / "w.py", f"""
+            import importlib.util, sys
+            spec = importlib.util.spec_from_file_location(
+                "chaos", {chaos_lib.__file__!r})
+            chaos = importlib.util.module_from_spec(spec)
+            sys.modules["chaos"] = chaos   # @dataclass needs the entry
+            spec.loader.exec_module(chaos)
+            plan = chaos.active_chaos()
+            assert plan is not None, "chaos env missing"
+            for step in range(50):
+                plan.trip(chaos.SITE_WORKER_STEP, step)
+            sys.exit(0)
+        """)
+        plan = ChaosPlan([FaultSpec(site=chaos_lib.SITE_WORKER_STEP,
+                                    at_step=7, kind="kill",
+                                    exit_code=137, process_index=1)])
+        cluster = ZooCluster(num_processes=3, chaos=plan)
+        cluster.start(script)
+        codes = cluster.wait(timeout=30)
+        assert list(codes) == [0, 137, 0]
+        assert codes.first_failure["process_index"] == 1
+        assert is_preemption_like(codes.first_failure["classification"])
+
+    def test_check_health_flags_dead_worker(self, tmp_path):
+        from analytics_zoo_tpu.observability import get_registry
+        from analytics_zoo_tpu.parallel.launcher import ZooCluster
+        script = _write(tmp_path / "w.py", """
+            import os, sys, time
+            if int(os.environ["ZOO_TPU_PROCESS_ID"]) == 1:
+                sys.exit(3)
+            time.sleep(600)
+        """)
+        cluster = ZooCluster(num_processes=3)
+        cluster.start(script)
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                health = cluster.check_health()
+                if health.missing:
+                    break
+                time.sleep(0.05)
+            assert health.missing == [1]
+            assert health.alive == 2
+            assert not health.ok
+            assert health.first_death["process_index"] == 1
+            assert health.first_death["classification"] == "error(3)"
+            reg = get_registry()
+            assert reg.gauge("cluster_hosts_expected", "").value == 3.0
+            assert reg.gauge("cluster_hosts_missing", "").value == 1.0
+        finally:
+            cluster.stop()
+
+    def test_degraded_worker_exits_17_and_launcher_honors_it(
+            self, tmp_path):
+        """The shipped DegradedTraining -> DEGRADED_EXIT_CODE mapping
+        (resilience.degraded_exit) speaks the launcher protocol end to
+        end: the degraded worker prints its structured result and
+        exits 17, which wait() surfaces distinctly from a crash."""
+        from analytics_zoo_tpu.parallel.launcher import ZooCluster
+        from analytics_zoo_tpu.resilience.policy import (
+            DEGRADED_EXIT_CODE)
+        script = _write(tmp_path / "w.py", """
+            import os
+            from analytics_zoo_tpu.resilience import (
+                DegradedTraining, degraded_exit)
+            with degraded_exit():
+                if os.environ["ZOO_TPU_PROCESS_ID"] == "0":
+                    raise DegradedTraining(
+                        "no viable topology",
+                        result={"status": "degraded",
+                                "failure_class": "lost_host"})
+        """)
+        cluster = ZooCluster(num_processes=2,
+                             env={"PYTHONPATH": REPO_ROOT})
+        procs = []
+        for pid in range(2):
+            proc = subprocess.Popen(
+                [sys.executable, script],
+                env=cluster.worker_env(pid),
+                stdout=subprocess.PIPE, text=True)
+            procs.append(proc)
+            cluster.monitor.register(proc, index=pid)
+        codes = cluster.wait(timeout=60)
+        assert list(codes) == [DEGRADED_EXIT_CODE, 0]
+        # an orderly degraded ending is NOT a failure/death: it must
+        # never be named the root cause, or counted missing
+        assert codes.first_failure is None
+        health = cluster.check_health()
+        assert health.degraded == [0]
+        assert health.missing == []
+        assert health.first_death is None
+        # the structured result rode the degraded worker's stdout
+        result = json.loads(procs[0].stdout.read().strip())
+        assert result == {"status": "degraded",
+                          "failure_class": "lost_host"}
+
+    def test_reused_run_dir_drops_stale_heartbeats(self, tmp_path):
+        """A run_dir reused across runs must not carry the previous
+        run's heartbeats — check_health would flag a live,
+        still-initializing worker as stale."""
+        from analytics_zoo_tpu.parallel.launcher import ZooCluster
+        run_dir = tmp_path / "run"
+        slot = run_dir / "host-0"
+        HostHeartbeat(str(slot), interval_s=0.0).beat(step=99)
+        assert read_heartbeats(str(run_dir)) != {}
+        ZooCluster(num_processes=1, run_dir=str(run_dir))
+        assert read_heartbeats(str(run_dir)) == {}
+
+    def test_clean_exit_is_not_missing(self, tmp_path):
+        from analytics_zoo_tpu.parallel.launcher import ZooCluster
+        script = _write(tmp_path / "w.py", "import sys; sys.exit(0)")
+        cluster = ZooCluster(num_processes=2)
+        cluster.start(script)
+        cluster.wait(timeout=30)
+        health = cluster.check_health()
+        assert health.missing == []
+        assert health.ok
+        assert health.first_death is None
+
+
+# ------------------------------------------ serving write backpressure
+def _enqueue_npy(broker, uri, arr):
+    import base64
+    import io
+
+    from analytics_zoo_tpu.serving.server import INPUT_STREAM
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    broker.xadd(INPUT_STREAM, {
+        "uri": uri, "data": base64.b64encode(buf.getvalue()).decode(),
+        "request_id": f"req-{uri}"})
+
+
+class _StubModel:
+    def predict(self, x):
+        return np.tile(np.array([2.0, 1.0, 0.0], np.float32),
+                       (len(x), 1))
+
+
+class TestServingWriteBackpressure:
+    def _serving(self, broker, retries=3):
+        from analytics_zoo_tpu.serving.server import (
+            ClusterServing, ServingConfig)
+        return ClusterServing(
+            _StubModel(),
+            ServingConfig(batch_size=2, top_n=1,
+                          result_write_retries=retries),
+            broker=broker)
+
+    def test_abandons_to_dead_letter_instead_of_crashing(self):
+        from analytics_zoo_tpu.observability import get_registry
+        from analytics_zoo_tpu.serving.redis_client import EmbeddedBroker
+        from analytics_zoo_tpu.serving.server import DEAD_LETTER_STREAM
+
+        class ResultWritesFail(EmbeddedBroker):
+            def hset(self, key, fields):
+                if key.startswith("result:"):
+                    raise ConnectionError("broker write refused")
+                return super().hset(key, fields)
+
+        broker = ResultWritesFail()
+        serving = self._serving(broker, retries=3)
+        # readiness must see the outage: configure the error-rate gate
+        serving.config.healthz_max_error_rate = 0.5
+        _enqueue_npy(broker, "a", np.zeros((4,), np.float32))
+        _enqueue_npy(broker, "b", np.zeros((4,), np.float32))
+        reg = get_registry()
+        abandoned = reg.counter(
+            "serving_result_write_abandoned_total", "")
+        retried = reg.counter("serving_redis_retry_total", "")
+        errors = reg.counter("serving_errors_total", "").value
+        a0, r0 = abandoned.value, retried.value
+        # the old behavior raised out of the worker loop here; now:
+        # processed but DELIVERED zero
+        served = serving.run_once(block_ms=0)
+        assert served == 0
+        assert serving.total_records == 2        # processed (progress)
+        assert abandoned.value - a0 == 2
+        assert retried.value - r0 == 2 * 3       # 3 bounded attempts each
+        # abandoned writes are failures to error accounting and the
+        # /healthz window — an orchestrator pulls this worker instead
+        # of routing to a black hole
+        assert reg.counter("serving_errors_total",
+                           "").value - errors == 2
+        not_ready = serving.readiness()
+        assert not_ready is not None
+        assert not_ready["reason"] == "error_rate"
+        # dead letter carries the correlation ids
+        d = lambda v: v.decode() if isinstance(v, bytes) else v  # noqa: E731
+        entries = broker.xread(DEAD_LETTER_STREAM, count=10)
+        letters = [{d(k): d(v) for k, v in f.items()}
+                   for _i, f in entries]
+        assert sorted(l["uri"] for l in letters) == ["a", "b"]
+        assert sorted(l["request_id"] for l in letters) == \
+            ["req-a", "req-b"]
+        assert all("ConnectionError" in l["error"] for l in letters)
+        # the loop is still alive
+        assert serving.run_once(block_ms=0) == 0
+
+    def test_flaky_broker_recovers_within_budget(self):
+        from analytics_zoo_tpu.observability import get_registry
+        from analytics_zoo_tpu.serving.redis_client import EmbeddedBroker
+
+        class FlakyBroker(EmbeddedBroker):
+            fail_next = 2
+
+            def hset(self, key, fields):
+                if key.startswith("result:") and self.fail_next > 0:
+                    self.fail_next -= 1
+                    raise ConnectionError("transient broker flake")
+                return super().hset(key, fields)
+
+        broker = FlakyBroker()
+        serving = self._serving(broker, retries=4)
+        _enqueue_npy(broker, "ok", np.zeros((4,), np.float32))
+        abandoned = get_registry().counter(
+            "serving_result_write_abandoned_total", "")
+        a0 = abandoned.value
+        assert serving.run_once(block_ms=0) == 1
+        assert abandoned.value == a0             # landed within budget
+        assert broker.hgetall("result:ok")       # result is there
+
+    def test_config_yaml_knob(self, tmp_path):
+        from analytics_zoo_tpu.serving.server import ServingConfig
+        cfg = tmp_path / "config.yaml"
+        cfg.write_text("params:\n  batch_size: 4\n"
+                       "  result_write_retries: 3\n")
+        assert ServingConfig.from_yaml(
+            str(cfg)).result_write_retries == 3
+        assert ServingConfig().result_write_retries == 8   # default
+
+
+# --------------------------------------------------- bench degradation
+class TestBenchDegraded:
+    def test_probe_chaos_yields_structured_degraded_exit_zero(self):
+        """The r03/r04 acceptance: a contended chip (simulated by a
+        scripted probe fault) makes bench emit structured
+        status=degraded lines and exit 0 under --max-degraded,
+        instead of timing out empty."""
+        env = dict(os.environ)
+        env["ZOO_TPU_CHAOS"] = ChaosPlan([FaultSpec(
+            site=chaos_lib.SITE_BENCH_PROBE, at_step=0,
+            kind="raise", message="simulated chip contention")]
+        ).to_json()
+        r = subprocess.run(
+            [sys.executable, "bench.py", "--workload", "input_pipeline",
+             "--max-degraded", "1"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=180)
+        lines = [json.loads(ln) for ln in r.stdout.splitlines()
+                 if ln.strip().startswith("{")]
+        assert r.returncode == 0, r.stdout + r.stderr
+        per_workload = [ln for ln in lines
+                        if ln.get("status") == "degraded"
+                        and ln.get("workload") == "input_pipeline"]
+        assert per_workload and per_workload[0]["value"] == 0
+        assert per_workload[0]["degraded_reason"] == \
+            "backend_unreachable"
+        (summary,) = [ln for ln in lines
+                      if ln.get("bench_status") == "degraded"]
+        assert summary["within_budget"] is True
+        assert summary["workloads_degraded"] == ["input_pipeline"]
+        assert "simulated chip contention" in summary["error_tail"]
