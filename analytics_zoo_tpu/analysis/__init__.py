@@ -8,7 +8,12 @@ the same offline contract ``scripts/obs_report.py`` keeps.
 The correctness-tooling counterpart to the runtime diagnostics layer:
 ``observability.diagnostics.CompileMonitor`` can only flag recompile
 churn *after* you have paid for it; these rules flag the pattern
-before the code ever runs.  Rule catalog + workflow:
+before the code ever runs.  Since zoolint v2 the pass is
+INTERPROCEDURAL (``project.py`` links every analyzed file into a
+module graph + call graph, so helper calls no longer hide findings)
+and includes the sharding/HBM/deadlock families
+(SHARD007/MEM009/LOCK010, ``rules_graph.py``) with the static
+comm/HBM cost models in ``comms.py``.  Rule catalog + workflow:
 docs/static-analysis.md.
 """
 
@@ -18,6 +23,12 @@ from analytics_zoo_tpu.analysis.baseline import (
     diff_findings,
     load_baseline,
     write_baseline,
+)
+from analytics_zoo_tpu.analysis.comms import (
+    all_gather_bytes,
+    estimate_step_hbm_bytes,
+    estimate_train_step_comm_bytes,
+    ring_all_reduce_bytes,
 )
 from analytics_zoo_tpu.analysis.core import (
     Finding,
@@ -29,16 +40,28 @@ from analytics_zoo_tpu.analysis.core import (
     iter_python_files,
     register_rule,
 )
+from analytics_zoo_tpu.analysis.project import (
+    ProjectContext,
+    load_project,
+    register_project_rule,
+)
 
 __all__ = [
     "Finding",
     "ModuleContext",
+    "ProjectContext",
     "Rule",
+    "all_gather_bytes",
     "all_rule_classes",
     "analyze_paths",
     "analyze_source",
+    "estimate_step_hbm_bytes",
+    "estimate_train_step_comm_bytes",
     "iter_python_files",
+    "load_project",
+    "register_project_rule",
     "register_rule",
+    "ring_all_reduce_bytes",
     "apply_baseline",
     "count_by_key",
     "diff_findings",

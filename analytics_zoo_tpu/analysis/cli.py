@@ -3,10 +3,13 @@
 Usage (see docs/static-analysis.md for the workflow)::
 
     zoolint analytics_zoo_tpu scripts examples
+    zoolint --jobs 4 analytics_zoo_tpu ...   # parallel rule runs
     zoolint --baseline .zoolint-baseline.json analytics_zoo_tpu ...
     zoolint --json pkg/ > report.json
     zoolint --diff main-report.json pkg/     # PR gate: new findings only
     zoolint --write-baseline .zoolint-baseline.json pkg/
+    zoolint --explain-comms --mesh data=8 --param-count 1000000 pkg/
+    zoolint --explain-hbm --param-bytes 4000000 pkg/
     zoolint --list-rules
 
 Exit codes (stable — CI depends on them):
@@ -50,9 +53,10 @@ def _report_json(findings: List[Finding], errors: List[str]) -> dict:
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="zoolint",
-        description="JAX/TPU-aware static analysis: jit purity, "
-                    "host-sync hygiene, recompile safety, donation, "
-                    "thread safety, PRNG key reuse",
+        description="JAX/TPU-aware static analysis (interprocedural): "
+                    "jit purity, host-sync hygiene, recompile safety, "
+                    "donation, thread safety, PRNG key reuse, "
+                    "sharding specs, HBM live buffers, lock ordering",
         epilog="suppress one line with "
                "'# zoolint: disable=RULE — reason'")
     ap.add_argument("paths", nargs="*", default=[],
@@ -73,27 +77,86 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--root", default=".",
                     help="directory paths are reported relative to "
                          "(default: cwd)")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="fan the per-file rule runs over N worker "
+                         "processes (fork; the interprocedural pass "
+                         "stays serial; output identical to --jobs 1)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--explain-comms", action="store_true",
+                    help="report the static collective-bytes-per-step "
+                         "estimate for every jitted train step (same "
+                         "ring identities as the runtime "
+                         "collective_bytes_total counters) and exit")
+    ap.add_argument("--explain-hbm", action="store_true",
+                    help="report the static per-step peak-HBM "
+                         "composition for every jitted train step "
+                         "and exit")
+    ap.add_argument("--mesh", default=None, metavar="AXIS=N[,..]",
+                    help="mesh sizes for the explain reports, e.g. "
+                         "data=8,fsdp=2")
+    ap.add_argument("--param-count", type=int, default=None,
+                    help="model parameter count to price "
+                         "--explain-comms with")
+    ap.add_argument("--param-bytes", type=int, default=None,
+                    help="model parameter bytes to price "
+                         "--explain-hbm with")
+    ap.add_argument("--grad-dtype", default="float32",
+                    help="gradient sync dtype for --explain-comms "
+                         "(default float32)")
     return ap
+
+
+def _explain(args) -> int:
+    """The --explain-comms / --explain-hbm report modes: link the
+    project, find the jitted train steps, price them with the stdlib
+    comm/HBM models (analysis/comms.py)."""
+    from analytics_zoo_tpu.analysis import comms
+    from analytics_zoo_tpu.analysis import project as project_mod
+    try:
+        # validate the mesh spec BEFORE the whole-project parse — a
+        # typo'd --mesh should fail instantly, not after linking
+        mesh = comms.parse_mesh_spec(args.mesh)
+    except ValueError as e:
+        print(f"zoolint: {e}", file=sys.stderr)
+        return 2
+    proj, errors = project_mod.load_project(args.paths, root=args.root)
+    lines: List[str] = []
+    if args.explain_comms:
+        lines += comms.render_comm_report(
+            proj.train_steps, mesh, args.param_count, args.grad_dtype)
+    if args.explain_hbm:
+        lines += comms.render_hbm_report(
+            proj.train_steps, args.param_bytes)
+    for line in lines:
+        print(line)
+    for e in errors:
+        print(f"zoolint: ERROR {e}", file=sys.stderr)
+    return 1 if errors else 0
 
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.list_rules:
-        for cls in sorted(all_rule_classes(), key=lambda c: c.rule_id):
+        from analytics_zoo_tpu.analysis.project import (
+            project_rule_classes)
+        classes = all_rule_classes() + project_rule_classes()
+        for cls in sorted(classes, key=lambda c: c.rule_id):
             print(f"{cls.rule_id}  {cls.severity:7s}  {cls.doc}")
         return 0
     if not args.paths:
         print("zoolint: no paths given (try: zoolint "
               "analytics_zoo_tpu scripts examples)", file=sys.stderr)
         return 2
+    if args.explain_comms or args.explain_hbm:
+        return _explain(args)
 
     rule_ids = ([r.strip() for r in args.rules.split(",") if r.strip()]
                 if args.rules else None)
     findings, errors = analyze_paths(args.paths, root=args.root,
-                                     rule_ids=rule_ids)
+                                     rule_ids=rule_ids,
+                                     jobs=max(1, args.jobs))
 
     if args.write_baseline:
         prev_total = None

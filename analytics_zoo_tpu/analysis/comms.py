@@ -1,0 +1,192 @@
+"""Static communication + HBM cost models (stdlib mirror of
+``observability/collectives.py``).
+
+SHARD007's ``zoolint --explain-comms`` report and MEM009's
+``--explain-hbm`` report price a jitted train step from the sharding
+contract alone, using the SAME ring identities PR 4's runtime
+counters use — so a static estimate printed here is directly
+comparable to the measured ``collective_bytes_total{op}`` counters
+(the tier-1 parity test in ``tests/test_zoolint.py`` holds them to
+±10%).  The identities are duplicated rather than imported because
+this package must never import jax (``observability/collectives.py``
+pulls jax for the param-tree walk); the tier-1 test pins the two
+implementations together so they cannot drift silently.
+
+All functions are pure host arithmetic over plain ints/floats.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# canonical op labels — MUST match observability/collectives.py so
+# static and runtime reports join on the same keys
+OP_PSUM_GRADS = "psum_grads"
+OP_ALL_GATHER_PARAMS = "all_gather_params"
+
+DTYPE_BYTES = {"bfloat16": 2, "float16": 2, "float32": 4,
+               "float64": 8, "int8": 1, "int32": 4}
+
+
+def dtype_bytes(dtype_str: str) -> int:
+    return DTYPE_BYTES.get(str(dtype_str), 4)
+
+
+def ring_all_reduce_bytes(payload_bytes: float, n: int) -> float:
+    """Per-device link traffic of a ring all-reduce (reduce-scatter +
+    all-gather): ``2(n-1)/n`` of the payload."""
+    if n <= 1:
+        return 0.0
+    return 2.0 * (n - 1) / n * float(payload_bytes)
+
+
+def all_gather_bytes(payload_bytes: float, n: int) -> float:
+    """Per-device link traffic of an all-gather of a sharded payload:
+    each device receives the ``(n-1)/n`` it doesn't hold."""
+    if n <= 1:
+        return 0.0
+    return (n - 1) / n * float(payload_bytes)
+
+
+def estimate_train_step_comm_bytes(
+        param_count: int, dp: int, fsdp: int = 1,
+        grad_sync_dtype: str = "float32",
+        param_dtype: str = "float32") -> Dict[str, float]:
+    """``{op: bytes_per_step}`` implied by the trainer's sharding
+    contract — the static twin of
+    ``observability.collectives.estimate_train_step_collectives``:
+    gradients psum (ring all-reduce) over the data×fsdp axes in
+    ``grad_sync_dtype``; when ``fsdp > 1``, the forward+backward
+    all-gathers that rematerialize the fsdp-sharded params."""
+    out: Dict[str, float] = {}
+    sync = int(dp) * int(fsdp)
+    n = int(param_count)
+    if sync > 1 and n:
+        out[OP_PSUM_GRADS] = ring_all_reduce_bytes(
+            n * dtype_bytes(grad_sync_dtype), sync)
+    if fsdp > 1 and n:
+        out[OP_ALL_GATHER_PARAMS] = 2.0 * all_gather_bytes(
+            n * dtype_bytes(param_dtype), fsdp)
+    return out
+
+
+def estimate_step_hbm_bytes(
+        param_bytes: int, opt_slots: int = 2,
+        batch_bytes: int = 0, donated: bool = True,
+        grad_dtype_ratio: float = 1.0) -> Dict[str, float]:
+    """Static per-step peak-HBM composition of a jitted train step.
+
+    ``opt_slots`` is the optimizer's per-param state multiplier (adam
+    keeps first+second moments → 2; sgd+momentum → 1; plain sgd → 0).
+    Without donation XLA keeps the input AND output params/opt-state
+    trees live simultaneously — the doubling MEM009/DONATE004 exist
+    to catch.  Returns the components plus their ``peak`` sum."""
+    p = float(param_bytes)
+    opt = p * float(opt_slots)
+    grads = p * float(grad_dtype_ratio)
+    live_state = (p + opt) if donated else 2.0 * (p + opt)
+    out = {
+        "params": p,
+        "opt_state": opt,
+        "grads": grads,
+        "batch": float(batch_bytes),
+        "undonated_copies": 0.0 if donated else (p + opt),
+        "peak": live_state + grads + float(batch_bytes),
+    }
+    return out
+
+
+def parse_mesh_spec(spec: Optional[str]) -> Dict[str, int]:
+    """``"data=8,fsdp=2"`` -> ``{"data": 8, "fsdp": 2}`` (the
+    ``--mesh`` CLI argument)."""
+    out: Dict[str, int] = {}
+    if not spec:
+        return out
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"--mesh entry '{part}' is not axis=size")
+        axis, _, size = part.partition("=")
+        out[axis.strip()] = int(size)
+    return out
+
+
+def render_comm_report(train_steps: List[Dict],
+                       mesh: Dict[str, int],
+                       param_count: Optional[int],
+                       grad_sync_dtype: str = "float32") -> List[str]:
+    """Human-readable --explain-comms lines: one block per discovered
+    jitted train step, symbolic always, priced when ``param_count``
+    and a mesh are given."""
+    dp = int(mesh.get("data", 1))
+    fsdp = int(mesh.get("fsdp", 1))
+    sync = dp * fsdp
+    lines: List[str] = []
+    if not train_steps:
+        lines.append("explain-comms: no jitted train steps "
+                     "(opt-state-threading jit roots) found")
+        return lines
+    lines.append(
+        f"explain-comms: ring identities over mesh "
+        f"data={dp} fsdp={fsdp} (grad sync {grad_sync_dtype}) — "
+        f"comparable to runtime collective_bytes_total{{op}} / steps")
+    for step in train_steps:
+        lines.append(f"{step['path']}:{step['line']}: jitted step "
+                     f"[{step['symbol']}]")
+        lines.append(
+            f"  {OP_PSUM_GRADS}: 2(n-1)/n x grad_bytes, "
+            f"n = dpxfsdp = {sync}")
+        if fsdp > 1:
+            lines.append(
+                f"  {OP_ALL_GATHER_PARAMS}: 2 x (n-1)/n x "
+                f"param_bytes, n = fsdp = {fsdp} (fwd+bwd regather)")
+        else:
+            lines.append(f"  {OP_ALL_GATHER_PARAMS}: inactive "
+                         f"(fsdp={fsdp})")
+        if param_count:
+            est = estimate_train_step_comm_bytes(
+                param_count, dp, fsdp, grad_sync_dtype)
+            for op in sorted(est):
+                lines.append(f"  -> {op}: {est[op]:,.0f} bytes/step "
+                             f"({param_count:,} params)")
+    return lines
+
+
+def render_hbm_report(train_steps: List[Dict],
+                      param_bytes: Optional[int],
+                      opt_slots: int = 2,
+                      batch_bytes: int = 0) -> List[str]:
+    """Human-readable --explain-hbm lines: static per-step peak-bytes
+    composition for each discovered jitted train step, with and
+    without donation so the DONATE004/MEM009 cost is explicit."""
+    lines: List[str] = []
+    if not train_steps:
+        lines.append("explain-hbm: no jitted train steps "
+                     "(opt-state-threading jit roots) found")
+        return lines
+    lines.append(
+        "explain-hbm: peak ~= params + opt_state(+slots) + grads + "
+        "batch; +params+opt_state again when not donated — compare "
+        "with device_memory_* telemetry gauges")
+    for step in train_steps:
+        lines.append(f"{step['path']}:{step['line']}: jitted step "
+                     f"[{step['symbol']}]")
+        if param_bytes:
+            don = estimate_step_hbm_bytes(param_bytes, opt_slots,
+                                          batch_bytes, donated=True)
+            und = estimate_step_hbm_bytes(param_bytes, opt_slots,
+                                          batch_bytes, donated=False)
+            lines.append(f"  donated:     peak "
+                         f"{don['peak']:,.0f} bytes")
+            lines.append(f"  not donated: peak "
+                         f"{und['peak']:,.0f} bytes "
+                         f"(+{und['undonated_copies']:,.0f} dead "
+                         f"input copies)")
+        else:
+            lines.append("  peak = P(1 + opt_slots) + G + B "
+                         "(x2 on P+O when not donated) — pass "
+                         "--param-bytes to price it")
+    return lines

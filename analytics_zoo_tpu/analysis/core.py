@@ -35,8 +35,10 @@ import dataclasses
 import hashlib
 import os
 import re
+import sys
 import tokenize
-from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple, Type
+from typing import (Any, Dict, Iterable, List, Optional, Sequence, Set,
+                    Tuple, Type)
 
 SEVERITIES = ("error", "warning")
 
@@ -139,14 +141,53 @@ def register_rule(cls: Type[Rule]) -> Type[Rule]:
 
 
 def all_rule_classes() -> List[Type[Rule]]:
-    # rules.py registers on import; imported lazily so ``core`` stays
-    # importable standalone (scripts/zoolint file-path loading)
-    if not _RULE_CLASSES:
-        from analytics_zoo_tpu.analysis import rules as _rules  # noqa: F401
+    # rules.py / rules_graph.py register on import; imported lazily so
+    # ``core`` stays importable standalone (scripts/zoolint file-path
+    # loading).  Both imports run UNCONDITIONALLY (idempotent via
+    # sys.modules) — guarding on ``_RULE_CLASSES`` being empty once
+    # silently dropped the rules_graph families whenever rules.py had
+    # already been imported through another path (project.py's link
+    # pass), i.e. in every fresh CLI process.
+    from analytics_zoo_tpu.analysis import rules as _rules  # noqa: F401
+    from analytics_zoo_tpu.analysis import (  # noqa: F401
+        rules_graph as _rules_graph)
     return list(_RULE_CLASSES)
 
 
 # ------------------------------------------------------- module context
+
+
+def _fn_name(node: ast.AST) -> str:
+    """Display/qualname segment for a function node; lambdas are
+    disambiguated by line number ('<lambda:12>') so same-scope
+    siblings never share a qualname."""
+    return getattr(node, "name", None) or f"<lambda:{node.lineno}>"
+
+
+def donated_positions(kws) -> Optional[Set[int]]:
+    """The literal ``donate_argnums`` of a jit keyword spec as a
+    position set; empty set = no donation declared.  ``None`` =
+    donation declared in a form that can't be mapped to call-site
+    positions (``donate_argnames``, a non-literal argnums expression)
+    — callers exempt rather than guess: mere PRESENCE of donation
+    must not pass a call whose rebound state args aren't the donated
+    ones."""
+    donated: Set[int] = set()
+    for kw in kws:
+        if kw.arg == "donate_argnames":
+            return None
+        if kw.arg != "donate_argnums":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, int):
+            donated.add(v.value)
+        elif isinstance(v, (ast.Tuple, ast.List)) and all(
+                isinstance(e, ast.Constant)
+                and isinstance(e.value, int) for e in v.elts):
+            donated |= {e.value for e in v.elts}
+        else:
+            return None
+    return donated
 
 
 def _dotted(node: ast.AST) -> Optional[str]:
@@ -193,10 +234,10 @@ class ModuleContext:
         self.source = source
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=path)
-        self.suppressed = self._scan_suppressions(source)
         self._parents: Dict[int, ast.AST] = {}
         self._func_of: Dict[int, Optional[ast.AST]] = {}
         self._qualnames: Dict[int, str] = {}
+        self._class_qualnames: Dict[int, str] = {}
         self.aliases: Dict[str, str] = {}
         self.functions: List[ast.AST] = []   # FunctionDef/Lambda, all
         self.jit_functions: Set[int] = set()     # id(node), compiled
@@ -210,15 +251,114 @@ class ModuleContext:
         self.threaded = False
         self.thread_evidence = ""
         self.module_mutables: Dict[str, int] = {}   # name -> def lineno
+        # ---- interprocedural marks (set by project.py / apply_facts)
+        #: function ids whose ENTIRE body behaves as the body of a hot
+        #: loop (the function is called from inside a train/step/
+        #: predict loop) — in_loop()/is_hot_function() honor this
+        self.hot_loop_functions: Set[int] = set()
+        #: id(node) -> human-readable reason a mark was applied
+        #: ("called from jitted DistributedTrainer._step_core")
+        self.mark_reason: Dict[int, str] = {}
+        #: call site (lineno, col) -> key-argument names consumed by
+        #: the (interprocedurally resolved) callee — RNG006 input
+        self.rng_call_consumes: Dict[Tuple[int, int], List[str]] = {}
+        #: mesh axis names known to the project (SHARD007); None means
+        #: "derive from this module alone / fall back to canonical"
+        self.axis_universe: Optional[Set[str]] = None
+        #: dotted constant name -> axis string it denotes
+        #: ("analytics_zoo_tpu.parallel.mesh.DATA_AXIS" -> "data")
+        self.axis_constants: Dict[str, str] = {}
         self._index()
+        # the tokenize-based suppression scan is LAZY (see
+        # ``suppressed``): only modules that actually report findings
+        # pay for it, and under --jobs the cost lands in the workers
+        self._suppressed: Optional[Dict[int, Set[str]]] = None
         self._discover_jit()
         self._discover_threads_and_globals()
+        #: qualname -> FunctionDef nodes (lambda qualnames may repeat)
+        self.functions_by_qualname: Dict[str, List[ast.AST]] = {}
+        for fn in self.functions:
+            q = self._qualnames.get(id(fn), "")
+            if q:
+                self.functions_by_qualname.setdefault(q, []).append(fn)
+
+    @property
+    def module_name(self) -> str:
+        """Dotted module name derived from the repo-relative path
+        ('analytics_zoo_tpu/parallel/mesh.py' ->
+        'analytics_zoo_tpu.parallel.mesh')."""
+        rp = self.relpath
+        if rp.endswith(".py"):
+            rp = rp[:-3]
+        if rp.endswith("/__init__"):
+            rp = rp[: -len("/__init__")]
+        return rp.replace("/", ".")
+
+    # ------------------------------------------- interprocedural marks
+    def force_traced(self, fn: ast.AST, compiled: bool,
+                     reason: str = "") -> None:
+        """Mark ``fn`` (and everything defined inside it) traced — the
+        project layer calls this when ``fn`` is reachable from a
+        jitted/traced function in another scope or module."""
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                self.traced_functions.add(id(sub))
+                if compiled:
+                    self.jit_functions.add(id(sub))
+                if reason and id(sub) not in self.mark_reason:
+                    self.mark_reason[id(sub)] = reason
+
+    def force_hot_loop(self, fn: ast.AST, reason: str = "") -> None:
+        """Mark ``fn`` as called from inside a hot loop: its whole
+        body is then treated as loop-resident by SYNC002/MEM009."""
+        self.hot_loop_functions.add(id(fn))
+        if reason and id(fn) not in self.mark_reason:
+            self.mark_reason[id(fn)] = reason
+
+    def add_external_jitted(self, name: str, static_declared: bool,
+                            donate_declared: bool,
+                            donate_pos: Optional[List[int]] = None
+                            ) -> None:
+        """Register a jit-compiled callable imported from another
+        analyzed module, synthesizing the keyword facts COMPILE003/
+        MEM009 read off local jit sites.  ``donate_pos`` carries the
+        LITERAL donate_argnums positions when the defining module
+        declared them (so MEM009's coverage check works across module
+        boundaries); ``None`` with ``donate_declared`` means donation
+        in an unmappable form (argnames / computed) — assume covered."""
+        if name in self.jitted_callables:
+            return
+        kws: List[ast.keyword] = []
+        if static_declared:
+            kws.append(ast.keyword(arg="static_argnums",
+                                   value=ast.Constant(value=None)))
+        if donate_declared:
+            if donate_pos is not None:
+                val: ast.AST = ast.Tuple(
+                    elts=[ast.Constant(value=p) for p in donate_pos],
+                    ctx=ast.Load())
+            else:
+                val = ast.Constant(value=None)
+            kws.append(ast.keyword(arg="donate_argnums", value=val))
+        self.jitted_callables[name] = kws
 
     # ---------------------------------------------------------- indexing
+    @property
+    def suppressed(self) -> Dict[int, Set[str]]:
+        if self._suppressed is None:
+            self._suppressed = self._scan_suppressions(self.source)
+        return self._suppressed
+
     def _scan_suppressions(self, source: str) -> Dict[int, Set[str]]:
         """line(1-based) -> set of rule ids disabled there.  A
         suppression comment alone on a line also covers the next
-        line, so block-style disables read naturally."""
+        line, so block-style disables read naturally.  On a decorated
+        ``def`` the decorator lines and the ``def`` line are ALIASED:
+        a suppression on either covers findings reported at any of
+        them (rules report decorator-form findings at the decorator
+        line but def-scoped ones at the ``def`` line, and authors
+        can't be expected to know which)."""
         out: Dict[int, Set[str]] = {}
         import io
         try:
@@ -240,10 +380,32 @@ class ModuleContext:
                     out.setdefault(lineno + 1, set()).update(rules)
         except tokenize.TokenizeError:
             pass
+        # decorated defs: spread each line's rule set over the whole
+        # decorator+def span so "either line" suppresses
+        for span in self._decorated_def_spans():
+            joint: Set[str] = set()
+            for ln in span:
+                joint |= out.get(ln, set())
+            if joint:
+                for ln in span:
+                    out.setdefault(ln, set()).update(joint)
         return out
 
+    def _decorated_def_spans(self) -> List[List[int]]:
+        """[[decorator lines..., def line], ...] for every decorated
+        function/class def in the module."""
+        return self._decorated_spans
+
     def _index(self) -> None:
+        """ONE recursive walk collecting everything position-dependent:
+        parent links, scope chains, qualnames, import aliases, the
+        name-binding index, decorated-def spans.  Per-module cost is
+        dominated by tree traversal, so the facts that only need node
+        dispatch ride the same pass (this file is on the CI critical
+        path — the zoolint gate is the slowest tier-1 subprocess)."""
         stack: List[ast.AST] = []
+        self._name_assigns: Dict[str, List[ast.Assign]] = {}
+        self._decorated_spans: List[List[int]] = []
 
         def walk(node: ast.AST, parent: Optional[ast.AST]) -> None:
             if parent is not None:
@@ -253,23 +415,31 @@ class ModuleContext:
             self._func_of[id(node)] = stack[-1] if stack else None
             if is_func:
                 self.functions.append(node)
-                name = getattr(node, "name", "<lambda>")
-                outer = [getattr(f, "name", "<lambda>") for f in stack]
-                self._qualnames[id(node)] = ".".join(outer + [name])
+                # lambdas carry their line so two in one function get
+                # DISTINCT qualnames — project facts keyed on the
+                # shared 'fn.<lambda>' used to force-trace every
+                # sibling lambda when only one was jitted
+                outer = [_fn_name(f) for f in stack]
+                self._qualnames[id(node)] = \
+                    ".".join(outer + [_fn_name(node)])
                 stack.append(node)
             elif isinstance(node, ast.ClassDef):
+                outer = [_fn_name(f) for f in stack]
+                self._class_qualnames[id(node)] = \
+                    ".".join(outer + [node.name])
                 stack.append(node)
-            for child in ast.iter_child_nodes(node):
-                walk(child, node)
-            if is_func or isinstance(node, ast.ClassDef):
-                stack.pop()
-
-        walk(self.tree, None)
-        self._collect_aliases()
-
-    def _collect_aliases(self) -> None:
-        for node in ast.walk(self.tree):
-            if isinstance(node, ast.Import):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and node.decorator_list:
+                self._decorated_spans.append(sorted(
+                    {d.lineno for d in node.decorator_list}
+                    | {node.lineno}))
+            elif isinstance(node, ast.Assign) and isinstance(
+                    node.value, (ast.Lambda, ast.Name, ast.Attribute)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self._name_assigns.setdefault(
+                            t.id, []).append(node)
+            elif isinstance(node, ast.Import):
                 for a in node.names:
                     self.aliases[a.asname or a.name.split(".")[0]] = \
                         a.name if a.asname else a.name.split(".")[0]
@@ -280,6 +450,12 @@ class ModuleContext:
                 for a in node.names:
                     self.aliases[a.asname or a.name] = \
                         f"{node.module}.{a.name}"
+            for child in ast.iter_child_nodes(node):
+                walk(child, node)
+            if is_func or isinstance(node, ast.ClassDef):
+                stack.pop()
+
+        walk(self.tree, None)
         # normalize the two ubiquitous scientific aliases even when the
         # import is conventional (import numpy as np)
         self.aliases.setdefault("np", "numpy")
@@ -298,6 +474,18 @@ class ModuleContext:
                                 ast.Lambda)):
                 return cur
         return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        """Nearest ClassDef strictly containing ``node``."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            cur = self._parents.get(id(cur))
+            if isinstance(cur, ast.ClassDef):
+                return cur
+        return None
+
+    def class_qualname(self, node: ast.ClassDef) -> str:
+        return self._class_qualnames.get(id(node), node.name)
 
     def qualname_of(self, node: ast.AST) -> str:
         fn = node if isinstance(
@@ -328,10 +516,15 @@ class ModuleContext:
         rules = self.suppressed.get(finding.line, set())
         return finding.rule.upper() in rules or "ALL" in rules
 
-    def in_loop(self, node: ast.AST) -> bool:
+    def in_loop(self, node: ast.AST, lexical_only: bool = False) -> bool:
         """Is ``node`` inside a For/While body of its own function
-        (loops in *enclosing* functions don't count)?"""
+        (loops in *enclosing* functions don't count)?  A function the
+        project layer marked hot-loop-resident (called from inside a
+        hot loop) counts wholesale unless ``lexical_only``."""
         fn = self.enclosing_function(node)
+        if not lexical_only and fn is not None and \
+                id(fn) in self.hot_loop_functions:
+            return True
         cur: Optional[ast.AST] = node
         while cur is not None and cur is not fn:
             par = self._parents.get(id(cur))
@@ -344,12 +537,16 @@ class ModuleContext:
 
     def is_hot_function(self, fn: Optional[ast.AST]) -> bool:
         """Host-side hot path: name matches the train/step/predict
-        family.  Jitted functions are excluded — host-sync calls there
-        are JIT001/trace errors, not hidden syncs."""
+        family, or the project layer proved the function is called
+        from inside one (hot_loop_functions).  Jitted functions are
+        excluded — host-sync calls there are JIT001/trace errors, not
+        hidden syncs."""
         if fn is None or isinstance(fn, ast.Lambda):
             return False
         if id(fn) in self.traced_functions:
             return False
+        if id(fn) in self.hot_loop_functions:
+            return True
         return bool(self.HOT_NAME_RE.search(fn.name.lower()))
 
     # ----------------------------------------------- jit-function discovery
@@ -385,14 +582,51 @@ class ModuleContext:
         if isinstance(arg, ast.Lambda):
             return arg
         if isinstance(arg, ast.Name):
-            return self._local_function_named(origin, arg.id)
+            fn = self._local_function_named(origin, arg.id)
+            if fn is not None:
+                return fn
+            return self._local_lambda_named(origin, arg.id)
         if isinstance(arg, ast.Call) and \
                 self.resolve(arg.func) in ("functools.partial", "partial") \
                 and arg.args:
             return self._wrapped_function(arg.args[0], origin)
         return None
 
+    def scoped_binding_value(self, origin: ast.AST, name: str,
+                             types: Tuple[type, ...]) -> Optional[ast.AST]:
+        """The VALUE of the deepest in-scope ``name = <expr>`` binding
+        visible from ``origin``, restricted to value nodes of
+        ``types`` — the one binding-chase used for both name-bound
+        lambdas (``fn = lambda ...; jax.jit(fn)``) and method refs
+        (``fn = self._step_core``)."""
+        chain: List[Optional[ast.AST]] = []
+        cur = self.enclosing_function(origin)
+        while True:
+            chain.append(cur)
+            if cur is None:
+                break
+            cur = self.enclosing_function(cur)
+        best: Optional[ast.AST] = None
+        best_depth = -1
+        for node in self._name_assigns.get(name, ()):
+            if not isinstance(node.value, types):
+                continue
+            owner = self.enclosing_function(node)
+            if owner in chain:
+                depth = len(chain) - chain.index(owner)
+                if depth > best_depth:
+                    best, best_depth = node.value, depth
+        return best
+
+    def _local_lambda_named(self, origin: ast.AST,
+                            name: str) -> Optional[ast.Lambda]:
+        return self.scoped_binding_value(origin, name, (ast.Lambda,))
+
     def _discover_jit(self) -> None:
+        """One shared walk discovering jit roots AND thread evidence
+        (both need the completed alias table, both dispatch on the
+        same node types — merged to keep ModuleContext construction
+        at two tree passes total)."""
         roots: List[Tuple[ast.AST, bool]] = []   # (fn, compiled?)
         for node in ast.walk(self.tree):
             # f = jax.jit(g) / @jax.jit / @partial(jax.jit, ...)
@@ -405,6 +639,20 @@ class ModuleContext:
                         roots.append((fn, compiled))
                     if compiled:
                         self._record_jitted_target(node)
+                elif fname in self.THREAD_NAMES:
+                    self.threaded = True
+                    self.thread_evidence = f"{fname}(...)"
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.split(".")[0] in ("threading",
+                                                "concurrent"):
+                        self.threaded = True
+                        self.thread_evidence = f"import {a.name}"
+            elif isinstance(node, ast.ImportFrom) and node.module and \
+                    node.module.split(".")[0] in ("threading",
+                                                  "concurrent"):
+                self.threaded = True
+                self.thread_evidence = f"from {node.module} import"
             elif isinstance(node, (ast.FunctionDef,
                                    ast.AsyncFunctionDef)):
                 for dec in node.decorator_list:
@@ -467,23 +715,8 @@ class ModuleContext:
     }
 
     def _discover_threads_and_globals(self) -> None:
-        for node in ast.walk(self.tree):
-            if isinstance(node, ast.Import):
-                for a in node.names:
-                    if a.name.split(".")[0] in ("threading",
-                                                "concurrent"):
-                        self.threaded = True
-                        self.thread_evidence = f"import {a.name}"
-            elif isinstance(node, ast.ImportFrom) and node.module:
-                if node.module.split(".")[0] in ("threading",
-                                                 "concurrent"):
-                    self.threaded = True
-                    self.thread_evidence = f"from {node.module} import"
-            elif isinstance(node, ast.Call):
-                fname = self.resolve(node.func)
-                if fname in self.THREAD_NAMES:
-                    self.threaded = True
-                    self.thread_evidence = f"{fname}(...)"
+        # thread evidence rides _discover_jit's walk; only the
+        # module-level mutable scan remains here
         for stmt in self.tree.body:
             targets: List[ast.AST] = []
             value: Optional[ast.AST] = None
@@ -509,6 +742,31 @@ class ModuleContext:
         # ``X = None`` rebound later via ``global X`` counts as shared
         # state too, but rules detect that from the global-stmt side
         return False
+
+    # ---------------------------------------------- project-fact intake
+    def apply_facts(self, facts: Dict) -> None:
+        """Apply the picklable per-module fact bundle the project
+        layer computed (``project.ProjectContext.compute_facts``) —
+        the only channel between the interprocedural pass and the
+        per-module rule run, so ``--jobs`` workers can re-parse a file
+        and still see the whole-program facts."""
+        for qual, (kind, reason) in (facts.get("traced") or {}).items():
+            for fn in self.functions_by_qualname.get(qual, []):
+                self.force_traced(fn, kind == "jit", reason)
+        for qual, reason in (facts.get("hot_loop") or {}).items():
+            for fn in self.functions_by_qualname.get(qual, []):
+                self.force_hot_loop(fn, reason)
+        for name, d in (facts.get("external_jitted") or {}).items():
+            pos = d.get("donate_pos")
+            self.add_external_jitted(
+                name, bool(d.get("static")), bool(d.get("donate")),
+                donate_pos=None if pos is None else list(pos))
+        for key, names in (facts.get("rng_consumes") or {}).items():
+            self.rng_call_consumes[tuple(key)] = list(names)
+        axes = facts.get("axes")
+        if axes is not None:
+            self.axis_universe = set(axes)
+        self.axis_constants.update(facts.get("axis_constants") or {})
 
 
 # --------------------------------------------------------------- driver
@@ -554,25 +812,33 @@ def analyze_source(source: str, path: str = "<string>",
                    root: str = ".",
                    rule_ids: Optional[Iterable[str]] = None
                    ) -> List[Finding]:
-    """Analyze one source string; the unit tests' entry point."""
+    """Analyze one source string; the unit tests' entry point.  The
+    interprocedural layer links the single module against itself, so
+    same-file helper calls (self-methods, name-bound lambdas) resolve
+    exactly as they do in a whole-repo run."""
+    from analytics_zoo_tpu.analysis import project as project_mod
     ctx = ModuleContext(path, source, root=root)
-    return _run_rules(ctx, rule_ids)
+    proj = project_mod.ProjectContext([ctx])
+    ctx.apply_facts(proj.compute_facts().get(ctx.relpath, {}))
+    findings = _run_rules(ctx, rule_ids)
+    findings.extend(project_mod.project_findings(proj, rule_ids))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
 
 
-def analyze_paths(paths: Sequence[str], root: str = ".",
-                  rule_ids: Optional[Iterable[str]] = None
-                  ) -> Tuple[List[Finding], List[str]]:
-    """Analyze files/dirs.  Returns (findings, unparseable-file
-    errors).  Unparseable files are surfaced, not silently skipped —
-    a file the linter cannot read is a file it cannot vouch for."""
-    findings: List[Finding] = []
+def parse_contexts(paths: Sequence[str], root: str = "."
+                   ) -> Tuple[List["ModuleContext"], List[str]]:
+    """Parse a path set into ModuleContexts, collecting errors instead
+    of raising: missing targets must FAIL, not silently shrink
+    coverage (a renamed dir or a CI typo would otherwise turn the
+    gate into a no-op), and unreadable/unparseable files are files
+    the linter cannot vouch for.  Shared by ``analyze_paths`` and the
+    explain modes' ``load_project``."""
     errors: List[str] = []
     for p in paths:
         if not os.path.exists(p):
-            # a missing target must FAIL, not silently shrink
-            # coverage (a renamed dir or a CI typo would otherwise
-            # turn the gate into a no-op)
             errors.append(f"{p}: no such file or directory")
+    contexts: List[ModuleContext] = []
     for fpath in iter_python_files([p for p in paths
                                     if os.path.exists(p)]):
         try:
@@ -582,12 +848,102 @@ def analyze_paths(paths: Sequence[str], root: str = ".",
             errors.append(f"{fpath}: unreadable: {e}")
             continue
         try:
-            findings.extend(analyze_source(source, path=fpath, root=root,
-                                           rule_ids=rule_ids))
+            contexts.append(ModuleContext(fpath, source, root=root))
         except SyntaxError as e:
             errors.append(f"{fpath}: syntax error: {e}")
+    return contexts, errors
+
+
+# ---- ``--jobs`` worker state: populated in the parent immediately
+# before the fork-start pool is created, inherited by the children —
+# nothing here is pickled (ASTs travel by fork, findings by dataclass)
+_JOBS_STATE: Dict[str, Any] = {}
+
+
+def _jobs_worker(i: int) -> List[Finding]:
+    ctx = _JOBS_STATE["contexts"][i]
+    return _run_rules(ctx, _JOBS_STATE["rule_ids"])
+
+
+def analyze_paths(paths: Sequence[str], root: str = ".",
+                  rule_ids: Optional[Iterable[str]] = None,
+                  jobs: int = 1
+                  ) -> Tuple[List[Finding], List[str]]:
+    """Analyze files/dirs.  Returns (findings, unparseable-file
+    errors).  Unparseable files are surfaced, not silently skipped —
+    a file the linter cannot read is a file it cannot vouch for.
+
+    Two phases: (1) parse every file and run the interprocedural
+    project pass (serial — it needs the whole module graph); (2) run
+    the per-module rules, fanned out over ``jobs`` fork-started
+    worker processes when ``jobs > 1``.  Output is sorted either way,
+    so ``--jobs`` never changes what the gate sees."""
+    findings: List[Finding] = []
+    contexts, errors = parse_contexts(paths, root=root)
+
+    from analytics_zoo_tpu.analysis import project as project_mod
+    proj = project_mod.ProjectContext(contexts)
+    facts = proj.compute_facts()
+    for ctx in contexts:
+        ctx.apply_facts(facts.get(ctx.relpath, {}))
+
+    def run_project_rules() -> List[Finding]:
+        return project_mod.project_findings(proj, rule_ids)
+
+    if jobs > 1 and len(contexts) > 1:
+        findings.extend(_run_rules_pool(contexts, rule_ids, jobs,
+                                        overlap=run_project_rules))
+    else:
+        for ctx in contexts:
+            findings.extend(_run_rules(ctx, rule_ids))
+        findings.extend(run_project_rules())
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings, errors
+
+
+def _run_rules_pool(contexts: List[ModuleContext],
+                    rule_ids: Optional[Iterable[str]],
+                    jobs: int, overlap) -> List[Finding]:
+    """Fan the per-module rule runs over a fork-start process pool,
+    running ``overlap()`` (the project-level rules) in the parent
+    while the workers grind.  Fork (not spawn) is load-bearing:
+    children inherit the parent's already-parsed contexts AND its
+    stub ``analytics_zoo_tpu`` parent module, so a ``--jobs`` run
+    stays jax-free even on images where the real package is
+    importable.  Falls back to serial where fork is unavailable
+    (non-POSIX)."""
+    import multiprocessing
+
+    def serial() -> List[Finding]:
+        out = [f for ctx in contexts
+               for f in _run_rules(ctx, rule_ids)]
+        out.extend(overlap())
+        return out
+
+    # forking a parent that already loaded jax (tests importing the
+    # engine through the package) risks deadlock — jax spawns threads
+    # and os.fork() only clones the calling one.  The production path
+    # (scripts/zoolint via the jax-free file loader) never hits this;
+    # anywhere else, degrade to serial (same output, by contract).
+    if "jax" in sys.modules:
+        return serial()
+    try:
+        mp = multiprocessing.get_context("fork")
+    except ValueError:
+        return serial()
+    n = len(contexts)
+    _JOBS_STATE["contexts"] = contexts
+    _JOBS_STATE["rule_ids"] = list(rule_ids) if rule_ids else None
+    try:
+        with mp.Pool(processes=min(jobs, n)) as pool:
+            async_result = pool.map_async(
+                _jobs_worker, range(n),
+                chunksize=max(1, n // (min(jobs, n) * 2)))
+            out = list(overlap())   # parent works too, not just waits
+            chunks = async_result.get()
+        return out + [f for chunk in chunks for f in chunk]
+    finally:
+        _JOBS_STATE.clear()
 
 
 def _run_rules(ctx: ModuleContext,

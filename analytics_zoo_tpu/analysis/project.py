@@ -1,0 +1,704 @@
+"""zoolint's interprocedural layer: module graph + call graph.
+
+PR 5's rules were intraprocedural — a ``print`` inside a helper
+*called from* a jitted step, or a PRNG key handed to a consuming
+helper, was invisible.  This module links every analyzed file into a
+:class:`ProjectContext` and propagates the facts the per-module rules
+consume:
+
+- **traced reachability**: a function called (transitively) from a
+  jit/trace-compiled function is itself traced — JIT001/COMPILE003
+  then see through helper calls;
+- **hot-loop reachability**: a function called from inside a
+  train/step/predict loop is loop-resident wholesale — SYNC002/MEM009
+  then flag the per-iteration device pull it hides;
+- **PRNG consumer summaries**: which parameters of each function end
+  up consumed by a ``jax.random`` primitive, so a call site passing
+  the same key to two consuming helpers is an RNG006 finding;
+- **cross-module jitted callables** and the **mesh axis universe**
+  (every ``*_AXIS`` constant / ``Mesh(...)`` axis literal in the
+  project) for COMPILE003/MEM009/SHARD007;
+- **lock summaries** (which locks each function acquires, which
+  functions block) consumed by LOCK010's project-wide deadlock pass.
+
+Resolution is deliberately conservative — precision over recall, the
+same contract the PR 5 rules keep.  A call resolves only when it is a
+direct name (local def, name-bound lambda, imported function) or a
+single-level ``self.method()`` / class instantiation; everything else
+(duck-typed objects, ``model.apply``) stays unresolved and propagates
+nothing.
+
+All results are exported as a **picklable per-module fact bundle**
+(:meth:`ProjectContext.compute_facts` →
+``ModuleContext.apply_facts``), the only channel into the per-module
+rule runs — which is what lets ``zoolint --jobs N`` fan those runs
+out over a process pool without re-doing (or disagreeing about) the
+whole-program analysis.
+
+Stdlib-only; never imports jax (the ``scripts/zoolint`` contract).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from analytics_zoo_tpu.analysis.core import (
+    Finding, ModuleContext, _dotted, donated_positions)
+
+FuncKey = Tuple[str, str]          # (relpath, qualname)
+
+#: fallback axis names when the project defines none (matches
+#: parallel/mesh.py's canonical ALL_AXES — kept literal so the
+#: analyzer never imports the package it lints)
+CANONICAL_AXES = ("data", "fsdp", "model", "seq", "pipe", "expert")
+
+#: parameter names that look like optimizer state — the signature of
+#: a *train* step (vs eval/predict)
+STATE_PARAMS = ("opt_state", "optimizer_state", "opt_states")
+
+
+def _jit_kw_spec(kws) -> Dict:
+    """Picklable spec of a jit wrapper's keywords for the fact
+    bundle.  ``donate_pos`` preserves the LITERAL donate_argnums
+    positions so MEM009's coverage check survives the module
+    boundary (None = declared but unmappable — assume covered)."""
+    spec: Dict = {
+        "static": any(k.arg in ("static_argnums", "static_argnames")
+                      for k in kws),
+        "donate": any(k.arg in ("donate_argnums", "donate_argnames")
+                      for k in kws),
+    }
+    if spec["donate"]:
+        pos = donated_positions(kws)
+        spec["donate_pos"] = None if pos is None else sorted(pos)
+    return spec
+
+
+class CallEdge:
+    __slots__ = ("site", "callee", "in_callback")
+
+    def __init__(self, site: ast.Call, callee: FuncKey,
+                 in_callback: bool):
+        self.site = site
+        self.callee = callee
+        self.in_callback = in_callback
+
+
+class ProjectContext:
+    """The linked view over every analyzed module."""
+
+    def __init__(self, contexts: Sequence[ModuleContext]):
+        self.contexts = list(contexts)
+        self.by_relpath: Dict[str, ModuleContext] = {
+            c.relpath: c for c in self.contexts}
+        self.by_module: Dict[str, ModuleContext] = {
+            c.module_name: c for c in self.contexts}
+        #: (relpath, qualname) -> function nodes (lambda quals repeat)
+        self.functions: Dict[FuncKey, List[ast.AST]] = {}
+        self._qual_of: Dict[int, str] = {}      # id(fn) -> qualname
+        #: caller FuncKey -> resolved outgoing call edges
+        self.calls: Dict[FuncKey, List[CallEdge]] = {}
+        #: jit-root train-step functions (thread opt-state), for the
+        #: --explain-comms / --explain-hbm reports
+        self.train_steps: List[Dict] = []
+        self.axis_names: Set[str] = set()
+        self.axis_constants: Dict[str, str] = {}
+        #: per-ctx trace-wrapper call sites found during the scan
+        self._wrapper_calls: Dict[str, List[Tuple[ast.Call, str]]] = {}
+        self._index_functions()
+        self._scan_modules()
+        self._marks_traced: Dict[FuncKey, Tuple[str, str]] = {}
+        self._marks_hot: Dict[FuncKey, str] = {}
+        #: relpath -> {name: {"static","donate"}} for jit targets only
+        #: the project resolution could see (ride the fact bundle)
+        self._seed_jitted: Dict[str, Dict[str, Dict]] = {}
+        self._rng_consumed: Dict[FuncKey, Set[str]] = {}
+        self._rng_call_facts: Dict[str, Dict[Tuple[int, int],
+                                             List[str]]] = {}
+        self._propagate_traced()
+        self._propagate_hot_loops()
+        self._summarize_rng_consumers()
+        self._collect_train_steps()
+
+    # ------------------------------------------------------------ indexing
+    def _index_functions(self) -> None:
+        for ctx in self.contexts:
+            for fn in ctx.functions:
+                qual = ctx._qualnames.get(id(fn), "")
+                if not qual:
+                    continue
+                self._qual_of[id(fn)] = qual
+                self.functions.setdefault(
+                    (ctx.relpath, qual), []).append(fn)
+
+    def ctx_for(self, key: FuncKey) -> Optional[ModuleContext]:
+        return self.by_relpath.get(key[0])
+
+    def node_for(self, key: FuncKey) -> Optional[ast.AST]:
+        nodes = self.functions.get(key)
+        return nodes[0] if nodes else None
+
+    def func_params(self, key: FuncKey) -> List[str]:
+        return self.func_params_of_node(self.node_for(key))
+
+    # ------------------------------------- the one per-module scan
+    def _scan_modules(self) -> None:
+        """ONE walk per module collecting everything the project
+        needs from call sites: the axis universe, the resolved call
+        graph, trace-wrapper sites (jit seeds), callback-protected
+        regions.  Merged because tree traversal dominates the whole
+        pass (the full-repo gate is CI's slowest tier-1 subprocess)."""
+        from analytics_zoo_tpu.analysis.rules import ImpureJitRule
+        for ctx in self.contexts:
+            for stmt in ctx.tree.body:
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                if isinstance(stmt.value, ast.Constant) and \
+                        isinstance(stmt.value.value, str):
+                    for tgt in stmt.targets:
+                        if isinstance(tgt, ast.Name) and \
+                                tgt.id.endswith("_AXIS"):
+                            axis = stmt.value.value
+                            self.axis_names.add(axis)
+                            self.axis_constants[
+                                f"{ctx.module_name}.{tgt.id}"] = axis
+            callback_sites: List[ast.Call] = []
+            edges: List[Tuple[FuncKey, CallEdge]] = []
+            wrappers: List[Tuple[ast.Call, str]] = []
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = ctx.resolve(node.func) or ""
+                tail = fname.rsplit(".", 1)[-1]
+                if tail == "Mesh" and len(node.args) >= 2:
+                    self.axis_names.update(
+                        self._string_elts(node.args[1]))
+                elif tail == "create_mesh":
+                    for arg in list(node.args) + \
+                            [kw.value for kw in node.keywords]:
+                        if isinstance(arg, ast.Dict):
+                            for k in arg.keys:
+                                if isinstance(k, ast.Constant) and \
+                                        isinstance(k.value, str):
+                                    self.axis_names.add(k.value)
+                if fname in ImpureJitRule.CALLBACK_HOSTS:
+                    callback_sites.append(node)
+                if fname in ctx.TRACE_WRAPPERS and node.args:
+                    wrappers.append((node, fname))
+                caller = ctx.enclosing_function(node)
+                if caller is None:
+                    continue   # module-level init: runs once, untraced
+                caller_qual = self._qual_of.get(id(caller))
+                if not caller_qual:
+                    continue
+                callee = self.resolve_call(ctx, node)
+                if callee is None:
+                    continue
+                edges.append(((ctx.relpath, caller_qual),
+                              CallEdge(node, callee, False)))
+            # callback-host args run on HOST, not under the trace:
+            # flag the edges inside them (rare — walk only their args)
+            protected: Set[int] = set()
+            for cb in callback_sites:
+                for arg in list(cb.args) + \
+                        [kw.value for kw in cb.keywords]:
+                    for sub in ast.walk(arg):
+                        protected.add(id(sub))
+            for key, edge in edges:
+                if id(edge.site) in protected:
+                    edge.in_callback = True
+                self.calls.setdefault(key, []).append(edge)
+            self._wrapper_calls[ctx.relpath] = wrappers
+
+    @staticmethod
+    def _string_elts(node: ast.AST) -> List[str]:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return [e.value for e in node.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)]
+        return []
+
+    def resolve_call(self, ctx: ModuleContext,
+                     call: ast.Call) -> Optional[FuncKey]:
+        """Resolve a call site to a (relpath, qualname) when it can be
+        done conservatively; None otherwise."""
+        return self.resolve_func_expr(ctx, call.func, call)
+
+    def resolve_func_expr(self, ctx: ModuleContext, expr: ast.AST,
+                          origin: ast.AST,
+                          depth: int = 0) -> Optional[FuncKey]:
+        """Resolve an expression DENOTING a callable (the jit arg in
+        ``jax.jit(self._step_core)``, a call's ``func``)."""
+        if depth > 4:
+            return None
+        if isinstance(expr, ast.Lambda):
+            qual = self._qual_of.get(id(expr))
+            return (ctx.relpath, qual) if qual else None
+        if isinstance(expr, ast.Call):
+            # functools.partial(f, ...) denotes f
+            if ctx.resolve(expr.func) in ("functools.partial",
+                                          "partial") and expr.args:
+                return self.resolve_func_expr(ctx, expr.args[0],
+                                              origin, depth + 1)
+            return None
+        if isinstance(expr, ast.Name):
+            target = ctx._local_function_named(origin, expr.id)
+            if target is not None:
+                qual = self._qual_of.get(id(target))
+                return (ctx.relpath, qual) if qual else None
+            lam = ctx._local_lambda_named(origin, expr.id)
+            if lam is not None:
+                qual = self._qual_of.get(id(lam))
+                return (ctx.relpath, qual) if qual else None
+            bound = self._local_binding_value(ctx, origin, expr.id)
+            if bound is not None:
+                return self.resolve_func_expr(ctx, bound, origin,
+                                              depth + 1)
+            dotted = ctx.aliases.get(expr.id)
+            if dotted and dotted != expr.id:
+                return self._resolve_dotted(dotted)
+            return None
+        if isinstance(expr, ast.Attribute):
+            d = _dotted(expr)
+            if d is None:
+                return None
+            head = d.split(".", 1)[0]
+            if head in ("self", "cls"):
+                if d.count(".") != 1:
+                    return None   # self.a.b — another object's method
+                cls = ctx.enclosing_class(origin)
+                if cls is None:
+                    return None
+                qual = f"{ctx.class_qualname(cls)}.{expr.attr}"
+                if (ctx.relpath, qual) in self.functions:
+                    return (ctx.relpath, qual)
+                return None
+            resolved = ctx.resolve(expr)
+            if resolved:
+                return self._resolve_dotted(resolved)
+        return None
+
+    @staticmethod
+    def _local_binding_value(ctx: ModuleContext, origin: ast.AST,
+                             name: str) -> Optional[ast.AST]:
+        """The RHS of the deepest in-scope ``name = <expr>`` binding
+        (used to chase ``fn = self._step_core; jax.jit(fn)``)."""
+        return ctx.scoped_binding_value(
+            origin, name, (ast.Attribute, ast.Name))
+
+    def _resolve_dotted(self, dotted: str) -> Optional[FuncKey]:
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            mctx = self.by_module.get(".".join(parts[:i]))
+            if mctx is None:
+                continue
+            rest = ".".join(parts[i:])
+            if (mctx.relpath, rest) in self.functions:
+                return (mctx.relpath, rest)
+            init = f"{rest}.__init__"
+            if (mctx.relpath, init) in self.functions:
+                return (mctx.relpath, init)
+            return None
+        return None
+
+    # --------------------------------------------- traced propagation
+    def _traced_seeds(self) -> Dict[FuncKey, bool]:
+        """FuncKey -> compiled? for every function each module already
+        discovered as traced, plus jit-wrapper args only the richer
+        project resolution can see (``jax.jit(self._step_core)``,
+        ``fn = lambda ...; jax.jit(fn)``)."""
+        seeds: Dict[FuncKey, bool] = {}
+        for ctx in self.contexts:
+            for fn in ctx.functions:
+                if id(fn) in ctx.traced_functions:
+                    qual = self._qual_of.get(id(fn))
+                    if qual:
+                        key = (ctx.relpath, qual)
+                        seeds[key] = seeds.get(key, False) or \
+                            id(fn) in ctx.jit_functions
+            for node, fname in self._wrapper_calls.get(
+                    ctx.relpath, ()):
+                key = self.resolve_func_expr(ctx, node.args[0], node)
+                if key is None:
+                    continue
+                compiled = fname in ctx.JIT_WRAPPERS
+                seeds[key] = seeds.get(key, False) or compiled
+                node_fn = self.node_for(key)
+                kctx = self.ctx_for(key)
+                if node_fn is not None and kctx is not None:
+                    reason = (f"wrapped by {fname} at "
+                              f"{ctx.relpath}:{node.lineno}")
+                    kctx.force_traced(node_fn, compiled, reason)
+                    # ALSO record into the fact bundle: the bundle is
+                    # the documented only-channel into per-module rule
+                    # runs, so a worker that re-parses (rather than
+                    # fork-inherits) must see these marks too
+                    prev = self._marks_traced.get(key)
+                    if prev is None or (compiled and prev[0] != "jit"):
+                        self._marks_traced[key] = (
+                            "jit" if compiled else "trace", reason)
+                    if key[0] == ctx.relpath and \
+                            isinstance(node_fn, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef)) \
+                            and compiled and "." not in key[1] \
+                            and self._jit_rebinds_name(ctx, node,
+                                                       key[1]):
+                        # only when the jit result is bound BACK onto
+                        # the wrapped function's own name (``helper =
+                        # jax.jit(helper)``) does calling that name
+                        # run compiled code — ``step = jax.jit(
+                        # helper)`` leaves direct ``helper(...)``
+                        # calls eager, and flagging them as jit call
+                        # sites minted false MEM009/COMPILE003 hits
+                        ctx.jitted_callables.setdefault(
+                            key[1], list(node.keywords))
+                        self._seed_jitted.setdefault(
+                            ctx.relpath, {}).setdefault(
+                                key[1], _jit_kw_spec(node.keywords))
+        return seeds
+
+    @staticmethod
+    def _jit_rebinds_name(ctx: ModuleContext, call: ast.Call,
+                          name: str) -> bool:
+        """Is the jit-wrapper ``call`` assigned back onto ``name``
+        itself (possibly through chained wrappers like
+        ``monitor.wrap(jax.jit(f))``)?"""
+        cur = ctx.parent(call)
+        while isinstance(cur, ast.Call):
+            cur = ctx.parent(cur)
+        if not isinstance(cur, ast.Assign):
+            return False
+        for tgt in cur.targets:
+            if isinstance(tgt, ast.Name) and tgt.id == name:
+                return True
+        return False
+
+    def _propagate_traced(self) -> None:
+        seeds = self._traced_seeds()
+        state: Dict[FuncKey, bool] = dict(seeds)
+        queue = list(seeds.items())
+        while queue:
+            key, compiled = queue.pop()
+            for edge in self.calls.get(key, ()):
+                if edge.in_callback:
+                    continue   # host side-channel out of the trace
+                cur = state.get(edge.callee)
+                if cur is None or (compiled and not cur):
+                    state[edge.callee] = compiled or bool(cur)
+                    reason = (f"called from "
+                              f"{'jitted' if compiled else 'traced'} "
+                              f"{key[1]} ({key[0]}:"
+                              f"{edge.site.lineno})")
+                    if edge.callee not in seeds:
+                        self._marks_traced[edge.callee] = (
+                            "jit" if state[edge.callee] else "trace",
+                            reason)
+                    queue.append((edge.callee, state[edge.callee]))
+
+    # ------------------------------------------- hot-loop propagation
+    def _propagate_hot_loops(self) -> None:
+        traced = set(self._marks_traced)
+        hot: Dict[FuncKey, str] = {}
+        queue: List[FuncKey] = []
+
+        def callee_is_traced(key: FuncKey) -> bool:
+            if key in traced:
+                return True
+            kctx = self.ctx_for(key)
+            node = self.node_for(key)
+            return bool(kctx and node and
+                        id(node) in kctx.traced_functions)
+
+        for (rel, qual), edges in self.calls.items():
+            ctx = self.by_relpath[rel]
+            if (rel, qual) in traced:
+                continue   # traced by propagation, not host
+            caller_nodes = self.functions.get((rel, qual), [])
+            for edge in edges:
+                caller = ctx.enclosing_function(edge.site)
+                if caller is None or caller not in caller_nodes:
+                    continue
+                if not ctx.is_hot_function(caller):
+                    continue
+                if not ctx.in_loop(edge.site, lexical_only=True):
+                    continue
+                if self._in_except_handler(ctx, edge.site):
+                    continue   # recovery paths run once per
+                    # failure, not per steady-state iteration
+                if callee_is_traced(edge.callee):
+                    continue   # dispatching a jit is the POINT
+                if edge.callee not in hot:
+                    hot[edge.callee] = (
+                        f"called from the loop in hot "
+                        f"{qual} ({rel}:{edge.site.lineno})")
+                    queue.append(edge.callee)
+        # a hot-loop-resident function's ENTIRE body is loop code:
+        # every call it makes is per-iteration too
+        while queue:
+            key = queue.pop()
+            kctx = self.ctx_for(key)
+            for edge in self.calls.get(key, ()):
+                if callee_is_traced(edge.callee):
+                    continue
+                if kctx is not None and \
+                        self._in_except_handler(kctx, edge.site):
+                    continue
+                if edge.callee not in hot:
+                    hot[edge.callee] = (
+                        f"reached from a hot loop via {key[1]} "
+                        f"({key[0]}:{edge.site.lineno})")
+                    queue.append(edge.callee)
+        self._marks_hot = hot
+
+    @staticmethod
+    def _in_except_handler(ctx: ModuleContext, node: ast.AST) -> bool:
+        cur = ctx.parent(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                      ast.Lambda)):
+            if isinstance(cur, ast.ExceptHandler):
+                return True
+            cur = ctx.parent(cur)
+        return False
+
+    # --------------------------------------------- RNG consumer summaries
+    def _direct_key_consumptions(
+            self, ctx: ModuleContext,
+            fn: ast.AST) -> Set[str]:
+        """Parameter names of ``fn`` consumed by a jax.random
+        primitive (or rng= kwarg) directly in its body."""
+        from analytics_zoo_tpu.analysis.rules import KeyReuseRule
+        params = set(self.func_params_of_node(fn))
+        if not params:
+            return set()
+        out: Set[str] = set()
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        stack: List[ast.AST] = list(body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue   # nested scope: separate timing
+            stack.extend(ast.iter_child_nodes(node))
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.resolve(node.func)
+            if name and name.startswith("jax.random."):
+                prim = name.rsplit(".", 1)[1]
+                if prim in KeyReuseRule.DERIVE:
+                    continue
+                if node.args and isinstance(node.args[0], ast.Name) \
+                        and node.args[0].id in params:
+                    out.add(node.args[0].id)
+            else:
+                for kw in node.keywords:
+                    if kw.arg == "rng" and \
+                            isinstance(kw.value, ast.Name) and \
+                            kw.value.id in params:
+                        out.add(kw.value.id)
+        return out
+
+    @staticmethod
+    def func_params_of_node(fn: Optional[ast.AST]) -> List[str]:
+        args = getattr(fn, "args", None)
+        if args is None:
+            return []
+        return [a.arg for a in
+                (args.posonlyargs + args.args + args.kwonlyargs)]
+
+    def _consumed_args_at(self, ctx: ModuleContext, call: ast.Call,
+                          callee: FuncKey,
+                          consumed: Dict[FuncKey, Set[str]]
+                          ) -> List[str]:
+        """Names of Name-arguments at ``call`` that land on a
+        key-consuming parameter of ``callee``."""
+        target_params = consumed.get(callee)
+        if not target_params:
+            return []
+        params = self.func_params(callee)
+        offset = 0
+        if isinstance(call.func, ast.Attribute):
+            head = _dotted(call.func) or ""
+            if head.split(".", 1)[0] in ("self", "cls") and params \
+                    and params[0] in ("self", "cls"):
+                offset = 1
+        out: List[str] = []
+        for i, arg in enumerate(call.args):
+            j = i + offset
+            if j < len(params) and params[j] in target_params and \
+                    isinstance(arg, ast.Name):
+                out.append(arg.id)
+        for kw in call.keywords:
+            if kw.arg in target_params and \
+                    isinstance(kw.value, ast.Name):
+                out.append(kw.value.id)
+        return out
+
+    def _summarize_rng_consumers(self) -> None:
+        consumed: Dict[FuncKey, Set[str]] = {}
+        for ctx in self.contexts:
+            for fn in ctx.functions:
+                qual = self._qual_of.get(id(fn))
+                if not qual:
+                    continue
+                direct = self._direct_key_consumptions(ctx, fn)
+                if direct:
+                    consumed[(ctx.relpath, qual)] = direct
+        # transitive: a param forwarded into a consuming position of a
+        # resolvable callee is consumed too
+        changed = True
+        while changed:
+            changed = False
+            for key, edges in self.calls.items():
+                params = set(self.func_params(key))
+                if not params:
+                    continue
+                for edge in edges:
+                    for name in self._consumed_args_at(
+                            self.ctx_for(key), edge.site, edge.callee,
+                            consumed):
+                        if name in params and \
+                                name not in consumed.get(key, ()):
+                            consumed.setdefault(key, set()).add(name)
+                            changed = True
+        self._rng_consumed = consumed
+        # per-call-site facts for RNG006
+        for key, edges in self.calls.items():
+            ctx = self.ctx_for(key)
+            for edge in edges:
+                names = self._consumed_args_at(ctx, edge.site,
+                                               edge.callee, consumed)
+                if names:
+                    self._rng_call_facts.setdefault(
+                        key[0], {})[(edge.site.lineno,
+                                     edge.site.col_offset)] = names
+
+    # --------------------------------------------------- train-step roots
+    def _collect_train_steps(self) -> None:
+        """Jit-compiled functions that thread optimizer state — the
+        steps the --explain-comms/--explain-hbm reports describe."""
+        seen: Set[FuncKey] = set()
+        for ctx in self.contexts:
+            for fn in ctx.functions:
+                qual = self._qual_of.get(id(fn), "")
+                key = (ctx.relpath, qual)
+                if id(fn) not in ctx.jit_functions and \
+                        self._marks_traced.get(key, ("",))[0] != "jit":
+                    continue
+                if key in seen:
+                    continue
+                params = self.func_params_of_node(fn)
+                if not any(p in STATE_PARAMS for p in params):
+                    continue
+                seen.add(key)
+                self.train_steps.append({
+                    "path": ctx.relpath,
+                    "symbol": qual or "<lambda>",
+                    "line": getattr(fn, "lineno", 1),
+                    "params": params,
+                })
+        self.train_steps.sort(key=lambda d: (d["path"], d["line"]))
+
+    # ------------------------------------------------------------ facts
+    def compute_facts(self) -> Dict[str, Dict]:
+        axes = sorted(self.axis_names) if self.axis_names \
+            else sorted(CANONICAL_AXES)
+        facts: Dict[str, Dict] = {}
+        for ctx in self.contexts:
+            external = self._external_jitted_for(ctx)
+            # seed-resolved jit targets of THIS module too — the
+            # bundle must be self-sufficient for a re-parsing worker
+            for name, spec in self._seed_jitted.get(
+                    ctx.relpath, {}).items():
+                external.setdefault(name, spec)
+            facts[ctx.relpath] = {
+                "traced": {},
+                "hot_loop": {},
+                "external_jitted": external,
+                "rng_consumes": self._rng_call_facts.get(
+                    ctx.relpath, {}),
+                "axes": axes,
+                "axis_constants": dict(self.axis_constants),
+            }
+        for (rel, qual), (kind, reason) in self._marks_traced.items():
+            if rel in facts:
+                facts[rel]["traced"][qual] = (kind, reason)
+        for (rel, qual), reason in self._marks_hot.items():
+            if rel in facts:
+                facts[rel]["hot_loop"][qual] = reason
+        return facts
+
+    def _external_jitted_for(self, ctx: ModuleContext) -> Dict[str, Dict]:
+        """Names in ``ctx`` that denote jit-compiled callables defined
+        in OTHER analyzed modules (``from m import step_fn`` and
+        ``m.step_fn`` forms)."""
+        out: Dict[str, Dict] = {}
+
+        for alias, dotted in ctx.aliases.items():
+            if dotted == alias:
+                continue
+            # from m import f (alias -> "m.f")
+            mod, _, fname = dotted.rpartition(".")
+            mctx = self.by_module.get(mod)
+            if mctx is not None and mctx is not ctx and \
+                    fname in mctx.jitted_callables:
+                out[alias] = _jit_kw_spec(mctx.jitted_callables[fname])
+                continue
+            # import m [as alias] (alias -> "m"): expose m.f for every
+            # module-level jitted callable f
+            mctx = self.by_module.get(dotted)
+            if mctx is not None and mctx is not ctx:
+                for fname, kws in mctx.jitted_callables.items():
+                    if "." not in fname and not fname.startswith("self"):
+                        out[f"{alias}.{fname}"] = _jit_kw_spec(kws)
+        return out
+
+
+# ----------------------------------------------------- project rules
+
+
+_PROJECT_RULE_CLASSES: List[type] = []
+
+
+def register_project_rule(cls):
+    """Class decorator for rules that need the WHOLE project (lock
+    graphs); they implement ``check_project(proj) -> List[Finding]``
+    instead of per-module visitors."""
+    assert cls.rule_id
+    _PROJECT_RULE_CLASSES.append(cls)
+    return cls
+
+
+def project_rule_classes() -> List[type]:
+    """The registered project-level rules (for --list-rules and the
+    docs catalog); rules_graph registers on import."""
+    from analytics_zoo_tpu.analysis import rules_graph  # noqa: F401
+    return list(_PROJECT_RULE_CLASSES)
+
+
+def project_findings(proj: ProjectContext,
+                     rule_ids: Optional[Iterable[str]] = None
+                     ) -> List[Finding]:
+    # rules_graph registers its project rules on import
+    from analytics_zoo_tpu.analysis import rules_graph  # noqa: F401
+    wanted = {r.upper() for r in rule_ids} if rule_ids else None
+    out: List[Finding] = []
+    for cls in _PROJECT_RULE_CLASSES:
+        if wanted is not None and cls.rule_id not in wanted:
+            continue
+        for f in cls().check_project(proj):
+            ctx = proj.by_relpath.get(f.path)
+            if ctx is None or not ctx.is_suppressed(f):
+                out.append(f)
+    return out
+
+
+def load_project(paths: Sequence[str], root: str = "."
+                 ) -> Tuple[ProjectContext, List[str]]:
+    """Parse + link a path set WITHOUT running the per-module rules —
+    the entry point of the CLI's --explain-comms/--explain-hbm
+    reports."""
+    from analytics_zoo_tpu.analysis.core import parse_contexts
+    contexts, errors = parse_contexts(paths, root=root)
+    return ProjectContext(contexts), errors

@@ -32,6 +32,21 @@ from analytics_zoo_tpu.analysis.core import (
 # --------------------------------------------------------------- helpers
 
 
+def _is_none_guard(test: ast.AST, target: str) -> bool:
+    """``target is None`` / ``target == None`` / ``not target`` — the
+    guard test of the platform's lazy-init idiom (``target`` is the
+    dotted form: a bare name or ``self.attr`` chain)."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+            isinstance(test.ops[0], (ast.Is, ast.Eq)) and \
+            isinstance(test.comparators[0], ast.Constant) and \
+            test.comparators[0].value is None and \
+            _dotted(test.left) == target:
+        return True
+    return isinstance(test, ast.UnaryOp) and \
+        isinstance(test.op, ast.Not) and \
+        _dotted(test.operand) == target
+
+
 def _local_bindings(fn: ast.AST) -> Set[str]:
     """Names bound INSIDE ``fn`` (params + every assignment form), not
     descending into nested functions — the complement is the
@@ -208,12 +223,54 @@ class ImpureJitRule(Rule):
         fn = ctx.enclosing_function(node)
         if fn is None or id(fn) not in ctx.traced_functions:
             return
-        names = ", ".join(node.names)
+        # the lazy-init singleton idiom (``global X; if X is None:
+        # X = ctor(); return X``) memoizes HOST state — calling such a
+        # getter at trace time is the platform's config-read
+        # convention, not a trace-time-only mutation of program state
+        flagged = [n for n in node.names
+                   if not self._memoized_only(fn, ctx, n)]
+        if not flagged:
+            return
+        names = ", ".join(flagged)
         self.report(
             node,
             f"jitted function declares {kind} '{names}' — writes to "
             f"it happen at trace time only and are invisible to the "
             f"compiled program")
+
+    @staticmethod
+    def _memoized_only(fn: ast.AST, ctx: ModuleContext,
+                       name: str) -> bool:
+        """Every write to ``name`` inside ``fn`` sits under an
+        ``if name is None:`` / ``if not name:`` guard (or there is no
+        write at all)."""
+
+        def guarded(node: ast.AST) -> bool:
+            # the write must sit in the THEN branch of the guard —
+            # an ``else:`` write runs exactly when the name is
+            # already set, i.e. on every retrace
+            prev: ast.AST = node
+            cur = ctx.parent(node)
+            while cur is not None and cur is not fn:
+                if isinstance(cur, ast.If) and \
+                        any(child is prev for child in cur.body) and \
+                        _is_none_guard(cur.test, name):
+                    return True
+                prev = cur
+                cur = ctx.parent(cur)
+            return False
+
+        for node in ast.walk(fn):
+            targets: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == name and \
+                        not guarded(node):
+                    return False
+        return True
 
     def visit_Assign(self, node: ast.Assign, ctx: ModuleContext) -> None:
         self._check_store(node, node.targets, ctx)
@@ -282,7 +339,24 @@ class HostSyncRule(Rule):
         "time.", "len", "range", "enumerate", "os.", "math.",
         "numpy.", "id", "sorted", "min", "max", "sum", "abs", "round",
         "str", "repr", "perf_counter", "get_config",
+        "int", "float", "bool",
+        # host metadata, not device arrays
+        "jax.devices", "jax.local_devices", "jax.device_count",
+        "jax.local_device_count", "jax.process_count",
+        "jax.process_index",
     )
+    #: method names whose results are host values regardless of the
+    #: receiver (string/dict/env plumbing — the interprocedural
+    #: hot-loop marks would otherwise taint every config parser)
+    HOST_METHODS = {
+        "strip", "lstrip", "rstrip", "split", "rsplit", "lower",
+        "upper", "format", "join", "decode", "encode", "group",
+        "get", "gethostname", "getvalue", "items", "keys", "values",
+        "read", "readline",
+        # NOT "copy": list/dict.copy() is host plumbing, but
+        # jax.Array.copy() preserves device residency — classified by
+        # the receiver below instead
+    }
 
     def visit_Call(self, node: ast.Call, ctx: ModuleContext) -> None:
         fn = ctx.enclosing_function(node)
@@ -333,10 +407,16 @@ class HostSyncRule(Rule):
                 f"step, or branch on a host-side counter")
 
     def _device_sourced(self, name: str, fn: ast.AST,
-                        jit_only: bool = False) -> bool:
+                        jit_only: bool = False,
+                        _seen: Optional[Set[str]] = None) -> bool:
         """Was ``name`` assigned (anywhere in ``fn``) from a function
         call that plausibly returns device values?  Parameters and
         host-source calls don't count — precision over recall."""
+        if _seen is None:
+            _seen = set()
+        if name in _seen:
+            return False   # copy-chain cycle: stay conservative
+        _seen.add(name)
         ctx = self._ctx
         assert ctx is not None
         # explicit source-order queue so nested defs/lambdas are
@@ -368,6 +448,19 @@ class HostSyncRule(Rule):
                 value = value.value
             if not isinstance(value, ast.Call):
                 return False   # literal / arithmetic — host
+            if isinstance(value.func, ast.Attribute) and \
+                    value.func.attr in self.HOST_METHODS:
+                return False   # string/dict/env plumbing
+            if isinstance(value.func, ast.Attribute) and \
+                    value.func.attr == "copy":
+                # device-ness passes through .copy(): a jax.Array
+                # copy is still on device, a list/dict copy is host
+                recv = value.func.value
+                if isinstance(recv, ast.Name):
+                    return self._device_sourced(recv.id, fn,
+                                                jit_only=jit_only,
+                                                _seen=_seen)
+                return False   # non-name receiver: host default
             vname = ctx.resolve(value.func) or ""
             if jit_only:
                 target = _dotted(value.func)
@@ -411,7 +504,7 @@ class RecompileHazardRule(Rule):
     def visit_Call(self, node: ast.Call, ctx: ModuleContext) -> None:
         name = ctx.resolve(node.func)
         if name in ctx.JIT_WRAPPERS:
-            if ctx.in_loop(node):
+            if ctx.in_loop(node) and not self._memoized(node, ctx):
                 self.report(
                     node,
                     "jax.jit called inside a loop builds a fresh "
@@ -465,6 +558,33 @@ class RecompileHazardRule(Rule):
                     f"concretization; use jax.debug.print for runtime "
                     f"values")
                 return
+
+    @staticmethod
+    def _memoized(node: ast.Call, ctx: ModuleContext) -> bool:
+        """A jit built under an ``if self._step is None: self._step =
+        jax.jit(...)`` guard compiles ONCE no matter how hot the
+        enclosing code is — the platform's own lazy-build idiom."""
+        target: Optional[str] = None
+        cur = ctx.parent(node)
+        while isinstance(cur, ast.Call):   # monitor.wrap(jax.jit(..))
+            cur = ctx.parent(cur)
+        if isinstance(cur, ast.Assign) and len(cur.targets) == 1:
+            target = _dotted(cur.targets[0])
+        if target is None:
+            return False
+        prev: ast.AST = cur
+        guard = ctx.parent(cur)
+        while guard is not None and not isinstance(
+                guard, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # only the THEN branch of the None-check memoizes; a build
+            # in the ``else:`` runs on every pass through the guard
+            if isinstance(guard, ast.If) and \
+                    any(child is prev for child in guard.body) and \
+                    _is_none_guard(guard.test, target):
+                return True
+            prev = guard
+            guard = ctx.parent(guard)
+        return False
 
     @staticmethod
     def _params(fn: ast.AST) -> Set[str]:
@@ -741,41 +861,81 @@ class KeyReuseRule(Rule):
 
     def _scan(self, stmts: List[ast.stmt], consumed: Dict[str, ast.AST],
               reported: Set[Tuple[int, int]], ctx: ModuleContext,
-              fn: ast.AST) -> None:
+              fn: ast.AST,
+              break_sink: Optional[Dict[str, ast.AST]] = None) -> None:
         for stmt in stmts:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                                  ast.ClassDef)):
                 continue   # nested scopes get their own pass
+            if isinstance(stmt, ast.Break):
+                # a break path leaves the loop BODY but still reaches
+                # the code after the loop — its consumptions flow to
+                # the enclosing loop's post-loop state, not the rest
+                # of the body
+                if break_sink is not None:
+                    for k, v in consumed.items():
+                        break_sink.setdefault(k, v)
+                continue
             if isinstance(stmt, ast.If):
                 # the test expression evaluates first, on every path
                 self._apply_expr(stmt.test, consumed, reported, ctx)
                 # each branch starts from the current state; afterwards
                 # a key consumed in EITHER branch counts as consumed
-                # (max-merge: one use per executed path is fine)
+                # (max-merge: one use per executed path is fine) — but
+                # a branch that TERMINATES (return/raise/break/
+                # continue) never reaches the code after the If, so
+                # its consumptions must not poison the fall-through
+                # state (``if small: return normal(rng); ...use rng``)
                 before = dict(consumed)
-                self._scan(stmt.body, consumed, reported, ctx, fn)
-                other = dict(before)
-                self._scan(stmt.orelse, other, reported, ctx, fn)
-                for k, v in other.items():
-                    consumed.setdefault(k, v)
+                body_state = dict(before)
+                self._scan(stmt.body, body_state, reported, ctx, fn,
+                           break_sink)
+                else_state = dict(before)
+                self._scan(stmt.orelse, else_state, reported, ctx, fn,
+                           break_sink)
+                body_term = self._terminates(stmt.body)
+                else_term = self._terminates(stmt.orelse)
+                consumed.clear()
+                if body_term and not else_term:
+                    consumed.update(else_state)
+                elif else_term and not body_term:
+                    consumed.update(body_state)
+                elif body_term and else_term:
+                    consumed.update(before)   # code after is dead-ish
+                else:
+                    consumed.update(body_state)
+                    for k, v in else_state.items():
+                        consumed.setdefault(k, v)
                 continue
             if isinstance(stmt, (ast.For, ast.AsyncFor)):
                 # iterable evaluates ONCE, before the loop
                 self._apply_expr(stmt.iter, consumed, reported, ctx)
                 # two passes ≈ two iterations: a consume with no rebind
                 # inside the loop body reuses the key on iteration 2;
-                # the loop target rebinds fresh per iteration
+                # the loop target rebinds fresh per iteration.  Breaks
+                # inside the body collect in THIS loop's sink and
+                # merge into the post-loop state below.
+                sink: Dict[str, ast.AST] = {}
                 for _ in range(2):
                     for name in self._bound_names(stmt.target):
                         consumed.pop(name, None)
-                    self._scan(stmt.body, consumed, reported, ctx, fn)
-                self._scan(stmt.orelse, consumed, reported, ctx, fn)
+                    self._scan(stmt.body, consumed, reported, ctx, fn,
+                               sink)
+                self._scan(stmt.orelse, consumed, reported, ctx, fn,
+                           break_sink)
+                for k, v in sink.items():
+                    consumed.setdefault(k, v)
                 continue
             if isinstance(stmt, ast.While):
+                sink = {}
                 for _ in range(2):   # test re-evaluates per iteration
                     self._apply_expr(stmt.test, consumed, reported, ctx)
-                    self._scan(stmt.body, consumed, reported, ctx, fn)
-                self._scan(stmt.orelse, consumed, reported, ctx, fn)
+                    self._scan(stmt.body, consumed, reported, ctx, fn,
+                               sink)
+                self._scan(stmt.orelse, consumed, reported, ctx, fn,
+                           break_sink)
+                for k, v in sink.items():
+                    consumed.setdefault(k, v)
                 continue
             if isinstance(stmt, (ast.With, ast.AsyncWith)):
                 for item in stmt.items:
@@ -785,14 +945,19 @@ class KeyReuseRule(Rule):
                         for name in self._bound_names(
                                 item.optional_vars):
                             consumed.pop(name, None)
-                self._scan(stmt.body, consumed, reported, ctx, fn)
+                self._scan(stmt.body, consumed, reported, ctx, fn,
+                           break_sink)
                 continue
             if isinstance(stmt, ast.Try):
-                self._scan(stmt.body, consumed, reported, ctx, fn)
+                self._scan(stmt.body, consumed, reported, ctx, fn,
+                           break_sink)
                 for h in stmt.handlers:
-                    self._scan(h.body, consumed, reported, ctx, fn)
-                self._scan(stmt.orelse, consumed, reported, ctx, fn)
-                self._scan(stmt.finalbody, consumed, reported, ctx, fn)
+                    self._scan(h.body, consumed, reported, ctx, fn,
+                               break_sink)
+                self._scan(stmt.orelse, consumed, reported, ctx, fn,
+                           break_sink)
+                self._scan(stmt.finalbody, consumed, reported, ctx, fn,
+                           break_sink)
                 continue
             # expression statement / assignment: consumptions first,
             # then rebinds (RHS evaluates before the LHS binds)
@@ -820,6 +985,27 @@ class KeyReuseRule(Rule):
             else:
                 consumed[name] = site
 
+    @classmethod
+    def _terminates(cls, stmts: List[ast.stmt]) -> bool:
+        """Does this branch body end on a statement whose path never
+        reaches the code AFTER the enclosing If?  Return/raise leave
+        the function; break leaves the loop body (its consumptions
+        still reach post-loop code — the break sink carries them
+        there, they just must not poison the rest of the body).
+        A trailing If BOTH of whose arms terminate is itself a
+        terminator (``if ...: raise A else: raise B``).
+        ``continue`` is NOT a terminator: it re-enters the loop
+        header, so a key consumed before a ``continue`` is consumed
+        again on the next matching iteration (the two-pass loop scan
+        needs the state to survive the merge to see it)."""
+        if not stmts:
+            return False
+        last = stmts[-1]
+        if isinstance(last, (ast.Return, ast.Raise, ast.Break)):
+            return True
+        return isinstance(last, ast.If) and \
+            cls._terminates(last.body) and cls._terminates(last.orelse)
+
     @staticmethod
     def _bound_names(target: ast.AST) -> Set[str]:
         names: Set[str] = set()
@@ -842,6 +1028,7 @@ class KeyReuseRule(Rule):
         for node in ast.walk(stmt):
             if not isinstance(node, ast.Call):
                 continue
+            seen_here: Set[str] = set()
             name = ctx.resolve(node.func)
             if name and name.startswith("jax.random."):
                 prim = name.rsplit(".", 1)[1]
@@ -849,6 +1036,7 @@ class KeyReuseRule(Rule):
                     continue
                 if node.args and isinstance(node.args[0], ast.Name):
                     out.append((node.args[0].id, node))
+                    seen_here.add(node.args[0].id)
             else:
                 # rng= is the platform's key-threading kwarg
                 # (model.apply(..., rng=k)); ``key=`` is NOT counted —
@@ -858,6 +1046,15 @@ class KeyReuseRule(Rule):
                     if kw.arg == "rng" and \
                             isinstance(kw.value, ast.Name):
                         out.append((kw.value.id, node))
+                        seen_here.add(kw.value.id)
+            # interprocedural: the project layer resolved this call to
+            # a function that consumes a key parameter — the argument
+            # passed there is consumed HERE (one entry per name)
+            for nm in ctx.rng_call_consumes.get(
+                    (node.lineno, node.col_offset), ()):
+                if nm not in seen_here:
+                    out.append((nm, node))
+                    seen_here.add(nm)
         return out
 
     @classmethod

@@ -1,0 +1,818 @@
+"""zoolint v2 rule families — the graph-powered checks.
+
+These rules consume what the interprocedural layer (``project.py``)
+computed: the mesh-axis universe, cross-module jitted callables, the
+call graph and the lock summaries.  Catalog (docs/static-analysis.md
+renders the full entries with their runtime-diagnostics twins):
+
+=========  ==========================================================
+SHARD007   PartitionSpec propagation: axis names absent from every
+           mesh in the project, full replication of large params
+           under shard_map, spec churn in hot loops, conflicting
+           sharding constraints — runtime twin: PR 4's
+           ``collective_bytes_total{op}`` counters
+           (``zoolint --explain-comms`` prices the traffic with the
+           same ring identities)
+MEM009     static HBM live-buffer hazards: state rebound through a
+           non-donating jit call site (both copies live), device
+           results accumulated unboundedly in hot loops — runtime
+           twin: device telemetry gauges
+           (``zoolint --explain-hbm`` prices the step peak)
+LOCK010    lock-order/deadlock analysis over the thread-running
+           modules: lock-acquisition graph cycles (inconsistent
+           order), re-acquisition of a non-reentrant lock through a
+           call chain, locks held across blocking calls — runtime
+           twin: PR 3's stall watchdog
+=========  ==========================================================
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from analytics_zoo_tpu.analysis.core import (
+    Finding, ModuleContext, Rule, _dotted, donated_positions,
+    register_rule)
+from analytics_zoo_tpu.analysis.project import (
+    CANONICAL_AXES, FuncKey, ProjectContext, register_project_rule)
+
+# ================================================================ SHARD007
+
+
+_PSPEC_NAMES = ("jax.sharding.PartitionSpec",
+                "jax.experimental.pjit.PartitionSpec",
+                "PartitionSpec")
+_NAMED_SHARDING_NAMES = ("jax.sharding.NamedSharding", "NamedSharding")
+_SHARD_MAP_NAMES = ("jax.shard_map", "shard_map",
+                    "jax.experimental.shard_map.shard_map")
+_WSC_NAMES = ("jax.lax.with_sharding_constraint",
+              "with_sharding_constraint",
+              "jax.experimental.pjit.with_sharding_constraint")
+
+#: parameter names whose full replication is worth flagging
+_LARGE_PARAM_RE = re.compile(
+    r"(?:^|_)(params?|weights?|table|embeddings?|kernel|w\d?|"
+    r"opt_state|state)s?$")
+
+
+def _is_pspec_call(ctx: ModuleContext, node: ast.Call) -> bool:
+    name = ctx.resolve(node.func)
+    return name in _PSPEC_NAMES or (
+        name is not None and name.endswith(".PartitionSpec"))
+
+
+@register_rule
+class ShardSpecRule(Rule):
+    """PartitionSpec propagation checks.
+
+    Why: GSPMD trusts the annotation.  A typo'd axis name raises only
+    when the program finally runs on a mesh; a ``P()`` on a large
+    param under ``shard_map`` silently replicates it onto every
+    device; a spec constructed per hot-loop iteration churns
+    placement; two different constraints on one value force a
+    reshard.  All four are invisible until the job is on real
+    hardware — exactly what a static pass is for.  The runtime twin
+    is PR 4's ``collective_bytes_total{op}`` accounting; ``zoolint
+    --explain-comms`` prices the implied traffic with the same ring
+    identities so static and measured numbers join.
+    """
+
+    rule_id = "SHARD007"
+    severity = "warning"
+    doc = ("sharding-spec hazard: unknown mesh axis, implicit full "
+           "replication, spec churn in a hot loop, or conflicting "
+           "constraints")
+
+    def begin_module(self, ctx: ModuleContext) -> None:
+        super().begin_module(ctx)
+        self._wsc_seen: Dict[Tuple[int, str], Tuple[str, ast.AST]] = {}
+
+    # -- axis universe ---------------------------------------------------
+    def _universe(self, ctx: ModuleContext) -> Set[str]:
+        if ctx.axis_universe:
+            return ctx.axis_universe
+        return set(CANONICAL_AXES)
+
+    def _axis_of(self, ctx: ModuleContext,
+                 node: ast.AST) -> Optional[str]:
+        """The axis STRING an expression denotes, when statically
+        known: a literal, or a ``*_AXIS`` constant the project
+        indexed.  None = unverifiable (a variable)."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        resolved = _dotted(node) and ctx.resolve(node)
+        if resolved:
+            if resolved in ctx.axis_constants:
+                return ctx.axis_constants[resolved]
+            local = f"{ctx.module_name}.{resolved}"
+            if local in ctx.axis_constants:
+                return ctx.axis_constants[local]
+        return None
+
+    def _check_axes(self, ctx: ModuleContext, call: ast.Call,
+                    exprs) -> None:
+        universe = self._universe(ctx)
+        for expr in exprs:
+            parts = expr.elts if isinstance(
+                expr, (ast.Tuple, ast.List)) else [expr]
+            for part in parts:
+                axis = self._axis_of(ctx, part)
+                if axis is not None and axis not in universe:
+                    self.report(
+                        call,
+                        f"PartitionSpec axis '{axis}' is not an axis "
+                        f"of any mesh in this project (known: "
+                        f"{', '.join(sorted(universe))}) — GSPMD "
+                        f"will reject it at run time",
+                        line=getattr(part, "lineno", call.lineno))
+
+    # -- visitors --------------------------------------------------------
+    def visit_Call(self, node: ast.Call, ctx: ModuleContext) -> None:
+        name = ctx.resolve(node.func) or ""
+        if _is_pspec_call(ctx, node):
+            self._check_axes(ctx, node, node.args)
+            self._check_hot_loop_spec(ctx, node, "PartitionSpec")
+            return
+        if name in _NAMED_SHARDING_NAMES or \
+                name.endswith(".NamedSharding"):
+            self._check_hot_loop_spec(ctx, node, "NamedSharding")
+            return
+        if name in _SHARD_MAP_NAMES:
+            self._check_shard_map(ctx, node)
+            return
+        if name in _WSC_NAMES:
+            self._check_constraint(ctx, node)
+
+    def _check_hot_loop_spec(self, ctx: ModuleContext, node: ast.Call,
+                             what: str) -> None:
+        """Spec/sharding objects built per iteration of a host-side
+        hot loop: every construction is a fresh object, and a placed
+        array gets resharded when the spec drifts — hoist it.
+        Lexical loops only: a helper that builds one spec per CALL is
+        priced at its call site, not here."""
+        fn = ctx.enclosing_function(node)
+        if fn is None or id(fn) in ctx.traced_functions:
+            return
+        if not ctx.is_hot_function(fn):
+            return
+        if not ctx.in_loop(node, lexical_only=True):
+            return
+        self.report(
+            node,
+            f"{what} constructed inside a hot loop — build the spec "
+            f"once outside the loop (a drifting spec implicitly "
+            f"reshards every iteration)")
+
+    def _check_shard_map(self, ctx: ModuleContext,
+                         node: ast.Call) -> None:
+        in_specs = None
+        for kw in node.keywords:
+            if kw.arg == "in_specs":
+                in_specs = kw.value
+            if kw.arg in ("in_specs", "out_specs"):
+                specs = kw.value.elts if isinstance(
+                    kw.value, (ast.Tuple, ast.List)) else [kw.value]
+                for spec in specs:
+                    if isinstance(spec, ast.Call) and \
+                            _is_pspec_call(ctx, spec):
+                        self._check_axes(ctx, node, spec.args)
+        if in_specs is None or not node.args:
+            return
+        fn = ctx._wrapped_function(node.args[0], node)
+        params = ProjectContext.func_params_of_node(fn)
+        specs = in_specs.elts if isinstance(
+            in_specs, (ast.Tuple, ast.List)) else [in_specs]
+        for i, spec in enumerate(specs):
+            if not (isinstance(spec, ast.Call)
+                    and _is_pspec_call(ctx, spec)
+                    and not spec.args and not spec.keywords):
+                continue
+            pname = params[i] if i < len(params) else f"arg{i}"
+            if _LARGE_PARAM_RE.search(pname):
+                self.report(
+                    node,
+                    f"shard_map arg '{pname}' has in_spec P() — the "
+                    f"full array is replicated onto every device; "
+                    f"shard it over a mesh axis (or confirm it is "
+                    f"small and suppress)",
+                    line=spec.lineno)
+
+    def _check_constraint(self, ctx: ModuleContext,
+                          node: ast.Call) -> None:
+        """Two different with_sharding_constraint specs on the same
+        name inside one traced function = a forced mid-program
+        reshard."""
+        if not node.args or not isinstance(node.args[0], ast.Name) or \
+                len(node.args) < 2:
+            return
+        fn = ctx.enclosing_function(node)
+        if fn is None:
+            return
+        try:
+            spec_src = ast.unparse(node.args[1])
+        except Exception:
+            return
+        key = (id(fn), node.args[0].id)
+        prev = self._wsc_seen.get(key)
+        if prev is None:
+            self._wsc_seen[key] = (spec_src, node)
+        elif prev[0] != spec_src and not self._exclusive_branches(
+                ctx, prev[1], node):
+            self.report(
+                node,
+                f"'{node.args[0].id}' already constrained to "
+                f"{prev[0]} at line {prev[1].lineno} — a different "
+                f"spec here forces a reshard between the two points")
+
+    @staticmethod
+    def _exclusive_branches(ctx: ModuleContext, a: ast.AST,
+                            b: ast.AST) -> bool:
+        """Do ``a`` and ``b`` sit in OPPOSITE arms of some shared
+        ``if``?  Then only one executes per trace — two different
+        constraints there are a dispatch, not a reshard."""
+
+        def arms(node: ast.AST) -> Dict[int, str]:
+            out: Dict[int, str] = {}
+            prev, cur = node, ctx.parent(node)
+            while cur is not None:
+                if isinstance(cur, ast.If):
+                    if any(c is prev for c in cur.body):
+                        out[id(cur)] = "body"
+                    elif any(c is prev for c in cur.orelse):
+                        out[id(cur)] = "orelse"
+                prev, cur = cur, ctx.parent(cur)
+            return out
+
+        arms_a = arms(a)
+        return any(side != arms_a.get(if_id, side)
+                   for if_id, side in arms(b).items())
+
+
+# ================================================================= MEM009
+
+
+_STATE_NAME_RE = re.compile(
+    r"^(?:new_)?(params?|opt_states?|optimizer_state|state|weights?|"
+    r"variables|grads?|master_params)$")
+
+
+def _bound_names_of_targets(targets) -> Set[str]:
+    # one binding-target walker for the whole rule set
+    from analytics_zoo_tpu.analysis.rules import KeyReuseRule
+    out: Set[str] = set()
+    for t in targets:
+        out |= KeyReuseRule._bound_names(t)
+    return out
+
+
+@register_rule
+class HbmLiveBufferRule(Rule):
+    """Static HBM live-buffer hazards.
+
+    Why: HBM is the scarcest resource on the chip.  (1) A jit call
+    whose state inputs die at the call (``params, opt_state =
+    step(params, opt_state, ...)``) but whose jit declares no
+    donation keeps BOTH trees live through the step — double the
+    largest arrays in the program (this generalizes DONATE004 from
+    the jit's own signature to any call site of any jitted
+    callable).  (2) A hot loop appending jitted outputs to a plain
+    list pins every step's device buffers forever — the OOM arrives
+    hours in.  Runtime twin: the device telemetry gauges
+    (``device_memory_bytes``/live-array census); ``zoolint
+    --explain-hbm`` prices the step peak statically.
+    """
+
+    rule_id = "MEM009"
+    severity = "warning"
+    doc = ("HBM hazard: non-donated dead state at a jit call site, "
+           "or unbounded device-array accumulation in a hot loop")
+
+    # -- (1) dead state through a non-donating jit ----------------------
+    def visit_Assign(self, node: ast.Assign,
+                     ctx: ModuleContext) -> None:
+        value = node.value
+        if not isinstance(value, ast.Call):
+            return
+        target = _dotted(value.func)
+        if target is None or target not in ctx.jitted_callables:
+            return
+        kws = ctx.jitted_callables[target]
+        donated = self._donated_positions(kws)
+        if donated is None:
+            return   # argnames / non-literal argnums: assume covered
+        bound = _bound_names_of_targets(node.targets)
+        for pos, arg in enumerate(value.args):
+            if pos in donated:
+                continue
+            if isinstance(arg, ast.Name) and arg.id in bound and \
+                    _STATE_NAME_RE.match(arg.id):
+                self.report(
+                    node,
+                    f"'{arg.id}' dies at this call (rebound by the "
+                    f"result) but jitted '{target}' does not donate "
+                    f"it (no donate_argnums covering position {pos}) "
+                    f"— input and output copies stay live together "
+                    f"through the step (double HBM for the biggest "
+                    f"arrays)")
+                return
+
+    _donated_positions = staticmethod(donated_positions)
+
+    # -- (2) unbounded device accumulation in hot loops ------------------
+    _GROW_METHODS = ("append", "extend")
+
+    def visit_Call(self, node: ast.Call, ctx: ModuleContext) -> None:
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._GROW_METHODS
+                and len(node.args) == 1
+                and isinstance(node.func.value, ast.Name)):
+            return
+        fn = ctx.enclosing_function(node)
+        if fn is None or id(fn) in ctx.traced_functions:
+            return
+        if not ctx.is_hot_function(fn) or not ctx.in_loop(node):
+            return
+        if not self._is_device_valued(ctx, fn, node.args[0],
+                                      node.lineno):
+            return
+        lname = node.func.value.id
+        if self._is_bounded(ctx, fn, lname):
+            return
+        self.report(
+            node,
+            f"'{lname}.{node.func.attr}(...)' accumulates device "
+            f"results every iteration with no bound or host pull — "
+            f"each step's output stays pinned in HBM; pull to host "
+            f"(jax.device_get) or keep a bounded window")
+
+    def _is_device_valued(self, ctx: ModuleContext, fn: ast.AST,
+                          expr: ast.AST,
+                          use_line: Optional[int] = None) -> bool:
+        """Does ``expr`` denote the output of a jitted/traced
+        callable?  Direct call, or a name whose binding in ``fn`` is
+        such a call — precision over recall.  For a name, the binding
+        that REACHES the use site is the latest one before
+        ``use_line`` (``x = step(...); x = np.asarray(x)`` appends a
+        host array, not the jitted output); with none before, the
+        last binding overall (a loop's append sees the previous
+        iteration's final value)."""
+        if isinstance(expr, ast.Call):
+            target = _dotted(expr.func)
+            if target in ctx.jitted_callables:
+                return True
+            wrapped = ctx._wrapped_function(expr.func, expr)
+            return wrapped is not None and \
+                id(wrapped) in ctx.traced_functions
+        if isinstance(expr, (ast.Subscript, ast.Attribute)):
+            return False
+        if isinstance(expr, ast.Name):
+            before: Optional[ast.Assign] = None
+            last: Optional[ast.Assign] = None
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            queue: List[ast.AST] = list(body)
+            i = 0
+            while i < len(queue):
+                node = queue[i]
+                i += 1
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                    continue
+                queue.extend(ast.iter_child_nodes(node))
+                if not isinstance(node, ast.Assign):
+                    continue
+                if expr.id not in _bound_names_of_targets(
+                        node.targets):
+                    continue
+                if last is None or node.lineno >= last.lineno:
+                    last = node
+                if use_line is not None and node.lineno < use_line \
+                        and (before is None
+                             or node.lineno >= before.lineno):
+                    before = node
+            pick = before if before is not None else last
+            if pick is not None and isinstance(pick.value, ast.Call):
+                return self._is_device_valued(ctx, fn, pick.value)
+            return False
+        return False
+
+    @staticmethod
+    def _is_bounded(ctx: ModuleContext, fn: ast.AST,
+                    lname: str) -> bool:
+        """Evidence the accumulator is bounded or drained: a
+        ``len(lname)`` flush check, a ``deque(maxlen=...)`` binding,
+        an explicit ``clear``/``pop``/``del``, or a host pull
+        (device_get / np.asarray) that references it."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = ctx.resolve(node.func) or ""
+                if name == "len" and node.args and \
+                        isinstance(node.args[0], ast.Name) and \
+                        node.args[0].id == lname:
+                    return True
+                if name.split(".")[-1] in ("device_get", "asarray",
+                                           "array", "stack",
+                                           "concatenate"):
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Name) and \
+                                sub.id == lname:
+                            return True
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("clear", "popleft", "pop") \
+                        and isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == lname:
+                    return True
+                if name.split(".")[-1] == "deque":
+                    par = ctx.parent(node)
+                    if isinstance(par, ast.Assign) and any(
+                            isinstance(t, ast.Name) and t.id == lname
+                            for t in par.targets):
+                        return True
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    for sub in ast.walk(tgt):
+                        if isinstance(sub, ast.Name) and \
+                                sub.id == lname:
+                            return True
+        return False
+
+
+# ================================================================ LOCK010
+
+
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "threading.Semaphore": "semaphore",
+    "threading.BoundedSemaphore": "semaphore",
+    "multiprocessing.Lock": "lock",
+    "multiprocessing.RLock": "rlock",
+    "Lock": "lock", "RLock": "rlock", "Condition": "condition",
+}
+
+#: resolved call names that block the calling thread
+_BLOCKING_RESOLVED = {
+    "time.sleep", "subprocess.run", "subprocess.call",
+    "subprocess.check_output", "subprocess.check_call",
+    "select.select", "socket.create_connection",
+    "jax.block_until_ready", "jax.device_get",
+}
+
+#: attribute-call names that block (with the precision guards applied
+#: in ``_blocking_desc``)
+_BLOCKING_ATTRS = {
+    "wait", "communicate", "blpop", "brpop", "brpoplpush",
+    "xread", "xreadgroup", "block_until_ready", "accept", "recv",
+}
+
+
+class _FnLockSummary:
+    __slots__ = ("edges", "blocking", "calls_under", "acquired",
+                 "blocks_desc")
+
+    def __init__(self):
+        # (held_id, acquired_id, site)
+        self.edges: List[Tuple[str, str, ast.AST]] = []
+        # (held_id, site, description)
+        self.blocking: List[Tuple[str, ast.AST, str]] = []
+        # (held_id, callee FuncKey, site)
+        self.calls_under: List[Tuple[str, FuncKey, ast.AST]] = []
+        self.acquired: Set[str] = set()
+        self.blocks_desc: Optional[str] = None   # fn blocks directly
+
+
+@register_project_rule
+class LockOrderRule:
+    """Lock-order / deadlock analysis over thread-running modules.
+
+    Why: the observability aggregator, serving loop, launcher and
+    resilience machinery all hold locks from multiple threads.  Two
+    locks taken in opposite orders on two threads deadlock — a hang
+    with no traceback, which PR 3's stall watchdog can only report
+    *after* the job froze.  This pass builds the lock-acquisition
+    graph (``with`` nesting plus acquisitions reached through
+    resolvable calls), flags cycles, non-reentrant re-acquisition
+    through a call chain, and locks held across blocking calls
+    (``queue.get``, redis reads, ``subprocess.wait``, device syncs)
+    — the pattern that turns one slow consumer into a cluster-wide
+    stall.  Scoped to modules that define locks.
+    """
+
+    rule_id = "LOCK010"
+    severity = "warning"
+    doc = ("lock-order cycle, non-reentrant re-acquisition, or lock "
+           "held across a blocking call")
+
+    # ------------------------------------------------------------ locks
+    def _lock_registry(self, ctx: ModuleContext) -> Dict[str, str]:
+        """lock id -> kind for every lock the module defines.
+        Module-level ``X = threading.Lock()`` ->
+        ``relpath::X``; ``self.X = threading.Lock()`` inside class C
+        -> ``relpath::C.X`` (one id per class attribute: standard
+        instance-insensitive lock analysis)."""
+        reg: Dict[str, str] = {}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            resolved = ctx.resolve(node.value.func)
+            kind = _LOCK_CTORS.get(resolved or "")
+            if kind is None:
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and \
+                        ctx.enclosing_function(node) is None:
+                    reg[f"{ctx.relpath}::{tgt.id}"] = kind
+                elif isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id == "self":
+                    cls = ctx.enclosing_class(node)
+                    if cls is not None:
+                        reg[f"{ctx.relpath}::"
+                            f"{ctx.class_qualname(cls)}."
+                            f"{tgt.attr}"] = kind
+        return reg
+
+    def _lock_id(self, ctx: ModuleContext, registry: Dict[str, str],
+                 expr: ast.AST, origin: ast.AST,
+                 proj: Optional[ProjectContext] = None
+                 ) -> Optional[str]:
+        """Resolve a ``with`` target / receiver to a lock id.  Bare
+        names only count when they denote MODULE state: a lock-ish
+        name bound locally (``my_lock = threading.Lock()`` inside the
+        function) is a fresh per-call object that cannot deadlock
+        across functions — aliasing those by name minted false
+        order-cycle pairs.  An IMPORTED lock's identity is its
+        DEFINING module — per-importer ids would split one lock into
+        many (false self-deadlocks on re-entry, an order cycle across
+        two importers never connecting into one graph node)."""
+        if isinstance(expr, ast.Call):
+            return None
+        if isinstance(expr, ast.Name):
+            mid = f"{ctx.relpath}::{expr.id}"
+            if mid in registry:
+                return mid
+            resolved = ctx.resolve(expr)
+            if proj is not None and resolved and "." in resolved:
+                parts = resolved.split(".")
+                for i in range(len(parts) - 1, 0, -1):
+                    mctx = proj.by_module.get(".".join(parts[:i]))
+                    if mctx is not None:
+                        return (f"{mctx.relpath}::"
+                                f"{'.'.join(parts[i:])}")
+            if "lock" in expr.id.lower():
+                from analytics_zoo_tpu.analysis.rules import (
+                    _local_bindings)
+                fn = ctx.enclosing_function(origin)
+                if fn is not None and expr.id in _local_bindings(fn):
+                    return None   # function-local lock object
+                return mid
+            return None
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls"):
+            cls = ctx.enclosing_class(origin)
+            if cls is None:
+                return None
+            cid = f"{ctx.relpath}::" \
+                  f"{ctx.class_qualname(cls)}.{expr.attr}"
+            if cid in registry or "lock" in expr.attr.lower():
+                return cid
+        return None
+
+    # ------------------------------------------------------- summaries
+    def _blocking_desc(self, ctx: ModuleContext, registry,
+                       call: ast.Call,
+                       held: List[str],
+                       origin_fn: ast.AST,
+                       proj: Optional[ProjectContext] = None
+                       ) -> Optional[Tuple[str, Optional[str]]]:
+        """(description, released_lock_id) for a blocking call.
+        ``released_lock_id`` is the one lock the call itself lets go
+        of while waiting — a Condition's own lock during ``.wait()``
+        (the cv idiom) — which must not be reported as held across
+        it; every OTHER held lock stays held for the whole wait."""
+        resolved = ctx.resolve(call.func)
+        if resolved in _BLOCKING_RESOLVED:
+            return (resolved, None)
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        attr = call.func.attr
+        if attr == "get":
+            # queue.Queue.get() / .get(block=, timeout=) blocks;
+            # dict.get(key[, default]) never has ZERO positional args
+            if not call.args and all(
+                    kw.arg in ("block", "timeout")
+                    for kw in call.keywords):
+                return (".get() (queue)", None)
+            return None
+        if attr == "join":
+            # thread/process/queue join blocks; ''.join(seq) takes a
+            # positional argument
+            return (".join() (thread/queue)", None) \
+                if not call.args else None
+        if attr == "result":
+            return (".result() (future)", None) \
+                if not call.args else None
+        if attr in _BLOCKING_ATTRS:
+            if attr == "wait":
+                rid = self._lock_id(ctx, registry, call.func.value,
+                                    origin_fn, proj)
+                if rid is not None and registry.get(rid) == \
+                        "condition":
+                    return (".wait() (condition)", rid)
+            return (f".{attr}()", None)
+        return None
+
+    def _summarize(self, proj: ProjectContext, ctx: ModuleContext,
+                   registry: Dict[str, str], fn: ast.AST,
+                   key: FuncKey) -> _FnLockSummary:
+        s = _FnLockSummary()
+
+        def walk(node: ast.AST, held: List[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                return   # nested scope: summarized on its own
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                acquired_here: List[str] = []
+                for item in node.items:
+                    lid = self._lock_id(ctx, registry,
+                                        item.context_expr, node, proj)
+                    if lid is not None:
+                        s.acquired.add(lid)
+                        for h in held:
+                            s.edges.append((h, lid, node))
+                        acquired_here.append(lid)
+                for child in node.body:
+                    walk(child, held + acquired_here)
+                return
+            if isinstance(node, ast.Call):
+                if held:
+                    res = self._blocking_desc(ctx, registry, node,
+                                              held, fn, proj)
+                    if res is not None:
+                        desc, released = res
+                        # EVERY held lock (except the one the call
+                        # releases) stays held for the whole wait —
+                        # reporting only the innermost would go green
+                        # after fixing the inner scope while an outer
+                        # (e.g. global) lock still stalls the world
+                        for h in dict.fromkeys(held):
+                            if h != released:
+                                s.blocking.append((h, node, desc))
+                        # callers holding THEIR lock across a call to
+                        # this function stall the same way (a cv
+                        # wait releases only the cv — the thread
+                        # still blocks)
+                        if s.blocks_desc is None:
+                            s.blocks_desc = desc
+                    callee = proj.resolve_call(ctx, node)
+                    if callee is not None:
+                        for h in dict.fromkeys(held):
+                            s.calls_under.append((h, callee, node))
+                elif s.blocks_desc is None:
+                    res = self._blocking_desc(ctx, registry, node,
+                                              held, fn, proj)
+                    if res is not None:
+                        s.blocks_desc = res[0]
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            walk(stmt, [])
+        return s
+
+    # ----------------------------------------------------------- driver
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        registries = {ctx.relpath: self._lock_registry(ctx)
+                      for ctx in proj.contexts}
+        kinds: Dict[str, str] = {}
+        for reg in registries.values():
+            kinds.update(reg)
+        summaries: Dict[FuncKey, _FnLockSummary] = {}
+        for ctx in proj.contexts:
+            registry = registries[ctx.relpath]
+            # only modules that define (or name) locks participate —
+            # but summaries resolve against the MERGED kind map, so an
+            # imported lock's kind (rlock/condition) is known here too
+            if not registry and "lock" not in ctx.source.lower():
+                continue
+            for fn in ctx.functions:
+                if isinstance(fn, ast.Lambda):
+                    continue
+                qual = ctx.qualname_of(fn)
+                if not qual:
+                    continue
+                summaries[(ctx.relpath, qual)] = self._summarize(
+                    proj, ctx, kinds, fn, (ctx.relpath, qual))
+
+        # transitive lock sets + does-it-block, to fixpoint
+        acquired_star: Dict[FuncKey, Set[str]] = {
+            k: set(s.acquired) for k, s in summaries.items()}
+        blocks: Dict[FuncKey, Optional[str]] = {
+            k: s.blocks_desc for k, s in summaries.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key, s in summaries.items():
+                for edge in proj.calls.get(key, ()):
+                    sub = acquired_star.get(edge.callee)
+                    if sub and not sub <= acquired_star[key]:
+                        acquired_star[key] |= sub
+                        changed = True
+                    # does-it-block propagates too: calling a function
+                    # that (transitively) blocks IS blocking — this is
+                    # what lets ``with lock: a()`` fire when a() only
+                    # reaches the sleep/get through another hop
+                    cal_blocks = blocks.get(edge.callee)
+                    if cal_blocks and not blocks.get(key):
+                        blocks[key] = (f"{cal_blocks} "
+                                       f"(via {edge.callee[1]})")
+                        changed = True
+
+        findings: List[Finding] = []
+
+        def emit(ctx: ModuleContext, site: ast.AST,
+                 message: str) -> None:
+            findings.append(Finding(
+                rule=self.rule_id, severity=self.severity,
+                path=ctx.relpath, line=site.lineno,
+                col=getattr(site, "col_offset", 0), message=message,
+                symbol=ctx.qualname_of(site),
+                snippet=ctx.line_text(site.lineno).strip()))
+
+        # interprocedural edges + held-across-blocking-call findings
+        all_edges: List[Tuple[str, str, ast.AST, str]] = []
+        for key, s in summaries.items():
+            ctx = proj.by_relpath[key[0]]
+            for held, lid, site in s.edges:
+                all_edges.append((held, lid, site, key[0]))
+            for held, site, desc in s.blocking:
+                if kinds.get(held) == "semaphore":
+                    continue   # a semaphore BRACKETING slow work is a
+                    # throttle, not a mutex held across I/O
+                emit(ctx, site,
+                     f"'{_short(held)}' is held across blocking "
+                     f"{desc} — every thread needing the lock "
+                     f"stalls behind the wait (runtime twin: the "
+                     f"stall watchdog)")
+            for held, callee, site in s.calls_under:
+                sub = acquired_star.get(callee, set())
+                for lid in sorted(sub):
+                    all_edges.append((held, lid, site, key[0]))
+                cal_blocks = blocks.get(callee)
+                if cal_blocks and kinds.get(held) != "semaphore":
+                    emit(ctx, site,
+                         f"'{_short(held)}' is held across a call "
+                         f"to {callee[1]} which blocks on "
+                         f"{cal_blocks}")
+
+        # graph analysis: self-loops (non-reentrant) and order cycles
+        graph: Dict[str, Set[str]] = {}
+        for a, b, _, _ in all_edges:
+            graph.setdefault(a, set()).add(b)
+
+        def reaches(src: str, dst: str) -> bool:
+            seen: Set[str] = set()
+            stack = [src]
+            while stack:
+                cur = stack.pop()
+                if cur == dst:
+                    return True
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                stack.extend(graph.get(cur, ()))
+            return False
+
+        reported: Set[Tuple[str, int, str, str]] = set()
+        for a, b, site, rel in all_edges:
+            ctx = proj.by_relpath[rel]
+            dedup = (rel, site.lineno, a, b)
+            if dedup in reported:
+                continue
+            if a == b:
+                if kinds.get(a, "lock") in ("rlock", "condition",
+                                            "semaphore"):
+                    continue
+                reported.add(dedup)
+                emit(ctx, site,
+                     f"non-reentrant '{_short(a)}' is re-acquired "
+                     f"while already held (directly or through this "
+                     f"call) — self-deadlock")
+            elif reaches(b, a):
+                reported.add(dedup)
+                emit(ctx, site,
+                     f"'{_short(b)}' acquired while holding "
+                     f"'{_short(a)}', but elsewhere the acquisition "
+                     f"order is reversed — inconsistent lock order "
+                     f"across threads can deadlock")
+        findings.sort(key=lambda f: (f.path, f.line, f.col))
+        return findings
+
+
+def _short(lock_id: str) -> str:
+    return lock_id.split("::", 1)[-1]

@@ -45,6 +45,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _tried = True
         if not os.path.exists(_LIB_PATH) or \
                 os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC):
+            # deliberate: the one-shot native build MUST be
+            # serialized (two concurrent cc invocations would corrupt
+            # the artifact); waiters need the lib anyway, and _tried
+            # caps this to one build ever
+            # zoolint: disable=LOCK010 — serialized one-shot build
             if not _build():
                 return None
         try:

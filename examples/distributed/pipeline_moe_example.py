@@ -12,6 +12,7 @@ Run: ``python examples/distributed/pipeline_moe_example.py [--smoke]``
 import argparse
 import os
 import sys
+from functools import partial
 
 _ROOT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
@@ -94,7 +95,10 @@ def main(argv=None):
     ye = jnp.tanh(xe @ jnp.asarray(w_true))
     mopt = tx.init(params)
 
-    @jax.jit
+    # donate the state trees: the loop rebinds params/mopt from the
+    # result, so without donation XLA keeps both copies live through
+    # the step (double HBM for the expert weights — MEM009)
+    @partial(jax.jit, donate_argnums=(0, 1))
     def estep(params, mopt):
         def loss_fn(pr):
             out, aux = moe.call_with_aux(pr, xe)

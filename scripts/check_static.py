@@ -23,17 +23,30 @@ Usage::
 
     python scripts/check_static.py                 # both passes
     python scripts/check_static.py --skip-metrics  # zoolint only
-    python scripts/check_static.py --zoolint-args "--json"  # passthrough
+    python scripts/check_static.py --jobs 4        # parallel zoolint
+    python scripts/check_static.py --json > static_report.json
+    python scripts/check_static.py --zoolint-args="--rules LOCK010"
+
+``--json`` emits ONE merged machine-readable document (zoolint's
+full report plus metrics_lint's issue list) so downstream tooling —
+``obs_report.py`` joining static comm estimates against measured
+collective counters, the Jenkins artifact archiver — reads a single
+file with a stable schema.
 """
 
 from __future__ import annotations
 
 import argparse
+import contextlib
 import importlib.util
+import io
+import json
 import os
 import shlex
 import sys
 from typing import List, Optional
+
+JSON_VERSION = 1
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ZOOLINT_TARGETS = ("analytics_zoo_tpu", "scripts", "examples")
@@ -105,6 +118,66 @@ def run_metrics_lint(extra_args: Optional[List[str]] = None) -> int:
     return 0
 
 
+def metrics_lint_issues() -> List[str]:
+    """The representative-registry lint as data (for --json)."""
+    lint = _load_by_path(
+        "zoo_metrics_lint", os.path.join(REPO, "scripts",
+                                         "metrics_lint.py"))
+    return [str(i) for i in
+            lint.lint_registry(_representative_registry())]
+
+
+def run_json(args) -> int:
+    """One merged machine-readable report: zoolint's own --json
+    document embedded verbatim (so keys/counts stay joinable with
+    zoolint reports elsewhere) plus metrics_lint's issues."""
+    doc = {"version": JSON_VERSION, "tool": "check_static"}
+    rc = 0
+    if not args.skip_zoolint:
+        zargs = shlex.split(args.zoolint_args) + ["--json"]
+        if args.jobs > 1:
+            zargs += ["--jobs", str(args.jobs)]
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            zrc = run_zoolint(zargs)
+        rc = max(rc, zrc)
+        try:
+            doc["zoolint"] = json.loads(buf.getvalue())
+        except ValueError:
+            doc["zoolint"] = {"error": "unparseable zoolint output",
+                              "raw": buf.getvalue()[:2000]}
+            rc = max(rc, 2)
+    if not args.skip_metrics:
+        margs = shlex.split(args.metrics_args)
+        if margs:
+            # same passthrough contract as the non-JSON path: lint
+            # the user-supplied dump, capturing its report lines
+            lint = _load_by_path(
+                "zoo_metrics_lint",
+                os.path.join(REPO, "scripts", "metrics_lint.py"))
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                mrc = lint.main(margs)
+            lines = [ln for ln in buf.getvalue().splitlines()
+                     if ln.strip()]
+            # main() always prints a trailing summary line ('clean'
+            # or 'N issue(s)') — it is not an issue itself
+            issues = lines[:-1] if lines else []
+            doc["metrics_lint"] = {"total": len(issues),
+                                   "issues": issues}
+            rc = max(rc, mrc)
+        else:
+            issues = metrics_lint_issues()
+            doc["metrics_lint"] = {"total": len(issues),
+                                   "issues": issues}
+            if issues:
+                rc = max(rc, 1)
+    doc["rc"] = rc
+    json.dump(doc, sys.stdout, indent=2)
+    sys.stdout.write("\n")
+    return rc
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="check_static",
@@ -112,6 +185,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "convention (0 clean / 1 findings / 2 usage)")
     ap.add_argument("--skip-zoolint", action="store_true")
     ap.add_argument("--skip-metrics", action="store_true")
+    ap.add_argument("--json", action="store_true",
+                    help="merged machine-readable report on stdout")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parallelize zoolint's per-file rule runs")
     ap.add_argument("--zoolint-args", default="",
                     help="extra args passed through to zoolint "
                          "(quoted string)")
@@ -123,11 +200,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.skip_zoolint and args.skip_metrics:
         print("check_static: nothing to do", file=sys.stderr)
         return 2
+    if args.json:
+        return run_json(args)
 
     rc = 0
     if not args.skip_zoolint:
         print("== zoolint ==")
-        rc = max(rc, run_zoolint(shlex.split(args.zoolint_args)))
+        zargs = shlex.split(args.zoolint_args)
+        if args.jobs > 1:
+            zargs += ["--jobs", str(args.jobs)]
+        rc = max(rc, run_zoolint(zargs))
     if not args.skip_metrics:
         print("== metrics_lint ==")
         rc = max(rc, run_metrics_lint(

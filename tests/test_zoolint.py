@@ -1,13 +1,21 @@
 """zoolint — the static-analysis suite's own tests.
 
-Three layers:
+Five layers:
 
-1. per-rule fixtures: each of the six rules has at least one proven
-   TRUE POSITIVE and one proven NON-FINDING (the acceptance contract
-   of ISSUE 5);
-2. framework semantics: inline suppressions, baseline only-shrink,
-   ``--diff`` PR gating, JSON schema, CLI exit codes;
-3. the tier-1 repo gate: the full pass over ``analytics_zoo_tpu``,
+1. per-rule fixtures: each rule (six from PR 5, plus v2's
+   SHARD007/MEM009/LOCK010) has at least one proven TRUE POSITIVE
+   and one proven NON-FINDING;
+2. interprocedural variants: JIT001/SYNC002/RNG006 findings hidden
+   behind helper calls, resolved through the project layer's call
+   graph;
+3. framework semantics: inline suppressions (incl. the decorated-def
+   either-line rule), baseline only-shrink, ``--diff`` PR gating,
+   JSON schema, CLI exit codes, ``--jobs`` determinism, the
+   ``--explain-comms``/``--explain-hbm`` report modes;
+4. the static↔runtime parity gate: the static collective-bytes
+   estimate must agree with the measured ``collective_bytes_total``
+   counters of a REAL training run to within ±10%;
+5. the tier-1 repo gate: the full pass over ``analytics_zoo_tpu``,
    ``scripts`` and ``examples`` must report ZERO non-baselined
    findings, and the checked-in baseline must stay strictly below
    the pre-fix finding count.
@@ -121,6 +129,25 @@ class TestJIT001:
             "    print('ok')\n"
             "    return time.time()\n", rules=["JIT001"])
         assert out == []
+
+    def test_else_branch_global_write_is_not_lazy_init(self):
+        # regression: the lazy-singleton exemption once keyed on the
+        # ``if X is None:`` merely being an ANCESTOR — a write in the
+        # else branch runs exactly when the cache is already set,
+        # i.e. on every retrace
+        out = lint(
+            "import jax\n"
+            "_CACHE = None\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    global _CACHE\n"
+            "    if _CACHE is None:\n"
+            "        pass\n"
+            "    else:\n"
+            "        _CACHE = x + 1\n"
+            "    return x\n", rules=["JIT001"])
+        assert rule_ids(out) == ["JIT001"]
+        assert "global '_CACHE'" in out[0].message
 
 
 # =============================================================== SYNC002
@@ -261,6 +288,24 @@ class TestCOMPILE003:
             "    return [f(x) for x in xs]\n", rules=["COMPILE003"])
         assert out == []
 
+    def test_else_branch_jit_build_in_loop_is_not_memoized(self):
+        # regression: the memoized-build exemption once keyed on the
+        # ``if step is None:`` merely being an ANCESTOR — a build in
+        # the else branch runs on every iteration after the first
+        out = lint(
+            "import jax\n"
+            "def run(xs):\n"
+            "    step = None\n"
+            "    for x in xs:\n"
+            "        if step is None:\n"
+            "            pass\n"
+            "        else:\n"
+            "            step = jax.jit(lambda v: v + 1)\n"
+            "        x = step(x)\n"
+            "    return xs\n", rules=["COMPILE003"])
+        assert rule_ids(out) == ["COMPILE003"]
+        assert "inside a loop" in out[0].message
+
 
 # ============================================================= DONATE004
 
@@ -384,6 +429,24 @@ class TestRNG006:
             "    return f + b\n", rules=["RNG006"])
         assert rule_ids(out) == ["RNG006"]
 
+    def test_negative_fully_terminating_trailing_if(self):
+        # the consuming branch ends in an If BOTH of whose arms
+        # raise — nothing falls through to the final consumption, so
+        # the key is used once per executed path (regression:
+        # _terminates only looked at the last statement's type)
+        out = lint(
+            "import jax\n"
+            "def f(rng, c):\n"
+            "    if c:\n"
+            "        x = jax.random.normal(rng, (2,))\n"
+            "        if x.sum() > 0:\n"
+            "            raise ValueError()\n"
+            "        else:\n"
+            "            raise KeyError()\n"
+            "    return jax.random.normal(rng, (2,))\n",
+            rules=["RNG006"])
+        assert out == []
+
     def test_consumption_in_loop_iterable_counts(self):
         out = lint(
             "import jax\n"
@@ -439,6 +502,63 @@ class TestRNG006:
             "    return b\n", rules=["RNG006"])
         assert rule_ids(out) == ["RNG006"]
 
+    def test_continue_branch_still_reuses_across_iterations(self):
+        # ``continue`` re-enters the loop header — the key consumed
+        # before it is consumed AGAIN next iteration (unlike
+        # return/break, which leave the path entirely)
+        out = lint(
+            "import jax\n"
+            "def sample(rng, xs):\n"
+            "    out = []\n"
+            "    for x in xs:\n"
+            "        if x > 0:\n"
+            "            out.append(jax.random.normal(rng, (2,)))\n"
+            "            continue\n"
+            "        out.append(x)\n"
+            "    return out\n", rules=["RNG006"])
+        assert rule_ids(out) == ["RNG006"]
+
+    def test_negative_break_branch_cannot_pair_with_later_iterations(self):
+        out = lint(
+            "import jax\n"
+            "def sample(rng, xs):\n"
+            "    for x in xs:\n"
+            "        if x > 0:\n"
+            "            y = jax.random.normal(rng, (2,))\n"
+            "            break\n"
+            "    return xs\n", rules=["RNG006"])
+        assert out == []
+
+    def test_break_branch_pairs_with_post_loop_use(self):
+        # regression: a break path leaves the loop BODY but still
+        # reaches the code after the loop — consume-before-break +
+        # consume-after-loop is the same key twice on that path
+        out = lint(
+            "import jax\n"
+            "def sample(rng, xs):\n"
+            "    a = None\n"
+            "    for x in xs:\n"
+            "        if x > 0:\n"
+            "            a = jax.random.normal(rng, (2,))\n"
+            "            break\n"
+            "    b = jax.random.normal(rng, (2,))\n"
+            "    return a, b\n", rules=["RNG006"])
+        assert rule_ids(out) == ["RNG006"]
+        assert "already consumed" in out[0].message
+
+    def test_negative_split_before_break_rearms_post_loop_use(self):
+        out = lint(
+            "import jax\n"
+            "def sample(rng, xs):\n"
+            "    for x in xs:\n"
+            "        if x > 0:\n"
+            "            rng, sub = jax.random.split(rng)\n"
+            "            a = jax.random.normal(sub, (2,))\n"
+            "            break\n"
+            "    return jax.random.normal(rng, (2,))\n",
+            rules=["RNG006"])
+        assert out == []
+
     def test_negative_one_use_per_branch(self):
         out = lint(
             "import jax\n"
@@ -448,6 +568,721 @@ class TestRNG006:
             "    else:\n"
             "        return jax.random.uniform(key, (3,))\n",
             rules=["RNG006"])
+        assert out == []
+
+
+# ==================================================== interprocedural layer
+
+
+class TestInterprocedural:
+    def test_jit001_sees_through_helper_calls(self):
+        # the print lives in a helper CALLED FROM the jitted step —
+        # invisible to PR 5's intraprocedural pass
+        out = lint(
+            "import jax\n"
+            "def log_stats(x):\n"
+            "    print('stats', x)\n"
+            "@jax.jit\n"
+            "def step(p, x):\n"
+            "    log_stats(x)\n"
+            "    return p * x\n", rules=["JIT001"])
+        assert rule_ids(out) == ["JIT001"]
+        assert out[0].symbol == "log_stats"
+
+    def test_jit001_through_self_method_and_bound_lambda(self):
+        out = lint(
+            "import jax\n"
+            "import time\n"
+            "class Trainer:\n"
+            "    def _core(self, p, b):\n"
+            "        t = time.time()\n"
+            "        return p + t\n"
+            "    def build(self):\n"
+            "        fn = lambda p, b: self._core(p, b)\n"
+            "        return jax.jit(fn)\n", rules=["JIT001"])
+        assert rule_ids(out) == ["JIT001"]
+        assert out[0].symbol == "Trainer._core"
+
+    def test_jit001_negative_sibling_lambda_stays_host(self):
+        # two lambdas in one function share a '<qual>.<lambda>'-style
+        # qualname unless disambiguated — jitting the second must not
+        # force-trace the host-only first (regression: the clock read
+        # in 'host' was flagged as inside-jit)
+        out = lint(
+            "import jax\n"
+            "import time\n"
+            "def build():\n"
+            "    host = lambda: time.time()\n"
+            "    fn = lambda p: p + 1\n"
+            "    step = jax.jit(fn)\n"
+            "    t = host()\n"
+            "    return step, t\n", rules=["JIT001"])
+        assert out == []
+
+    def test_jit001_negative_callback_arg_is_host(self):
+        # the helper reaches the trace only through debug.callback —
+        # it runs on HOST, not at trace time
+        out = lint(
+            "import jax\n"
+            "import time\n"
+            "def record(x):\n"
+            "    return time.time()\n"
+            "@jax.jit\n"
+            "def step(p):\n"
+            "    jax.debug.callback(record, p)\n"
+            "    return p\n", rules=["JIT001"])
+        assert out == []
+
+    def test_sync002_sees_item_inside_helper(self):
+        out = lint(
+            "import jax\n"
+            "step = jax.jit(lambda p, b: (p, p.sum()))\n"
+            "def log_loss(loss):\n"
+            "    return loss.item()\n"
+            "def train_loop(p, batches):\n"
+            "    for b in batches:\n"
+            "        p, loss = step(p, b)\n"
+            "        log_loss(loss)\n"
+            "    return p\n", rules=["SYNC002"])
+        assert rule_ids(out) == ["SYNC002"]
+        assert out[0].symbol == "log_loss"
+
+    def test_sync002_negative_helper_outside_loop(self):
+        out = lint(
+            "import jax\n"
+            "step = jax.jit(lambda p, b: (p, p.sum()))\n"
+            "def log_loss(loss):\n"
+            "    return loss.item()\n"
+            "def train_loop(p, batches):\n"
+            "    for b in batches:\n"
+            "        p, loss = step(p, b)\n"
+            "    log_loss(loss)\n"
+            "    return p\n", rules=["SYNC002"])
+        assert out == []
+
+    def test_rng006_key_consumed_by_two_helpers(self):
+        out = lint(
+            "import jax\n"
+            "def sample_a(k):\n"
+            "    return jax.random.normal(k, (3,))\n"
+            "def sample_b(k):\n"
+            "    return jax.random.uniform(k, (3,))\n"
+            "def draw(key):\n"
+            "    a = sample_a(key)\n"
+            "    b = sample_b(key)\n"
+            "    return a + b\n", rules=["RNG006"])
+        assert rule_ids(out) == ["RNG006"]
+        assert "key" in out[0].message
+
+    def test_rng006_negative_helper_only_derives(self):
+        out = lint(
+            "import jax\n"
+            "def derive(k, n):\n"
+            "    return jax.random.split(k, n)\n"
+            "def draw(key):\n"
+            "    k1, k2 = derive(key, 2)\n"
+            "    a = jax.random.normal(k1, (3,))\n"
+            "    b = jax.random.normal(k2, (3,))\n"
+            "    return a + b\n", rules=["RNG006"])
+        assert out == []
+
+    def test_rng006_negative_early_return_branch(self):
+        # ``if small: return normal(rng)`` never falls through — the
+        # second use is NOT a reuse (the orthogonal-init pattern)
+        out = lint(
+            "import jax\n"
+            "def normal(rng, shape):\n"
+            "    return jax.random.normal(rng, shape)\n"
+            "def init(rng, shape):\n"
+            "    if len(shape) < 2:\n"
+            "        return normal(rng, shape)\n"
+            "    return jax.random.normal(rng, (max(shape), 2))\n",
+            rules=["RNG006"])
+        assert out == []
+
+    def test_jit001_negative_lazy_singleton_getter(self):
+        # ``global X; if X is None: X = ctor()`` memoizes HOST state —
+        # the platform's get_config/get_policy idiom, callable at
+        # trace time by convention
+        out = lint(
+            "import jax\n"
+            "_CFG = None\n"
+            "def get_cfg():\n"
+            "    global _CFG\n"
+            "    if _CFG is None:\n"
+            "        _CFG = object()\n"
+            "    return _CFG\n"
+            "@jax.jit\n"
+            "def step(p):\n"
+            "    cfg = get_cfg()\n"
+            "    return p\n", rules=["JIT001"])
+        assert out == []
+
+    def test_compile003_negative_memoized_jit_in_hot_helper(self):
+        # built under ``if self._step is None:`` — compiles once no
+        # matter how hot the caller is
+        out = lint(
+            "import jax\n"
+            "class Est:\n"
+            "    def __init__(self):\n"
+            "        self._step = None\n"
+            "    def evaluate(self, b):\n"
+            "        if self._step is None:\n"
+            "            self._step = jax.jit(lambda x: x + 1)\n"
+            "        return self._step(b)\n"
+            "    def fit(self, batches):\n"
+            "        for b in batches:\n"
+            "            self.evaluate(b)\n", rules=["COMPILE003"])
+        assert out == []
+
+    def test_donation_spec_visible_across_modules(self, tmp_path):
+        # a jitted callable imported from another analyzed module
+        # carries its (lack of) static_argnums into COMPILE003
+        (tmp_path / "steps.py").write_text(
+            "import jax\n"
+            "g = jax.jit(lambda a, n: a * n)\n")
+        (tmp_path / "loop.py").write_text(
+            "from steps import g\n"
+            "def predict(batches):\n"
+            "    for b in batches:\n"
+            "        out = g(b, b.shape[0])\n"
+            "    return out\n")
+        from analytics_zoo_tpu.analysis import analyze_paths
+        findings, errors = analyze_paths(
+            [str(tmp_path)], root=str(tmp_path),
+            rule_ids=["COMPILE003"])
+        assert errors == []
+        assert rule_ids(findings) == ["COMPILE003"]
+        assert "shape-derived" in findings[0].message
+
+
+# ================================================================ SHARD007
+
+
+class TestSHARD007:
+    def test_unknown_axis_flagged_against_canonical_universe(self):
+        out = lint(
+            "from jax.sharding import PartitionSpec as P\n"
+            "spec = P('data', 'modle')\n", rules=["SHARD007"])
+        assert rule_ids(out) == ["SHARD007"]
+        assert "'modle'" in out[0].message
+
+    def test_axis_constants_and_project_meshes_define_universe(self):
+        # a custom Mesh literal adds its axes; the *_AXIS constant
+        # resolves through the project's constant index
+        out = lint(
+            "import numpy as np\n"
+            "from jax.sharding import Mesh, PartitionSpec as P\n"
+            "RING_AXIS = 'ring'\n"
+            "mesh = Mesh(np.array([[0]]), ('ring', 'lane'))\n"
+            "a = P(RING_AXIS)\n"
+            "b = P('lane', None)\n", rules=["SHARD007"])
+        assert out == []
+
+    def test_shard_map_full_replication_of_params(self):
+        out = lint(
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "def body(params, x):\n"
+            "    return params @ x\n"
+            "def build(mesh):\n"
+            "    return jax.shard_map(body, mesh=mesh,\n"
+            "                         in_specs=(P(), P('data')),\n"
+            "                         out_specs=P('data'))\n",
+            rules=["SHARD007"])
+        assert rule_ids(out) == ["SHARD007"]
+        assert "replicated" in out[0].message
+
+    def test_shard_map_negative_sharded_params_and_small_args(self):
+        out = lint(
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "def body(params, scale):\n"
+            "    return params * scale\n"
+            "def build(mesh):\n"
+            "    return jax.shard_map(body, mesh=mesh,\n"
+            "                         in_specs=(P('model'), P()),\n"
+            "                         out_specs=P('model'))\n",
+            rules=["SHARD007"])
+        # params is sharded; ``scale`` is not a large-param name
+        assert out == []
+
+    def test_spec_construction_in_hot_loop(self):
+        out = lint(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+            "def train_loop(mesh, batches):\n"
+            "    for b in batches:\n"
+            "        sh = NamedSharding(mesh, P('data'))\n"
+            "        jax.device_put(b, sh)\n", rules=["SHARD007"])
+        assert [f.rule for f in out].count("SHARD007") >= 1
+        assert "hot loop" in out[0].message
+
+    def test_negative_spec_built_outside_loop(self):
+        out = lint(
+            "import jax\n"
+            "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+            "def train_loop(mesh, batches):\n"
+            "    sh = NamedSharding(mesh, P('data'))\n"
+            "    for b in batches:\n"
+            "        jax.device_put(b, sh)\n", rules=["SHARD007"])
+        assert out == []
+
+    def test_conflicting_sharding_constraints(self):
+        out = lint(
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    x = jax.lax.with_sharding_constraint(x, P('data'))\n"
+            "    x = x * 2\n"
+            "    x = jax.lax.with_sharding_constraint(x, P('model'))\n"
+            "    return x\n", rules=["SHARD007"])
+        assert rule_ids(out) == ["SHARD007"]
+        assert "reshard" in out[0].message
+
+    def test_negative_constraints_in_exclusive_branches(self):
+        # opposite arms of one ``if`` — only one constraint executes
+        # per (static-arg-specialized) trace, so there is no reshard
+        # between them
+        out = lint(
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "@jax.jit\n"
+            "def step(x, c):\n"
+            "    if c:\n"
+            "        x = jax.lax.with_sharding_constraint(x, P('data'))\n"
+            "    else:\n"
+            "        x = jax.lax.with_sharding_constraint(x, P('model'))\n"
+            "    return x\n", rules=["SHARD007"])
+        assert out == []
+
+    def test_negative_repeated_identical_constraint(self):
+        out = lint(
+            "import jax\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "@jax.jit\n"
+            "def step(x):\n"
+            "    x = jax.lax.with_sharding_constraint(x, P('data'))\n"
+            "    x = x * 2\n"
+            "    x = jax.lax.with_sharding_constraint(x, P('data'))\n"
+            "    return x\n", rules=["SHARD007"])
+        assert out == []
+
+
+# ================================================================= MEM009
+
+
+class TestMEM009:
+    def test_dead_state_through_non_donating_jit_call(self):
+        out = lint(
+            "import jax\n"
+            "step = jax.jit(lambda p, o, b: (p, o))\n"
+            "def train(params, opt_state, batches):\n"
+            "    for b in batches:\n"
+            "        params, opt_state = step(params, opt_state, b)\n"
+            "    return params\n", rules=["MEM009"])
+        assert rule_ids(out) == ["MEM009"]
+        assert "donate_argnums" in out[0].message
+
+    def test_negative_donating_jit_call(self):
+        out = lint(
+            "import jax\n"
+            "step = jax.jit(lambda p, o, b: (p, o),\n"
+            "               donate_argnums=(0, 1))\n"
+            "def train(params, opt_state, batches):\n"
+            "    for b in batches:\n"
+            "        params, opt_state = step(params, opt_state, b)\n"
+            "    return params\n", rules=["MEM009"])
+        assert out == []
+
+    def test_unbounded_device_accumulation_in_hot_loop(self):
+        out = lint(
+            "import jax\n"
+            "predict_step = jax.jit(lambda p, b: p @ b)\n"
+            "def predict(p, batches):\n"
+            "    outs = []\n"
+            "    for b in batches:\n"
+            "        outs.append(predict_step(p, b))\n"
+            "    return outs\n", rules=["MEM009"])
+        assert rule_ids(out) == ["MEM009"]
+        assert "HBM" in out[0].message
+
+    def test_negative_bounded_window_with_flush(self):
+        # the PR 5 predict pattern: window-8 sliding device_get
+        out = lint(
+            "import jax\n"
+            "predict_step = jax.jit(lambda p, b: p @ b)\n"
+            "def predict(p, batches):\n"
+            "    outs, window = [], []\n"
+            "    for b in batches:\n"
+            "        window.append(predict_step(p, b))\n"
+            "        if len(window) >= 8:\n"
+            "            outs.append(jax.device_get(window.pop(0)))\n"
+            "    outs.extend(jax.device_get(window))\n"
+            "    return outs\n", rules=["MEM009"])
+        assert out == []
+
+    def test_negative_host_values_accumulate_fine(self):
+        out = lint(
+            "def predict(batches):\n"
+            "    outs = []\n"
+            "    for b in batches:\n"
+            "        outs.append(len(b))\n"
+            "    return outs\n", rules=["MEM009"])
+        assert out == []
+
+    def test_negative_host_pull_rebind_before_append(self):
+        # regression: the reaching binding is the LATEST one before
+        # the append — ``x = step(...); x = np.asarray(x)`` appends a
+        # host array, not the jitted output
+        out = lint(
+            "import jax\n"
+            "import numpy as np\n"
+            "step = jax.jit(lambda p, b: p @ b)\n"
+            "def predict(p, batches):\n"
+            "    outs = []\n"
+            "    for b in batches:\n"
+            "        x = step(p, b)\n"
+            "        x = np.asarray(x)\n"
+            "        outs.append(x)\n"
+            "    return outs\n", rules=["MEM009"])
+        assert out == []
+
+    def test_device_rebind_after_host_binding_still_fires(self):
+        # mirror image of the host-pull rebind: the binding reaching
+        # the append is the jitted call, whatever came first
+        out = lint(
+            "import jax\n"
+            "step = jax.jit(lambda p, b: p @ b)\n"
+            "def predict(p, batches):\n"
+            "    outs = []\n"
+            "    for b in batches:\n"
+            "        x = b\n"
+            "        x = step(p, b)\n"
+            "        outs.append(x)\n"
+            "    return outs\n", rules=["MEM009"])
+        assert rule_ids(out) == ["MEM009"]
+
+    def test_donation_must_cover_the_rebound_state_args(self):
+        # regression: mere PRESENCE of donate_argnums once exempted
+        # the call site — donating only the batch arg leaves both
+        # state trees live
+        out = lint(
+            "import jax\n"
+            "step = jax.jit(lambda p, o, b: (p, o),\n"
+            "               donate_argnums=(2,))\n"
+            "def train(params, opt_state, batches):\n"
+            "    for b in batches:\n"
+            "        params, opt_state = step(params, opt_state, b)\n"
+            "    return params\n", rules=["MEM009"])
+        assert rule_ids(out) == ["MEM009"]
+        assert "position 0" in out[0].message
+
+    def test_partial_donation_coverage_across_modules(self, tmp_path):
+        # the fact bundle must carry the LITERAL donate positions,
+        # not a declared-donation boolean — donating only the batch
+        # in the defining module leaves both state trees live at the
+        # importing call site (regression: cross-module partial
+        # donation was silently assumed covered)
+        (tmp_path / "steps.py").write_text(
+            "import jax\n"
+            "step = jax.jit(lambda p, o, b: (p, o),\n"
+            "               donate_argnums=(2,))\n")
+        (tmp_path / "loop.py").write_text(
+            "from steps import step\n"
+            "def fit(params, opt_state, batches):\n"
+            "    for b in batches:\n"
+            "        params, opt_state = step(params, opt_state, b)\n"
+            "    return params\n")
+        from analytics_zoo_tpu.analysis import analyze_paths
+        findings, errors = analyze_paths(
+            [str(tmp_path)], root=str(tmp_path), rule_ids=["MEM009"])
+        assert errors == []
+        assert rule_ids(findings) == ["MEM009"]
+        assert "position 0" in findings[0].message
+        # full coverage in the defining module stays clean
+        (tmp_path / "steps.py").write_text(
+            "import jax\n"
+            "step = jax.jit(lambda p, o, b: (p, o),\n"
+            "               donate_argnums=(0, 1))\n")
+        findings, errors = analyze_paths(
+            [str(tmp_path)], root=str(tmp_path), rule_ids=["MEM009"])
+        assert errors == []
+        assert findings == []
+
+    def test_negative_single_int_donate_argnums_covers_state(self):
+        out = lint(
+            "import jax\n"
+            "update = jax.jit(lambda o, g: o, donate_argnums=0)\n"
+            "def train(opt_state, grads_list):\n"
+            "    for g in grads_list:\n"
+            "        opt_state = update(opt_state, g)\n"
+            "    return opt_state\n", rules=["MEM009"])
+        assert out == []
+
+    def test_negative_eager_call_to_raw_wrapped_function(self):
+        # regression: ``step = jax.jit(helper)`` once registered
+        # 'helper' itself as a jit call site — a debug/eager path
+        # calling helper() directly was flagged for donation, where
+        # donation semantics don't apply at all
+        out = lint(
+            "import jax\n"
+            "def helper(params, opt_state, b):\n"
+            "    return params, opt_state\n"
+            "step = jax.jit(helper, donate_argnums=(0, 1))\n"
+            "def debug_path(params, opt_state, batches):\n"
+            "    for b in batches:\n"
+            "        params, opt_state = helper(params, opt_state, b)\n"
+            "    return params\n", rules=["MEM009", "COMPILE003"])
+        assert out == []
+
+    def test_self_rebound_jit_wrapper_still_counts(self):
+        # ``helper = jax.jit(helper)`` makes the raw name THE
+        # compiled callable — its call sites keep the donation check
+        out = lint(
+            "import jax\n"
+            "def helper(params, opt_state, b):\n"
+            "    return params, opt_state\n"
+            "helper = jax.jit(helper)\n"
+            "def train(params, opt_state, batches):\n"
+            "    for b in batches:\n"
+            "        params, opt_state = helper(params, opt_state, b)\n"
+            "    return params\n", rules=["MEM009"])
+        assert rule_ids(out) == ["MEM009"]
+
+
+# ================================================================ LOCK010
+
+
+class TestLOCK010:
+    def test_inconsistent_lock_order_across_functions(self):
+        out = lint(
+            "import threading\n"
+            "_A = threading.Lock()\n"
+            "_B = threading.Lock()\n"
+            "def one():\n"
+            "    with _A:\n"
+            "        with _B:\n"
+            "            return 1\n"
+            "def two():\n"
+            "    with _B:\n"
+            "        with _A:\n"
+            "            return 2\n", rules=["LOCK010"])
+        assert len(out) == 2
+        assert all(f.rule == "LOCK010" for f in out)
+        assert "inconsistent lock order" in out[0].message
+
+    def test_negative_consistent_order(self):
+        out = lint(
+            "import threading\n"
+            "_A = threading.Lock()\n"
+            "_B = threading.Lock()\n"
+            "def one():\n"
+            "    with _A:\n"
+            "        with _B:\n"
+            "            return 1\n"
+            "def two():\n"
+            "    with _A:\n"
+            "        with _B:\n"
+            "            return 2\n", rules=["LOCK010"])
+        assert out == []
+
+    def test_self_deadlock_through_call_chain(self):
+        out = lint(
+            "import threading\n"
+            "_LOCK = threading.Lock()\n"
+            "def inner():\n"
+            "    with _LOCK:\n"
+            "        return 1\n"
+            "def outer():\n"
+            "    with _LOCK:\n"
+            "        return inner()\n", rules=["LOCK010"])
+        assert rule_ids(out) == ["LOCK010"]
+        assert "self-deadlock" in out[0].message
+
+    def test_negative_rlock_reentry_is_fine(self):
+        out = lint(
+            "import threading\n"
+            "_LOCK = threading.RLock()\n"
+            "def inner():\n"
+            "    with _LOCK:\n"
+            "        return 1\n"
+            "def outer():\n"
+            "    with _LOCK:\n"
+            "        return inner()\n", rules=["LOCK010"])
+        assert out == []
+
+    def test_lock_held_across_blocking_calls(self):
+        out = lint(
+            "import queue\n"
+            "import threading\n"
+            "import time\n"
+            "_LOCK = threading.Lock()\n"
+            "q = queue.Queue()\n"
+            "def drain():\n"
+            "    with _LOCK:\n"
+            "        item = q.get()\n"
+            "        time.sleep(0.1)\n"
+            "        return item\n", rules=["LOCK010"])
+        assert len(out) == 2
+        assert "blocking" in out[0].message
+
+    def test_imported_rlock_keeps_identity_and_kind(self, tmp_path):
+        # regression: an imported lock once minted a per-importer id —
+        # the defining module's kind (rlock) was unknown there, so a
+        # legal re-entry through a call chain read as self-deadlock
+        (tmp_path / "locks.py").write_text(
+            "import threading\n"
+            "STATE_LOCK = threading.RLock()\n")
+        (tmp_path / "user.py").write_text(
+            "import threading\n"
+            "from locks import STATE_LOCK\n"
+            "def inner():\n"
+            "    with STATE_LOCK:\n"
+            "        return 1\n"
+            "def outer():\n"
+            "    with STATE_LOCK:\n"
+            "        return inner()\n"
+            "def spawn():\n"
+            "    threading.Thread(target=outer).start()\n")
+        from analytics_zoo_tpu.analysis import analyze_paths
+        findings, errors = analyze_paths(
+            [str(tmp_path)], root=str(tmp_path), rule_ids=["LOCK010"])
+        assert errors == []
+        assert findings == []
+
+    def test_order_cycle_connects_across_importing_modules(
+            self, tmp_path):
+        # the flip side of per-importer ids: an A/B inversion split
+        # over two modules importing the same locks must join into
+        # ONE graph and fire
+        (tmp_path / "locks.py").write_text(
+            "import threading\n"
+            "ORDER_A = threading.Lock()\n"
+            "ORDER_B = threading.Lock()\n")
+        (tmp_path / "m1.py").write_text(
+            "import threading\n"
+            "from locks import ORDER_A, ORDER_B\n"
+            "def one():\n"
+            "    with ORDER_A:\n"
+            "        with ORDER_B:\n"
+            "            return 1\n"
+            "def spawn():\n"
+            "    threading.Thread(target=one).start()\n")
+        (tmp_path / "m2.py").write_text(
+            "from locks import ORDER_A, ORDER_B\n"
+            "def two():\n"
+            "    with ORDER_B:\n"
+            "        with ORDER_A:\n"
+            "            return 2\n")
+        from analytics_zoo_tpu.analysis import analyze_paths
+        findings, errors = analyze_paths(
+            [str(tmp_path)], root=str(tmp_path), rule_ids=["LOCK010"])
+        assert errors == []
+        assert rule_ids(findings) == ["LOCK010", "LOCK010"]
+        assert {f.path for f in findings} == {"m1.py", "m2.py"}
+
+    def test_every_held_lock_reported_across_blocking_call(self):
+        # regression: only the INNERMOST held lock was reported —
+        # fixing the inner scope went green while the outer lock was
+        # still held across the wait
+        out = lint(
+            "import queue\n"
+            "import threading\n"
+            "_A = threading.Lock()\n"
+            "_B = threading.Lock()\n"
+            "_q = queue.Queue()\n"
+            "def drain():\n"
+            "    with _A:\n"
+            "        with _B:\n"
+            "            return _q.get()\n"
+            "def spawn():\n"
+            "    threading.Thread(target=drain).start()\n",
+            rules=["LOCK010"])
+        assert rule_ids(out) == ["LOCK010", "LOCK010"]
+        assert {f.message.split("'")[1] for f in out} == {"_A", "_B"}
+
+    def test_unrelated_lock_held_across_condition_wait(self):
+        # regression: the cv-idiom exemption once keyed only on the
+        # wait RECEIVER being a Condition — but wait() releases only
+        # the condition's own lock; any other lock stays held for
+        # the whole (unbounded) wait
+        out = lint(
+            "import threading\n"
+            "class W:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._cv = threading.Condition()\n"
+            "    def worker(self):\n"
+            "        with self._lock:\n"
+            "            with self._cv:\n"
+            "                self._cv.wait()\n", rules=["LOCK010"])
+        assert rule_ids(out) == ["LOCK010"]
+        assert "_lock" in out[0].message
+        assert "_cv' is held" not in out[0].message
+
+    def test_lock_held_across_transitively_blocking_call(self):
+        # regression: does-it-block must propagate through the call
+        # graph — the sleep here is TWO resolvable hops below the
+        # lock-holding frame
+        out = lint(
+            "import threading\n"
+            "import time\n"
+            "_LOCK = threading.Lock()\n"
+            "def leaf():\n"
+            "    time.sleep(5)\n"
+            "def mid():\n"
+            "    leaf()\n"
+            "def serve():\n"
+            "    with _LOCK:\n"
+            "        mid()\n", rules=["LOCK010"])
+        assert rule_ids(out) == ["LOCK010"]
+        assert "blocks on" in out[0].message
+        assert "via" in out[0].message
+
+    def test_negative_condition_wait_and_dict_get(self):
+        out = lint(
+            "import threading\n"
+            "_cv = threading.Condition()\n"
+            "_LOCK = threading.Lock()\n"
+            "_cache = {}\n"
+            "def waiter():\n"
+            "    with _cv:\n"
+            "        _cv.wait()\n"
+            "def reader(k):\n"
+            "    with _LOCK:\n"
+            "        return _cache.get(k, None)\n", rules=["LOCK010"])
+        assert out == []
+
+    def test_negative_function_local_locks_never_alias(self):
+        # each call creates FRESH lock objects — two functions nesting
+        # their own locals in opposite orders cannot deadlock
+        out = lint(
+            "import threading\n"
+            "def one():\n"
+            "    my_lock = threading.Lock()\n"
+            "    other_lock = threading.Lock()\n"
+            "    with my_lock:\n"
+            "        with other_lock:\n"
+            "            return 1\n"
+            "def two():\n"
+            "    my_lock = threading.Lock()\n"
+            "    other_lock = threading.Lock()\n"
+            "    with other_lock:\n"
+            "        with my_lock:\n"
+            "            return 2\n", rules=["LOCK010"])
+        assert out == []
+
+    def test_lock010_suppression_works(self):
+        out = lint(
+            "import threading\n"
+            "import time\n"
+            "_LOCK = threading.Lock()\n"
+            "def slow():\n"
+            "    with _LOCK:\n"
+            "        # zoolint: disable=LOCK010 — deliberate\n"
+            "        time.sleep(1)\n", rules=["LOCK010"])
         assert out == []
 
 
@@ -490,6 +1325,43 @@ class TestSuppression:
         out = lint(self.SRC.format(
             suffix="  # zoolint: disable=JIT001 because trace banner"))
         assert out == []
+
+    # -- decorated defs: a suppression on EITHER the decorator line or
+    # the def line covers findings reported at any line of the span
+    # (the regression fixed in this PR: DONATE004 reports decorator-
+    # form findings at the decorator line but def-scoped ones at the
+    # def line, and authors can't be expected to know which)
+    DECORATED = (
+        "import jax\n"
+        "from functools import partial\n"
+        "{before_dec}@partial(jax.jit, static_argnums=(2,)){on_dec}\n"
+        "def step(params, opt_state, n):{on_def}\n"
+        "    return params, opt_state\n")
+
+    def test_suppression_on_decorator_line_covers_def_finding(self):
+        out = lint(self.DECORATED.format(
+            before_dec="",
+            on_dec="  # zoolint: disable=DONATE004 — eval-only step",
+            on_def=""))
+        assert out == []
+
+    def test_suppression_on_def_line_covers_decorator_finding(self):
+        out = lint(self.DECORATED.format(
+            before_dec="",
+            on_dec="",
+            on_def="  # zoolint: disable=DONATE004 — eval-only step"))
+        assert out == []
+
+    def test_suppression_above_decorator_covers_def_finding(self):
+        out = lint(self.DECORATED.format(
+            before_dec="# zoolint: disable=DONATE004 — eval-only\n",
+            on_dec="", on_def=""))
+        assert out == []
+
+    def test_unsuppressed_decorated_def_still_fires(self):
+        out = lint(self.DECORATED.format(
+            before_dec="", on_dec="", on_def=""))
+        assert rule_ids(out) == ["DONATE004"]
 
 
 DIRTY = (
@@ -599,12 +1471,160 @@ class TestCLIAndJson:
         assert zoolint_main([str(tmp_path / "no_such_dir")]) == 1
         assert "no such file" in capsys.readouterr().out
 
-    def test_list_rules_names_all_six(self, capsys):
+    def test_fresh_process_runs_the_graph_rule_families(self, tmp_path):
+        # regression: rule registration must not depend on import
+        # order — a fresh CLI process once silently skipped
+        # SHARD007/MEM009 because the project link pass imported
+        # rules.py first, and the registry guard then never imported
+        # rules_graph
+        (tmp_path / "bad.py").write_text(
+            "from jax.sharding import PartitionSpec as P\n"
+            "spec = P('bogus_axis')\n")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "scripts", "zoolint"),
+             "--root", str(tmp_path), str(tmp_path)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 1
+        assert "SHARD007" in proc.stdout
+        assert "bogus_axis" in proc.stdout
+
+    def test_list_rules_names_all_nine(self, capsys):
         assert zoolint_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rid in ("JIT001", "SYNC002", "COMPILE003", "DONATE004",
-                    "RACE005", "RNG006"):
+                    "RACE005", "RNG006", "SHARD007", "MEM009"):
             assert rid in out
+        # LOCK010 is a project rule — the catalog must list it too
+        assert "LOCK010" in out
+
+
+class TestJobsAndExplain:
+    def _fixture_dir(self, tmp_path):
+        (tmp_path / "dirty_a.py").write_text(DIRTY)
+        (tmp_path / "dirty_b.py").write_text(
+            DIRTY.replace("def f", "def g").replace("'hi'", "'ho'"))
+        (tmp_path / "steps.py").write_text(
+            "import jax\n"
+            "def build():\n"
+            "    def step(params, opt_state, batch):\n"
+            "        return params, opt_state\n"
+            "    return jax.jit(step)\n")
+        return tmp_path
+
+    def test_jobs_output_identical_to_serial(self, tmp_path):
+        # through scripts/zoolint (the jax-free loader) so the fork
+        # pool REALLY runs — in-process (jax loaded) the pool refuses
+        # to fork a multithreaded parent and degrades to serial
+        d = self._fixture_dir(tmp_path)
+
+        def run(*extra):
+            return subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO_ROOT, "scripts", "zoolint"),
+                 *extra, "--root", str(d), str(d)],
+                capture_output=True, text=True, timeout=120)
+
+        serial = run()
+        parallel = run("--jobs", "3")
+        assert serial.returncode == parallel.returncode == 1
+        assert serial.stdout == parallel.stdout
+        assert "dirty_a.py" in serial.stdout
+        assert "dirty_b.py" in serial.stdout
+
+    def test_jobs_on_json_report_keeps_schema(self, tmp_path, capsys):
+        d = self._fixture_dir(tmp_path)
+        assert zoolint_main(["--jobs", "2", "--json", "--root",
+                             str(d), str(d)]) == 1
+        report = json.loads(capsys.readouterr().out)
+        assert report["tool"] == "zoolint"
+        assert report["total"] == len(report["findings"]) >= 3
+
+    def test_explain_comms_prices_the_psum(self, tmp_path, capsys):
+        d = self._fixture_dir(tmp_path)
+        rc = zoolint_main(["--explain-comms", "--mesh", "data=8",
+                           "--param-count", "1000", "--root", str(d),
+                           str(d)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "steps.py" in out and "psum_grads" in out
+        # 2(n-1)/n * 1000 params * 4 bytes, n=8 -> 7000
+        assert "7,000 bytes/step" in out
+
+    def test_explain_hbm_reports_donation_cost(self, tmp_path, capsys):
+        d = self._fixture_dir(tmp_path)
+        rc = zoolint_main(["--explain-hbm", "--param-bytes", "4000",
+                           "--root", str(d), str(d)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "donated" in out and "not donated" in out
+
+
+# ============================================== static↔runtime parity gate
+
+
+class TestStaticCommParity:
+    """ISSUE 7's acceptance criterion: SHARD007's static
+    collective-bytes-per-step estimate must agree with PR 4's runtime
+    ``collective_bytes_total`` identity to within ±10% on the tier-1
+    allreduce trainer path (8-device data-parallel mesh)."""
+
+    def test_static_estimate_matches_runtime_counters(self):
+        import jax
+        import numpy as np
+        from analytics_zoo_tpu.analysis.comms import (
+            estimate_train_step_comm_bytes)
+        from analytics_zoo_tpu.common.config import get_config
+        from analytics_zoo_tpu.common.triggers import MaxIteration
+        from analytics_zoo_tpu.feature.feature_set import FeatureSet
+        from analytics_zoo_tpu.observability import get_registry
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+
+        rs = np.random.RandomState(0)
+        x = rs.randn(256, 8).astype(np.float32)
+        y = rs.randn(256, 1).astype(np.float32)
+        m = Sequential()
+        m.add(Dense(4, input_shape=(8,)))
+        m.add(Dense(1))
+        m.compile(optimizer="sgd", loss="mse")
+
+        reg = get_registry()
+        c_bytes = reg.counter(
+            "collective_bytes_total", "", labels=("op",)
+        ).labels("psum_grads")
+        c_steps = reg.counter(
+            "collective_ops_total", "", labels=("op",)
+        ).labels("psum_grads")
+        bytes_before, steps_before = c_bytes.value, c_steps.value
+
+        est = Estimator(m, optim_method=m.optim_method)
+        # MaxIteration end-trigger forces the per-step engine (the
+        # dispatch path that bumps the collective counters per step)
+        est.train(FeatureSet.from_ndarrays(x, y), "mse",
+                  end_trigger=MaxIteration(6), batch_size=64)
+
+        steps = c_steps.value - steps_before
+        assert steps >= 6
+        runtime_per_step = (c_bytes.value - bytes_before) / steps
+
+        params = m.get_variables()["params"]
+        param_count = sum(int(np.prod(np.shape(leaf))) for leaf in
+                          jax.tree_util.tree_leaves(params))
+        mesh = est._mesh if est._mesh is not None else None
+        dp = int(mesh.shape["data"]) if mesh is not None \
+            else jax.device_count()
+        fsdp = int(mesh.shape["fsdp"]) if mesh is not None else 1
+        static = estimate_train_step_comm_bytes(
+            param_count, dp, fsdp,
+            str(get_config().get("train.grad_sync_dtype")))
+        assert dp * fsdp == 8        # the tier-1 virtual pod
+        assert static["psum_grads"] > 0
+        assert abs(static["psum_grads"] - runtime_per_step) <= \
+            0.10 * runtime_per_step, (
+            f"static {static['psum_grads']} vs runtime "
+            f"{runtime_per_step} bytes/step")
 
 
 # ========================================================= the tier-1 gate
@@ -616,15 +1636,69 @@ class TestRepoIsClean:
     def test_full_pass_zero_nonbaselined_findings(self):
         """``scripts/zoolint analytics_zoo_tpu scripts examples``
         exits 0 against the checked-in baseline — and does so through
-        the jax-free file-path loader (subprocess)."""
+        the jax-free file-path loader (subprocess), exercising the
+        --jobs process pool the CI stage uses."""
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO_ROOT, "scripts",
                                           "zoolint"),
+             "--jobs", "4",
              "--baseline", BASELINE, "--root", REPO_ROOT,
              "analytics_zoo_tpu", "scripts", "examples"],
             cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
         assert proc.returncode == 0, \
             f"zoolint found regressions:\n{proc.stdout}\n{proc.stderr}"
+
+    def test_check_static_json_merged_report(self):
+        """``check_static --json`` emits ONE machine-readable document
+        folding zoolint's full report and metrics_lint's issues, so
+        obs_report can later join static comm estimates against
+        measured collective counters."""
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                          "check_static.py"),
+             "--json", "--jobs", "2"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, \
+            f"check_static --json failed:\n{proc.stdout[-2000:]}" \
+            f"\n{proc.stderr[-2000:]}"
+        doc = json.loads(proc.stdout)
+        assert doc["tool"] == "check_static"
+        assert doc["rc"] == 0
+        assert doc["zoolint"]["tool"] == "zoolint"
+        assert doc["zoolint"]["total"] == 0
+        assert doc["metrics_lint"]["total"] == 0
+
+    def test_check_static_json_metrics_args_counts(self, tmp_path):
+        """Regression: the --metrics-args JSON branch once captured
+        metrics_lint's trailing 'N issue(s)'/'clean' summary line as
+        an issue — a clean dump reported issues=['clean'] and a dirty
+        one overcounted total by one."""
+        bad = tmp_path / "bad.txt"
+        bad.write_text('# TYPE foo counter\n'
+                       'foo{kind="a"} 1\n'
+                       'foo{kind="a"} 2\n')
+        clean = tmp_path / "clean.txt"
+        clean.write_text('# TYPE foo_total counter\n'
+                         'foo_total{kind="a"} 1\n')
+
+        def run(dump):
+            proc = subprocess.run(
+                [sys.executable, os.path.join(REPO_ROOT, "scripts",
+                                              "check_static.py"),
+                 "--json", "--skip-zoolint",
+                 "--metrics-args", str(dump)],
+                cwd=REPO_ROOT, capture_output=True, text=True,
+                timeout=120)
+            return proc.returncode, \
+                json.loads(proc.stdout)["metrics_lint"]
+
+        rc, ml = run(bad)
+        assert rc == 1
+        assert ml["total"] == len(ml["issues"]) == 2
+        assert not any("issue(s)" in i for i in ml["issues"])
+        rc, ml = run(clean)
+        assert rc == 0
+        assert ml == {"total": 0, "issues": []}
 
     def test_baseline_strictly_below_pre_fix_count(self):
         data = load_baseline(BASELINE)
