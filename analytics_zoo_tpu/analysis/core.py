@@ -210,10 +210,20 @@ class ModuleContext:
     small pattern matchers.
     """
 
-    #: callables whose function argument is jit-COMPILED
-    JIT_WRAPPERS = {
+    #: the RAW jax compile entry points — what COMPILE011 forbids
+    #: outside the ``analytics_zoo_tpu/compile/`` chokepoint
+    RAW_JIT_WRAPPERS = {
         "jax.jit", "jit", "pjit", "jax.pjit",
         "jax.experimental.pjit.pjit",
+    }
+    #: callables whose function argument is jit-COMPILED: the raw jax
+    #: forms plus the platform chokepoint (``engine_jit`` builds a jit
+    #: with identical call semantics, so the purity/donation/recompile
+    #: rules keep their coverage over converted sites)
+    JIT_WRAPPERS = RAW_JIT_WRAPPERS | {
+        "engine_jit", "compile.engine_jit",
+        "analytics_zoo_tpu.compile.engine_jit",
+        "analytics_zoo_tpu.compile.engine.engine_jit",
     }
     #: callables whose function argument is TRACED (purity contract
     #: identical to jit even when the wrapper itself isn't jit)

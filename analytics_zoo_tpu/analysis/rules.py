@@ -12,6 +12,10 @@ COMPILE003 recompile hazards (jit-in-loop, f-strings on traced
            values, shape-derived Python scalars as traced args) —
            the static twin of diagnostics.CompileMonitor's churn
            warnings
+COMPILE011 direct jax.jit/pjit construction in analytics_zoo_tpu/
+           outside the compile/ chokepoint — the program silently
+           opts out of AOT warm-start + the persistent executable
+           cache (use compile.engine_jit)
 DONATE004  training steps that thread params/opt-state through jit
            without donate_argnums (double HBM for the update)
 RACE005    module-level mutable state written without a lock in
@@ -607,6 +611,77 @@ class RecompileHazardRule(Rule):
             v = node.value
             return isinstance(v, ast.Attribute) and v.attr == "shape"
         return False
+
+
+# ============================================================ COMPILE011
+
+
+@register_rule
+class EngineChokepointRule(Rule):
+    """Every engine-built jit must go through the ``compile/``
+    chokepoint.
+
+    Why: ``analytics_zoo_tpu.compile.engine_jit`` is the platform's
+    single lowering chokepoint — it is what gives every compiled
+    program the AOT fast path, the persistent executable cache (141s
+    ResNet-50 cold compile → ~seconds warm deserialize, BENCH_r05),
+    the compile-farm write policy, and the cache hit/miss accounting.
+    A direct ``jax.jit``/``pjit`` construction silently opts that
+    program OUT of all of it: it recompiles in every process forever
+    and its cold-start never shows up in the cache counters.  Scoped
+    to ``analytics_zoo_tpu/`` (examples/tests/scripts are free to jit
+    directly); ``compile/`` itself is the one place allowed to touch
+    the raw wrappers.
+    """
+
+    rule_id = "COMPILE011"
+    severity = "error"
+    doc = ("direct jax.jit/pjit construction outside the compile/ "
+           "chokepoint — bypasses the AOT path + persistent "
+           "executable cache (use engine_jit)")
+
+    SCOPE = "analytics_zoo_tpu/"
+    EXEMPT = ("analytics_zoo_tpu/compile/",)
+
+    def _in_scope(self, ctx: ModuleContext) -> bool:
+        rp = ctx.relpath
+        return rp.startswith(self.SCOPE) and \
+            not any(rp.startswith(e) for e in self.EXEMPT)
+
+    def _flag(self, node: ast.AST, name: str) -> None:
+        self.report(
+            node,
+            f"direct {name}(...) bypasses the engine_jit chokepoint — "
+            f"this program gets no AOT warm-start, no persistent "
+            f"executable cache entry, and no cache accounting; build "
+            f"it with analytics_zoo_tpu.compile.engine_jit (same "
+            f"static_argnums/donate_argnums/shardings semantics)")
+
+    def visit_Call(self, node: ast.Call, ctx: ModuleContext) -> None:
+        if not self._in_scope(ctx):
+            return
+        name = ctx.resolve(node.func)
+        if name in ctx.RAW_JIT_WRAPPERS:
+            self._flag(node, name)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef,
+                          ctx: ModuleContext) -> None:
+        """Decorator forms visit_Call cannot see: bare ``@jax.jit``
+        (an Attribute, not a Call) and ``@partial(jax.jit, ...)``
+        (jax.jit is an argument, not the callee).  The ``@jax.jit(..)``
+        call form is already a Call and reports there."""
+        if not self._in_scope(ctx):
+            return
+        for dec in node.decorator_list:
+            dname = ctx.resolve(dec)
+            if dname in ctx.RAW_JIT_WRAPPERS:
+                self._flag(dec, dname)
+            elif isinstance(dec, ast.Call):
+                fname = ctx.resolve(dec.func)
+                if fname in ("functools.partial", "partial") and \
+                        dec.args and \
+                        ctx.resolve(dec.args[0]) in ctx.RAW_JIT_WRAPPERS:
+                    self._flag(dec, ctx.resolve(dec.args[0]))
 
 
 # ============================================================= DONATE004

@@ -101,14 +101,17 @@ def calibrate_chip(repeats: int = 4, matmul_n: int = 8192,
         b = jax.random.normal(jax.random.fold_in(k, 1),
                               (matmul_n, matmul_n), jnp.bfloat16)
 
-        @jax.jit
-        def mm_chain(a, b):
+        from analytics_zoo_tpu.compile import engine_jit
+
+        def mm_chain_fn(a, b):
             def body(c, _):
                 return jax.lax.dot_general(
                     a, c, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.bfloat16), None
             out, _ = jax.lax.scan(body, b, None, length=matmul_iters)
             return out[0, 0].astype(jnp.float32)
+
+        mm_chain = engine_jit(mm_chain_fn, key_hint="calibrate_mm_chain")
 
         float(mm_chain(a, b))              # compile + warm
         mm_flops = 2.0 * matmul_n ** 3 * matmul_iters
@@ -121,12 +124,13 @@ def calibrate_chip(repeats: int = 4, matmul_n: int = 8192,
         n_elem = bw_mb * (1 << 20) // 4
         x = jnp.ones((n_elem,), jnp.float32)
 
-        @jax.jit
-        def triad(x):
+        def triad_fn(x):
             def body(c, _):
                 return c * jnp.float32(1.0000001) + jnp.float32(1e-9), None
             out, _ = jax.lax.scan(body, x, None, length=bw_iters)
             return out[0]
+
+        triad = engine_jit(triad_fn, key_hint="calibrate_triad")
 
         float(triad(x))
         bw_bytes = 2.0 * n_elem * 4 * bw_iters      # read + write

@@ -173,7 +173,8 @@ class SSDDetector:
 
             return jax.vmap(per_image)(boxes, probs)
 
-        self._fn = jax.jit(detect)
+        from analytics_zoo_tpu.compile import engine_jit
+        self._fn = engine_jit(detect, key_hint="ssd_detect")
 
     def detect(self, images: np.ndarray):
         """-> list per image of (boxes (k,4), scores (k,), labels (k,))."""

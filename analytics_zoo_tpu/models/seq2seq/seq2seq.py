@@ -145,7 +145,9 @@ class Seq2seq(KerasNet):
                                    length=max_seq_len)
             return jnp.swapaxes(toks, 0, 1)
 
-        out = np.asarray(jax.jit(decode)(params, enc_ids))
+        from analytics_zoo_tpu.compile import engine_jit
+        out = np.asarray(engine_jit(
+            decode, key_hint="seq2seq_decode")(params, enc_ids))
         if stop_sign is not None:
             # mask everything after the first stop token
             stopped = np.cumsum(out == stop_sign, axis=1) > 0

@@ -52,6 +52,9 @@ def _int8_conv_supported() -> bool:
         try:
             x = jnp.zeros((1, 4, 4, 1), jnp.int8)
             k = jnp.zeros((2, 2, 1, 1), jnp.int8)
+            # one-shot backend capability probe, not an engine program:
+            # caching its throwaway executable would pollute the store
+            # zoolint: disable=COMPILE011 — capability probe, not an engine program
             out = jax.jit(lambda a, b: jax.lax.conv_general_dilated(
                 a, b, (1, 1), "SAME",
                 dimension_numbers=("NHWC", "HWIO", "NHWC"),

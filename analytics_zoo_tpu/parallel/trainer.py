@@ -33,6 +33,7 @@ import numpy as np
 import optax
 
 from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.compile import engine_jit
 from analytics_zoo_tpu.observability import get_registry, get_tracer
 from analytics_zoo_tpu.observability.diagnostics import (
     get_compile_monitor, publish_mfu, step_attribution_histogram)
@@ -245,7 +246,7 @@ class DistributedTrainer:
                 }
             return self.optim.init(p)
 
-        out = jax.jit(init)(params)
+        out = engine_jit(init, key_hint="init_opt_state")(params)
         if jax.process_count() > 1:
             # multi-host jit outputs are already global arrays
             return out
@@ -336,11 +337,12 @@ class DistributedTrainer:
                 p, o, s, b, jax.random.fold_in(r, i))
         else:
             fn = self._step_core
-        jitted = jax.jit(
+        jitted = engine_jit(
             fn,
             out_shardings=(self._param_shardings, None, self._rep,
                            self._rep),
-            donate_argnums=donate)
+            donate_argnums=donate,
+            key_hint="train_step_at" if fold_rng else "train_step")
         # compile/recompile accounting + cost-analysis FLOPs for the
         # live MFU gauge (diagnostics.CompileMonitor)
         return self._monitor.wrap("train_step", jitted)
@@ -480,6 +482,40 @@ class DistributedTrainer:
             self._train_step_at, params, opt_state, state, batch, rng,
             step)
 
+    # ----------------------------------------------------- AOT warm-start
+    def warm_start(self, params, opt_state, state, host_batch,
+                   rng) -> bool:
+        """Pre-lower-and-compile (or cache-load) the per-step train
+        program BEFORE the first real batch arrives, so the compile —
+        or the ~seconds deserialize from a warm executable cache — is
+        paid at startup where it is attributable, not inside the first
+        training step.
+
+        ``params``/``opt_state``/``state`` are the live device trees
+        (their shardings are part of the program signature);
+        ``host_batch`` is one representative HOST batch — it is
+        device-placed exactly like a real step's batch (``put_batch``)
+        so the warmed signature is bit-for-bit the one the training
+        loop will dispatch.  Nothing is executed and nothing is
+        donated.  Returns whether an AOT executable is in place
+        (False = the plain jit path will compile lazily — never an
+        error)."""
+        try:
+            if self._train_step_at is None:
+                self._train_step_at = self._build_train_step(
+                    fold_rng=True)
+            batch = self.put_batch(host_batch)
+            with get_tracer().span("aot_warm_start"):
+                # _MonitoredJit forwards .warm to the EngineJit
+                return bool(self._train_step_at.warm(
+                    params, opt_state, state, batch, rng, np.int32(0)))
+        except Exception:   # noqa: BLE001 — warm-start is best-effort
+            import logging
+            logging.getLogger("analytics_zoo_tpu.compile").debug(
+                "train-step warm start failed; compiling lazily",
+                exc_info=True)
+            return False
+
     # ------------------------------------------------- device-resident epoch
     def epoch_scan_fn(self, num_batches: int, batch_size: int,
                       unroll: int = 1):
@@ -549,11 +585,11 @@ class DistributedTrainer:
             return params, opt_state, state, losses.mean()
 
         donate = (0, 1, 2) if self.donate else ()
-        jitted = jax.jit(
+        jitted = engine_jit(
             epoch,
             out_shardings=(self._param_shardings, None, self._rep,
                            self._rep),
-            donate_argnums=donate)
+            donate_argnums=donate, key_hint="train_epoch_scan")
         # cost analysis counts the scan BODY once (~ one step), so the
         # monitor's flops gauge stays per-step-comparable
         return self._monitor.wrap("train_epoch_scan", jitted)
@@ -645,7 +681,8 @@ class DistributedTrainer:
                     if y is not None else None
                 return xe, ye
 
-            self._permute_rows = jax.jit(permute)
+            self._permute_rows = engine_jit(permute,
+                                            key_hint="permute_rows")
         return self._permute_rows
 
     # ----------------------------------------------------------- eval step
@@ -657,7 +694,8 @@ class DistributedTrainer:
             out, _ = model.apply(params, x, state=state, training=False)
             return tuple(m.batch_update(y, out, mask) for m in metrics)
 
-        return jax.jit(step, out_shardings=self._rep)
+        return engine_jit(step, out_shardings=self._rep,
+                          key_hint="eval_step")
 
     def make_eval_runner(self, metrics):
         from analytics_zoo_tpu.pipeline.api.keras.metrics import accumulate
@@ -676,7 +714,9 @@ class DistributedTrainer:
             def step(params, state, x):
                 out, _ = model.apply(params, x, state=state, training=False)
                 return out
-            self._predict_step = jax.jit(step, out_shardings=self._rep)
+            self._predict_step = engine_jit(step,
+                                            out_shardings=self._rep,
+                                            key_hint="predict_step")
         return self._predict_step
 
     # ------------------------------------------------------- data movement

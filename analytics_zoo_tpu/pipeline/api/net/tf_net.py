@@ -76,7 +76,8 @@ class TFNet(Layer):
 
     def predict(self, x, batch_size: int = 256):
         """Convenience distributed prediction (TFNet.predict surface)."""
-        fn = jax.jit(self._jax_fn)
+        from analytics_zoo_tpu.compile import engine_jit
+        fn = engine_jit(self._jax_fn, key_hint="tfnet_predict")
         outs = []
         n = len(x)
         for lo in range(0, n, batch_size):

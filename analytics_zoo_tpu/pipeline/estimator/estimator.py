@@ -517,6 +517,41 @@ class Estimator:
                     self._train_summary.add_scalar(
                         "Loss", ts.last_loss, ts.iteration)
 
+        # AOT warm-start (docs/aot-compile.md): pre-lower-and-compile
+        # the per-step train program — deserialized from the
+        # persistent executable cache when one is configured
+        # (ZOO_TPU_COMPILE_CACHE / compile.cache_dir / farm run-dir) —
+        # so the compile lands at startup, attributably, instead of
+        # inside the first dispatched step.  Per-step/pipeline paths
+        # only: the fused paths (hbm scan, chunked) build their
+        # programs through the same chokepoint and warm on first
+        # dispatch.  The peeked batch is NOT consumed: the pipeline
+        # position only commits per batch the DeviceLoader delivers,
+        # and epoch_batches is a fresh generator every epoch.
+        if hbm_src is None and not use_chunks and \
+                getattr(train_set, "num_slices", 1) == 1:
+            warm_batch = None
+            try:
+                if is_pipeline:
+                    warm_batch = next(iter(train_set.iter_epoch(
+                        train_set.epoch,
+                        start_step=train_set.step)))[1]
+                elif type(train_set) is FeatureSet:
+                    # exact-class guard, same as the HBM/eval caches:
+                    # subclasses may have per-call epoch_batches
+                    # semantics (fresh augmentation, a consuming
+                    # source) that an extra peek would disturb
+                    warm_batch = next(iter(train_set.epoch_batches(
+                        ts.epoch, batch_size, train=True)))
+            except StopIteration:
+                warm_batch = None
+            except Exception:   # noqa: BLE001 — warm is best-effort
+                log.debug("could not peek a warm-start batch",
+                          exc_info=True)
+            if warm_batch is not None:
+                trainer.warm_start(params, opt_state, state,
+                                   warm_batch, rng)
+
         stop = False
         # install the watchdog only now: the finally below is the ONLY
         # teardown, so nothing may fail between install and the try

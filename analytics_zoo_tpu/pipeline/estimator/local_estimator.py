@@ -76,9 +76,12 @@ class LocalEstimator:
             new_params = mask_frozen_params(model, params, new_params)
             return new_params, new_opt_state, new_state, loss
 
+        from analytics_zoo_tpu.compile import engine_jit
         from analytics_zoo_tpu.observability import get_compile_monitor
         return get_compile_monitor().wrap(
-            "local_train_step", jax.jit(step, donate_argnums=(0, 1, 2)))
+            "local_train_step",
+            engine_jit(step, donate_argnums=(0, 1, 2),
+                       key_hint="local_train_step"))
 
     def _current_step(self):
         """The jitted step, rebuilt whenever the model's frozen-layer
@@ -118,7 +121,9 @@ class LocalEstimator:
             lambda a: jnp.array(a, copy=True), t)
         params = copy(variables["params"])
         state = copy(variables["state"])
-        opt_state = jax.jit(self.optim.init)(params)
+        from analytics_zoo_tpu.compile import engine_jit
+        opt_state = engine_jit(self.optim.init,
+                               key_hint="local_init_opt_state")(params)
         self._current_step()
 
         it = 0
@@ -238,10 +243,13 @@ class LocalEstimator:
             else FeatureSet.from_ndarrays(x, y)
         model, metrics = self.model, self.metrics
         if self._eval_step is None:
+            from analytics_zoo_tpu.compile import engine_jit
+
             def step(params, state, bx, by, mask):
                 out, _ = model.apply(params, bx, state=state, training=False)
                 return tuple(m.batch_update(by, out, mask) for m in metrics)
-            self._eval_step = jax.jit(step)
+            self._eval_step = engine_jit(step,
+                                         key_hint="local_eval_step")
 
         variables = self.model.get_variables()
         return accumulate(
@@ -257,10 +265,13 @@ class LocalEstimator:
             predict_in_batches)
         model = self.model
         if self._predict_step is None:
+            from analytics_zoo_tpu.compile import engine_jit
+
             def step(params, state, bx):
                 out, _ = model.apply(params, bx, state=state, training=False)
                 return out
-            self._predict_step = jax.jit(step)
+            self._predict_step = engine_jit(
+                step, key_hint="local_predict_step")
         variables = self.model.get_variables()
         return predict_in_batches(
             lambda xb: self._predict_step(variables["params"],

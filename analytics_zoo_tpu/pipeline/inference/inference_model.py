@@ -200,7 +200,8 @@ class InferenceModel:
         # (resnet-18 f32 is ~46 MB/call; the serving loop pays it per
         # batch)
         self._variables = jax.device_put(self._variables)
-        self._predict_fn = jax.jit(fn)
+        from analytics_zoo_tpu.compile import engine_jit
+        self._predict_fn = engine_jit(fn, key_hint="inference_predict")
         return self
 
     def load_zoo_file(self, model, path: str,
@@ -230,9 +231,38 @@ class InferenceModel:
             net = TFNet.from_keras(source, **kwargs)
         self.model = net
         self._variables = {"params": {}, "state": {}}
-        jfn = jax.jit(net._jax_fn)
+        from analytics_zoo_tpu.compile import engine_jit
+        jfn = engine_jit(net._jax_fn, key_hint="inference_tf_predict")
         self._predict_fn = lambda p, s, x: jfn(x)
         return self
+
+    # ----------------------------------------------------------- warm-start
+    def warm(self, input_shape, batch_size: int,
+             dtype=np.float32) -> bool:
+        """AOT warm-start: pre-lower-and-compile (or deserialize from
+        the persistent executable cache) the predict program for
+        ``(batch_size,) + input_shape`` before the first request
+        arrives — a serving replica pays its cold-start at spawn,
+        attributably, instead of inside the first client's request.
+        Never executes the model.  Returns whether an AOT executable
+        is ready (False = the first request compiles lazily)."""
+        if self._predict_fn is None:
+            raise RuntimeError("no model loaded")
+        warm = getattr(self._predict_fn, "warm", None)
+        if warm is None:   # the TF path wraps in a lambda
+            return False
+        try:
+            import jax as _jax
+            spec = _jax.ShapeDtypeStruct(
+                (int(batch_size),) + tuple(input_shape), np.dtype(dtype))
+            return bool(warm(self._variables["params"],
+                             self._variables["state"], spec))
+        except Exception:   # noqa: BLE001 — warm-start is best-effort
+            import logging
+            logging.getLogger("analytics_zoo_tpu.compile").debug(
+                "inference warm start failed; compiling lazily",
+                exc_info=True)
+            return False
 
     # -------------------------------------------------------------- predict
     def predict(self, x, batch_size: Optional[int] = None):

@@ -81,6 +81,7 @@ class ServingConfig:
                  healthz_max_queue: Optional[int] = None,
                  healthz_max_error_rate: Optional[float] = None,
                  result_write_retries: Optional[int] = None,
+                 input_shape=None,
                  extra: Optional[Dict[str, str]] = None):
         self.redis_url = redis_url
         self.batch_size = int(batch_size)
@@ -131,6 +132,17 @@ class ServingConfig:
         # Spark partition; redis-native scale-out uses XREADGROUP)
         self.consumer_group = consumer_group
         self.consumer_name = consumer_name
+        # per-record input shape (no batch dim), e.g. (224, 224, 3):
+        # when set, the worker AOT warm-starts the padded-batch predict
+        # program at startup — from the persistent executable cache
+        # when one is configured — instead of compiling inside the
+        # first client's request (config.yaml ``params.input_shape:
+        # 224,224,3``)
+        if isinstance(input_shape, str):
+            input_shape = tuple(
+                int(d) for d in input_shape.replace("x", ",").split(",")
+                if d.strip())
+        self.input_shape = tuple(input_shape) if input_shape else None
         self.extra = extra or {}   # raw section.key entries (model.* etc)
 
     @classmethod
@@ -166,6 +178,7 @@ class ServingConfig:
                 cfg.get("params.healthz_max_error_rate") or 0.0) or None,
             result_write_retries=int(
                 cfg.get("params.result_write_retries") or 0) or None,
+            input_shape=cfg.get("params.input_shape") or None,
             extra=cfg,
         )
 
@@ -234,6 +247,28 @@ class ClusterServing:
                 port=self.config.metrics_port,
                 host=self.config.metrics_host,
                 health_check=self.readiness).start()
+
+    # ----------------------------------------------------------- warm-start
+    def warm_start(self) -> bool:
+        """AOT warm-start of the padded-batch predict program (serving
+        pads every batch to ``batch_size``, so ONE executable serves
+        all traffic — warm exactly that one).  With a persistent
+        executable cache configured (``ZOO_TPU_COMPILE_CACHE`` /
+        ``compile.cache_dir``), a replica respawn deserializes in
+        seconds instead of recompiling — the serving half of the
+        141s-cold-start fix.  No-op without ``params.input_shape``."""
+        if self.config.input_shape is None:
+            return False
+        warm = getattr(self.model, "warm", None)
+        if warm is None:
+            return False
+        t0 = time.perf_counter()
+        ok = bool(warm(self.config.input_shape, self.config.batch_size))
+        log.info("predict warm start %s in %.2fs (batch=%d, shape=%s)",
+                 "ready" if ok else "unavailable",
+                 time.perf_counter() - t0, self.config.batch_size,
+                 self.config.input_shape)
+        return ok
 
     # ------------------------------------------------------------ main loop
     def run_once(self, block_ms: int = 100) -> int:
@@ -565,6 +600,10 @@ class ClusterServing:
         # for every interval below
         started = time.time()
         self._serve_start = self._serve_start or time.perf_counter()
+        # pre-pay the predict compile (or the ~seconds cache load)
+        # BEFORE polling: the first client's request must not carry
+        # the cold-start
+        self.warm_start()
         if self.metrics_server is not None:
             self.metrics_server.start()   # no-op if already listening
         self._telemetry = TelemetrySampler(

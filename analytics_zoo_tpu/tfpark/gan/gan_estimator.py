@@ -128,8 +128,9 @@ class GANEstimator:
                 lambda p, u: p + u, g_params, updates), new_state, \
                 new_opt, l
 
-        self._d_step = jax.jit(d_step)
-        self._g_step = jax.jit(g_step)
+        from analytics_zoo_tpu.compile import engine_jit
+        self._d_step = engine_jit(d_step, key_hint="gan_d_step")
+        self._g_step = engine_jit(g_step, key_hint="gan_g_step")
         self._built = True
 
     def train(self, real_data, noise_dim: int, batch_size: int = 32,

@@ -1,0 +1,103 @@
+"""Subprocess worker for the warm-start acceptance test
+(tests/test_compile_cache.py::TestSecondProcessWarmStart).
+
+One full cold-vs-warm round trip of the platform's AOT path: train a
+small model through the Estimator (per-step dispatch, so the warmed
+``train_step_at`` program is the one the loop uses) and predict, with
+``ZOO_TPU_COMPILE_CACHE`` pointing at the directory argv[1] names.
+Everything that could differ between two runs is pinned (data via a
+seeded RandomState, init via the per-process layer-name reset, the
+training rng via ``data.shuffle_seed``), so a second process over the
+SAME cache dir must be bit-identical to the first: a deserialized
+executable is the same machine code the cold run compiled.
+
+Prints ONE JSON line: content digests of the trained params and the
+predictions, plus the CompileMonitor's cache/recompile counters —
+the parent asserts cold (misses, no hits) vs warm (>=1 hit, zero
+post-warm recompiles, identical digests).
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+
+def main() -> int:
+    cache_dir = sys.argv[1]
+    os.environ["ZOO_TPU_COMPILE_CACHE"] = cache_dir
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+    import numpy as np
+
+    from analytics_zoo_tpu.common.config import get_config
+    from analytics_zoo_tpu.common.triggers import MaxEpoch
+    from analytics_zoo_tpu.feature.feature_set import FeatureSet
+    from analytics_zoo_tpu.pipeline.api.keras import Sequential
+    from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+    from analytics_zoo_tpu.pipeline.estimator.estimator import Estimator
+
+    # force the per-step dispatch path: it is the one Estimator.train
+    # AOT-warms at startup, and the one serving/elastic recovery care
+    # about
+    cfg = get_config()
+    cfg.set("train.steps_per_dispatch", 1)
+    cfg.set("train.hbm_cache_mb", 0)
+    # a host debug-callback (the watchdog's in-jit finite fold) embeds
+    # a PyCapsule the backend cannot serialize — that program would
+    # degrade (loudly) to in-memory AOT only.  The acceptance claim
+    # here is that the TRAIN STEP itself round-trips through the
+    # persistent cache, so run it callback-free (docs/aot-compile.md
+    # documents the interaction).
+    cfg.set("observability.check_finite", False)
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 8).astype(np.float32)
+    y = rs.randint(0, 2, (256,)).astype(np.int32)
+
+    m = Sequential()
+    m.add(Dense(16, input_shape=(8,), activation="relu"))
+    m.add(Dense(2))
+    m.init()
+
+    est = Estimator(m, optim_method=Adam(lr=1e-3))
+    est.train(FeatureSet.from_ndarrays(x, y),
+              "sparse_categorical_crossentropy_with_logits",
+              end_trigger=MaxEpoch(2), batch_size=32)
+    pred = np.asarray(est.predict(x[:64], batch_size=32))
+
+    import jax
+    digest = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(est.variables["params"]):
+        digest.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    params_digest = digest.hexdigest()
+    pred_digest = hashlib.sha256(
+        np.ascontiguousarray(pred).tobytes()).hexdigest()
+
+    from analytics_zoo_tpu.observability import get_registry
+    counters = get_registry().snapshot().get("counters", {})
+
+    def total(prefix):
+        return sum(v for k, v in counters.items() if k.startswith(prefix))
+
+    print(json.dumps({
+        "params_digest": params_digest,
+        "pred_digest": pred_digest,
+        "final_loss": est.train_state.last_loss,
+        "cache_hits": total("compile_cache_hits_total"),
+        "cache_misses": total("compile_cache_misses_total"),
+        "cache_load_seconds": total("compile_cache_load_seconds"),
+        "cache_writes": total("compile_cache_writes_total"),
+        "cache_errors": total("compile_cache_errors_total"),
+        "recompiles_after_warmup": total("jax_recompiles_total"),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -307,6 +307,98 @@ class TestCOMPILE003:
         assert "inside a loop" in out[0].message
 
 
+# ============================================================ COMPILE011
+
+
+def lint_at(path, src, rules=None):
+    """Like ``lint`` but at an explicit repo-relative path —
+    COMPILE011 is path-scoped (only ``analytics_zoo_tpu/`` outside
+    ``compile/`` is gated)."""
+    from analytics_zoo_tpu.analysis.core import analyze_source
+    return analyze_source(src, path=path, rule_ids=rules)
+
+
+class TestCOMPILE011:
+    SRC_DIRECT = (
+        "import jax\n"
+        "f = jax.jit(lambda x: x + 1)\n")
+
+    def test_direct_jit_inside_package_fires(self):
+        out = lint_at("analytics_zoo_tpu/models/m.py", self.SRC_DIRECT,
+                      rules=["COMPILE011"])
+        assert rule_ids(out) == ["COMPILE011"]
+        assert out[0].severity == "error"
+        assert "engine_jit" in out[0].message
+
+    def test_decorator_forms_fire(self):
+        out = lint_at(
+            "analytics_zoo_tpu/models/m.py",
+            "import jax\n"
+            "from functools import partial\n"
+            "@jax.jit\n"
+            "def g(x):\n"
+            "    return x * 2\n"
+            "@partial(jax.jit, static_argnums=(1,))\n"
+            "def h(x, n):\n"
+            "    return x * n\n"
+            "@jax.jit\n"  # zoolint fixture: call-form via visit_Call
+            "def k(x):\n"
+            "    return x\n", rules=["COMPILE011"])
+        assert rule_ids(out) == ["COMPILE011"] * 3
+
+    def test_pjit_and_from_import_fire(self):
+        out = lint_at(
+            "analytics_zoo_tpu/ops/m.py",
+            "from jax import jit\n"
+            "from jax.experimental.pjit import pjit\n"
+            "a = jit(lambda x: x)\n"
+            "b = pjit(lambda x: x)\n", rules=["COMPILE011"])
+        assert rule_ids(out) == ["COMPILE011"] * 2
+
+    def test_engine_jit_is_clean(self):
+        out = lint_at(
+            "analytics_zoo_tpu/models/m.py",
+            "from analytics_zoo_tpu.compile import engine_jit\n"
+            "f = engine_jit(lambda x: x + 1, key_hint='f')\n",
+            rules=["COMPILE011"])
+        assert out == []
+
+    def test_compile_package_itself_exempt(self):
+        out = lint_at("analytics_zoo_tpu/compile/engine.py",
+                      self.SRC_DIRECT, rules=["COMPILE011"])
+        assert out == []
+
+    def test_examples_and_tests_exempt(self):
+        for path in ("examples/quickstart/demo.py",
+                     "tests/test_something.py",
+                     "scripts/tool.py"):
+            assert lint_at(path, self.SRC_DIRECT,
+                           rules=["COMPILE011"]) == []
+
+    def test_inline_suppression(self):
+        out = lint_at(
+            "analytics_zoo_tpu/ops/m.py",
+            "import jax\n"
+            "# zoolint: disable=COMPILE011 — capability probe\n"
+            "f = jax.jit(lambda x: x)\n", rules=["COMPILE011"])
+        assert out == []
+
+    def test_rule_coverage_survives_the_chokepoint(self):
+        """Converting a site to engine_jit must NOT lose the other
+        rules' coverage: an impure function built through the
+        chokepoint still fires JIT001, and an undonated opt_state
+        thread still fires DONATE004."""
+        out = lint_at(
+            "analytics_zoo_tpu/models/m.py",
+            "from analytics_zoo_tpu.compile import engine_jit\n"
+            "def step(params, opt_state, x):\n"
+            "    print('hi')\n"
+            "    return params, opt_state\n"
+            "jitted = engine_jit(step)\n",
+            rules=["JIT001", "DONATE004", "COMPILE011"])
+        assert sorted(rule_ids(out)) == ["DONATE004", "JIT001"]
+
+
 # ============================================================= DONATE004
 
 
