@@ -24,6 +24,17 @@ Sites shipped in this repo:
 * ``worker.step``       — free site for launched worker scripts
 * ``bench.probe``       — bench.py backend probe (simulated chip
   contention)
+* ``serving.decode``    — ClusterServing batch decode (step = decode
+  batch counter; fires inside the decode pool worker)
+* ``serving.predict``   — ClusterServing predict (step = predict batch
+  counter; fires BEFORE the model call, so a ``kill`` here is a
+  replica dying mid-batch with the batch un-acked — the PEL-reclaim /
+  poison-quarantine trigger)
+* ``serving.redis``     — broker ops through the serving circuit
+  breaker (redis_client.BreakerClient).  Steps count *attempted* ops
+  since the current plan became active (each newly installed plan sees
+  steps 0, 1, 2, …), so ``at_step=0, times=k`` means "the next k
+  broker ops fail" — a scripted broker outage window
 
 Fault kinds:
 
@@ -64,6 +75,9 @@ SITE_TRAINER_DISPATCH = "trainer.dispatch"
 SITE_DATA_BATCH = "data.batch"
 SITE_WORKER_STEP = "worker.step"
 SITE_BENCH_PROBE = "bench.probe"
+SITE_SERVING_DECODE = "serving.decode"
+SITE_SERVING_PREDICT = "serving.predict"
+SITE_SERVING_REDIS = "serving.redis"
 
 KINDS = ("raise", "drop_collective", "poison", "lose_host", "kill",
          "hang", "slow")

@@ -1,4 +1,7 @@
 from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
 from analytics_zoo_tpu.serving.server import ClusterServing
+from analytics_zoo_tpu.serving.supervisor import (
+    ServingSupervisor, cli_worker_factory)
 
-__all__ = ["InputQueue", "OutputQueue", "ClusterServing"]
+__all__ = ["InputQueue", "OutputQueue", "ClusterServing",
+           "ServingSupervisor", "cli_worker_factory"]

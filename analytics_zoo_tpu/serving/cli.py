@@ -50,6 +50,9 @@ def _start(cfg, args):
     from analytics_zoo_tpu.serving.server import ClusterServing
     im = InferenceModel().load_zoo(model, quantize=args.quantize)
     serving = ClusterServing(im, cfg)
+    # graceful drain: SIGTERM (supervisor / orchestrator shutdown) →
+    # finish + ack in-flight batches, flush metrics, exit 0
+    serving.install_signal_handlers()
     serving.run()
     return 0
 
@@ -66,6 +69,12 @@ def main(argv=None):
     p.add_argument("--weights", default=None)
     p.add_argument("--redis", default=None, help="host:port")
     p.add_argument("--quantize", action="store_true")
+    p.add_argument("--consumer-group", default=None,
+                   help="shared consumer group for replica fleets "
+                        "(overrides config params: consumer_group)")
+    p.add_argument("--consumer-name", default=None,
+                   help="this replica's unique consumer name "
+                        "(overrides config params: consumer_name)")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="expose Prometheus /metrics on this port "
                         "(0 = ephemeral; overrides config "
@@ -82,6 +91,10 @@ def main(argv=None):
         cfg.redis_url = args.redis
     if args.metrics_port is not None:
         cfg.metrics_port = args.metrics_port
+    if args.consumer_group:
+        cfg.consumer_group = args.consumer_group
+    if args.consumer_name:
+        cfg.consumer_name = args.consumer_name
 
     if args.command == "init":
         # validate the full setup without serving (ref

@@ -68,30 +68,80 @@ class InputQueue:
 
 class OutputQueue:
     def __init__(self, redis_url: Optional[str] = None, broker=None):
+        self.redis_url = redis_url
         self.broker = broker if broker is not None else connect(redis_url)
 
-    def query(self, uri: str, timeout_s: float = 0.0):
+    def _reconnect(self) -> None:
+        """Replace a dead socket (url-constructed queues only; an
+        injected broker has nothing to reconnect).  A failed reconnect
+        is left for the next poll to count — the retry budget, not
+        this helper, decides when to give up."""
+        if self.redis_url is None:
+            return
+        try:
+            self.broker.close()
+        except Exception:   # noqa: BLE001 — already broken
+            pass
+        try:
+            self.broker = connect(self.redis_url)
+        except (OSError, RuntimeError):
+            pass
+
+    def query(self, uri: str, timeout_s: float = 0.0,
+              retries: int = 8):
         """Result for one uri (list of [class, prob]), or None."""
-        meta = self.query_meta(uri, timeout_s)
+        meta = self.query_meta(uri, timeout_s, retries=retries)
         return meta["value"] if meta else None
 
-    def query_meta(self, uri: str, timeout_s: float = 0.0
-                   ) -> Optional[Dict[str, Any]]:
+    def query_meta(self, uri: str, timeout_s: float = 0.0,
+                   retries: int = 8) -> Optional[Dict[str, Any]]:
         """Result plus correlation metadata: ``{"value": ...,
         "request_id": str | None}`` — the id the server echoed from
-        the matching enqueue."""
-        deadline = time.time() + timeout_s
+        the matching enqueue.
+
+        Polling backs off exponentially (20 ms → 250 ms cap) instead
+        of hammering a fixed 20 ms, and a transient broker error no
+        longer raises straight through: up to ``retries`` consecutive
+        connection failures are absorbed with the same bounded
+        exponential backoff + jitter the server's result-write path
+        uses (reconnecting between attempts), after which the last
+        error is re-raised.  A positive ``timeout_s`` is the per-call
+        deadline and wins over the retry ladder: when it expires
+        mid-retry the call returns ``None`` cleanly, exactly like an
+        absent result.  ``timeout_s=0`` (the default) polls for the
+        result without blocking but has NO deadline, so broker-blip
+        retries may still block up to a few seconds — callers that
+        need fail-fast on a dead broker pass ``retries=1``."""
+        import random
+        deadline = time.monotonic() + timeout_s
+        poll_delay, retry_delay, failures = 0.02, 0.05, 0
         while True:
-            fields = self.broker.hgetall(RESULT_PREFIX + uri)
+            try:
+                fields = self.broker.hgetall(RESULT_PREFIX + uri)
+            except OSError:
+                # connection-class trouble only: a redis COMMAND error
+                # (RuntimeError) is an application bug and re-raises
+                # immediately — retrying cannot fix it
+                failures += 1
+                if failures >= max(int(retries), 1):
+                    raise
+                if timeout_s > 0 and time.monotonic() >= deadline:
+                    return None
+                self._reconnect()
+                time.sleep(retry_delay * (0.5 + random.random()))
+                retry_delay = min(retry_delay * 2.0, 2.0)
+                continue
+            failures, retry_delay = 0, 0.05
             if fields:
                 def dec(v):
                     return v.decode() if isinstance(v, bytes) else v
                 rid = fields.get("request_id")
                 return {"value": json.loads(dec(fields.get("value"))),
                         "request_id": dec(rid) if rid else None}
-            if time.time() >= deadline:
+            if time.monotonic() >= deadline:
                 return None
-            time.sleep(0.02)
+            time.sleep(poll_delay)
+            poll_delay = min(poll_delay * 1.5, 0.25)
 
     def dequeue(self, uris) -> Dict[str, Any]:
         """Fetch-and-delete results for many uris (client.py dequeue)."""

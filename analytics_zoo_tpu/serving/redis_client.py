@@ -47,7 +47,7 @@ class _RespReader:
 
 class RedisClient:
     """Speaks RESP2 for the commands serving needs: XADD, XREAD, XLEN,
-    XTRIM, XDEL, HSET, HGETALL, DEL, PING, INFO."""
+    XTRIM, XDEL, HSET, HGETALL, HDEL, DEL, PING, INFO."""
 
     def __init__(self, host: str = "localhost", port: int = 6379,
                  timeout: float = 5.0):
@@ -184,6 +184,9 @@ class RedisClient:
         reply = self.execute("HGETALL", key) or []
         return {reply[i].decode(): reply[i + 1]
                 for i in range(0, len(reply), 2)}
+
+    def hdel(self, key: str, *fields) -> int:
+        return self.execute("HDEL", key, *fields)
 
     def delete(self, *keys) -> int:
         return self.execute("DEL", *keys)
@@ -345,6 +348,14 @@ class EmbeddedBroker:
     def hgetall(self, key: str) -> Dict[str, Any]:
         with self._lock:
             return dict(self._hashes.get(key, {}))
+
+    def hdel(self, key: str, *fields) -> int:
+        with self._lock:
+            h = self._hashes.get(key, {})
+            n = 0
+            for f in fields:
+                n += h.pop(f, None) is not None
+            return n
 
     def delete(self, *keys) -> int:
         with self._lock:
@@ -545,6 +556,8 @@ class BrokerServer:
                 flat.append(_enc_bulk(k))
                 flat.append(_enc_bulk(v))
             return _enc_array(flat)
+        if cmd == "HDEL":
+            return _enc_int(b.hdel(dec(a[0]), *[dec(f) for f in a[1:]]))
         if cmd == "DEL":
             return _enc_int(b.delete(*[dec(k) for k in a]))
         return _enc_err(f"ERR unknown command '{cmd}'")
@@ -589,3 +602,210 @@ def connect(url: Optional[str] = None):
         return EmbeddedBroker()
     host, _, port = url.partition(":")
     return RedisClient(host or "localhost", int(port or 6379))
+
+
+# ------------------------------------------------------ circuit breaker
+class CircuitOpenError(ConnectionError):
+    """Fast-fail: the breaker is open — no broker IO was attempted."""
+
+
+#: the exception classes the breaker counts as broker failures:
+#: socket/transport trouble (ConnectionError and TimeoutError are both
+#: OSError subclasses) plus injected chaos faults.  Redis COMMAND
+#: errors (NOGROUP, WRONGTYPE, …) are application bugs, not outages —
+#: they raise RuntimeError and pass through uncounted.
+def _breaker_failure_excs():
+    from analytics_zoo_tpu.resilience.chaos import InjectedFault
+    return (OSError, InjectedFault)
+
+
+BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN = 0, 1, 2
+
+
+class CircuitBreaker:
+    """k-consecutive-failures → open → cooldown → half-open probe.
+
+    Closed: every call allowed; ``failures`` consecutive recorded
+    failures open it.  Open: every call fast-fails for ``cooldown_s``.
+    Half-open: exactly ONE probe call is allowed through; its success
+    closes the breaker, its failure re-opens (fresh cooldown).  All
+    transitions happen under one lock that is never held across IO —
+    the caller does the blocking call *outside* and reports back."""
+
+    def __init__(self, failures: int = 5, cooldown_s: float = 2.0,
+                 clock=time.monotonic):
+        self.failures = max(int(failures), 1)
+        self.cooldown_s = float(cooldown_s)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._state = BREAKER_CLOSED
+        self._opened_at = 0.0
+        self._probing = False
+
+    @property
+    def state(self) -> int:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May a call be attempted right now?  (Claims the half-open
+        probe slot when it grants one during cooldown recovery.)"""
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True
+            if self._state == BREAKER_OPEN and \
+                    self._clock() - self._opened_at >= self.cooldown_s:
+                self._state = BREAKER_HALF_OPEN
+            if self._state == BREAKER_HALF_OPEN and not self._probing:
+                self._probing = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive = 0
+            self._probing = False
+            self._state = BREAKER_CLOSED
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._consecutive += 1
+            self._probing = False
+            if self._state == BREAKER_HALF_OPEN or \
+                    self._consecutive >= self.failures:
+                self._state = BREAKER_OPEN
+                self._opened_at = self._clock()
+
+
+class BreakerClient:
+    """Circuit breaker around a broker connection.
+
+    Every delegated op goes through :meth:`_call`: breaker-open →
+    :class:`CircuitOpenError` with **no** socket IO (a broker outage
+    degrades to fast-fail instead of a per-op connect-timeout
+    crash-loop); a transport failure (see ``_breaker_failure_excs``)
+    is counted AND drops the underlying connection, so the half-open
+    probe reconnects through ``factory`` instead of reusing a dead
+    socket.  Exposes the breaker state as the ``serving_breaker_state``
+    gauge (0 closed / 1 half-open / 2 open).
+
+    The chaos site ``serving.redis`` fires here, between the breaker
+    gate and the real op — step = attempted ops since the active plan
+    was installed (each new plan sees steps 0, 1, 2, …), so a scripted
+    outage is "the next k ops fail" regardless of how many ops ran
+    before the test armed it.
+
+    Like the raw clients, a ``BreakerClient`` is NOT thread-safe for
+    concurrent ops (serving keeps all broker IO on one thread); the
+    breaker's own state is locked so `/healthz` threads may read
+    ``breaker.state`` concurrently."""
+
+    def __init__(self, factory, failures: int = 5,
+                 cooldown_s: float = 2.0, conn=None,
+                 clock=time.monotonic):
+        self._factory = factory
+        self._conn = conn
+        self.breaker = CircuitBreaker(failures, cooldown_s, clock)
+        # attempted ops while a chaos plan is armed; reset per plan so
+        # FaultSpec(at_step=0, times=k) means "the next k ops"
+        self._chaos_step = 0
+        self._chaos_plan = None
+        try:
+            from analytics_zoo_tpu.observability import get_registry
+            self._gauge = get_registry().gauge(
+                "serving_breaker_state",
+                "redis circuit breaker: 0 closed, 1 half-open, 2 open")
+            self._gauge.set(BREAKER_CLOSED)
+        except Exception:   # pragma: no cover — registry unavailable
+            self._gauge = None
+
+    # ------------------------------------------------------------ plumbing
+    def _set_gauge(self) -> None:
+        if self._gauge is not None:
+            self._gauge.set(self.breaker.state)
+
+    def _drop_conn(self) -> None:
+        conn, self._conn = self._conn, None
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:   # noqa: BLE001 — already broken
+                pass
+
+    def _trip_chaos(self) -> None:
+        from analytics_zoo_tpu.resilience.chaos import (
+            SITE_SERVING_REDIS, active_chaos)
+        plan = active_chaos()
+        if plan is None:
+            self._chaos_plan = None
+            return
+        if plan is not self._chaos_plan:
+            self._chaos_plan = plan
+            self._chaos_step = 0
+        step = self._chaos_step
+        self._chaos_step += 1
+        plan.trip(SITE_SERVING_REDIS, step)
+
+    def _call(self, name: str, *args, **kwargs):
+        if not self.breaker.allow():
+            self._set_gauge()
+            raise CircuitOpenError(
+                f"redis breaker open: {name} not attempted")
+        try:
+            self._trip_chaos()
+            if self._conn is None:
+                self._conn = self._factory()
+            out = getattr(self._conn, name)(*args, **kwargs)
+        except _breaker_failure_excs():
+            self.breaker.record_failure()
+            self._drop_conn()
+            self._set_gauge()
+            raise
+        except Exception:
+            # a redis COMMAND error (NOGROUP, WRONGTYPE, …) means the
+            # broker answered — the transport is healthy.  Recording
+            # success matters beyond bookkeeping: it releases a
+            # half-open probe slot; leaking it would wedge the breaker
+            # HALF_OPEN forever (every later op fast-failing) while
+            # readiness, which only checks BREAKER_OPEN, reads ready.
+            self.breaker.record_success()
+            self._set_gauge()
+            raise
+        self.breaker.record_success()
+        self._set_gauge()
+        return out
+
+    def __getattr__(self, name: str):
+        # delegate the whole broker command surface through the breaker
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(*args, **kwargs):
+            return self._call(name, *args, **kwargs)
+        call.__name__ = name
+        return call
+
+    def close(self) -> None:
+        """Release the underlying connection (never breaker-gated)."""
+        self._drop_conn()
+
+
+def with_breaker(url: Optional[str] = None, broker=None,
+                 failures: int = 5, cooldown_s: float = 2.0):
+    """Wrap a broker in a :class:`BreakerClient`.
+
+    ``url`` given → connects lazily and RE-connects after transport
+    failures; ``broker`` given (embedded/test double) → the "reconnect"
+    returns the same instance — as does an embedded ``url`` (None /
+    'embedded'): an in-process broker IS the state, so a "reconnect"
+    must never swap in a fresh empty one.  ``failures <= 0`` disables
+    the breaker and returns the raw broker unchanged."""
+    if broker is None and url in (None, "embedded"):
+        broker = connect(url)
+    if failures <= 0:
+        return broker if broker is not None else connect(url)
+    if broker is not None:
+        return BreakerClient(lambda: broker, failures, cooldown_s,
+                             conn=broker)
+    return BreakerClient(lambda: connect(url), failures, cooldown_s)

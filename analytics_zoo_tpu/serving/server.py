@@ -15,8 +15,10 @@ serves all traffic.
 from __future__ import annotations
 
 import base64
+import itertools
 import json
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -25,10 +27,16 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.common.fsutil import atomic_write_text
 from analytics_zoo_tpu.data.stages import WorkerPool, pad_to_batch
 from analytics_zoo_tpu.observability import (
     MetricsServer, TelemetrySampler, get_registry, get_tracer)
-from analytics_zoo_tpu.serving.redis_client import connect
+from analytics_zoo_tpu.resilience.chaos import (
+    SITE_SERVING_DECODE, SITE_SERVING_PREDICT, active_chaos)
+from analytics_zoo_tpu.resilience.detector import HostHeartbeat
+from analytics_zoo_tpu.serving.redis_client import (
+    BREAKER_OPEN, CircuitOpenError, _breaker_failure_excs, connect,
+    with_breaker)
 from analytics_zoo_tpu.utils.summary import InferenceSummary
 
 log = logging.getLogger("analytics_zoo_tpu.serving")
@@ -37,10 +45,21 @@ INPUT_STREAM = "serving_stream"
 RESULT_PREFIX = "result:"
 STOP_KEY = "zoo-serving-stop"   # cross-process stop signal
                                 # (ClusterServingManager.listenTermination)
-# results whose write was abandoned after the bounded backoff: the
-# request_id/uri land here so an operator (or a replaying client) can
-# find them — losing a result beats losing the worker loop
+# results whose write was abandoned after the bounded backoff, shed
+# requests, and quarantined poison records: the request_id/uri land
+# here with a ``reason`` field (write_abandoned | shed | poison) so an
+# operator (or a replaying client) can find every record the fleet
+# gave up on — losing a result beats losing the worker loop
 DEAD_LETTER_STREAM = "serving_dead_letter"
+# delivery-attempt counts for records on the crash-recovery (reclaim)
+# path, keyed by request_id (entry id when absent) — the poison-
+# quarantine bookkeeping must survive the very worker deaths it counts
+POISON_ATTEMPTS_KEY = "serving_poison_attempts"
+
+# the broker-outage class: breaker fast-fails plus the transport
+# failures the breaker counts (socket errors, injected serving.redis
+# faults) — the run loop idles on these instead of crashing
+_BROKER_OUTAGE_EXCS = (CircuitOpenError,) + _breaker_failure_excs()
 
 
 def decode_field(fields: Dict[str, bytes]):
@@ -81,6 +100,11 @@ class ServingConfig:
                  healthz_max_queue: Optional[int] = None,
                  healthz_max_error_rate: Optional[float] = None,
                  result_write_retries: Optional[int] = None,
+                 request_deadline_ms: Optional[int] = None,
+                 reclaim_min_idle_ms: Optional[int] = None,
+                 poison_max_attempts: Optional[int] = None,
+                 breaker_failures: Optional[int] = None,
+                 breaker_cooldown_s: Optional[float] = None,
                  input_shape=None,
                  extra: Optional[Dict[str, str]] = None):
         self.redis_url = redis_url
@@ -127,6 +151,52 @@ class ServingConfig:
             result_write_retries = get_config().get(
                 "serving.result_write_retries", 8)
         self.result_write_retries = max(int(result_write_retries), 1)
+        # admission control: a record older than request_deadline_ms
+        # is shed (dead-lettered reason=shed + error result) instead
+        # of burning predict capacity on a response nobody is waiting
+        # for.  0 disables shedding entirely.  While the stream
+        # backlog exceeds healthz_max_queue (the worker is already
+        # 503-not-ready), records past HALF the deadline are shed too:
+        # behind a >threshold queue they would age out before their
+        # predict anyway.
+        if request_deadline_ms is None:
+            request_deadline_ms = get_config().get(
+                "serving.request_deadline_ms", 0)
+        self.request_deadline_ms = int(request_deadline_ms or 0)
+        # crash recovery: minimum idle time before another worker's
+        # un-acked pending entries are claimed.  Must comfortably
+        # exceed one worst-case batch (decode + predict + result
+        # writes) so an alive-but-slow replica is not robbed, and
+        # should stay BELOW the supervisor's restart window (backoff +
+        # respawn + warm start): then a dead replica's in-flight
+        # records are already re-served by its peers by the time its
+        # replacement comes up.  The reclaim poll tick is derived from
+        # it (min_idle/2, clamped to [0.25s, 10s]).
+        if reclaim_min_idle_ms is None:
+            reclaim_min_idle_ms = get_config().get(
+                "serving.reclaim_min_idle_ms", 30000)
+        self.reclaim_min_idle_ms = max(int(reclaim_min_idle_ms or 0), 0)
+        # poison quarantine: total delivery attempts (the original
+        # XREADGROUP delivery + reclaim re-deliveries, tracked by
+        # request_id in POISON_ATTEMPTS_KEY) before a record that
+        # keeps killing its worker is quarantined to the dead-letter
+        # stream with reason=poison instead of being served again
+        if poison_max_attempts is None:
+            poison_max_attempts = get_config().get(
+                "serving.poison_max_attempts", 2)
+        self.poison_max_attempts = max(int(poison_max_attempts or 0), 1)
+        # circuit breaker around broker ops: open after k consecutive
+        # transport failures, half-open probe after cooldown.  0
+        # disables (raw broker, pre-PR-9 behavior).
+        if breaker_failures is None:
+            breaker_failures = get_config().get(
+                "serving.breaker_failures", 5)
+        self.breaker_failures = int(breaker_failures or 0)
+        if breaker_cooldown_s is None:
+            breaker_cooldown_s = get_config().get(
+                "serving.breaker_cooldown_s", 2.0)
+        self.breaker_cooldown_s = max(float(breaker_cooldown_s or 0.0),
+                                      0.05)
         # consumer_group set → multiple workers SHARE the stream, each
         # record served exactly once (the reference parallelizes per
         # Spark partition; redis-native scale-out uses XREADGROUP)
@@ -178,6 +248,23 @@ class ServingConfig:
                 cfg.get("params.healthz_max_error_rate") or 0.0) or None,
             result_write_retries=int(
                 cfg.get("params.result_write_retries") or 0) or None,
+            request_deadline_ms=int(
+                cfg.get("params.request_deadline_ms") or 0) or None,
+            reclaim_min_idle_ms=(
+                int(cfg["params.reclaim_min_idle_ms"])
+                if cfg.get("params.reclaim_min_idle_ms")
+                not in (None, "") else None),   # explicit 0 = claim
+                                                # stale entries now
+            poison_max_attempts=int(
+                cfg.get("params.poison_max_attempts") or 0) or None,
+            breaker_failures=(int(cfg["params.breaker_failures"])
+                              if cfg.get("params.breaker_failures")
+                              not in (None, "") else None),
+            breaker_cooldown_s=(
+                float(cfg["params.breaker_cooldown_s"])
+                if cfg.get("params.breaker_cooldown_s")
+                not in (None, "") else None),   # explicit 0 clamps to
+                                                # the 0.05s floor
             input_shape=cfg.get("params.input_shape") or None,
             extra=cfg,
         )
@@ -190,16 +277,35 @@ class ClusterServing:
                  broker=None):
         self.model = inference_model
         self.config = config or ServingConfig()
-        self.broker = broker if broker is not None else connect(
-            self.config.redis_url)
+        # breaker-wrapped broker (serving.breaker_failures=0 for the
+        # raw connection): a broker outage opens the circuit and every
+        # op fast-fails until a half-open probe reconnects — the run
+        # loop idles on CircuitOpenError instead of crash-looping
+        self.broker = with_breaker(
+            url=self.config.redis_url, broker=broker,
+            failures=self.config.breaker_failures,
+            cooldown_s=self.config.breaker_cooldown_s)
         self.summary = (InferenceSummary(self.config.log_dir, "serving")
                         if self.config.log_dir else None)
         self._stop = threading.Event()
         self._last_id = "0-0"
         self.total_records = 0
+        self._group_ready = not self.config.consumer_group
         if self.config.consumer_group:
-            self.broker.xgroup_create(INPUT_STREAM,
-                                      self.config.consumer_group)
+            try:
+                self._ensure_group()
+            except _BROKER_OUTAGE_EXCS as e:
+                # broker down at bring-up: crashing here would make
+                # the supervisor restart-loop the replica against a
+                # dead broker — exactly what the breaker exists to
+                # prevent.  The group is created lazily by the first
+                # successful read attempt once the probe reconnects;
+                # until then reads fail into the run loop's outage
+                # idle path like any other broker op.
+                log.warning(
+                    "broker unavailable at startup (%s: %s); consumer "
+                    "group %r will be created once it recovers",
+                    type(e).__name__, e, self.config.consumer_group)
         # per-record arrival→result latencies (seconds), bounded
         self.latencies: deque = deque(maxlen=10000)
         self._serve_start: Optional[float] = None
@@ -232,6 +338,14 @@ class ClusterServing:
         self._m_reclaimed = reg.counter(
             "serving_reclaimed_total",
             "stale pending records reclaimed from dead workers")
+        self._m_shed = reg.counter(
+            "serving_shed_total",
+            "records shed by admission control instead of predicted",
+            labels=("cause",))
+        self._m_quarantined = reg.counter(
+            "serving_quarantined_total",
+            "poison records quarantined to the dead-letter stream "
+            "after repeatedly killing their worker")
         self._tracer = get_tracer()
         self._telemetry: Optional[TelemetrySampler] = None
         # readiness window: 1 per recently served record, 0 per record
@@ -241,6 +355,13 @@ class ClusterServing:
         # mid-iteration, which would flip a healthy worker to 503.
         self._recent_outcomes: deque = deque(maxlen=200)
         self._outcomes_lock = threading.Lock()
+        # True while warm_start() compiles/loads the predict program:
+        # /healthz answers 503 warming_up (alive, not routable)
+        self._warming = False
+        # chaos-site step counters (decode runs in the pool →
+        # itertools.count.__next__ is atomic under the GIL)
+        self._decode_seq = itertools.count()
+        self._predict_seq = itertools.count()
         self.metrics_server: Optional[MetricsServer] = None
         if self.config.metrics_port is not None:
             self.metrics_server = MetricsServer(
@@ -333,6 +454,7 @@ class ClusterServing:
             self.broker.xadd(DEAD_LETTER_STREAM, {
                 "uri": uri,
                 "request_id": request_id or "",
+                "reason": "write_abandoned",
                 "error": f"{type(last_exc).__name__}: {last_exc}",
                 "abandoned_unix": f"{time.time():.3f}",
             })
@@ -343,12 +465,22 @@ class ClusterServing:
         return False
 
     # -------------------------------------------------- pipelined serving
+    def _ensure_group(self) -> None:
+        """Create the consumer group if this worker has not managed to
+        yet (idempotent; deferred past __init__ when the broker was
+        down at bring-up)."""
+        if not self._group_ready:
+            self.broker.xgroup_create(INPUT_STREAM,
+                                      self.config.consumer_group)
+            self._group_ready = True
+
     def _read_entries(self, count: int, block_ms: int):
         """Read the next batch: plain XREAD (single worker owns the
         stream) or XREADGROUP (workers share it, exactly-once
         delivery)."""
         cfg = self.config
         if cfg.consumer_group:
+            self._ensure_group()
             return self.broker.xreadgroup(
                 cfg.consumer_group, cfg.consumer_name, INPUT_STREAM,
                 count=count, block_ms=block_ms)
@@ -363,14 +495,29 @@ class ClusterServing:
             self.broker.xack(INPUT_STREAM, self.config.consumer_group,
                              *[i for i, _ in entries])
 
-    def _reclaim_stale(self, min_idle_ms: int = 30000):
+    def _reclaim_stale(self, min_idle_ms: Optional[int] = None):
         """Crash recovery: claim entries another worker read but never
         acknowledged (died between XREADGROUP and XACK) and serve them
         — without this, records in a dead worker's pending list would
-        wait forever."""
+        wait forever.
+
+        Reclaimed records are served ONE AT A TIME under the poison-
+        quarantine contract: a record on this path has already been
+        delivered and never acknowledged (its worker likely died on
+        it), so before each individual serve its delivery count is
+        persisted to ``POISON_ATTEMPTS_KEY`` — a crash mid-serve still
+        counts.  A record whose total deliveries would exceed
+        ``poison_max_attempts`` is quarantined to the dead-letter
+        stream (reason=poison) instead of killing this replica too.
+        Individual serving also shields the innocent co-batched
+        records: they are served (and their count cleared) before or
+        after the poison one dies, instead of sharing its fate
+        forever."""
         cfg = self.config
         if not cfg.consumer_group:
             return 0
+        if min_idle_ms is None:
+            min_idle_ms = cfg.reclaim_min_idle_ms
         try:
             entries = self.broker.xautoclaim(
                 INPUT_STREAM, cfg.consumer_group, cfg.consumer_name,
@@ -385,14 +532,78 @@ class ClusterServing:
         entries = [e for e in entries if e[0] not in self._inflight]
         if not entries:
             return 0
-        # a reclaimed batch can be the very poison that killed its
-        # original worker — _serve_entries guarantees it cannot kill
-        # THIS one too (no crash-loop across reclaiming workers)
-        real = self._serve_entries(entries, time.perf_counter())
-        self._m_reclaimed.inc(len(entries))
-        log.info("reclaimed %d stale pending records (%d poison)",
-                 real, len(entries) - real)
+        try:
+            counts = self.broker.hgetall(POISON_ATTEMPTS_KEY)
+        except Exception:   # noqa: BLE001 — count-less reclaim is fine
+            counts = {}
+        real = served = 0
+        for entry_id, fields in entries:
+            key = self._rid_of(fields) or str(entry_id)
+            attempts = int(counts.get(key, 0) or 0)
+            # total deliveries so far = the original XREADGROUP
+            # delivery + `attempts` reclaim re-serves; would this
+            # re-serve exceed the budget?
+            if attempts + 1 >= cfg.poison_max_attempts:
+                self._quarantine(entry_id, fields, attempts + 1)
+                continue
+            try:
+                self.broker.hset(POISON_ATTEMPTS_KEY,
+                                 {key: str(attempts + 1)})
+            except Exception:   # noqa: BLE001 — serve counts anyway
+                log.exception("poison-attempt mark failed for %s", key)
+            # a reclaimed record can be the very poison that killed
+            # its original worker — an in-process failure is absorbed
+            # by _serve_entries' poison contract; a process-killing
+            # one leaves the count above persisted for the NEXT
+            # reclaimer's verdict
+            real += self._serve_entries([(entry_id, fields)],
+                                        time.perf_counter())
+            served += 1
+            try:
+                self.broker.hdel(POISON_ATTEMPTS_KEY, key)
+            except Exception:   # noqa: BLE001 — stale count is benign
+                pass
+        self._m_reclaimed.inc(served)
+        log.info("reclaimed %d stale pending records (%d served, "
+                 "%d error-resulted, %d quarantined)", len(entries),
+                 real, served - real, len(entries) - served)
         return real
+
+    def _quarantine(self, entry_id, fields, deliveries: int) -> None:
+        """Dead-letter a record that keeps killing its workers
+        (reason=poison), give its client an explicit error result, and
+        ack it out of the PEL so it can never be delivered again."""
+        uri, rid = self._uri_of(fields), self._rid_of(fields)
+        log.error("quarantining poison record %s (uri=%s, request_id="
+                  "%s) after %d deliveries", entry_id, uri, rid,
+                  deliveries)
+        try:
+            self.broker.xadd(DEAD_LETTER_STREAM, {
+                "uri": uri or "",
+                "request_id": rid or "",
+                "reason": "poison",
+                "entry_id": str(entry_id),
+                "deliveries": str(deliveries),
+                "quarantined_unix": f"{time.time():.3f}",
+            })
+        except Exception:   # noqa: BLE001 — broker may be flaking
+            log.exception("dead-letter write failed for quarantined "
+                          "record %s", entry_id)
+        if uri:
+            self._write_result(uri, json.dumps({
+                "error": f"poison: quarantined after "
+                         f"{deliveries} deliveries"}),
+                request_id=rid)
+        self._m_quarantined.inc()
+        self._m_errors.inc()
+        with self._outcomes_lock:
+            self._recent_outcomes.append(0)
+        self._ack([(entry_id, fields)])
+        try:
+            self.broker.hdel(POISON_ATTEMPTS_KEY,
+                             rid or str(entry_id))
+        except Exception:   # noqa: BLE001 — stale count is benign
+            pass
 
     def _decode_batch(self, entries):
         """Decode one batch of raw stream entries (runs in the decode
@@ -402,6 +613,9 @@ class ClusterServing:
         the serve path writes them an error result, because acking
         consumes the record and a consumed record with no result
         strands its client."""
+        chaos = active_chaos()
+        if chaos is not None:
+            chaos.trip(SITE_SERVING_DECODE, next(self._decode_seq))
         uris, arrays, rids, failed = [], [], [], []
         for entry_id, fields in entries:
             try:
@@ -427,10 +641,87 @@ class ClusterServing:
             else None
         return rid.decode() if isinstance(rid, bytes) else rid
 
+    # ------------------------------------------------- admission control
+    @staticmethod
+    def _entry_age_ms(entry_id, now_ms: float) -> Optional[float]:
+        """Age of a stream entry from the ms half of its id (stream
+        ids are ``<epoch-ms>-<seq>``); None when unparseable."""
+        if isinstance(entry_id, bytes):
+            entry_id = entry_id.decode()
+        try:
+            ms = int(str(entry_id).partition("-")[0])
+        except (TypeError, ValueError):
+            return None
+        return now_ms - ms
+
+    def _shed_expired(self, entries):
+        """Deadline-aware load shedding (``params.request_deadline_ms``
+        > 0 opts in): a record older than its deadline is shed —
+        dead-lettered with reason=shed + an explicit error result +
+        acked — instead of burning predict capacity on a response its
+        client stopped waiting for.  While the backlog at the last
+        poll exceeds ``params.healthz_max_queue`` (the same threshold
+        that 503s `/healthz`), records past HALF the deadline are shed
+        too: behind a >threshold queue they would age out before their
+        own predict anyway — shedding them is what lets a drowning
+        worker catch back up to fresh traffic.  Returns the admitted
+        entries."""
+        cfg = self.config
+        deadline = float(cfg.request_deadline_ms)
+        if not entries or deadline <= 0:
+            return entries
+        overloaded = (cfg.healthz_max_queue > 0
+                      and self._m_queue.value > cfg.healthz_max_queue)
+        cut = deadline / 2.0 if overloaded else deadline
+        now_ms = time.time() * 1000.0
+        keep, shed = [], []
+        for entry_id, fields in entries:
+            age = self._entry_age_ms(entry_id, now_ms)
+            if age is None or age <= cut:
+                keep.append((entry_id, fields))
+            else:
+                cause = "deadline" if age > deadline else "overload"
+                shed.append((entry_id, fields, age, cause))
+        for entry_id, fields, age, cause in shed:
+            uri, rid = self._uri_of(fields), self._rid_of(fields)
+            try:
+                self.broker.xadd(DEAD_LETTER_STREAM, {
+                    "uri": uri or "",
+                    "request_id": rid or "",
+                    "reason": "shed",
+                    "cause": cause,
+                    "age_ms": f"{age:.0f}",
+                    "deadline_ms": f"{deadline:.0f}",
+                })
+            except Exception:   # noqa: BLE001 — shedding must not kill
+                log.exception("dead-letter write failed for shed "
+                              "record %s", entry_id)
+            if uri:
+                self._write_result(uri, json.dumps({
+                    "error": f"shed: {cause} ({age:.0f}ms old, "
+                             f"deadline {deadline:.0f}ms)"}),
+                    request_id=rid)
+            self._m_shed.labels(cause).inc()
+        if shed:
+            # shed records are deliberate drops, not worker errors —
+            # they are acked (consumed) but kept OUT of the /healthz
+            # error-rate window: admission control under overload must
+            # not also flip the probe that is already watching the
+            # queue-depth threshold
+            self._ack([(i, f) for i, f, _a, _c in shed])
+            log.warning("shed %d records (%s)", len(shed),
+                        ", ".join(sorted({c for *_x, c in shed})))
+        return keep
+
     def _serve_entries(self, entries, t_arrival: float) -> int:
-        """Decode + serve one raw batch with the poison-batch contract
-        applied (shared by run_once, the pipelined loop via
-        _consume_batch, and _reclaim_stale).  Returns #served."""
+        """Decode + serve one raw batch with admission control and the
+        poison-batch contract applied (shared by run_once and
+        _reclaim_stale; the pipelined loop sheds BEFORE submitting
+        decode work instead, so an expired record costs no decode
+        either).  Returns #served."""
+        entries = self._shed_expired(entries)
+        if not entries:
+            return 0
         try:
             decoded = self._decode_batch(entries)
         except Exception as e:
@@ -489,6 +780,12 @@ class ClusterServing:
         # the span carries the batch's request ids, so a trace viewer
         # (or the merged cluster timeline) can follow one request from
         # client enqueue through this predict to its result write
+        # the chaos site fires BEFORE the model call: a ``kill`` here
+        # is a replica dying mid-batch with the batch un-acked — the
+        # scripted trigger for PEL reclaim and poison quarantine
+        chaos = active_chaos()
+        if chaos is not None:
+            chaos.trip(SITE_SERVING_PREDICT, next(self._predict_seq))
         with self._tracer.span(
                 "serving_predict", records=real,
                 request_ids=[r for r in rids if r][:16]):
@@ -530,8 +827,22 @@ class ClusterServing:
         MetricsServer): None when ready, else a JSON-able reason dict
         — the endpoint answers 503 with it.  Thresholds come from
         config.yaml ``params.healthz_max_queue`` /
-        ``params.healthz_max_error_rate`` (0 = check disabled)."""
+        ``params.healthz_max_error_rate`` (0 = check disabled).  An
+        OPEN circuit breaker is always not-ready: the broker is down,
+        so routing here is pointless — but the process is alive and
+        fast-failing, which is exactly why the supervisor watches
+        /healthz for liveness yet only restarts on *unreachable*
+        (restarting cannot fix a dead broker)."""
         cfg = self.config
+        if self._warming:
+            # predict program compiling / cache-loading: alive (the
+            # supervisor must not no-port kill a cold replica) but
+            # not ready for routing yet
+            return {"reason": "warming_up"}
+        breaker = getattr(self.broker, "breaker", None)
+        if breaker is not None and breaker.state == BREAKER_OPEN:
+            return {"reason": "breaker_open",
+                    "cooldown_s": breaker.cooldown_s}
         if cfg.healthz_max_queue > 0:
             depth = self._m_queue.value
             if depth > cfg.healthz_max_queue:
@@ -569,7 +880,12 @@ class ClusterServing:
     def _should_stop(self, started: float) -> bool:
         if self._stop.is_set():
             return True
-        sig = self.broker.hgetall(STOP_KEY)
+        try:
+            sig = self.broker.hgetall(STOP_KEY)
+        except _BROKER_OUTAGE_EXCS:
+            # the cross-process stop signal is unreadable during an
+            # outage; the local stop() path above still works
+            return False
         if sig:
             raw = sig.get(b"stop", sig.get("stop", b"0"))
             try:
@@ -582,6 +898,23 @@ class ClusterServing:
                 return True
         return False
 
+    def install_signal_handlers(self, signals=None) -> bool:
+        """SIGTERM → graceful drain: ``stop()`` is set, the run loop
+        finishes + acks every in-flight batch, flushes metrics, and
+        returns normally (exit 0 from the CLI) — no request stranded
+        in the PEL.  Signal handlers are a main-thread-only facility;
+        returns False when this is not the main thread (background
+        serving keeps using ``stop()`` directly)."""
+        import signal as _signal
+        if signals is None:
+            signals = (_signal.SIGTERM,)
+        try:
+            for s in signals:
+                _signal.signal(s, lambda _sig, _frame: self.stop())
+            return True
+        except ValueError:
+            return False
+
     def run(self, poll_ms: int = 100, decode_workers: int = 2,
             pipeline_depth: Optional[int] = None) -> None:
         """Pipelined loop: the decode POOL works batch N+1..N+depth
@@ -589,7 +922,14 @@ class ClusterServing:
         decode per partition, ClusterServing.scala:156-237; here decode
         threads overlap the XLA execute, which releases the GIL).  All
         broker IO stays on this thread — the RESP socket is not
-        thread-safe."""
+        thread-safe.
+
+        Broker-outage contract: transport failures (and the circuit
+        breaker's fast-fails once it opens) never kill the loop — the
+        worker idles, keeps heartbeating and answering ``/healthz``
+        (503 ``breaker_open``), and resumes when a half-open probe
+        reconnects.  Un-acked records ride the PEL through the outage.
+        """
         if pipeline_depth is None:
             pipeline_depth = self.config.pipeline_depth
         log.info("cluster serving started (batch=%d, decode_workers=%d, "
@@ -600,12 +940,27 @@ class ClusterServing:
         # for every interval below
         started = time.time()
         self._serve_start = self._serve_start or time.perf_counter()
+        # publish /healthz BEFORE the warm start: a cold compile can
+        # run minutes (the 141s north star), far past any supervisor
+        # startup grace — the port must be discoverable and answering
+        # (503 warming_up = alive, deliberately not-ready) while the
+        # predict program compiles, or every cold-cache replica would
+        # be no-port killed mid-compile and respawned into the same
+        # cold compile, forever
+        if self.metrics_server is not None:
+            self.metrics_server.start()   # no-op if already listening
+        self._publish_port()
         # pre-pay the predict compile (or the ~seconds cache load)
         # BEFORE polling: the first client's request must not carry
         # the cold-start
-        self.warm_start()
-        if self.metrics_server is not None:
-            self.metrics_server.start()   # no-op if already listening
+        self._warming = True
+        try:
+            self.warm_start()
+        finally:
+            self._warming = False
+        # replica liveness for the supervisor / launcher plane
+        # (ZOO_TPU_METRICS_DIR names this worker's host-<k>/ slot)
+        heartbeat = HostHeartbeat.from_env()
         self._telemetry = TelemetrySampler(
             float(get_config().get(
                 "observability.telemetry_interval_s", 10.0))).start()
@@ -614,47 +969,126 @@ class ClusterServing:
         # map stage — CPU-bound host transforms overlapping the chip
         pool = WorkerPool(decode_workers, name="serving-decode")
         pending: deque = deque()   # (future, t_arrival, entries)
+        reclaim_tick = max(0.25, min(
+            10.0, self.config.reclaim_min_idle_ms / 2000.0))
         last_reclaim = time.perf_counter()
+        outage = False
         try:
             while True:
-                if time.perf_counter() - last_reclaim > 10.0:
-                    self._reclaim_stale()
-                    last_reclaim = time.perf_counter()
-                # keep the decode pipeline full
-                while len(pending) < pipeline_depth:
-                    entries = self._read_entries(
-                        self.config.batch_size,
-                        0 if pending else poll_ms)
-                    if not entries:
-                        break
-                    self._inflight.update(i for i, _ in entries)
-                    pending.append((pool.submit(self._decode_batch,
-                                                entries),
-                                    time.perf_counter(), entries))
-                if pending:
-                    fut, t_arrival, entries = pending.popleft()
-                    self._consume_batch(fut, t_arrival, entries)
-                    if self.summary is not None and self.latencies:
-                        s = self.stats()
-                        self.summary.add_scalar(
-                            "Serving Throughput", s["throughput_rps"],
-                            self.total_records)
-                    qlen = self.broker.xlen(INPUT_STREAM)
-                    self._m_queue.set(qlen)
-                    if qlen > self.config.max_stream_len:
-                        self.broker.xtrim(INPUT_STREAM,
-                                          self.config.max_stream_len)
-                if self._should_stop(started):
-                    # drain: every batch already read past (_last_id
-                    # advanced) MUST still be predicted + written, or
-                    # its clients wait forever
-                    while pending:
+                if heartbeat is not None:
+                    heartbeat.beat(step=self.total_records)
+                try:
+                    if time.perf_counter() - last_reclaim \
+                            > reclaim_tick:
+                        self._reclaim_stale()
+                        last_reclaim = time.perf_counter()
+                    # keep the decode pipeline full (admission control
+                    # BEFORE the decode submit: an expired record
+                    # costs neither decode nor predict)
+                    while len(pending) < pipeline_depth:
+                        entries = self._read_entries(
+                            self.config.batch_size,
+                            0 if pending else poll_ms)
+                        if not entries:
+                            break
+                        entries = self._shed_expired(entries)
+                        if not entries:
+                            # fully-shed batch: yield to the OUTER
+                            # loop instead of reading again — purging
+                            # a deep expired backlog must not starve
+                            # the heartbeat, the stop/drain check, or
+                            # reclaim (a supervisor would TERM a
+                            # replica whose beat stalls mid-purge)
+                            break
+                        self._inflight.update(i for i, _ in entries)
+                        pending.append((pool.submit(self._decode_batch,
+                                                    entries),
+                                        time.perf_counter(), entries))
+                    if pending:
                         fut, t_arrival, entries = pending.popleft()
                         self._consume_batch(fut, t_arrival, entries)
+                        if self.summary is not None and self.latencies:
+                            s = self.stats()
+                            self.summary.add_scalar(
+                                "Serving Throughput",
+                                s["throughput_rps"],
+                                self.total_records)
+                        qlen = self.broker.xlen(INPUT_STREAM)
+                        self._m_queue.set(qlen)
+                        if qlen > self.config.max_stream_len:
+                            self.broker.xtrim(
+                                INPUT_STREAM,
+                                self.config.max_stream_len)
+                    if outage:
+                        outage = False
+                        log.warning("broker recovered; serving resumed")
+                except _BROKER_OUTAGE_EXCS as e:
+                    # fast-fail idle: one bounded sleep per failed
+                    # attempt (the breaker already swallowed the
+                    # per-op connect cost), not a crash that would
+                    # make the supervisor restart-loop the replica
+                    # against a dead broker
+                    if not outage:
+                        outage = True
+                        log.warning(
+                            "broker unavailable (%s: %s); idling until "
+                            "the breaker's half-open probe reconnects",
+                            type(e).__name__, e)
+                    time.sleep(min(
+                        0.25, self.config.breaker_cooldown_s / 2.0))
+                if self._should_stop(started):
+                    self._drain(pending)
                     break
         finally:
             pool.shutdown(wait=False)
+            self._flush_observability()
             self.close()
+
+    def _drain(self, pending: deque) -> None:
+        """Graceful drain: every batch already read past (_last_id
+        advanced / PEL-delivered) MUST still be predicted, written,
+        and acked, or its clients wait forever.  Under a broker
+        outage the remaining batches are left UN-acked — the PEL keeps
+        them for the surviving replicas to reclaim, which beats
+        blocking shutdown on a dead broker."""
+        while pending:
+            fut, t_arrival, entries = pending.popleft()
+            try:
+                self._consume_batch(fut, t_arrival, entries)
+            except _BROKER_OUTAGE_EXCS:
+                log.warning(
+                    "drain: broker unavailable; leaving %d batch(es) "
+                    "in the PEL for peer reclaim", len(pending) + 1)
+                break
+
+    def _publish_port(self) -> None:
+        """Replica→supervisor port discovery: atomically write the
+        bound /metrics (+/healthz) port to the file named by
+        ``ZOO_TPU_SERVING_PORT_FILE`` (the supervisor injects it and
+        polls readiness on the discovered port — metrics_port=0 keeps
+        replicas collision-free on one host)."""
+        path = os.environ.get("ZOO_TPU_SERVING_PORT_FILE")
+        if not path or self.metrics_server is None \
+                or not self.metrics_server.port:
+            return
+        try:
+            atomic_write_text(path, str(self.metrics_server.port))
+        except OSError:
+            log.exception("could not publish serving port to %s", path)
+
+    def _flush_observability(self) -> None:
+        """Drain-time metrics flush: inside a launcher-managed run dir
+        the worker's registry snapshot is persisted so fleet
+        aggregation sees the final counts (no-op anywhere else —
+        ``flush_worker_observability`` guards on its own init)."""
+        if not os.environ.get("ZOO_TPU_METRICS_DIR"):
+            return
+        try:
+            from analytics_zoo_tpu.observability.aggregator import (
+                flush_worker_observability)
+            flush_worker_observability()
+        except Exception:   # noqa: BLE001 — flush is best-effort
+            log.exception("observability flush failed")
 
     def _consume_batch(self, fut, t_arrival, entries) -> None:
         """Serve one pipelined batch whose decode ran in the pool:

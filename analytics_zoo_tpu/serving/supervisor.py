@@ -1,0 +1,542 @@
+"""Serving replica supervisor — the resilience layer of the fleet.
+
+The reference's Cluster Serving got multi-replica fault tolerance for
+free from Spark executor restart + Redis consumer groups (BigDL,
+arXiv 1804.05839 §serving; BigDL 2.0, arXiv 2204.01715).  Our rebuild
+provides — and beats — that property itself: a
+:class:`ServingSupervisor` spawns N ``ClusterServing`` worker
+processes against ONE consumer group (distinct consumer names, so each
+record is delivered to exactly one replica and a dead replica's
+un-acked records are PEL-reclaimed by its peers), watches them via
+
+* **process exit** — classified with
+  :func:`~analytics_zoo_tpu.resilience.detector.classify_exit`
+  (``ok`` / ``error(N)`` / ``signal(SIGKILL)`` …),
+* **/healthz** — each replica publishes its metrics port through
+  ``ZOO_TPU_SERVING_PORT_FILE``; 200/503 both mean *alive* (503 =
+  not-ready, e.g. ``breaker_open`` during a broker outage — restarting
+  cannot fix that, so the supervisor deliberately does NOT),
+* **heartbeats** — with a ``run_dir``, replicas write the PR 6
+  ``host-<k>/heartbeat.json``; a staleness past
+  ``resilience.heartbeat_timeout_s`` flags a replica whose process
+  still polls as running but whose serve loop is wedged,
+
+and restarts crashed replicas with exponential backoff under a
+:class:`~analytics_zoo_tpu.resilience.policy.RetryBudget` (the
+reference's time-windowed budget).  Budget exhausted → the fleet ends
+*structured*: a ``DegradedTraining``-style record (mirrored to
+``<run_dir>/degraded.json``) and
+:data:`~analytics_zoo_tpu.resilience.policy.DEGRADED_EXIT_CODE` (17)
+from the CLI — honoring the ``zoo-launch --max-degraded`` contract, so
+an orchestrator can tell "serving tier gave up in an orderly way"
+from "supervisor crashed".
+
+SIGTERM to the supervisor drains the fleet gracefully: each replica
+gets SIGTERM, finishes + acks its in-flight batches, flushes metrics,
+and exits 0 (escalating to SIGKILL only past ``drain_timeout_s``).
+
+The supervisor process never touches a device — replicas are separate
+processes, so the fleet controller can run on a host with no
+accelerator access at all.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from analytics_zoo_tpu.common.config import get_config
+from analytics_zoo_tpu.common.fsutil import atomic_write_text
+from analytics_zoo_tpu.observability import get_registry
+from analytics_zoo_tpu.resilience.detector import (
+    classify_exit, read_heartbeats)
+from analytics_zoo_tpu.resilience.policy import (
+    DEGRADED_EXIT_CODE, DegradedTraining, RetryBudget, degraded_exit)
+
+log = logging.getLogger("analytics_zoo_tpu.serving.supervisor")
+
+#: env var through which a replica publishes its bound /metrics
+#: (+/healthz) port back to the supervisor (server._publish_port)
+ENV_PORT_FILE = "ZOO_TPU_SERVING_PORT_FILE"
+
+#: worker_factory signature: (replica_index, incarnation) ->
+#: (argv list, extra env dict) — incarnation 0 is the first spawn,
+#: so tests can arm chaos for exactly one life of a replica
+WorkerFactory = Callable[[int, int], Tuple[List[str], Dict[str, str]]]
+
+
+def _set_pdeathsig():   # pragma: no cover — linux only
+    """Replica dies with the supervisor (launcher._set_pdeathsig's
+    role, re-implemented here so this module never imports the
+    jax-adjacent parallel package)."""
+    try:
+        import ctypes
+        import signal
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, signal.SIGKILL)
+    except Exception:   # noqa: BLE001 — non-linux
+        pass
+
+
+def cli_worker_factory(config_path: str,
+                       consumer_group: str = "serving",
+                       extra_args: Tuple[str, ...] = (),
+                       python: str = sys.executable) -> WorkerFactory:
+    """The default replica command: ``zoo-serving start`` against one
+    shared consumer group, a unique consumer name per replica slot,
+    and an ephemeral metrics port (the replica publishes the bound
+    port back through :data:`ENV_PORT_FILE`)."""
+    def factory(index: int, incarnation: int):
+        cmd = [python, "-m", "analytics_zoo_tpu.serving.cli", "start",
+               "-c", config_path,
+               "--consumer-group", consumer_group,
+               "--consumer-name", f"replica-{index}",
+               "--metrics-port", "0", *extra_args]
+        return cmd, {}
+    return factory
+
+
+@dataclasses.dataclass
+class _Replica:
+    """Supervisor-side state of one replica slot."""
+    index: int
+    port_file: str
+    budget: RetryBudget
+    proc: Optional[subprocess.Popen] = None
+    incarnation: int = 0          # lives spawned so far
+    port: Optional[int] = None    # discovered /healthz port
+    spawned_at: float = 0.0
+    next_spawn_at: Optional[float] = None   # backoff restart schedule
+    consecutive_failures: int = 0
+    health_fails: int = 0         # consecutive unreachable probes
+    last_health_at: float = 0.0
+    last_exit: Optional[int] = None
+    done: bool = False            # exited 0 (orderly drain)
+    degraded: bool = False        # exited DEGRADED_EXIT_CODE
+    kill_reason: Optional[str] = None   # supervisor-initiated kill
+
+
+class ServingSupervisor:
+    """Spawn, watch, restart, and drain a fleet of serving replicas.
+
+    ``run()`` blocks until the fleet drains (``stop()`` / SIGTERM /
+    every replica exiting 0 or 17) and returns a summary dict — or
+    raises :class:`DegradedTraining` when a replica exhausts its
+    restart budget (the CLI maps that to exit 17 via
+    ``degraded_exit``)."""
+
+    def __init__(self, worker_factory: WorkerFactory,
+                 replicas: int = 3, *,
+                 retry_times: Optional[int] = None,
+                 retry_window_s: Optional[float] = None,
+                 backoff_base_s: float = 0.5,
+                 backoff_max_s: float = 10.0,
+                 health_interval_s: float = 2.0,
+                 health_fail_threshold: int = 3,
+                 startup_grace_s: float = 30.0,
+                 heartbeat_timeout_s: Optional[float] = None,
+                 run_dir: Optional[str] = None,
+                 drain_timeout_s: float = 30.0):
+        if retry_times is None:
+            retry_times = int(get_config().get(
+                "serving.supervisor_retry_times", 5))
+        if retry_window_s is None:
+            retry_window_s = float(get_config().get(
+                "serving.supervisor_retry_window_s", 60.0))
+        if heartbeat_timeout_s is None:
+            heartbeat_timeout_s = float(get_config().get(
+                "resilience.heartbeat_timeout_s", 30.0))
+        self.worker_factory = worker_factory
+        self.replicas = int(replicas)
+        self.retry_times = int(retry_times)
+        self.retry_window_s = float(retry_window_s)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.health_interval_s = float(health_interval_s)
+        self.health_fail_threshold = max(int(health_fail_threshold), 1)
+        self.startup_grace_s = float(startup_grace_s)
+        self.heartbeat_timeout_s = float(heartbeat_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self.run_dir = run_dir
+        self._state_dir = run_dir or tempfile.mkdtemp(
+            prefix="zoo-serving-supervisor-")
+        os.makedirs(self._state_dir, exist_ok=True)
+        self._replicas: List[_Replica] = [
+            _Replica(index=i,
+                     port_file=os.path.join(self._state_dir,
+                                            f"replica-{i}.port"),
+                     budget=RetryBudget(self.retry_times,
+                                        self.retry_window_s))
+            for i in range(self.replicas)]
+        self.restarts_total = 0
+        self._stop = threading.Event()
+        reg = get_registry()
+        self._m_running = reg.gauge(
+            "serving_replicas_running",
+            "serving replicas currently alive under the supervisor")
+        self._m_restarts = reg.counter(
+            "serving_replica_restarts_total",
+            "replica restarts performed by the supervisor")
+        self._m_exits = reg.counter(
+            "serving_replica_exits_total",
+            "replica exits observed, by classified exit code",
+            labels=("class",))
+
+    # -------------------------------------------------------------- control
+    def stop(self) -> None:
+        """Request a graceful fleet drain (also the SIGTERM handler)."""
+        self._stop.set()
+
+    def install_signal_handlers(self) -> bool:
+        """SIGTERM → ``stop()`` (graceful drain).  Main-thread only;
+        returns False when not installable."""
+        import signal
+        try:
+            signal.signal(signal.SIGTERM,
+                          lambda _sig, _frame: self.stop())
+            return True
+        except ValueError:
+            return False
+
+    # ------------------------------------------------------------ lifecycle
+    def _spawn(self, r: _Replica) -> None:
+        cmd, env = self.worker_factory(r.index, r.incarnation)
+        full = dict(os.environ)
+        full.update(env or {})
+        try:
+            os.remove(r.port_file)     # stale port from a past life
+        except OSError:
+            pass
+        if self.run_dir:
+            # drop the dead incarnation's heartbeat too (the same
+            # contamination guard the launcher applies to reused run
+            # dirs): the replacement's first beat only lands after
+            # model load, and judging it by its predecessor's stale
+            # timestamp would kill every slow-starting respawn until
+            # the budget spuriously degrades the fleet
+            try:
+                os.remove(os.path.join(self.run_dir,
+                                       f"host-{r.index}",
+                                       "heartbeat.json"))
+            except OSError:
+                pass
+        r.port = None
+        r.health_fails = 0
+        r.kill_reason = None
+        full[ENV_PORT_FILE] = r.port_file
+        # chaos process filtering + const metric labels both key on
+        # the replica slot; a test factory may override
+        full.setdefault("ZOO_TPU_PROCESS_ID", str(r.index))
+        if self.run_dir:
+            slot = os.path.join(self.run_dir, f"host-{r.index}")
+            os.makedirs(slot, exist_ok=True)
+            full.setdefault("ZOO_TPU_METRICS_DIR", slot)
+        r.proc = subprocess.Popen(cmd, env=full,
+                                  preexec_fn=_set_pdeathsig)
+        r.incarnation += 1
+        r.spawned_at = time.monotonic()
+        r.next_spawn_at = None
+        log.info("replica %d spawned (incarnation %d, pid %d)",
+                 r.index, r.incarnation, r.proc.pid)
+
+    def _handle_exit(self, r: _Replica, code: int) -> None:
+        r.proc = None
+        r.last_exit = code
+        killed, r.kill_reason = r.kill_reason, None
+        cls = ("killed_by_supervisor" if killed
+               else "degraded" if code == DEGRADED_EXIT_CODE
+               else classify_exit(code))
+        self._m_exits.labels(cls).inc()
+        # a supervisor-initiated kill (wedged heartbeat, unreachable
+        # /healthz) must be restarted no matter HOW the replica ended:
+        # its SIGTERM handler drains gracefully to exit 0, and taking
+        # that as an orderly retirement would silently shrink the
+        # fleet with no restart and no degraded record
+        if killed:
+            log.warning("replica %d exited %d after supervisor kill "
+                        "(%s); routing through the restart budget",
+                        r.index, code, killed)
+        elif code == 0:
+            r.done = True
+            log.info("replica %d drained and exited 0", r.index)
+            return
+        elif code == DEGRADED_EXIT_CODE:
+            r.degraded = True
+            log.warning("replica %d ended DEGRADED (exit 17)", r.index)
+            return
+        # a crash.  Stable-for-a-window replicas restart their
+        # backoff ladder from the bottom (the budget itself refills on
+        # the same window rule inside RetryBudget.consume)
+        if time.monotonic() - r.spawned_at > self.retry_window_s:
+            r.consecutive_failures = 0
+        r.consecutive_failures += 1
+        if not r.budget.consume():
+            self._degrade(r, code, cls)
+        self.restarts_total += 1
+        self._m_restarts.inc()
+        delay = min(self.backoff_max_s,
+                    self.backoff_base_s
+                    * (2 ** (r.consecutive_failures - 1)))
+        r.next_spawn_at = time.monotonic() + delay
+        log.warning("replica %d died (%s); restart %d scheduled in "
+                    "%.2fs (%d budget left)", r.index, cls,
+                    r.incarnation, delay, r.budget.remaining)
+
+    def _degrade(self, r: _Replica, code: int, cls: str) -> None:
+        """Budget exhausted: end the fleet structured — the serving
+        twin of training's checkpoint-and-queue degraded record."""
+        # mark the replica BEFORE raising: with run_background() the
+        # DegradedTraining dies with the daemon thread, and summary()
+        # must still show which replica took the fleet down
+        r.degraded = True
+        r.last_exit = code
+        record = {
+            "status": "degraded",
+            "component": "serving",
+            "reason": (f"replica {r.index} exhausted its restart "
+                       f"budget ({self.retry_times} failures within "
+                       f"{self.retry_window_s:.0f}s)"),
+            "replica": r.index,
+            "exit_code": code,
+            "classification": cls,
+            "incarnations": r.incarnation,
+            "restarts_total": self.restarts_total,
+            "replicas": self.replicas,
+        }
+        if self.run_dir:
+            path = os.path.join(self.run_dir, "degraded.json")
+            try:
+                atomic_write_text(path, json.dumps(record, indent=2))
+            except OSError:
+                log.exception("could not mirror degraded record to %s",
+                              path)
+        raise DegradedTraining(record["reason"], result=record)
+
+    # ---------------------------------------------------------- health
+    def _probe(self, r: _Replica) -> str:
+        """One /healthz probe: ``ok`` | ``not_ready`` (503 — alive) |
+        ``unreachable`` | ``no_port`` (not yet published)."""
+        if r.port is None:
+            try:
+                with open(r.port_file) as f:
+                    r.port = int(f.read().strip() or 0) or None
+            except (OSError, ValueError):
+                r.port = None
+        if r.port is None:
+            return "no_port"
+        from urllib import error as urlerror
+        from urllib import request as urlrequest
+        try:
+            with urlrequest.urlopen(
+                    f"http://127.0.0.1:{r.port}/healthz",
+                    timeout=1.0):
+                return "ok"
+        except urlerror.HTTPError as e:
+            e.close()
+            return "not_ready"     # 503: alive, deliberately not-ready
+        except (urlerror.URLError, OSError):
+            return "unreachable"
+
+    def _poll_health(self, r: _Replica, now: float) -> None:
+        if now - r.last_health_at < self.health_interval_s:
+            return
+        r.last_health_at = now
+        status = self._probe(r)
+        if status in ("ok", "not_ready"):
+            r.health_fails = 0
+        elif status == "unreachable":
+            r.health_fails += 1
+            if r.health_fails >= self.health_fail_threshold:
+                self._kill_replica(
+                    r, f"/healthz unreachable x{r.health_fails}")
+                return
+        elif status == "no_port" and \
+                now - r.spawned_at > self.startup_grace_s:
+            self._kill_replica(
+                r, f"no /healthz port published within "
+                   f"{self.startup_grace_s:.0f}s of spawn")
+            return
+        # heartbeat staleness: a process that polls as running but
+        # whose serve loop is wedged (hung predict, dead collective)
+        # stops beating — flag it before clients notice
+        if self.run_dir:
+            hb = read_heartbeats(self.run_dir).get(r.index)
+            if hb is not None and \
+                    time.time() - float(hb.get("time", 0.0)) \
+                    > self.heartbeat_timeout_s and \
+                    now - r.spawned_at > self.heartbeat_timeout_s:
+                self._kill_replica(
+                    r, f"heartbeat stale > "
+                       f"{self.heartbeat_timeout_s:.0f}s")
+
+    def _kill_replica(self, r: _Replica, reason: str) -> None:
+        """TERM→KILL a wedged replica; the next tick classifies its
+        exit and routes it through the normal restart budget."""
+        proc = r.proc
+        if proc is None or proc.poll() is not None:
+            return
+        log.error("killing replica %d (pid %d): %s", r.index,
+                  proc.pid, reason)
+        r.kill_reason = reason
+        proc.terminate()
+        try:
+            proc.wait(2.0)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            try:
+                proc.wait(2.0)
+            except subprocess.TimeoutExpired:   # pragma: no cover
+                log.error("replica %d survived SIGKILL", r.index)
+
+    # ------------------------------------------------------------- run loop
+    def _tick(self) -> None:
+        now = time.monotonic()
+        alive = 0
+        for r in self._replicas:
+            if r.proc is None:
+                if (not r.done and not r.degraded
+                        and r.next_spawn_at is not None
+                        and now >= r.next_spawn_at):
+                    self._spawn(r)
+                    alive += 1
+                continue
+            code = r.proc.poll()
+            if code is None:
+                alive += 1
+                self._poll_health(r, now)
+            else:
+                self._handle_exit(r, code)
+        self._m_running.set(alive)
+
+    def run(self, poll_interval_s: float = 0.25) -> Dict:
+        """Supervise until drained; returns the fleet summary.  Raises
+        :class:`DegradedTraining` on budget exhaustion (wrap the CLI
+        in ``degraded_exit()`` for the exit-17 protocol)."""
+        self.install_signal_handlers()
+        for r in self._replicas:
+            self._spawn(r)
+        try:
+            while not self._stop.is_set():
+                self._tick()
+                if all(r.done or r.degraded for r in self._replicas):
+                    break
+                time.sleep(poll_interval_s)
+        finally:
+            self.drain_fleet()
+            self._m_running.set(0)
+        return self.summary()
+
+    def run_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, daemon=True,
+                             name="zoo-serving-supervisor")
+        t.start()
+        return t
+
+    def wait_ready(self, timeout_s: float = 30.0) -> bool:
+        """Block until every live replica answers /healthz 200 (for
+        tests and scripted bring-up)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            live = [r for r in self._replicas
+                    if not r.done and not r.degraded]
+            if live and all(self._probe(r) == "ok" for r in live):
+                return True
+            time.sleep(0.1)
+        return False
+
+    def drain_fleet(self) -> Dict[int, Optional[int]]:
+        """Graceful fleet drain: SIGTERM each replica (its handler
+        finishes + acks in-flight batches and exits 0), escalate to
+        SIGKILL per process past ``drain_timeout_s``, reap everything.
+        Returns {replica_index: exit code}."""
+        live = [r for r in self._replicas
+                if r.proc is not None and r.proc.poll() is None]
+        for r in live:
+            r.proc.terminate()
+        deadline = time.monotonic() + self.drain_timeout_s
+        codes: Dict[int, Optional[int]] = {}
+        for r in live:
+            try:
+                code = r.proc.wait(
+                    max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                log.warning("replica %d ignored SIGTERM for %.0fs; "
+                            "escalating to SIGKILL", r.index,
+                            self.drain_timeout_s)
+                r.proc.kill()
+                try:
+                    code = r.proc.wait(2.0)
+                except subprocess.TimeoutExpired:  # pragma: no cover
+                    code = None
+            codes[r.index] = code
+            r.last_exit = code
+            if code == 0:
+                r.done = True
+            r.proc = None
+        return codes
+
+    def summary(self) -> Dict:
+        return {
+            "replicas": self.replicas,
+            "restarts_total": self.restarts_total,
+            "done": [r.index for r in self._replicas if r.done],
+            "degraded": [r.index for r in self._replicas
+                         if r.degraded],
+            "exit_codes": {r.index: r.last_exit
+                           for r in self._replicas},
+        }
+
+
+def supervisor_main(argv=None) -> int:
+    """``python -m analytics_zoo_tpu.serving.supervisor``: run a
+    replica fleet from config.yaml (``params.replicas`` /
+    ``params.consumer_group``), speaking the launcher degraded-exit
+    protocol on budget exhaustion."""
+    p = argparse.ArgumentParser(prog="zoo-serving-supervisor")
+    p.add_argument("--config", "-c", default="config.yaml")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="replica count (default config "
+                        "params.replicas, else 3)")
+    p.add_argument("--consumer-group", default=None,
+                   help="shared consumer group (default config "
+                        "params.consumer_group, else 'serving')")
+    p.add_argument("--run-dir", default=None,
+                   help="fleet state dir: per-replica heartbeat "
+                        "slots + degraded.json")
+    p.add_argument("--retry-times", type=int, default=None)
+    p.add_argument("--retry-window-s", type=float, default=None)
+    p.add_argument("--drain-timeout-s", type=float, default=30.0)
+    args = p.parse_args(argv)
+
+    from analytics_zoo_tpu.serving.server import ServingConfig
+    cfg = (ServingConfig.from_yaml(args.config)
+           if os.path.exists(args.config) else ServingConfig())
+    replicas = args.replicas
+    if replicas is None:
+        replicas = int(cfg.extra.get("params.replicas") or 3)
+    group = (args.consumer_group or cfg.consumer_group or "serving")
+    sup = ServingSupervisor(
+        cli_worker_factory(args.config, consumer_group=group),
+        replicas=replicas,
+        retry_times=args.retry_times,
+        retry_window_s=args.retry_window_s,
+        run_dir=args.run_dir,
+        drain_timeout_s=args.drain_timeout_s)
+    with degraded_exit():
+        summary = sup.run()
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(supervisor_main())

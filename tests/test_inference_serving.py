@@ -228,6 +228,43 @@ class TestClusterServing:
         assert cfg.batch_size == 16
         assert cfg.top_n == 3
         assert cfg.redis_url == "localhost:6379"
+        # resilience knobs at their documented defaults
+        assert cfg.reclaim_min_idle_ms == 30000
+        assert cfg.request_deadline_ms == 0
+        assert cfg.poison_max_attempts == 2
+        assert cfg.breaker_failures == 5
+
+    def test_config_yaml_parse_resilience_keys(self, tmp_path):
+        p = tmp_path / "config.yaml"
+        p.write_text(
+            "data:\n  src: localhost:6379\n"
+            "params:\n"
+            "  request_deadline_ms: 250\n"
+            "  reclaim_min_idle_ms: 5000\n"
+            "  poison_max_attempts: 3\n"
+            "  breaker_failures: 0\n"
+            "  breaker_cooldown_s: 0.5\n")
+        cfg = ServingConfig.from_yaml(str(p))
+        assert cfg.request_deadline_ms == 250
+        assert cfg.reclaim_min_idle_ms == 5000
+        assert cfg.poison_max_attempts == 3
+        assert cfg.breaker_failures == 0     # 0 = breaker disabled
+        assert cfg.breaker_cooldown_s == 0.5
+
+    def test_config_yaml_explicit_zero_is_not_the_default(self, tmp_path):
+        """An explicit 0 in config.yaml must be honored, not silently
+        collapsed into the default: reclaim_min_idle_ms 0 = claim
+        stale entries immediately; breaker_cooldown_s 0 clamps to the
+        0.05s floor (not the 2.0s default)."""
+        p = tmp_path / "config.yaml"
+        p.write_text(
+            "data:\n  src: localhost:6379\n"
+            "params:\n"
+            "  reclaim_min_idle_ms: 0\n"
+            "  breaker_cooldown_s: 0\n")
+        cfg = ServingConfig.from_yaml(str(p))
+        assert cfg.reclaim_min_idle_ms == 0
+        assert cfg.breaker_cooldown_s == 0.05
 
 
 # -------------------------------------------------------------- serving CLI
@@ -794,6 +831,140 @@ def test_quick_start_self_contained():
     from analytics_zoo_tpu.serving.quick_start import main
     result = main(["--smoke"])
     assert result and len(result) == 3        # top-3 [class, prob]
+
+
+class _SimulatedReplicaDeath(BaseException):
+    """Escapes ``except Exception`` (the in-process poison contract)
+    the way a process kill escapes the worker: the batch stays
+    un-acked in the PEL."""
+
+
+class TestReclaimUnderReplicaDeath:
+    def test_second_replica_reclaims_midbatch_death(self):
+        """ISSUE 9 satellite: chaos-kill a replica mid-batch and prove
+        a second replica reclaims the PEL entries and every enqueued
+        request still gets exactly one visible result."""
+        import time as _t
+
+        broker = EmbeddedBroker()
+
+        class DiesOnFirstBatch:
+            def __init__(self):
+                self.calls = 0
+
+            def predict(self, x, batch_size=None):
+                self.calls += 1
+                if self.calls == 1:
+                    raise _SimulatedReplicaDeath("killed mid-batch")
+                return np.zeros((len(x), 4), np.float32)
+
+        w1 = ClusterServing(DiesOnFirstBatch(), ServingConfig(
+            batch_size=4, consumer_group="serve",
+            consumer_name="w1"), broker=broker)
+        inq = InputQueue(broker=broker)
+        n = 8
+        for i in range(n):
+            inq.enqueue(f"rd-{i}", np.zeros(3, np.float32))
+
+        def _run_until_death():
+            try:
+                w1.run(poll_ms=5)
+            except _SimulatedReplicaDeath:
+                pass
+        t = threading.Thread(target=_run_until_death)
+        t.start()
+        t.join(timeout=20)
+        assert not t.is_alive()
+        # the first batch died un-acked: it is pending, not lost
+        pend = broker._groups[("serving_stream", "serve")]["pending"]
+        assert len(pend) >= 4
+
+        class Counting:
+            def __init__(self):
+                self.served = 0
+
+            def predict(self, x, batch_size=None):
+                self.served += len(x)
+                return np.zeros((len(x), 4), np.float32)
+
+        model2 = Counting()
+        w2 = ClusterServing(model2, ServingConfig(
+            batch_size=4, consumer_group="serve",
+            consumer_name="w2", reclaim_min_idle_ms=0),
+            broker=broker)
+        # reclaim the dead replica's PEL (its pipelined loop had
+        # read-ahead a SECOND batch before dying, so all 8 records are
+        # pending — one reclaim pass claims at most batch_size)
+        reclaimed = w2._reclaim_stale(min_idle_ms=0)
+        assert reclaimed == 4
+        deadline = _t.time() + 20
+        while w2.total_records < n and _t.time() < deadline:
+            if w2.run_once(block_ms=10) == 0:
+                w2._reclaim_stale(min_idle_ms=0)
+        outq = OutputQueue(broker=broker)
+        for i in range(n):
+            assert outq.query(f"rd-{i}") is not None, f"rd-{i} lost"
+        # exactly-once-visible: w2 served each remaining record once
+        # (reclaim pads each single-record serve to the batch size,
+        # so count RECORDS via total_records, not padded model calls)
+        assert w2.total_records == n
+        assert not broker._groups[("serving_stream",
+                                   "serve")]["pending"]
+
+
+class TestClientRetry:
+    """ISSUE 9 satellite: OutputQueue.query_meta no longer raises
+    through a transient broker blip — bounded exponential backoff +
+    reconnect, with the per-call deadline returning None cleanly."""
+
+    class _FlakyBroker:
+        def __init__(self, real, failures):
+            self._real = real
+            self.failures_left = failures
+            self.attempts = 0
+
+        def hgetall(self, key):
+            self.attempts += 1
+            if self.failures_left > 0:
+                self.failures_left -= 1
+                raise ConnectionError("transient blip")
+            return self._real.hgetall(key)
+
+        def close(self):
+            pass
+
+    def test_query_meta_survives_transient_blips(self):
+        real = EmbeddedBroker()
+        real.hset("result:u", {"value": "[[1, 0.9]]"})
+        flaky = self._FlakyBroker(real, failures=3)
+        outq = OutputQueue(broker=flaky)
+        meta = outq.query_meta("u", timeout_s=10.0)
+        assert meta["value"] == [[1, 0.9]]
+        assert flaky.attempts >= 4           # 3 retried errors + hit
+
+    def test_query_meta_deadline_returns_none_cleanly(self):
+        import time as _t
+        flaky = self._FlakyBroker(EmbeddedBroker(), failures=10**6)
+        outq = OutputQueue(broker=flaky)
+        t0 = _t.time()
+        assert outq.query_meta("u", timeout_s=0.3,
+                               retries=10**6) is None
+        assert _t.time() - t0 < 5.0          # deadline won, no raise
+
+    def test_query_meta_bounded_retries_reraise(self):
+        flaky = self._FlakyBroker(EmbeddedBroker(), failures=10**6)
+        outq = OutputQueue(broker=flaky)
+        with pytest.raises(ConnectionError):
+            outq.query_meta("u", timeout_s=0.0, retries=3)
+        assert flaky.attempts == 3
+
+    def test_command_errors_raise_immediately(self):
+        class CmdErr:
+            def hgetall(self, key):
+                raise RuntimeError("redis error: WRONGTYPE")
+        outq = OutputQueue(broker=CmdErr())
+        with pytest.raises(RuntimeError):
+            outq.query_meta("u", timeout_s=5.0)
 
 
 class TestReclaimSafety:

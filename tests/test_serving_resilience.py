@@ -1,0 +1,771 @@
+"""Serving-tier resilience tests: circuit breaker, admission control,
+poison quarantine, replica supervision, and the 3-replica fleet
+acceptance run (ISSUE 9).
+
+The in-process halves (breaker state machine, chaos-scripted broker
+outage, deadline/overload shedding, reclaim-path quarantine) run
+against the embedded broker; the supervisor halves spawn real
+processes — tiny ``python -c`` stubs for the restart/budget/drain
+mechanics, and ``tests/serving_replica_worker.py`` (a real
+``ClusterServing`` loop with a numpy model) for the fleet acceptance
+criteria:
+
+(a) a replica chaos-killed mid-batch is restarted within its
+    RetryBudget and every in-flight request is still served via PEL
+    reclaim;
+(b) one poison record among healthy traffic is quarantined to
+    ``serving_dead_letter`` with reason=poison after
+    ``poison_max_attempts`` deliveries while healthy traffic
+    completes and /healthz stays ready;
+(c) a broker outage (chaos site ``serving.redis``) opens the breaker,
+    replicas fast-fail instead of crash-looping, and serving resumes
+    when the half-open probe succeeds.
+
+Part of the CI ``chaos`` shard (dev/run-tests chaos)."""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.resilience.chaos import (
+    SITE_SERVING_PREDICT, SITE_SERVING_REDIS, ChaosPlan, FaultSpec,
+    TransientFault, clear_chaos, install_chaos)
+from analytics_zoo_tpu.resilience.policy import DegradedTraining
+from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.redis_client import (
+    BREAKER_CLOSED, BREAKER_OPEN, BreakerClient, CircuitBreaker,
+    CircuitOpenError, BrokerServer, EmbeddedBroker, connect)
+from analytics_zoo_tpu.serving.server import (
+    DEAD_LETTER_STREAM, INPUT_STREAM, POISON_ATTEMPTS_KEY,
+    ClusterServing, ServingConfig)
+from analytics_zoo_tpu.serving.supervisor import ServingSupervisor
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPLICA_WORKER = os.path.join(REPO_ROOT, "tests",
+                              "serving_replica_worker.py")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    clear_chaos()
+    yield
+    clear_chaos()
+
+
+class OkModel:
+    def predict(self, x, batch_size=None):
+        return np.tile(np.arange(4, dtype=np.float32), (len(x), 1))
+
+
+class CountingModel(OkModel):
+    def __init__(self):
+        self.calls = 0
+
+    def predict(self, x, batch_size=None):
+        self.calls += 1
+        return super().predict(x, batch_size)
+
+
+def _dead_letters(broker, reason=None):
+    entries = broker.xread(DEAD_LETTER_STREAM, "0-0", count=1000)
+    out = []
+    for _id, fields in entries:
+        rec = {k: (v.decode() if isinstance(v, bytes) else v)
+               for k, v in fields.items()}
+        if reason is None or rec.get("reason") == reason:
+            out.append(rec)
+    return out
+
+
+# ------------------------------------------------------ circuit breaker
+class TestCircuitBreaker:
+    def test_state_machine(self):
+        clock = [0.0]
+        b = CircuitBreaker(failures=3, cooldown_s=1.0,
+                           clock=lambda: clock[0])
+        assert b.state == BREAKER_CLOSED
+        for _ in range(2):
+            assert b.allow()
+            b.record_failure()
+        assert b.state == BREAKER_CLOSED     # below threshold
+        assert b.allow()
+        b.record_failure()                   # 3rd consecutive -> open
+        assert b.state == BREAKER_OPEN
+        assert not b.allow()                 # fast-fail inside cooldown
+        clock[0] = 1.5
+        assert b.allow()                     # half-open probe slot
+        assert not b.allow()                 # ...exactly ONE probe
+        b.record_failure()                   # probe failed -> re-open
+        assert b.state == BREAKER_OPEN
+        assert not b.allow()
+        clock[0] = 3.0
+        assert b.allow()
+        b.record_success()                   # probe landed -> closed
+        assert b.state == BREAKER_CLOSED
+        assert b.allow()
+
+    def test_success_resets_consecutive_count(self):
+        b = CircuitBreaker(failures=2, cooldown_s=1.0)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == BREAKER_CLOSED     # 1-1-1, never 2 in a row
+
+    def test_breaker_client_fast_fails_without_io(self):
+        class FlakyConn:
+            def __init__(self):
+                self.calls = 0
+                self.broken = True
+
+            def ping(self):
+                self.calls += 1
+                if self.broken:
+                    raise ConnectionError("broker down")
+                return True
+
+            def close(self):
+                pass
+
+        conn = FlakyConn()
+        client = BreakerClient(lambda: conn, failures=2,
+                               cooldown_s=0.1, conn=conn)
+        for _ in range(2):
+            with pytest.raises(ConnectionError):
+                client.ping()
+        calls_at_open = conn.calls
+        with pytest.raises(CircuitOpenError):
+            client.ping()                    # open: NO socket touched
+        assert conn.calls == calls_at_open
+        time.sleep(0.15)
+        conn.broken = False                  # broker came back
+        assert client.ping() is True         # half-open probe reconnects
+        assert client.breaker.state == BREAKER_CLOSED
+
+    def test_command_errors_pass_through_uncounted(self):
+        """NOGROUP/WRONGTYPE-class RuntimeErrors are application bugs,
+        not outages — they must not open the breaker."""
+        class CmdErrConn:
+            def xack(self, *a):
+                raise RuntimeError("redis error: NOGROUP no such group")
+
+            def close(self):
+                pass
+
+        conn = CmdErrConn()
+        client = BreakerClient(lambda: conn, failures=1,
+                               cooldown_s=0.1, conn=conn)
+        for _ in range(3):
+            with pytest.raises(RuntimeError):
+                client.xack("s", "g", "1-1")
+        assert client.breaker.state == BREAKER_CLOSED
+
+    def test_command_error_during_probe_releases_the_slot(self):
+        """A half-open probe answered with a redis COMMAND error (the
+        broker restarted with flushed state → NOGROUP) proves the
+        transport is healthy: the breaker must close, not leak the
+        probe slot and wedge HALF_OPEN forever (which would fast-fail
+        every later op while /healthz — watching only BREAKER_OPEN —
+        kept reporting ready)."""
+        class RestartedConn:
+            def __init__(self):
+                self.down = True
+
+            def xreadgroup(self, *a, **k):
+                if self.down:
+                    raise ConnectionError("broker down")
+                raise RuntimeError("redis error: NOGROUP no such group")
+
+            def ping(self):
+                if self.down:
+                    raise ConnectionError("broker down")
+                return True
+
+            def close(self):
+                pass
+
+        conn = RestartedConn()
+        client = BreakerClient(lambda: conn, failures=1,
+                               cooldown_s=0.05, conn=conn)
+        with pytest.raises(ConnectionError):
+            client.xreadgroup("s", "g", "c")
+        assert client.breaker.state == BREAKER_OPEN
+        time.sleep(0.1)
+        conn.down = False                    # broker back, group gone
+        with pytest.raises(RuntimeError):
+            client.xreadgroup("s", "g", "c")     # the half-open probe
+        assert client.breaker.state == BREAKER_CLOSED
+        assert client.ping() is True         # NOT CircuitOpenError
+
+
+class TestWarmStartLiveness:
+    def test_port_published_and_healthz_alive_before_warm_start(
+            self, tmp_path, monkeypatch):
+        """The /healthz port must be discoverable (and answering 503
+        warming_up — alive, not routable) BEFORE warm_start runs: a
+        cold compile can take minutes, far past the supervisor's
+        startup grace, and a no-port kill mid-compile would respawn
+        the replica into the same cold compile forever."""
+        port_file = tmp_path / "replica.port"
+        monkeypatch.setenv("ZOO_TPU_SERVING_PORT_FILE", str(port_file))
+
+        class WarmProbeModel(OkModel):
+            saw_port_file = None
+            readiness_during_warm = "unset"
+
+            def warm(self, shape, batch_size):
+                WarmProbeModel.saw_port_file = port_file.exists()
+                WarmProbeModel.readiness_during_warm = \
+                    serving.readiness()
+                return True
+
+        serving = ClusterServing(
+            WarmProbeModel(),
+            ServingConfig(batch_size=4, metrics_port=0,
+                          input_shape=(3,)),
+            broker=EmbeddedBroker())
+        t = threading.Thread(target=serving.run,
+                             kwargs={"poll_ms": 5}, daemon=True)
+        t.start()
+        deadline = time.time() + 10.0
+        while WarmProbeModel.saw_port_file is None \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        serving.stop()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert WarmProbeModel.saw_port_file is True
+        assert (WarmProbeModel.readiness_during_warm
+                == {"reason": "warming_up"})
+        assert serving.readiness() is None   # ready once warm is done
+
+
+class TestStartupOutage:
+    def test_broker_down_at_bring_up_defers_group_creation(self):
+        """A broker outage during replica startup must not crash
+        __init__ (the supervisor would restart-loop the replica to
+        budget exhaustion against a dead broker): consumer-group
+        creation is deferred to the first successful read, and
+        records enqueued before the group exists are still delivered
+        (the group starts at id 0)."""
+        broker = EmbeddedBroker()
+        install_chaos(ChaosPlan([FaultSpec(
+            site=SITE_SERVING_REDIS, at_step=0, kind="raise",
+            times=1)]))
+        serving = ClusterServing(
+            OkModel(),
+            ServingConfig(batch_size=4, breaker_failures=5,
+                          consumer_group="serve", consumer_name="w0"),
+            broker=broker)                   # survives the outage
+        assert serving._group_ready is False
+        assert ("serving_stream", "serve") not in broker._groups
+        clear_chaos()                        # broker back
+        inq = InputQueue(broker=broker)
+        for i in range(4):
+            inq.enqueue(f"early-{i}", np.zeros(3, np.float32))
+        assert serving.run_once(block_ms=0) == 4
+        assert serving._group_ready is True
+        assert ("serving_stream", "serve") in broker._groups
+
+
+class TestBrokerOutageChaos:
+    """Acceptance (c), in-process: chaos site ``serving.redis`` takes
+    the broker down; the breaker opens, the worker idles (alive,
+    /healthz 503 breaker_open) instead of crash-looping, and serving
+    resumes when the half-open probe outlives the scripted outage."""
+
+    def test_breaker_opens_fast_fails_and_recovers(self):
+        broker = EmbeddedBroker()
+        serving = ClusterServing(
+            OkModel(),
+            ServingConfig(batch_size=2, breaker_failures=3,
+                          breaker_cooldown_s=0.1),
+            broker=broker)
+        inq = InputQueue(broker=broker)
+        outq = OutputQueue(broker=broker)
+        t = threading.Thread(target=serving.run, kwargs={"poll_ms": 5})
+        t.start()
+        try:
+            inq.enqueue("pre-0", np.zeros(3, np.float32))
+            assert outq.query("pre-0", timeout_s=10.0) is not None
+
+            # scripted outage: the next 10 attempted broker ops fail
+            # (steps count from plan install — chaos.py serving.redis)
+            install_chaos(ChaosPlan([FaultSpec(
+                site=SITE_SERVING_REDIS, at_step=0, kind="raise",
+                times=10,
+                message="connection reset by injected outage")]))
+            deadline = time.time() + 10.0
+            while serving.broker.breaker.state != BREAKER_OPEN \
+                    and time.time() < deadline:
+                time.sleep(0.01)
+            assert serving.broker.breaker.state == BREAKER_OPEN
+            assert t.is_alive()              # fast-fail, not crash-loop
+            # an open breaker flips readiness with an explicit reason
+            assert serving.readiness() == {
+                "reason": "breaker_open",
+                "cooldown_s": serving.config.breaker_cooldown_s}
+
+            # half-open probes burn the remaining scripted faults,
+            # then one lands -> closed -> serving resumes
+            deadline = time.time() + 20.0
+            while serving.broker.breaker.state != BREAKER_CLOSED \
+                    and time.time() < deadline:
+                time.sleep(0.02)
+            assert serving.broker.breaker.state == BREAKER_CLOSED
+            assert t.is_alive()
+            inq.enqueue("post-0", np.zeros(3, np.float32))
+            assert outq.query("post-0", timeout_s=10.0) is not None
+            assert serving.readiness() is None
+        finally:
+            serving.stop()
+            t.join(timeout=10)
+        assert not t.is_alive()
+
+
+# ---------------------------------------------------- admission control
+class TestAdmissionControl:
+    def _serving(self, model=None, **cfg):
+        broker = EmbeddedBroker()
+        serving = ClusterServing(
+            model or CountingModel(),
+            ServingConfig(batch_size=4, **cfg), broker=broker)
+        return serving, broker
+
+    def test_expired_records_are_shed_not_predicted(self):
+        serving, broker = self._serving(request_deadline_ms=100)
+        inq = InputQueue(broker=broker)
+        for i in range(4):
+            inq.enqueue(f"old-{i}", np.zeros(3, np.float32))
+        time.sleep(0.15)                     # age past the deadline
+        assert serving.run_once(block_ms=0) == 0
+        assert serving.model.calls == 0      # no predict burnt
+        sheds = _dead_letters(broker, reason="shed")
+        assert len(sheds) == 4
+        assert all(s["cause"] == "deadline" for s in sheds)
+        outq = OutputQueue(broker=broker)
+        res = outq.query("old-0")
+        assert isinstance(res, dict) and "shed" in res["error"]
+        # shed records were acked: nothing pending, nothing re-read
+        assert serving.run_once(block_ms=0) == 0
+        assert serving.model.calls == 0
+
+    def test_fresh_records_served_normally(self):
+        serving, broker = self._serving(request_deadline_ms=60000)
+        inq = InputQueue(broker=broker)
+        for i in range(4):
+            inq.enqueue(f"fresh-{i}", np.zeros(3, np.float32))
+        assert serving.run_once(block_ms=0) == 4
+        assert not _dead_letters(broker, reason="shed")
+
+    def test_overload_sheds_past_half_deadline(self):
+        """Queue-depth shedding wired to the /healthz threshold: while
+        the observed backlog exceeds healthz_max_queue, records past
+        HALF the deadline are shed too."""
+        serving, broker = self._serving(request_deadline_ms=600,
+                                        healthz_max_queue=2)
+        inq = InputQueue(broker=broker)
+        for i in range(4):
+            inq.enqueue(f"mid-{i}", np.zeros(3, np.float32))
+        time.sleep(0.4)                      # > deadline/2, < deadline
+        # simulate the drowning backlog the last poll observed
+        serving._m_queue.set(10)
+        assert serving.run_once(block_ms=0) == 0
+        sheds = _dead_letters(broker, reason="shed")
+        assert len(sheds) == 4
+        assert all(s["cause"] == "overload" for s in sheds)
+        # same age with a healthy backlog would have been served
+        serving2, broker2 = self._serving(request_deadline_ms=600,
+                                          healthz_max_queue=2)
+        inq2 = InputQueue(broker=broker2)
+        for i in range(4):
+            inq2.enqueue(f"ok-{i}", np.zeros(3, np.float32))
+        time.sleep(0.4)
+        serving2._m_queue.set(1)
+        assert serving2.run_once(block_ms=0) == 4
+
+    def test_shed_does_not_flip_error_rate_readiness(self):
+        serving, broker = self._serving(request_deadline_ms=100,
+                                        healthz_max_error_rate=0.5)
+        inq = InputQueue(broker=broker)
+        for i in range(4):
+            inq.enqueue(f"x-{i}", np.zeros(3, np.float32))
+        time.sleep(0.15)
+        serving.run_once(block_ms=0)
+        assert serving.readiness() is None   # deliberate drops != errors
+
+    def test_purging_expired_backlog_yields_between_batches(self):
+        """A deep fully-expired backlog must be shed one batch per
+        outer-loop iteration, not in one unyielding inner spin: the
+        outer loop is where the heartbeat, the stop/drain check, and
+        reclaim live — a supervisor would TERM a replica whose beat
+        stalls mid-purge.  Proven via the stop check: with stop
+        already requested, the loop sheds exactly ONE batch before it
+        notices and exits (the old inner `continue` purged all 40
+        first)."""
+        serving, broker = self._serving(request_deadline_ms=100)
+        inq = InputQueue(broker=broker)
+        for i in range(40):
+            inq.enqueue(f"stale-{i}", np.zeros(3, np.float32))
+        time.sleep(0.15)                     # all 40 past the deadline
+        serving.stop()
+        serving.run(poll_ms=5)               # returns immediately
+        assert len(_dead_letters(broker, reason="shed")) == 4
+
+
+# --------------------------------------------------- poison quarantine
+class _ReplicaDeath(BaseException):
+    """Stands in for a process kill: escapes ``except Exception`` (the
+    in-process poison contract) exactly like a real crash escapes the
+    worker, leaving the batch un-acked in the PEL."""
+
+
+class PoisonKillsWorker:
+    """Model that 'kills its replica' whenever the poison payload is
+    in the batch."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def predict(self, x, batch_size=None):
+        self.calls += 1
+        if np.any(np.asarray(x) > 1e8):
+            raise _ReplicaDeath("poison payload crashed the replica")
+        return np.tile(np.arange(4, dtype=np.float32), (len(x), 1))
+
+
+class TestPoisonQuarantine:
+    def _worker(self, broker, name, **cfg_kw):
+        cfg = ServingConfig(batch_size=4, consumer_group="serve",
+                            consumer_name=name, poison_max_attempts=2,
+                            **cfg_kw)
+        return ClusterServing(PoisonKillsWorker(), cfg, broker=broker)
+
+    def test_poison_record_quarantined_after_max_deliveries(self):
+        broker = EmbeddedBroker()
+        w1 = self._worker(broker, "w1")
+        inq = InputQueue(broker=broker)
+        outq = OutputQueue(broker=broker)
+        # poison second in the batch: the reclaim path must still
+        # serve the innocents around it
+        inq.enqueue("h-0", np.zeros(3, np.float32))
+        rid_poison = inq.enqueue("poison", np.full(3, 1e9, np.float32))
+        inq.enqueue("h-1", np.zeros(3, np.float32))
+        inq.enqueue("h-2", np.zeros(3, np.float32))
+
+        # delivery 1: the whole batch dies with its replica (un-acked)
+        def _run_until_death():
+            try:
+                w1.run(poll_ms=5)
+            except _ReplicaDeath:
+                pass
+        t = threading.Thread(target=_run_until_death)
+        t.start()
+        t.join(timeout=20)
+        assert not t.is_alive()
+        assert outq.query("h-0") is None     # nothing was written
+
+        # delivery 2 (reclaim, served one-at-a-time): innocents before
+        # the poison are served + acked, the poison kills again
+        w2 = self._worker(broker, "w2")
+        with pytest.raises(_ReplicaDeath):
+            w2._reclaim_stale(min_idle_ms=0)
+        assert outq.query("h-0") is not None
+        att = {k: v for k, v in broker.hgetall(
+            POISON_ATTEMPTS_KEY).items()}
+        assert att.get(rid_poison) == b"1"   # marked BEFORE the serve
+
+        # delivery 3 would exceed poison_max_attempts=2 -> quarantine,
+        # and the remaining innocents finally complete
+        w3 = self._worker(broker, "w3")
+        w3._reclaim_stale(min_idle_ms=0)
+        poison = _dead_letters(broker, reason="poison")
+        assert len(poison) == 1
+        assert poison[0]["request_id"] == rid_poison
+        assert poison[0]["deliveries"] == "2"
+        res = outq.query("poison")
+        assert isinstance(res, dict) and "quarantined" in res["error"]
+        for u in ("h-0", "h-1", "h-2"):
+            assert outq.query(u) is not None, u
+        # PEL empty + attempt bookkeeping cleaned up
+        assert not broker._groups[("serving_stream", "serve")]["pending"]
+        assert rid_poison not in broker.hgetall(POISON_ATTEMPTS_KEY)
+
+    def test_clean_reclaims_do_not_accumulate_attempts(self):
+        """A healthy record reclaimed from a dead worker is served once
+        and its delivery count cleared — no quarantine creep."""
+        broker = EmbeddedBroker()
+        broker.xgroup_create(INPUT_STREAM, "serve")
+        inq = InputQueue(broker=broker)
+        for i in range(3):
+            inq.enqueue(f"c-{i}", np.zeros(3, np.float32))
+        # dead worker: reads, never acks
+        broker.xreadgroup("serve", "dead", INPUT_STREAM, count=3)
+        w = self._worker(broker, "alive")
+        assert w._reclaim_stale(min_idle_ms=0) == 3
+        assert broker.hgetall(POISON_ATTEMPTS_KEY) == {}
+        assert not broker._groups[(INPUT_STREAM, "serve")]["pending"]
+
+
+# ------------------------------------------------- supervisor mechanics
+def _stub_factory(code_or_script):
+    """Worker factory running a tiny python stub (no imports beyond
+    stdlib — supervisor mechanics don't need a real serving loop)."""
+    def factory(index, incarnation):
+        if isinstance(code_or_script, int):
+            body = f"import sys; sys.exit({code_or_script})"
+        else:
+            body = code_or_script
+        return [sys.executable, "-c", body], {}
+    return factory
+
+
+class TestSupervisorMechanics:
+    def test_crash_restarts_then_budget_exhaustion_degrades(self,
+                                                            tmp_path):
+        sup = ServingSupervisor(
+            _stub_factory(3), replicas=1, retry_times=2,
+            retry_window_s=60.0, backoff_base_s=0.05,
+            backoff_max_s=0.1, run_dir=str(tmp_path))
+        with pytest.raises(DegradedTraining) as ei:
+            sup.run(poll_interval_s=0.05)
+        rec = ei.value.result
+        assert rec["status"] == "degraded"
+        assert rec["component"] == "serving"
+        assert rec["classification"] == "error(3)"
+        assert sup.restarts_total == 2       # budget of 2 consumed
+        # the structured record is mirrored like training's
+        # model_dir/degraded.json
+        on_disk = json.loads((tmp_path / "degraded.json").read_text())
+        assert on_disk == rec
+        # summary() must name the culprit even when the raise is lost
+        # in a run_background() daemon thread
+        assert sup.summary()["degraded"] == [0]
+
+    def test_clean_exit_is_not_restarted(self):
+        sup = ServingSupervisor(_stub_factory(0), replicas=2,
+                                retry_times=2, backoff_base_s=0.05)
+        summary = sup.run(poll_interval_s=0.05)
+        assert summary["done"] == [0, 1]
+        assert summary["restarts_total"] == 0
+
+    def test_degraded_exit17_is_not_restarted(self):
+        sup = ServingSupervisor(_stub_factory(17), replicas=1,
+                                retry_times=2, backoff_base_s=0.05)
+        summary = sup.run(poll_interval_s=0.05)
+        assert summary["degraded"] == [0]
+        assert summary["restarts_total"] == 0
+
+    def test_sigterm_drains_fleet_to_exit_zero(self):
+        body = ("import signal, sys, time\n"
+                "signal.signal(signal.SIGTERM,"
+                " lambda *_: sys.exit(0))\n"
+                "time.sleep(60)\n")
+        sup = ServingSupervisor(_stub_factory(body), replicas=2,
+                                drain_timeout_s=10.0)
+        t = sup.run_background()
+        deadline = time.time() + 10.0
+        while sum(1 for r in sup._replicas
+                  if r.proc is not None) < 2 \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        time.sleep(1.0)        # let the stubs install their handlers
+        sup.stop()
+        t.join(timeout=20)
+        assert not t.is_alive()
+        assert sup.summary()["exit_codes"] == {0: 0, 1: 0}
+
+    def test_silent_replica_is_killed_and_budgeted(self):
+        """A replica that never publishes its /healthz port within the
+        startup grace is killed (TERM), classified, and routed through
+        the same restart budget as a crash."""
+        sup = ServingSupervisor(
+            _stub_factory("import time; time.sleep(60)"),
+            replicas=1, retry_times=1, retry_window_s=60.0,
+            backoff_base_s=0.05, backoff_max_s=0.1,
+            health_interval_s=0.1, startup_grace_s=0.4)
+        with pytest.raises(DegradedTraining) as ei:
+            sup.run(poll_interval_s=0.05)
+        assert ("killed_by_supervisor"
+                == ei.value.result["classification"])
+        assert sup.restarts_total == 1
+
+    def test_graceful_exit_after_supervisor_kill_still_restarts(self):
+        """A replica the supervisor kills (here: no /healthz port
+        within the startup grace) whose SIGTERM handler drains
+        gracefully to exit 0 must still be routed through the restart
+        budget — taking the 0 as an orderly retirement would silently
+        shrink the fleet with no restart and no degraded record."""
+        body = ("import signal, sys, time\n"
+                "signal.signal(signal.SIGTERM,"
+                " lambda *_: sys.exit(0))\n"
+                "time.sleep(60)\n")
+        sup = ServingSupervisor(
+            _stub_factory(body),
+            replicas=1, retry_times=1, retry_window_s=60.0,
+            backoff_base_s=0.05, backoff_max_s=0.1,
+            health_interval_s=0.1, startup_grace_s=0.4)
+        with pytest.raises(DegradedTraining) as ei:
+            sup.run(poll_interval_s=0.05)
+        rec = ei.value.result
+        assert rec["classification"] == "killed_by_supervisor"
+        assert rec["exit_code"] == 0         # drained... but killed
+        assert sup.restarts_total == 1
+        assert sup._replicas[0].done is False
+
+    def test_spawn_drops_previous_incarnations_heartbeat(self, tmp_path):
+        """A respawn must not inherit its dead predecessor's stale
+        heartbeat.json: the replacement's first beat only lands after
+        model load, and judging it by the old timestamp would kill
+        every slow-starting respawn until the budget spuriously
+        degrades the fleet (the launcher applies the same
+        contamination guard to reused run dirs)."""
+        sup = ServingSupervisor(
+            _stub_factory("import time; time.sleep(60)"),
+            replicas=1, run_dir=str(tmp_path))
+        slot = tmp_path / "host-0"
+        slot.mkdir()
+        hb = slot / "heartbeat.json"
+        hb.write_text(json.dumps({"time": time.time() - 3600.0}))
+        r = sup._replicas[0]
+        try:
+            sup._spawn(r)
+            assert not hb.exists()
+        finally:
+            if r.proc is not None:
+                r.proc.kill()
+                r.proc.wait()
+
+
+# ------------------------------------------------ fleet acceptance run
+class TestServingFleetAcceptance:
+    """Acceptance (a) + (b) on a REAL 3-replica fleet: supervisor +
+    ``serving_replica_worker.py`` processes + BrokerServer over TCP."""
+
+    def _factory(self, url, chaos_env):
+        def factory(index, incarnation):
+            cmd = [sys.executable, REPLICA_WORKER,
+                   "--redis-url", url,
+                   "--consumer-group", "serve",
+                   "--consumer-name", f"replica-{index}",
+                   "--batch-size", "4",
+                   "--poison-max-attempts", "2",
+                   "--reclaim-min-idle-ms", "300"]
+            env = {}
+            if index != 0:
+                # replica 0 must own (and die on) the first batch
+                cmd += ["--start-delay", "2.0"]
+            if index == 0 and incarnation == 0 and chaos_env:
+                # arm the mid-batch kill for the FIRST life only: the
+                # restarted incarnation must come back healthy
+                env.update(chaos_env)
+            return cmd, env
+        return factory
+
+    def test_fleet_survives_kill_and_quarantines_poison(self,
+                                                        tmp_path):
+        srv = BrokerServer()
+        sup = None
+        t = None
+        try:
+            chaos_env = ChaosPlan([FaultSpec(
+                site=SITE_SERVING_PREDICT, at_step=0, kind="kill",
+                exit_code=137, process_index=0)]).env()
+            sup = ServingSupervisor(
+                self._factory(srv.url, chaos_env), replicas=3,
+                retry_times=5, retry_window_s=120.0,
+                backoff_base_s=0.2, backoff_max_s=1.0,
+                health_interval_s=0.5, run_dir=str(tmp_path),
+                drain_timeout_s=30.0)
+            inq = InputQueue(broker=connect(srv.url))
+            outq = OutputQueue(broker=connect(srv.url))
+
+            # ---- phase (a): kill one replica mid-batch -------------
+            n = 20
+            for i in range(n):
+                inq.enqueue(f"a-{i}", np.zeros(4, np.float32))
+            t = sup.run_background()
+            for i in range(n):
+                assert outq.query(f"a-{i}", timeout_s=90.0) \
+                    is not None, f"a-{i} lost"
+            # the chaos kill really happened and was absorbed by ONE
+            # budgeted restart
+            deadline = time.time() + 30.0
+            while sup.restarts_total < 1 and time.time() < deadline:
+                time.sleep(0.1)
+            assert sup.restarts_total == 1
+            assert sup._replicas[0].incarnation == 2
+            # exactly-once-visible: all served, PEL empty
+            pend = srv.broker._groups[("serving_stream",
+                                       "serve")]["pending"]
+            deadline = time.time() + 15.0
+            while pend and time.time() < deadline:
+                time.sleep(0.1)
+            assert not pend
+            # replicas heartbeat into the supervisor run dir.  Bounded
+            # wait: the respawn dropped incarnation 1's heartbeat (the
+            # stale-file contamination guard), and incarnation 2's
+            # first beat only lands once its serve loop starts.
+            hb = tmp_path / "host-0" / "heartbeat.json"
+            deadline = time.time() + 30.0
+            while not hb.exists() and time.time() < deadline:
+                time.sleep(0.1)
+            assert hb.exists()
+
+            # ---- phase (b): poison among healthy traffic -----------
+            rid_poison = inq.enqueue("b-poison",
+                                     np.full(4, 1e9, np.float32))
+            for i in range(10):
+                inq.enqueue(f"b-{i}", np.zeros(4, np.float32))
+            for i in range(10):
+                assert outq.query(f"b-{i}", timeout_s=90.0) \
+                    is not None, f"b-{i} lost"
+            # the poison record lands in the dead-letter stream with
+            # reason=poison after poison_max_attempts deliveries
+            dead = []
+            deadline = time.time() + 60.0
+            while not dead and time.time() < deadline:
+                dead = _dead_letters(srv.broker, reason="poison")
+                time.sleep(0.2)
+            assert dead and dead[0]["request_id"] == rid_poison
+            assert dead[0]["deliveries"] == "2"
+            meta = outq.query_meta("b-poison", timeout_s=10.0)
+            assert meta and "quarantined" in meta["value"]["error"]
+            # /healthz stayed ready on a live replica
+            live = [r for r in sup._replicas
+                    if r.proc is not None and r.proc.poll() is None]
+            assert live
+            deadline = time.time() + 15.0
+            status = None
+            while status != "ok" and time.time() < deadline:
+                status = sup._probe(live[0])
+                time.sleep(0.1)
+            assert status == "ok"
+            assert not sup.summary()["degraded"]
+
+            # ---- graceful drain ------------------------------------
+            # wait out any in-flight backoff respawn first, so every
+            # replica is up (handlers installed, /healthz answering)
+            # to receive the drain SIGTERM
+            deadline = time.time() + 30.0
+            while sum(1 for r in sup._replicas
+                      if r.proc is not None
+                      and r.proc.poll() is None) < 3 \
+                    and time.time() < deadline:
+                time.sleep(0.1)
+            assert sup.wait_ready(timeout_s=30.0)
+            sup.stop()
+            t.join(timeout=60)
+            assert not t.is_alive()
+            codes = sup.summary()["exit_codes"]
+            assert all(c == 0 for c in codes.values()), codes
+        finally:
+            if sup is not None:
+                sup.stop()
+            if t is not None:
+                t.join(timeout=30)
+            srv.stop()
