@@ -1,7 +1,10 @@
-from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
+from analytics_zoo_tpu.serving.client import (
+    InputQueue, OutputQueue, ServingHttpClient, predict_http)
+from analytics_zoo_tpu.serving.engine import ServingEngine
 from analytics_zoo_tpu.serving.server import ClusterServing
 from analytics_zoo_tpu.serving.supervisor import (
     ServingSupervisor, cli_worker_factory)
 
-__all__ = ["InputQueue", "OutputQueue", "ClusterServing",
+__all__ = ["InputQueue", "OutputQueue", "ServingHttpClient",
+           "predict_http", "ServingEngine", "ClusterServing",
            "ServingSupervisor", "cli_worker_factory"]

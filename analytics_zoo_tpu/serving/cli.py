@@ -39,6 +39,20 @@ def _send_stop(cfg):
     return broker
 
 
+def _parse_endpoints(spec: str):
+    """``params.endpoints`` / ``--endpoints``: comma/whitespace-
+    separated ``name=pkg.module:builder`` entries."""
+    out = []
+    for item in spec.replace(",", " ").split():
+        name, sep, builder = item.partition("=")
+        if not sep or not name or not builder:
+            raise SystemExit(
+                f"endpoint spec {item!r} must look like "
+                "name=pkg.module:builder")
+        out.append((name.strip(), builder.strip()))
+    return out
+
+
 def _start(cfg, args):
     builder = args.builder or cfg.extra.get("model.builder")
     if not builder:
@@ -50,6 +64,13 @@ def _start(cfg, args):
     from analytics_zoo_tpu.serving.server import ClusterServing
     im = InferenceModel().load_zoo(model, quantize=args.quantize)
     serving = ClusterServing(im, cfg)
+    # multi-model endpoints beside the default model: records with an
+    # ``endpoint`` field (and HTTP /predict/<name>) route to these
+    if cfg.endpoints:
+        for name, ep_builder in _parse_endpoints(cfg.endpoints):
+            ep_model = InferenceModel().load_zoo(
+                _build_model(ep_builder), quantize=args.quantize)
+            serving.register_endpoint(name, ep_model)
     # graceful drain: SIGTERM (supervisor / orchestrator shutdown) →
     # finish + ack in-flight batches, flush metrics, exit 0
     serving.install_signal_handlers()
@@ -79,6 +100,13 @@ def main(argv=None):
                    help="expose Prometheus /metrics on this port "
                         "(0 = ephemeral; overrides config "
                         "params: metrics_port)")
+    p.add_argument("--http-port", type=int, default=None,
+                   help="HTTP/JSON fast-path port (0 = ephemeral; "
+                        "overrides config params: http_port)")
+    p.add_argument("--endpoints", default=None,
+                   help="extra model endpoints, "
+                        "'name=pkg.module:builder,...' (overrides "
+                        "config params: endpoints)")
     args = p.parse_args(argv)
 
     import os
@@ -91,6 +119,10 @@ def main(argv=None):
         cfg.redis_url = args.redis
     if args.metrics_port is not None:
         cfg.metrics_port = args.metrics_port
+    if args.http_port is not None:
+        cfg.http_port = args.http_port
+    if args.endpoints:
+        cfg.endpoints = args.endpoints
     if args.consumer_group:
         cfg.consumer_group = args.consumer_group
     if args.consumer_name:

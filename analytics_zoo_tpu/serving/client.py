@@ -31,11 +31,14 @@ class InputQueue:
         return request_id if request_id else uuid.uuid4().hex
 
     def enqueue_image(self, uri: str, image,
-                      request_id: Optional[str] = None) -> str:
+                      request_id: Optional[str] = None,
+                      endpoint: Optional[str] = None) -> str:
         """image: ndarray (HWC uint8) or path or raw JPEG bytes.
         Returns the record's ``request_id`` (generated when not
         given) — correlate it against the server's spans and the
-        ``request_id`` field echoed beside the result."""
+        ``request_id`` field echoed beside the result.  ``endpoint``
+        routes the record to a registered model on a multi-model
+        worker (absent = the worker's default model)."""
         if isinstance(image, str):
             with open(image, "rb") as f:
                 raw = f.read()
@@ -48,21 +51,27 @@ class InputQueue:
                 raise ValueError("cannot encode image")
             raw = enc.tobytes()
         rid = self._request_id(request_id)
-        self.broker.xadd(INPUT_STREAM, {
-            "uri": uri, "image": base64.b64encode(raw),
-            "request_id": rid})
+        fields = {"uri": uri, "image": base64.b64encode(raw),
+                  "request_id": rid}
+        if endpoint:
+            fields["endpoint"] = endpoint
+        self.broker.xadd(INPUT_STREAM, fields)
         return rid
 
     def enqueue(self, uri: str, data: np.ndarray,
-                request_id: Optional[str] = None) -> str:
+                request_id: Optional[str] = None,
+                endpoint: Optional[str] = None) -> str:
         """Arbitrary ndarray input (npy-serialized); returns the
-        record's ``request_id``."""
+        record's ``request_id``.  ``endpoint`` routes to a registered
+        model on a multi-model worker."""
         buf = io.BytesIO()
         np.save(buf, np.ascontiguousarray(data), allow_pickle=False)
         rid = self._request_id(request_id)
-        self.broker.xadd(INPUT_STREAM, {
-            "uri": uri, "data": base64.b64encode(buf.getvalue()),
-            "request_id": rid})
+        fields = {"uri": uri, "data": base64.b64encode(buf.getvalue()),
+                  "request_id": rid}
+        if endpoint:
+            fields["endpoint"] = endpoint
+        self.broker.xadd(INPUT_STREAM, fields)
         return rid
 
 
@@ -152,3 +161,94 @@ class OutputQueue:
                 out[uri] = res
                 self.broker.delete(RESULT_PREFIX + uri)
         return out
+
+
+# ------------------------------------------------------ HTTP fast path
+class ServingHttpClient:
+    """Client for the serving engine's HTTP/JSON fast path
+    (``params.http_port``): one POST per record, the response returns
+    on the same connection — no broker round trip.
+
+    Same bounded retry/backoff contract as ``OutputQueue.query_meta``:
+    connection-class trouble (socket errors — the server is gone or
+    mid-restart) is absorbed up to ``retries`` consecutive failures
+    with exponential backoff + jitter, then the last error re-raises;
+    an HTTP *status* error means the server answered — an application
+    outcome, not an outage — and raises :class:`ServingHttpError`
+    immediately, retrying cannot fix it."""
+
+    def __init__(self, base_url: str, retries: int = 8,
+                 timeout_s: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        if "://" not in self.base_url:
+            self.base_url = "http://" + self.base_url
+        self.retries = int(retries)
+        self.timeout_s = float(timeout_s)
+
+    def predict_http(self, endpoint: str, payload, *,
+                     uri: str = "", request_id: Optional[str] = None,
+                     timeout_s: Optional[float] = None,
+                     retries: Optional[int] = None) -> Dict[str, Any]:
+        """Predict one record: ``payload`` is an ndarray (or nested
+        list).  Returns the response doc ``{"value": [[class, prob],
+        ...], "request_id": ..., "endpoint": ...}``."""
+        import random
+        from urllib import error as urlerror
+        from urllib import request as urlrequest
+        if timeout_s is None:
+            timeout_s = self.timeout_s
+        if retries is None:
+            retries = self.retries
+        body = json.dumps({
+            "data": np.asarray(payload).tolist(),
+            "dtype": str(np.asarray(payload).dtype),
+            "uri": uri,
+            "request_id": request_id or uuid.uuid4().hex,
+        }).encode()
+        req = urlrequest.Request(
+            f"{self.base_url}/predict/{endpoint}", data=body,
+            headers={"Content-Type": "application/json"})
+        delay, failures = 0.05, 0
+        while True:
+            try:
+                with urlrequest.urlopen(req, timeout=timeout_s) as r:
+                    return json.loads(r.read().decode())
+            except urlerror.HTTPError as e:
+                # the server ANSWERED: 400/404/500/504 are outcomes
+                try:
+                    doc = json.loads(e.read().decode())
+                except Exception:   # noqa: BLE001
+                    doc = {}
+                finally:
+                    e.close()
+                raise ServingHttpError(
+                    e.code, doc.get("error") or str(e), doc) from None
+            except (urlerror.URLError, OSError) as e:
+                failures += 1
+                if failures >= max(int(retries), 1):
+                    raise
+                time.sleep(delay * (0.5 + random.random()))
+                delay = min(delay * 2.0, 2.0)
+
+    def endpoints(self) -> Dict[str, Any]:
+        """The worker's registered endpoints (``GET /endpoints``)."""
+        from urllib import request as urlrequest
+        with urlrequest.urlopen(f"{self.base_url}/endpoints",
+                                timeout=self.timeout_s) as r:
+            return json.loads(r.read().decode())["endpoints"]
+
+
+class ServingHttpError(RuntimeError):
+    """The fast path answered with an HTTP error status."""
+
+    def __init__(self, status: int, message: str, doc: Dict):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+        self.doc = doc
+
+
+def predict_http(base_url: str, endpoint: str, payload,
+                 **kwargs) -> Dict[str, Any]:
+    """One-shot convenience over :class:`ServingHttpClient`."""
+    return ServingHttpClient(base_url).predict_http(
+        endpoint, payload, **kwargs)

@@ -1,0 +1,41 @@
+"""Serving engine v2 — the transport / batcher / executor split.
+
+The PR 9 serving tier was one fixed-size-batch loop: read a full
+``batch_size`` from the Redis stream, decode, predict, write.  This
+package decomposes serving into three independently testable layers
+(the refactor ROADMAP item 1 names):
+
+* **transport** — where requests come from and results go back.  The
+  existing Redis-stream bulk path (``ClusterServing`` remains that
+  transport's composition root) plus a new stdlib HTTP/JSON
+  low-latency fast path (:class:`HttpTransport`).  Both feed ONE
+  shared request queue, so an HTTP single rides the same device batch
+  as a Redis bulk group.
+* **batcher** — :class:`ContinuousBatcher`: continuous / in-flight
+  batching.  The moment the executor frees, a batch is formed from
+  whatever is queued and padded to the nearest of a small set of
+  AOT-warmed bucket sizes (instead of always ``batch_size``); the
+  ``max_wait_ms`` knob bounds how long a lone request may wait for
+  co-riders, so it never stalls.
+* **executor** — :class:`EndpointRegistry` + :class:`ModelExecutor`:
+  a multi-model endpoint registry (endpoint name →
+  ``InferenceModel`` + warmed executables), per-endpoint queues with
+  weighted scheduling, and per-bucket AOT warm-up at model load (the
+  PR 8 ``compile/`` cache makes a replica respawn deserialize in
+  seconds).
+
+:class:`ServingEngine` composes the three for embedders.
+"""
+
+from analytics_zoo_tpu.serving.engine.batcher import (
+    ContinuousBatcher, Request)
+from analytics_zoo_tpu.serving.engine.executor import (
+    Endpoint, EndpointRegistry, ModelExecutor, default_buckets)
+from analytics_zoo_tpu.serving.engine.core import ServingEngine
+from analytics_zoo_tpu.serving.engine.transport import HttpTransport
+
+__all__ = [
+    "ContinuousBatcher", "Request", "Endpoint", "EndpointRegistry",
+    "ModelExecutor", "ServingEngine", "HttpTransport",
+    "default_buckets",
+]

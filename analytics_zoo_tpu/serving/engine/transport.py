@@ -1,0 +1,201 @@
+"""HTTP/JSON fast-path transport.
+
+The Redis stream is the bulk path: durable, exactly-once, replayable —
+and a round trip costs an enqueue poll plus a result poll.  This
+transport is the low-latency path for interactive callers: one POST
+carries one record straight into the SAME engine queue the Redis loop
+feeds, rides a continuously-batched device predict, and the response
+returns on the same connection — no broker hop at all.  It keeps
+working during a broker outage (the breaker only guards broker IO),
+which is exactly when an orchestrator probing the fleet needs a live
+predict path.
+
+Contract (stdlib-only, JSON over ``ThreadingHTTPServer``):
+
+* ``POST /predict/<endpoint>`` — body ``{"data": <nested list>,
+  "dtype": "float32"?, "uri": str?, "request_id": str?}`` or
+  ``{"npy_b64": <base64 .npy bytes>, ...}``.  200 →
+  ``{"value": [[class, prob], ...], "request_id": ..., "endpoint":
+  ...}``; 404 unknown endpoint, 400 undecodable payload, 500 predict
+  error, 504 deadline.  (A stopped engine restarts on submit, so
+  there is deliberately no "engine down" status.)
+* ``GET /endpoints`` — the registry listing (name → buckets, top_n,
+  weight, records served).
+
+Each handler thread blocks on its own request's completion — HTTP
+concurrency is the transport's in-flight window, the batcher decides
+the device batching.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import logging
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.engine.batcher import Request
+from analytics_zoo_tpu.serving.engine.core import DEFAULT_ENDPOINT
+
+log = logging.getLogger("analytics_zoo_tpu.serving.engine")
+
+
+def decode_payload(body: bytes):
+    """JSON body → (ndarray, uri, request_id).  Raises ValueError on
+    anything undecodable (the handler answers 400)."""
+    try:
+        doc = json.loads(body or b"{}")
+    except json.JSONDecodeError as e:
+        raise ValueError(f"bad JSON: {e}") from e
+    if not isinstance(doc, dict):
+        raise ValueError("payload must be a JSON object")
+    uri = str(doc.get("uri") or "")
+    rid = doc.get("request_id") or uuid.uuid4().hex
+    if "npy_b64" in doc:
+        raw = base64.b64decode(doc["npy_b64"])
+        arr = np.load(io.BytesIO(raw), allow_pickle=False)
+    elif "data" in doc:
+        arr = np.asarray(doc["data"],
+                         dtype=np.dtype(doc.get("dtype") or "float32"))
+    else:
+        raise ValueError("payload needs 'data' or 'npy_b64'")
+    return arr, uri, str(rid)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):   # noqa: A003 — stdlib API
+        log.debug("http transport: " + fmt, *args)
+
+    def _respond(self, code: int, doc: dict) -> None:
+        body = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:   # noqa: N802 — stdlib API
+        path = self.path.split("?", 1)[0]
+        engine = self.server.engine
+        if path in ("/endpoints", "/"):
+            out = {}
+            for ep in engine.registry:
+                out[ep.name] = {
+                    "buckets": list(ep.buckets),
+                    "top_n": ep.top_n,
+                    "weight": ep.weight,
+                    "records_total": ep.records_total,
+                }
+            self._respond(200, {"endpoints": out})
+        else:
+            self._respond(404, {"error": f"no route {path!r}"})
+
+    def do_POST(self) -> None:   # noqa: N802 — stdlib API
+        path = self.path.split("?", 1)[0]
+        transport = self.server.transport
+        if path != "/predict" and not path.startswith("/predict/"):
+            self._respond(404, {"error": f"no route {path!r}"})
+            return
+        endpoint = path[len("/predict"):].strip("/") or DEFAULT_ENDPOINT
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length else b""
+        code, doc = transport.handle_predict(endpoint, body)
+        self._respond(code, doc)
+
+
+class HttpTransport:
+    """The fast-path listener over one :class:`ServingEngine`."""
+
+    def __init__(self, engine, port: int = 0,
+                 host: str = "127.0.0.1",
+                 timeout_s: float = 30.0):
+        from analytics_zoo_tpu.observability import (
+            get_registry, get_tracer)
+        self.engine = engine
+        self._host = host
+        self._requested_port = int(port)
+        self.timeout_s = float(timeout_s)
+        self.port: Optional[int] = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._tracer = get_tracer()
+        reg = get_registry()
+        self._m_requests = reg.counter(
+            "serving_http_requests_total",
+            "HTTP fast-path requests by response class",
+            labels=("status",))
+        self._m_latency = reg.histogram(
+            "serving_request_latency_seconds",
+            "stream-arrival to result-write latency per record")
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "HttpTransport":
+        if self._httpd is not None:
+            return self
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.engine = self.engine
+        self._httpd.transport = self
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"zoo-serving-http:{self.port}")
+        self._thread.start()
+        log.info("serving HTTP fast path listening on %s:%d/predict",
+                 self._host, self.port)
+        return self
+
+    def stop(self) -> None:
+        httpd, self._httpd = self._httpd, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        self.port = None
+
+    @property
+    def url(self) -> Optional[str]:
+        return (f"http://{self._host}:{self.port}"
+                if self.port else None)
+
+    # --------------------------------------------------------------- serve
+    def handle_predict(self, endpoint: str, body: bytes):
+        """One fast-path request → (http status, response doc).
+        Separated from the handler class so tests can drive the full
+        path without a socket."""
+        import time
+        t0 = time.perf_counter()
+        try:
+            arr, uri, rid = decode_payload(body)
+        except ValueError as e:
+            self._m_requests.labels("bad_request").inc()
+            return 400, {"error": str(e)}
+        if self.engine.registry.get(endpoint) is None:
+            self._m_requests.labels("unknown_endpoint").inc()
+            return 404, {
+                "error": f"unknown endpoint {endpoint!r}",
+                "endpoints": self.engine.endpoints()}
+        req = Request(endpoint=endpoint, uri=uri, data=arr,
+                      request_id=rid)
+        with self._tracer.span("serving_http_predict",
+                               endpoint=endpoint, request_id=rid):
+            self.engine.submit_wait([req], timeout_s=self.timeout_s)
+        if req.error is not None:
+            timed_out = isinstance(req.error, TimeoutError)
+            self._m_requests.labels(
+                "timeout" if timed_out else "error").inc()
+            return (504 if timed_out else 500), {
+                "error": f"{type(req.error).__name__}: {req.error}",
+                "request_id": rid, "endpoint": endpoint}
+        self._m_latency.observe(time.perf_counter() - t0)
+        self._m_requests.labels("ok").inc()
+        return 200, {"value": req.result, "request_id": rid,
+                     "endpoint": endpoint}

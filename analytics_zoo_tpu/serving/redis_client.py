@@ -159,6 +159,35 @@ class RedisClient:
     def xlen(self, stream: str) -> int:
         return self.execute("XLEN", stream)
 
+    def xlag(self, stream: str, group: str) -> int:
+        """The group's true BACKLOG: entries never delivered to any
+        consumer (``lag``, Redis >= 7.0) plus delivered-but-unacked
+        pending.  ``XLEN`` cannot express this — served entries stay
+        in the stream until trimmed, so stream length reads high
+        forever; backlog is what admission control and the fleet
+        autoscaler actually need.  Falls back to ``XLEN`` when XINFO
+        is unavailable (old server) or lag is nil (entries deleted
+        mid-stream make it uncomputable)."""
+        try:
+            reply = self.execute("XINFO", "GROUPS", stream)
+        except RuntimeError:
+            return self.xlen(stream)
+        for entry in reply or []:
+            fields = {}
+            for i in range(0, len(entry) - 1, 2):
+                k = entry[i]
+                fields[k.decode() if isinstance(k, bytes) else k] = \
+                    entry[i + 1]
+            name = fields.get("name")
+            if isinstance(name, bytes):
+                name = name.decode()
+            if name == group:
+                lag = fields.get("lag")
+                if lag is None:
+                    return self.xlen(stream)
+                return int(lag) + int(fields.get("pending", 0) or 0)
+        return self.xlen(stream)
+
     def xtrim(self, stream: str, maxlen: int) -> int:
         return self.execute("XTRIM", stream, "MAXLEN", maxlen)
 
@@ -324,6 +353,34 @@ class EmbeddedBroker:
         with self._lock:
             return len(self._streams.get(stream, []))
 
+    def group_info(self, stream: str):
+        """Per-group bookkeeping snapshot for ``stream``:
+        ``[(group, lag, pending, last_delivered_id), ...]`` where lag
+        counts entries never delivered past the group cursor — the
+        ONE computation behind both ``xlag`` and the TCP broker's
+        ``XINFO GROUPS`` answer, so the embedded and wire paths can
+        never report different backlogs."""
+        with self._lock:
+            entries = self._streams.get(stream, [])
+            out = []
+            for (s, group), g in self._groups.items():
+                if s != stream:
+                    continue
+                lag = sum(1 for i, _f in entries
+                          if _id_gt(i, g["delivered"]))
+                out.append((group, lag, len(g["pending"]),
+                            g["delivered"]))
+            return out
+
+    def xlag(self, stream: str, group: str) -> int:
+        """Undelivered entries past the group cursor + unacked
+        pending (see RedisClient.xlag); stream length when the group
+        does not exist yet."""
+        for name, lag, pending, _delivered in self.group_info(stream):
+            if name == group:
+                return lag + pending
+        return self.xlen(stream)
+
     def xtrim(self, stream: str, maxlen: int) -> int:
         with self._lock:
             s = self._streams.get(stream, [])
@@ -379,6 +436,7 @@ class EmbeddedBroker:
 def _id_gt(a: str, b: str) -> bool:
     def parse(x):
         ms, _, seq = x.partition("-")
+        # zoolint: disable=SYNC002 — stream ids are host strings
         return (int(ms), int(seq or 0))
     return parse(a) > parse(b)
 
@@ -543,6 +601,21 @@ class BrokerServer:
                                _enc_array([])])
         if cmd == "XLEN":
             return _enc_int(b.xlen(dec(a[0])))
+        if cmd == "XINFO":
+            if dec(a[0]).upper() != "GROUPS":
+                return _enc_err("ERR unsupported XINFO subcommand")
+            out = []
+            for group, lag, pending, delivered in \
+                    b.group_info(dec(a[1])):
+                out.append(_enc_array([
+                    _enc_bulk("name"), _enc_bulk(group),
+                    _enc_bulk("consumers"), _enc_int(0),
+                    _enc_bulk("pending"), _enc_int(pending),
+                    _enc_bulk("last-delivered-id"),
+                    _enc_bulk(delivered),
+                    _enc_bulk("lag"), _enc_int(lag),
+                ]))
+            return _enc_array(out)
         if cmd == "XTRIM":
             return _enc_int(b.xtrim(dec(a[0]), int(a[2])))
         if cmd == "XDEL":

@@ -7,9 +7,16 @@ table with backpressure retry; Redis OOM guard via XTRIM (:128-134);
 throughput scalars to the inference summary (:294-317).  Config comes
 from config.yaml (ClusterServingHelper).
 
-TPU version: the worker is a host process driving the one compiled XLA
-predict program; batching pads to a fixed shape so one executable
-serves all traffic.
+TPU version (serving engine v2): ``ClusterServing`` is the Redis
+*transport* — it owns the stream read / shed / decode-pool / ack /
+reclaim / dead-letter lifecycle — composed over the
+``serving.engine`` batcher/executor layers: decoded records are
+submitted as atomic groups to a :class:`~analytics_zoo_tpu.serving.
+engine.ServingEngine`, whose continuous batcher pads each in-flight
+batch to the nearest AOT-warmed bucket size and co-batches them with
+the HTTP fast path's singles (``params.http_port``).  Multi-model:
+every record may carry an ``endpoint`` field routing it to a
+registered model (``register_endpoint`` / ``params.endpoints``).
 """
 
 from __future__ import annotations
@@ -28,12 +35,16 @@ import numpy as np
 
 from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.common.fsutil import atomic_write_text
-from analytics_zoo_tpu.data.stages import WorkerPool, pad_to_batch
+from analytics_zoo_tpu.data.stages import WorkerPool
 from analytics_zoo_tpu.observability import (
     MetricsServer, TelemetrySampler, get_registry, get_tracer)
 from analytics_zoo_tpu.resilience.chaos import (
     SITE_SERVING_DECODE, SITE_SERVING_PREDICT, active_chaos)
 from analytics_zoo_tpu.resilience.detector import HostHeartbeat
+from analytics_zoo_tpu.serving.engine.batcher import Request
+from analytics_zoo_tpu.serving.engine.core import (
+    DEFAULT_ENDPOINT, ServingEngine)
+from analytics_zoo_tpu.serving.engine.transport import HttpTransport
 from analytics_zoo_tpu.serving.redis_client import (
     BREAKER_OPEN, CircuitOpenError, _breaker_failure_excs, connect,
     with_breaker)
@@ -106,6 +117,11 @@ class ServingConfig:
                  breaker_failures: Optional[int] = None,
                  breaker_cooldown_s: Optional[float] = None,
                  input_shape=None,
+                 batch_buckets=None,
+                 batch_max_wait_ms: Optional[float] = None,
+                 http_port: Optional[int] = None,
+                 http_timeout_s: Optional[float] = None,
+                 endpoints: Optional[str] = None,
                  extra: Optional[Dict[str, str]] = None):
         self.redis_url = redis_url
         self.batch_size = int(batch_size)
@@ -213,6 +229,30 @@ class ServingConfig:
                 int(d) for d in input_shape.replace("x", ",").split(",")
                 if d.strip())
         self.input_shape = tuple(input_shape) if input_shape else None
+        # continuous-batching knobs (serving engine v2): the bucket
+        # ladder the batcher pads in-flight batches to ("1,4,16"; None
+        # = powers of two up to batch_size), and how long the
+        # empty-queue edge may wait for co-riders before dispatching a
+        # partial bucket (0 = dispatch immediately — a lone request is
+        # always served within batch_max_wait_ms plus one predict)
+        if batch_max_wait_ms is None:
+            batch_max_wait_ms = get_config().get(
+                "serving.batch_max_wait_ms", 0.0)
+        self.batch_max_wait_ms = max(float(batch_max_wait_ms or 0.0),
+                                     0.0)
+        self.batch_buckets = batch_buckets or None
+        # HTTP/JSON fast path beside the Redis bulk path (None = off,
+        # 0 = ephemeral port).  Binds metrics_host — the same
+        # unauthenticated-endpoint caveat applies.
+        self.http_port = None if http_port is None else int(http_port)
+        if http_timeout_s is None:
+            http_timeout_s = get_config().get(
+                "serving.http_timeout_s", 30.0)
+        self.http_timeout_s = float(http_timeout_s or 30.0)
+        # multi-model endpoint spec: "name=pkg.module:builder" entries
+        # separated by commas/whitespace, built + registered by the
+        # CLI beside the primary model (which serves as 'default')
+        self.endpoints = endpoints or None
         self.extra = extra or {}   # raw section.key entries (model.* etc)
 
     @classmethod
@@ -266,17 +306,52 @@ class ServingConfig:
                 not in (None, "") else None),   # explicit 0 clamps to
                                                 # the 0.05s floor
             input_shape=cfg.get("params.input_shape") or None,
+            batch_buckets=cfg.get("params.batch_buckets") or None,
+            batch_max_wait_ms=(
+                float(cfg["params.batch_max_wait_ms"])
+                if cfg.get("params.batch_max_wait_ms")
+                not in (None, "") else None),
+            http_port=(int(cfg["params.http_port"])
+                       if cfg.get("params.http_port")
+                       not in (None, "") else None),   # explicit 0 =
+                                                       # ephemeral port
+            http_timeout_s=float(
+                cfg.get("params.http_timeout_s") or 0.0) or None,
+            endpoints=cfg.get("params.endpoints") or None,
             extra=cfg,
         )
 
 
 class ClusterServing:
-    """The serving worker loop."""
+    """The Redis transport + composition root of the serving engine.
+
+    The worker loop owns broker IO (read / shed / ack / reclaim /
+    result writes); predicts happen on the engine's batcher thread,
+    which continuously batches this transport's bulk groups with the
+    HTTP fast path's singles and pads to AOT-warmed buckets."""
 
     def __init__(self, inference_model, config: ServingConfig = None,
                  broker=None):
         self.model = inference_model
         self.config = config or ServingConfig()
+        cfg = self.config
+        # ---- engine: batcher + executor + endpoint registry --------
+        self.engine = ServingEngine(
+            max_wait_ms=cfg.batch_max_wait_ms,
+            default_timeout_s=max(cfg.http_timeout_s, 60.0))
+        if inference_model is not None:
+            self.engine.register(
+                DEFAULT_ENDPOINT, inference_model, top_n=cfg.top_n,
+                buckets=cfg.batch_buckets, batch_size=cfg.batch_size,
+                input_shape=cfg.input_shape)
+        self.engine.start()
+        # ---- HTTP/JSON fast path (shares the engine queue) ---------
+        self.http_transport: Optional[HttpTransport] = None
+        if cfg.http_port is not None:
+            self.http_transport = HttpTransport(
+                self.engine, port=cfg.http_port,
+                host=cfg.metrics_host or "127.0.0.1",
+                timeout_s=cfg.http_timeout_s).start()
         # breaker-wrapped broker (serving.breaker_failures=0 for the
         # raw connection): a broker outage opens the circuit and every
         # op fast-fails until a half-open probe reconnects — the run
@@ -313,14 +388,13 @@ class ClusterServing:
         # decode/predict pipeline) — the reclaim pass must not treat
         # them as another worker's stale pending
         self._inflight: set = set()
+        # last time the (extra-broker-op) group-lag gauge refreshed
+        self._backlog_obs_at = 0.0
         # ---- observability: shared-registry instruments + /metrics --
         reg = get_registry()
         self._m_latency = reg.histogram(
             "serving_request_latency_seconds",
             "stream-arrival to result-write latency per record")
-        self._m_fill = reg.gauge(
-            "serving_batch_fill_ratio",
-            "real records / batch capacity of the last served batch")
         self._m_records = reg.counter(
             "serving_records_total", "records served")
         self._m_errors = reg.counter(
@@ -346,6 +420,10 @@ class ClusterServing:
             "serving_quarantined_total",
             "poison records quarantined to the dead-letter stream "
             "after repeatedly killing their worker")
+        self._m_dead_letter = reg.counter(
+            "serving_dead_letter_total",
+            "records written to the serving_dead_letter stream, by "
+            "reason", labels=("reason",))
         self._tracer = get_tracer()
         self._telemetry: Optional[TelemetrySampler] = None
         # readiness window: 1 per recently served record, 0 per record
@@ -369,27 +447,76 @@ class ClusterServing:
                 host=self.config.metrics_host,
                 health_check=self.readiness).start()
 
+    # ------------------------------------------------------------ endpoints
+    def register_endpoint(self, name: str, model, *,
+                          top_n: Optional[int] = None,
+                          buckets=None, input_shape=None,
+                          weight: int = 1):
+        """Register an additional model under ``name`` (multi-model
+        serving): records carrying an ``endpoint`` field — and HTTP
+        ``POST /predict/<name>`` — route to it.  Per-endpoint knobs
+        default to this worker's config."""
+        cfg = self.config
+        return self.engine.register(
+            name, model,
+            top_n=cfg.top_n if top_n is None else top_n,
+            buckets=buckets or cfg.batch_buckets,
+            batch_size=cfg.batch_size,
+            input_shape=input_shape or cfg.input_shape,
+            weight=weight)
+
     # ----------------------------------------------------------- warm-start
     def warm_start(self) -> bool:
-        """AOT warm-start of the padded-batch predict program (serving
-        pads every batch to ``batch_size``, so ONE executable serves
-        all traffic — warm exactly that one).  With a persistent
-        executable cache configured (``ZOO_TPU_COMPILE_CACHE`` /
-        ``compile.cache_dir``), a replica respawn deserializes in
-        seconds instead of recompiling — the serving half of the
-        141s-cold-start fix.  No-op without ``params.input_shape``."""
-        if self.config.input_shape is None:
-            return False
-        warm = getattr(self.model, "warm", None)
-        if warm is None:
-            return False
+        """AOT warm-start of EVERY endpoint's full bucket ladder (the
+        batcher pads in-flight batches to the nearest bucket, so each
+        rung is its own executable — warm them all and a post-warm-up
+        run never compiles, whatever the fill level).  With a
+        persistent executable cache configured
+        (``ZOO_TPU_COMPILE_CACHE`` / ``compile.cache_dir``), a replica
+        respawn deserializes in seconds instead of recompiling — the
+        serving half of the 141s-cold-start fix.  No-op for endpoints
+        without an ``input_shape``."""
         t0 = time.perf_counter()
-        ok = bool(warm(self.config.input_shape, self.config.batch_size))
-        log.info("predict warm start %s in %.2fs (batch=%d, shape=%s)",
-                 "ready" if ok else "unavailable",
-                 time.perf_counter() - t0, self.config.batch_size,
-                 self.config.input_shape)
-        return ok
+        warmed = self.engine.warm_start()
+        total = sum(warmed.values())
+        if total:
+            log.info("predict warm start: %d bucket program(s) ready "
+                     "in %.2fs (%s)", total, time.perf_counter() - t0,
+                     warmed)
+        return total > 0
+
+    # ----------------------------------------------------------- dead letter
+    def dead_letter(self, reason: str, *, uri: Optional[str] = None,
+                    request_id: Optional[str] = None,
+                    cause: Optional[str] = None,
+                    error: Optional[BaseException] = None,
+                    extra: Optional[Dict[str, str]] = None) -> bool:
+        """The ONE write path to the ``serving_dead_letter`` stream
+        (reasons: ``write_abandoned`` | ``shed`` | ``poison``): builds
+        the entry, counts it under
+        ``serving_dead_letter_total{reason}``, and absorbs broker
+        failures — giving up on a record must never also kill the
+        worker loop.  Returns whether the entry landed."""
+        entry: Dict[str, str] = {
+            "uri": uri or "",
+            "request_id": request_id or "",
+            "reason": reason,
+        }
+        if cause:
+            entry["cause"] = cause
+        if error is not None:
+            entry["error"] = f"{type(error).__name__}: {error}"
+        entry.update(extra or {})
+        self._m_dead_letter.labels(reason).inc()
+        try:
+            self.broker.xadd(DEAD_LETTER_STREAM, entry)
+            return True
+        except Exception:   # noqa: BLE001 — the broker may be down
+            log.exception(
+                "dead-letter write failed for %s (reason=%s; broker "
+                "down?); the request_id above is the only record",
+                uri, reason)
+            return False
 
     # ------------------------------------------------------------ main loop
     def run_once(self, block_ms: int = 100) -> int:
@@ -405,12 +532,44 @@ class ClusterServing:
                 "Serving Throughput",
                 real / max(time.perf_counter() - t0, 1e-9),
                 self.total_records)
-        # OOM guard (ClusterServing.scala:128-134)
+        self._observe_queue()
+        return real
+
+    def _backlog(self) -> int:
+        """The input-stream BACKLOG this worker group still owes:
+        undelivered + pending via ``xlag`` in consumer-group mode
+        (served entries stay in the stream until trimmed, so ``XLEN``
+        reads high forever), stream length otherwise (a solo reader
+        advances ``_last_id`` but legacy dashboards key on length).
+        Transport failures propagate like any broker op."""
+        cfg = self.config
+        if cfg.consumer_group:
+            xlag = getattr(self.broker, "xlag", None)
+            if xlag is not None:
+                try:
+                    return int(xlag(INPUT_STREAM, cfg.consumer_group))
+                except _BROKER_OUTAGE_EXCS:
+                    raise
+                except Exception:   # noqa: BLE001 — duck broker
+                    pass
+        return self.broker.xlen(INPUT_STREAM)
+
+    def _observe_queue(self) -> None:
+        """Refresh ``serving_queue_depth`` (the /healthz, shedding,
+        and autoscaler signal) and apply the stream OOM guard
+        (ClusterServing.scala:128-134).  In consumer-group mode the
+        gauge is the true lag (``xlag`` = one extra broker op), so it
+        is throttled to ~4 Hz — the per-batch hot path stays at the
+        single XLEN round trip it always paid; solo-reader mode keeps
+        xlen, which the XLEN below already fetched."""
         qlen = self.broker.xlen(INPUT_STREAM)
-        self._m_queue.set(qlen)
+        if not self.config.consumer_group:
+            self._m_queue.set(qlen)
+        elif time.perf_counter() - self._backlog_obs_at >= 0.25:
+            self._m_queue.set(self._backlog())
+            self._backlog_obs_at = time.perf_counter()
         if qlen > self.config.max_stream_len:
             self.broker.xtrim(INPUT_STREAM, self.config.max_stream_len)
-        return real
 
     def _write_result(self, uri: str, value: str,
                       retries: Optional[int] = None,
@@ -450,18 +609,9 @@ class ClusterServing:
         log.error("abandoning result write for %s after %d attempts "
                   "(%s: %s); dead-lettering", uri, attempts,
                   type(last_exc).__name__, last_exc)
-        try:
-            self.broker.xadd(DEAD_LETTER_STREAM, {
-                "uri": uri,
-                "request_id": request_id or "",
-                "reason": "write_abandoned",
-                "error": f"{type(last_exc).__name__}: {last_exc}",
-                "abandoned_unix": f"{time.time():.3f}",
-            })
-        except Exception:   # noqa: BLE001 — the broker may be fully down
-            log.exception("dead-letter write failed for %s (broker "
-                          "down?); the request_id above is the only "
-                          "record", uri)
+        self.dead_letter("write_abandoned", uri=uri,
+                         request_id=request_id, error=last_exc,
+                         extra={"abandoned_unix": f"{time.time():.3f}"})
         return False
 
     # -------------------------------------------------- pipelined serving
@@ -577,18 +727,11 @@ class ClusterServing:
         log.error("quarantining poison record %s (uri=%s, request_id="
                   "%s) after %d deliveries", entry_id, uri, rid,
                   deliveries)
-        try:
-            self.broker.xadd(DEAD_LETTER_STREAM, {
-                "uri": uri or "",
-                "request_id": rid or "",
-                "reason": "poison",
-                "entry_id": str(entry_id),
-                "deliveries": str(deliveries),
-                "quarantined_unix": f"{time.time():.3f}",
-            })
-        except Exception:   # noqa: BLE001 — broker may be flaking
-            log.exception("dead-letter write failed for quarantined "
-                          "record %s", entry_id)
+        self.dead_letter(
+            "poison", uri=uri, request_id=rid,
+            extra={"entry_id": str(entry_id),
+                   "deliveries": str(deliveries),
+                   "quarantined_unix": f"{time.time():.3f}"})
         if uri:
             self._write_result(uri, json.dumps({
                 "error": f"poison: quarantined after "
@@ -616,7 +759,7 @@ class ClusterServing:
         chaos = active_chaos()
         if chaos is not None:
             chaos.trip(SITE_SERVING_DECODE, next(self._decode_seq))
-        uris, arrays, rids, failed = [], [], [], []
+        uris, arrays, rids, eps, failed = [], [], [], [], []
         for entry_id, fields in entries:
             try:
                 uri, arr, rid = decode_field(fields)
@@ -628,7 +771,8 @@ class ClusterServing:
             uris.append(uri)
             arrays.append(arr)
             rids.append(rid)
-        return uris, arrays, failed, rids
+            eps.append(self._endpoint_of(fields))
+        return uris, arrays, failed, rids, eps
 
     @staticmethod
     def _uri_of(fields) -> str:
@@ -640,6 +784,16 @@ class ClusterServing:
         rid = fields.get("request_id") if hasattr(fields, "get") \
             else None
         return rid.decode() if isinstance(rid, bytes) else rid
+
+    @staticmethod
+    def _endpoint_of(fields) -> str:
+        """Multi-model routing: the record's ``endpoint`` field (the
+        client's ``enqueue(..., endpoint=)``), defaulting to the
+        single-model endpoint."""
+        ep = fields.get("endpoint") if hasattr(fields, "get") else None
+        if isinstance(ep, bytes):
+            ep = ep.decode()
+        return ep or DEFAULT_ENDPOINT
 
     # ------------------------------------------------- admission control
     @staticmethod
@@ -684,18 +838,10 @@ class ClusterServing:
                 shed.append((entry_id, fields, age, cause))
         for entry_id, fields, age, cause in shed:
             uri, rid = self._uri_of(fields), self._rid_of(fields)
-            try:
-                self.broker.xadd(DEAD_LETTER_STREAM, {
-                    "uri": uri or "",
-                    "request_id": rid or "",
-                    "reason": "shed",
-                    "cause": cause,
-                    "age_ms": f"{age:.0f}",
-                    "deadline_ms": f"{deadline:.0f}",
-                })
-            except Exception:   # noqa: BLE001 — shedding must not kill
-                log.exception("dead-letter write failed for shed "
-                              "record %s", entry_id)
+            self.dead_letter(
+                "shed", uri=uri, request_id=rid, cause=cause,
+                extra={"age_ms": f"{age:.0f}",
+                       "deadline_ms": f"{deadline:.0f}"})
             if uri:
                 self._write_result(uri, json.dumps({
                     "error": f"shed: {cause} ({age:.0f}ms old, "
@@ -737,13 +883,17 @@ class ClusterServing:
         the worker loop with the batch un-acked), and every record that
         is acked without a prediction gets an explicit ERROR result so
         its client never blocks forever on a consumed record.
-        ``decoded`` is (uris, arrays[, failed[, request_ids]])."""
+        ``decoded`` is (uris, arrays[, failed[, request_ids[,
+        endpoints]]])."""
         uris, arrays, *rest = decoded
         failed = list(rest[0]) if rest else []
         rids = list(rest[1]) if len(rest) > 1 else [None] * len(uris)
+        eps = list(rest[2]) if len(rest) > 2 else \
+            [DEFAULT_ENDPOINT] * len(uris)
         real = 0
         try:
-            real = self._predict_write(uris, arrays, t_arrival, rids)
+            real = self._predict_write(uris, arrays, t_arrival, rids,
+                                       eps)
         except Exception as e:
             log.exception("poison batch skipped (%d records)",
                           len(entries))
@@ -764,58 +914,95 @@ class ClusterServing:
         return real
 
     def _predict_write(self, uris, arrays, t_arrival: float,
-                       rids=None) -> int:
-        """Pad/predict/top-N/write one decoded batch; returns #served."""
+                       rids=None, endpoints=None) -> int:
+        """Submit one decoded bulk batch to the engine as atomic
+        per-endpoint groups, wait for the batcher's bucket-padded
+        predicts, and write every result; returns #served.
+
+        The engine fails (rather than raises) model errors, so a
+        poisoned group costs error results for exactly its own
+        records; a non-``Exception`` escape (the simulated-process-
+        death class) re-raises here so the loop dies with the batch
+        un-acked — the PEL-reclaim trigger, exactly as before the
+        engine split."""
         if not arrays:
             return 0
         if rids is None:
             rids = [None] * len(uris)
-        bs = self.config.batch_size
-        x = np.stack(arrays)
+        if endpoints is None:
+            endpoints = [DEFAULT_ENDPOINT] * len(uris)
         real = len(arrays)
-        self._m_fill.set(real / bs)
-        # same fixed-shape padding primitive the train pipeline's
-        # pad-remainder mode uses (data/stages.py)
-        x = pad_to_batch(x, bs)
-        # the span carries the batch's request ids, so a trace viewer
-        # (or the merged cluster timeline) can follow one request from
-        # client enqueue through this predict to its result write
-        # the chaos site fires BEFORE the model call: a ``kill`` here
-        # is a replica dying mid-batch with the batch un-acked — the
-        # scripted trigger for PEL reclaim and poison quarantine
+        # the chaos site fires BEFORE the engine hand-off: a ``kill``
+        # here is a replica dying mid-batch with the batch un-acked —
+        # the scripted trigger for PEL reclaim and poison quarantine
         chaos = active_chaos()
         if chaos is not None:
             chaos.trip(SITE_SERVING_PREDICT, next(self._predict_seq))
+        # group by endpoint (a bulk read may interleave models); each
+        # group rides the engine as one atomic unit
+        groups: Dict[str, List[Request]] = {}
+        for uri, arr, rid, ep in zip(uris, arrays, rids, endpoints):
+            groups.setdefault(ep or DEFAULT_ENDPOINT, []).append(
+                Request(endpoint=ep or DEFAULT_ENDPOINT, uri=uri,
+                        data=arr, request_id=rid, arrival=t_arrival))
+        # the span carries the batch's request ids, so a trace viewer
+        # (or the merged cluster timeline) can follow one request from
+        # client enqueue through its predict to its result write
         with self._tracer.span(
                 "serving_predict", records=real,
                 request_ids=[r for r in rids if r][:16]):
-            out = np.asarray(self.model.predict(x))[:real]
-        exp = np.exp(out - out.max(axis=-1, keepdims=True))
-        probs = exp / exp.sum(axis=-1, keepdims=True)
-        top = np.argsort(-probs, axis=-1)[:, :self.config.top_n]
+            requests: List[Request] = []
+            for reqs in groups.values():
+                requests.extend(self.engine.submit(reqs))
+            self.engine.wait_all(requests)
+        fatal = next((r.error for r in requests
+                      if r.error is not None
+                      and not isinstance(r.error, Exception)), None)
+        if fatal is not None:
+            raise fatal
         done = time.perf_counter()
-        written = 0
-        for uri, t, p, rid in zip(uris, top, probs, rids):
-            value = json.dumps([[int(i), float(p[i])] for i in t])
-            if self._write_result(uri, value, request_id=rid):
+        written = predicted = failed = 0
+        for req in requests:
+            if req.error is not None:
+                # predict failed for this record's group: explicit
+                # error result, error accounting, readiness window 0
+                # — same consumed-record contract as a decode failure
+                failed += 1
+                try:
+                    if req.uri:
+                        self._write_result(req.uri, json.dumps(
+                            {"error": f"{type(req.error).__name__}: "
+                                      f"{req.error}"}),
+                            request_id=req.request_id)
+                except Exception:
+                    log.exception("could not write error result "
+                                  "for %s", req.uri)
+                continue
+            predicted += 1
+            if self._write_result(req.uri, json.dumps(req.result),
+                                  request_id=req.request_id):
                 written += 1
                 self.latencies.append(done - t_arrival)
                 self._m_latency.observe(done - t_arrival)
-        abandoned = real - written
+        if failed:
+            self._m_errors.inc(failed)
+            with self._outcomes_lock:
+                self._recent_outcomes.extend([0] * failed)
+        abandoned = predicted - written
         if abandoned:
             # a dead-lettered result is a FAILURE to error accounting
-            # and the /healthz error-rate window — the old raise made
-            # that implicit; the bounded path must keep the readiness
-            # probe honest during a result-write outage (an orchestrator
-            # should pull a worker whose results never land)
+            # and the /healthz error-rate window — the bounded path
+            # must keep the readiness probe honest during a result-
+            # write outage (an orchestrator should pull a worker whose
+            # results never land)
             self._m_errors.inc(abandoned)
             with self._outcomes_lock:
                 self._recent_outcomes.extend([0] * abandoned)
         # total_records counts records PROCESSED (drain/progress
         # bookkeeping); the return value counts records actually
         # DELIVERED — the outcome window gets its 1s from the caller
-        self.total_records += real
-        self._m_records.inc(real)
+        self.total_records += predicted
+        self._m_records.inc(predicted)
         if self.summary is not None:
             self.summary.add_scalar("Total Records Number",
                                     self.total_records,
@@ -949,7 +1136,21 @@ class ClusterServing:
         # cold compile, forever
         if self.metrics_server is not None:
             self.metrics_server.start()   # no-op if already listening
+        # the engine layers restart too (a closed worker can serve
+        # again): batcher thread + HTTP fast-path listener
+        self.engine.start()
+        if self.http_transport is not None:
+            self.http_transport.start()
         self._publish_port()
+        # the queue gauge must be honest BEFORE the (possibly
+        # minutes-long) warm start: /metrics is already answering, and
+        # a supervisor reading a never-set 0 while a real backlog
+        # waits behind the compile would scale the fleet DOWN at the
+        # exact moment it needs capacity
+        try:
+            self._observe_queue()
+        except _BROKER_OUTAGE_EXCS:
+            pass          # broker down at boot: gauge stays unset
         # pre-pay the predict compile (or the ~seconds cache load)
         # BEFORE polling: the first client's request must not carry
         # the cold-start
@@ -972,6 +1173,13 @@ class ClusterServing:
         reclaim_tick = max(0.25, min(
             10.0, self.config.reclaim_min_idle_ms / 2000.0))
         last_reclaim = time.perf_counter()
+        # the queue gauge must keep tracking the backlog while IDLE
+        # too: it naturally refreshes per consumed batch, but once
+        # traffic stops it would freeze at the last busy value — and
+        # the autoscaler's idle detection (queue == 0) would never
+        # fire, pinning the fleet at its peak forever
+        queue_obs_tick = 0.5
+        last_queue_obs = 0.0
         outage = False
         try:
             while True:
@@ -1013,12 +1221,12 @@ class ClusterServing:
                                 "Serving Throughput",
                                 s["throughput_rps"],
                                 self.total_records)
-                        qlen = self.broker.xlen(INPUT_STREAM)
-                        self._m_queue.set(qlen)
-                        if qlen > self.config.max_stream_len:
-                            self.broker.xtrim(
-                                INPUT_STREAM,
-                                self.config.max_stream_len)
+                        self._observe_queue()
+                        last_queue_obs = time.perf_counter()
+                    elif time.perf_counter() - last_queue_obs \
+                            > queue_obs_tick:
+                        self._observe_queue()
+                        last_queue_obs = time.perf_counter()
                     if outage:
                         outage = False
                         log.warning("broker recovered; serving resumed")
@@ -1068,13 +1276,24 @@ class ClusterServing:
         polls readiness on the discovered port — metrics_port=0 keeps
         replicas collision-free on one host)."""
         path = os.environ.get("ZOO_TPU_SERVING_PORT_FILE")
-        if not path or self.metrics_server is None \
-                or not self.metrics_server.port:
-            return
-        try:
-            atomic_write_text(path, str(self.metrics_server.port))
-        except OSError:
-            log.exception("could not publish serving port to %s", path)
+        if path and self.metrics_server is not None \
+                and self.metrics_server.port:
+            try:
+                atomic_write_text(path, str(self.metrics_server.port))
+            except OSError:
+                log.exception("could not publish serving port to %s",
+                              path)
+        # the HTTP fast path publishes its own (ephemeral) port the
+        # same way, for supervisors / load balancers fronting it
+        http_path = os.environ.get("ZOO_TPU_SERVING_HTTP_PORT_FILE")
+        if http_path and self.http_transport is not None \
+                and self.http_transport.port:
+            try:
+                atomic_write_text(http_path,
+                                  str(self.http_transport.port))
+            except OSError:
+                log.exception("could not publish serving http port "
+                              "to %s", http_path)
 
     def _flush_observability(self) -> None:
         """Drain-time metrics flush: inside a launcher-managed run dir
@@ -1119,9 +1338,11 @@ class ClusterServing:
 
     def close(self) -> None:
         """Release held resources: summary file handles, the telemetry
-        sampler, and the /metrics listener.  Idempotent; called by
-        ``run()`` on every exit path.  A closed engine can serve again
-        (summaries reopen on write; ``run()`` restarts the listener)."""
+        sampler, the /metrics listener, the HTTP fast path, and the
+        engine's batcher thread.  Idempotent; called by ``run()`` on
+        every exit path.  A closed engine can serve again (summaries
+        reopen on write; ``run()`` restarts the listeners and the
+        batcher)."""
         if self.summary is not None:
             self.summary.close()
         if self._telemetry is not None:
@@ -1129,6 +1350,9 @@ class ClusterServing:
             self._telemetry = None
         if self.metrics_server is not None:
             self.metrics_server.stop()
+        if self.http_transport is not None:
+            self.http_transport.stop()
+        self.engine.stop()
 
     def __enter__(self) -> "ClusterServing":
         return self

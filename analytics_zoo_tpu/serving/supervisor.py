@@ -35,6 +35,35 @@ SIGTERM to the supervisor drains the fleet gracefully: each replica
 gets SIGTERM, finishes + acks its in-flight batches, flushes metrics,
 and exits 0 (escalating to SIGKILL only past ``drain_timeout_s``).
 
+**SLO-driven autoscaling** (``min_replicas``/``max_replicas``): the
+supervisor already polls every replica's /healthz port — the same
+``MetricsServer`` serves ``/metrics.json``, so the fleet's own
+exported signals drive scale decisions with zero new plumbing:
+
+* **up** — the shared-stream backlog (``serving_queue_depth``, the
+  PR 1 gauge every replica exports) sustained above
+  ``scale_up_queue_depth`` for ``scale_up_sustain_s``, or p50 request
+  latency (the PR 1 histogram) sustained above
+  ``scale_up_latency_p50_ms`` when that knob is set;
+* **down** — backlog empty for ``scale_down_idle_s`` (fill ratio and
+  latency ride every scale event's signal record for forensics, but
+  the LIVE backlog is the decisive idle signal — the fill gauge
+  holds the last batch's value and would read stale-high forever on
+  an idle fleet): the highest-index replica is *retired* — SIGTERM,
+  the existing drain contract, so it finishes + acks in-flight
+  batches and exits 0 — and never restarted;
+* **hysteresis** — both signals must SUSTAIN (one noisy poll never
+  scales), and ``scale_cooldown_s`` separates consecutive scale
+  events so a fresh replica gets to absorb load before the next
+  decision;
+* **held** — a replica 503ing ``error_rate`` pauses scale-up: a
+  poisoned stream amplified across more replicas is more poison, not
+  more throughput.
+
+The live fleet size is exported as ``serving_fleet_replicas`` and
+recorded in ``replica_trajectory`` — the autoscaler's acceptance
+evidence.
+
 The supervisor process never touches a device — replicas are separate
 processes, so the fleet controller can run on a host with no
 accelerator access at all.
@@ -124,6 +153,9 @@ class _Replica:
     done: bool = False            # exited 0 (orderly drain)
     degraded: bool = False        # exited DEGRADED_EXIT_CODE
     kill_reason: Optional[str] = None   # supervisor-initiated kill
+    retiring: bool = False        # scale-down drain in progress
+    retire_deadline: float = 0.0  # monotonic: SIGKILL escalation
+    last_health: str = ""         # latest _probe result
 
 
 class ServingSupervisor:
@@ -146,7 +178,15 @@ class ServingSupervisor:
                  startup_grace_s: float = 30.0,
                  heartbeat_timeout_s: Optional[float] = None,
                  run_dir: Optional[str] = None,
-                 drain_timeout_s: float = 30.0):
+                 drain_timeout_s: float = 30.0,
+                 min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 scale_up_queue_depth: Optional[int] = None,
+                 scale_up_latency_p50_ms: Optional[float] = None,
+                 scale_up_sustain_s: Optional[float] = None,
+                 scale_down_idle_s: Optional[float] = None,
+                 scale_cooldown_s: Optional[float] = None,
+                 autoscale_interval_s: float = 1.0):
         if retry_times is None:
             retry_times = int(get_config().get(
                 "serving.supervisor_retry_times", 5))
@@ -168,6 +208,50 @@ class ServingSupervisor:
         self.heartbeat_timeout_s = float(heartbeat_timeout_s)
         self.drain_timeout_s = float(drain_timeout_s)
         self.run_dir = run_dir
+        # ---- autoscaler: bounds + SLO knobs (config-defaulted) -----
+        cfg = get_config()
+        if scale_up_queue_depth is None:
+            scale_up_queue_depth = int(cfg.get(
+                "serving.scale_up_queue_depth", 16))
+        if scale_up_latency_p50_ms is None:
+            scale_up_latency_p50_ms = float(cfg.get(
+                "serving.scale_up_latency_p50_ms", 0.0))   # 0 = off
+        if scale_up_sustain_s is None:
+            scale_up_sustain_s = float(cfg.get(
+                "serving.scale_up_sustain_s", 3.0))
+        if scale_down_idle_s is None:
+            scale_down_idle_s = float(cfg.get(
+                "serving.scale_down_idle_s", 10.0))
+        if scale_cooldown_s is None:
+            scale_cooldown_s = float(cfg.get(
+                "serving.scale_cooldown_s", 5.0))
+        self.min_replicas = int(replicas if min_replicas is None
+                                else min_replicas)
+        self.max_replicas = int(replicas if max_replicas is None
+                                else max_replicas)
+        if self.min_replicas > self.max_replicas:
+            raise ValueError(
+                f"min_replicas {self.min_replicas} > max_replicas "
+                f"{self.max_replicas}")
+        self.autoscale = self.max_replicas > self.min_replicas
+        self.scale_up_queue_depth = int(scale_up_queue_depth)
+        self.scale_up_latency_p50_ms = float(scale_up_latency_p50_ms)
+        self.scale_up_sustain_s = float(scale_up_sustain_s)
+        self.scale_down_idle_s = float(scale_down_idle_s)
+        self.scale_cooldown_s = float(scale_cooldown_s)
+        self.autoscale_interval_s = float(autoscale_interval_s)
+        # hysteresis state: when each condition STARTED holding
+        self._pressure_since: Optional[float] = None
+        self._idle_since: Optional[float] = None
+        self._last_scale_at = 0.0
+        self._last_autoscale_poll = 0.0
+        #: [(unix time, fleet size, reason)] — every size change,
+        #: including the initial spawn; the acceptance trajectory
+        self.replica_trajectory: List[Tuple[float, int, str]] = []
+        self.scale_events: List[Dict] = []
+        # ``replicas`` is the INITIAL size, clamped into bounds
+        self.replicas = min(max(self.replicas, self.min_replicas),
+                            self.max_replicas)
         self._state_dir = run_dir or tempfile.mkdtemp(
             prefix="zoo-serving-supervisor-")
         os.makedirs(self._state_dir, exist_ok=True)
@@ -191,6 +275,14 @@ class ServingSupervisor:
             "serving_replica_exits_total",
             "replica exits observed, by classified exit code",
             labels=("class",))
+        self._m_fleet = reg.gauge(
+            "serving_fleet_replicas",
+            "live (non-retiring) serving replicas the autoscaler is "
+            "holding the fleet at")
+        self._m_scale = reg.counter(
+            "serving_scale_events_total",
+            "autoscaler scale decisions", labels=("direction",))
+        self._record_fleet_size("initial")
 
     # -------------------------------------------------------------- control
     def stop(self) -> None:
@@ -253,6 +345,21 @@ class ServingSupervisor:
         r.proc = None
         r.last_exit = code
         killed, r.kill_reason = r.kill_reason, None
+        if r.retiring:
+            # scale-down retirement: whatever the exit code, this slot
+            # is finished — respawning it would undo the scale
+            # decision.  (A non-zero exit during drain is logged: the
+            # records it read are in the PEL for its peers.)
+            r.done = True
+            if code == 0:
+                log.info("replica %d retired (scale-down drain, "
+                         "exit 0)", r.index)
+            else:
+                log.warning("replica %d exited %d during scale-down "
+                            "drain; peers will reclaim its PEL",
+                            r.index, code)
+            self._m_exits.labels("retired").inc()
+            return
         cls = ("killed_by_supervisor" if killed
                else "degraded" if code == DEGRADED_EXIT_CODE
                else classify_exit(code))
@@ -322,6 +429,213 @@ class ServingSupervisor:
                               path)
         raise DegradedTraining(record["reason"], result=record)
 
+    # ------------------------------------------------------------ autoscale
+    def _fleet_size(self) -> int:
+        """The live fleet: slots that are neither finished nor on
+        their way out (a retiring replica still drains, but traffic
+        planning must not count it)."""
+        return sum(1 for r in self._replicas
+                   if not r.done and not r.degraded and not r.retiring)
+
+    def _record_fleet_size(self, reason: str) -> None:
+        size = self._fleet_size()
+        self._m_fleet.set(size)
+        if not self.replica_trajectory \
+                or self.replica_trajectory[-1][1] != size:
+            self.replica_trajectory.append(
+                (time.time(), size, reason))
+
+    def _replica_gauges(self, r: _Replica) -> Dict:
+        """One replica's ``/metrics.json`` snapshot sections (gauges +
+        histograms); {} when unreachable — the autoscaler treats a
+        silent replica as contributing no signal, and the health loop
+        separately decides whether it is dead."""
+        if r.port is None:
+            return {}
+        from urllib import request as urlrequest
+        try:
+            with urlrequest.urlopen(
+                    f"http://127.0.0.1:{r.port}/metrics.json",
+                    timeout=1.0) as resp:
+                return json.loads(resp.read().decode())
+        except Exception:   # noqa: BLE001 — probe, not a failure
+            return {}
+
+    def _healthz_error_rate(self, r: _Replica) -> bool:
+        """Whether this replica's /healthz 503s for ``error_rate`` —
+        the one signal that must HOLD scale-up (more replicas on a
+        poisoned stream amplify the poison)."""
+        if r.port is None:
+            return False
+        from urllib import error as urlerror
+        from urllib import request as urlrequest
+        try:
+            with urlrequest.urlopen(
+                    f"http://127.0.0.1:{r.port}/healthz",
+                    timeout=1.0):
+                return False
+        except urlerror.HTTPError as e:
+            try:
+                reason = json.loads(e.read().decode()).get("reason")
+            except Exception:   # noqa: BLE001
+                reason = None
+            finally:
+                e.close()
+            return reason == "error_rate"
+        except (urlerror.URLError, OSError):
+            return False
+
+    def _collect_signals(self) -> Dict:
+        """Fleet-wide scale signals from the replicas' own exported
+        metrics: max queue depth (every replica sees the same shared
+        stream, so max ≈ truth even mid-scrape), max batch fill, max
+        p50 request latency.  The error-rate /healthz probe is NOT
+        taken here — it only matters when a scale-up is about to
+        fire, so ``_autoscale`` checks it lazily at that moment
+        instead of paying a second per-replica round trip every
+        interval."""
+        queue = fill = p50_ms = 0.0
+        saw_metrics = False
+        for r in self._replicas:
+            if r.proc is None or r.done or r.degraded or r.retiring:
+                continue
+            snap = self._replica_gauges(r)
+            if not snap:
+                continue
+            saw_metrics = True
+            gauges = snap.get("gauges", {})
+            queue = max(queue,
+                        float(gauges.get("serving_queue_depth", 0.0)))
+            fill = max(fill, float(gauges.get(
+                "serving_batch_fill_ratio", 0.0)))
+            hist = snap.get("histograms", {}).get(
+                "serving_request_latency_seconds")
+            if hist:
+                p50_ms = max(p50_ms,
+                             float(hist.get("p50") or 0.0) * 1000.0)
+        return {"queue": queue, "fill": fill, "p50_ms": p50_ms,
+                "saw_metrics": saw_metrics}
+
+    def _error_rate_hold(self) -> bool:
+        """Lazy scale-up gate: does ANY live replica 503 for
+        error_rate right now?  Only called when a scale-up is
+        otherwise ready to fire."""
+        return any(
+            self._healthz_error_rate(r) for r in self._replicas
+            if r.proc is not None and not r.done and not r.degraded
+            and not r.retiring)
+
+    def _scale_down_allowed(self) -> bool:
+        """Scale-down is only trusted when every live replica's last
+        /healthz probe was a plain 200: a warming replica (503
+        warming_up, queue gauge frozen at boot) or a breaker-open one
+        (broker invisible) cannot vouch that the backlog is really
+        empty — retiring capacity on their say-so is the cold-boot
+        scale-to-floor failure mode."""
+        live = [r for r in self._replicas
+                if r.proc is not None and not r.done
+                and not r.degraded and not r.retiring]
+        return bool(live) and all(r.last_health == "ok"
+                                  for r in live)
+
+    def _autoscale(self, now: float) -> None:
+        if not self.autoscale or self._stop.is_set():
+            return
+        if now - self._last_autoscale_poll < self.autoscale_interval_s:
+            return
+        self._last_autoscale_poll = now
+        sig = self._collect_signals()
+        if not sig["saw_metrics"]:
+            # nobody reachable yet (cold fleet / every port pending):
+            # no evidence, no decision — hysteresis clocks reset so a
+            # blind window can never accumulate into a scale event
+            self._pressure_since = self._idle_since = None
+            return
+        pressure = sig["queue"] > self.scale_up_queue_depth or (
+            self.scale_up_latency_p50_ms > 0
+            and sig["p50_ms"] > self.scale_up_latency_p50_ms)
+        # idle keys on the live backlog alone: the fill gauge holds
+        # the LAST batch's ratio, so a full final batch would read
+        # stale-high forever and wedge scale-down.  Fill still rides
+        # every scale event's signal record for operator forensics.
+        idle = sig["queue"] <= 0
+        self._pressure_since = (
+            None if not pressure
+            else self._pressure_since if self._pressure_since
+            is not None else now)
+        self._idle_since = (
+            None if not idle
+            else self._idle_since if self._idle_since
+            is not None else now)
+        in_cooldown = now - self._last_scale_at < self.scale_cooldown_s
+        size = self._fleet_size()
+        if pressure and size < self.max_replicas and not in_cooldown \
+                and now - self._pressure_since \
+                >= self.scale_up_sustain_s:
+            if self._error_rate_hold():
+                log.warning(
+                    "autoscaler: scale-up held — a replica 503s "
+                    "error_rate (queue=%.0f); more replicas would "
+                    "amplify a poisoned stream", sig["queue"])
+                return
+            self._scale_up(now, sig)
+        elif idle and size > self.min_replicas and not in_cooldown \
+                and now - self._idle_since >= self.scale_down_idle_s \
+                and self._scale_down_allowed():
+            self._scale_down(now, sig)
+
+    def _scale_up(self, now: float, sig: Dict) -> None:
+        index = len(self._replicas)
+        r = _Replica(index=index,
+                     port_file=os.path.join(self._state_dir,
+                                            f"replica-{index}.port"),
+                     budget=RetryBudget(self.retry_times,
+                                        self.retry_window_s))
+        self._replicas.append(r)
+        self._spawn(r)
+        self._last_scale_at = now
+        self._pressure_since = None
+        self._m_scale.labels("up").inc()
+        self.scale_events.append({
+            "direction": "up", "replica": index,
+            "fleet": self._fleet_size(), "signals": sig})
+        self._record_fleet_size("scale_up")
+        log.warning(
+            "autoscaler: scale UP → replica %d spawned (fleet %d, "
+            "queue=%.0f, p50=%.0fms)", index, self._fleet_size(),
+            sig["queue"], sig["p50_ms"])
+
+    def _scale_down(self, now: float, sig: Dict) -> None:
+        """Retire the highest-index live replica via the SIGTERM
+        drain contract: it finishes + acks in-flight batches, flushes
+        metrics, and exits 0 — and is never restarted."""
+        victim = None
+        for r in reversed(self._replicas):
+            if r.proc is not None and r.proc.poll() is None \
+                    and not r.retiring and not r.done \
+                    and not r.degraded:
+                victim = r
+                break
+        if victim is None:
+            return
+        victim.retiring = True
+        # a retiring replica leaves the health/heartbeat watchdog, so
+        # it needs its own wedge guard: past the drain window it is
+        # SIGKILLed by _tick (same escalation drain_fleet applies)
+        victim.retire_deadline = now + self.drain_timeout_s
+        victim.proc.terminate()
+        self._last_scale_at = now
+        self._idle_since = None
+        self._m_scale.labels("down").inc()
+        self.scale_events.append({
+            "direction": "down", "replica": victim.index,
+            "fleet": self._fleet_size(), "signals": sig})
+        self._record_fleet_size("scale_down")
+        log.warning(
+            "autoscaler: scale DOWN → replica %d draining (fleet %d, "
+            "idle %.1fs)", victim.index, self._fleet_size(),
+            self.scale_down_idle_s)
+
     # ---------------------------------------------------------- health
     def _probe(self, r: _Replica) -> str:
         """One /healthz probe: ``ok`` | ``not_ready`` (503 — alive) |
@@ -352,6 +666,7 @@ class ServingSupervisor:
             return
         r.last_health_at = now
         status = self._probe(r)
+        r.last_health = status
         if status in ("ok", "not_ready"):
             r.health_fails = 0
         elif status == "unreachable":
@@ -413,10 +728,21 @@ class ServingSupervisor:
             code = r.proc.poll()
             if code is None:
                 alive += 1
-                self._poll_health(r, now)
+                if r.retiring:
+                    if now >= r.retire_deadline:
+                        log.warning(
+                            "replica %d ignored SIGTERM for %.0fs "
+                            "during scale-down; escalating to "
+                            "SIGKILL", r.index, self.drain_timeout_s)
+                        r.proc.kill()
+                        r.retire_deadline = now + 2.0   # reap window
+                else:
+                    self._poll_health(r, now)
             else:
                 self._handle_exit(r, code)
         self._m_running.set(alive)
+        self._autoscale(now)
+        self._record_fleet_size("tick")
 
     def run(self, poll_interval_s: float = 0.25) -> Dict:
         """Supervise until drained; returns the fleet summary.  Raises
@@ -486,7 +812,7 @@ class ServingSupervisor:
         return codes
 
     def summary(self) -> Dict:
-        return {
+        out = {
             "replicas": self.replicas,
             "restarts_total": self.restarts_total,
             "done": [r.index for r in self._replicas if r.done],
@@ -495,6 +821,13 @@ class ServingSupervisor:
             "exit_codes": {r.index: r.last_exit
                            for r in self._replicas},
         }
+        if self.autoscale:
+            out["min_replicas"] = self.min_replicas
+            out["max_replicas"] = self.max_replicas
+            out["scale_events"] = list(self.scale_events)
+            out["replica_trajectory"] = [
+                size for _t, size, _r in self.replica_trajectory]
+        return out
 
 
 def supervisor_main(argv=None) -> int:
@@ -516,14 +849,32 @@ def supervisor_main(argv=None) -> int:
     p.add_argument("--retry-times", type=int, default=None)
     p.add_argument("--retry-window-s", type=float, default=None)
     p.add_argument("--drain-timeout-s", type=float, default=30.0)
+    p.add_argument("--min-replicas", type=int, default=None,
+                   help="autoscaler floor (default config "
+                        "params.min_replicas; equal to --replicas "
+                        "disables autoscaling)")
+    p.add_argument("--max-replicas", type=int, default=None,
+                   help="autoscaler ceiling (default config "
+                        "params.max_replicas)")
     args = p.parse_args(argv)
 
     from analytics_zoo_tpu.serving.server import ServingConfig
     cfg = (ServingConfig.from_yaml(args.config)
            if os.path.exists(args.config) else ServingConfig())
+
+    def _cfg_int(key):
+        v = cfg.extra.get(key)
+        return int(v) if v not in (None, "") else None
+
     replicas = args.replicas
     if replicas is None:
-        replicas = int(cfg.extra.get("params.replicas") or 3)
+        replicas = _cfg_int("params.replicas") or 3
+    min_replicas = (args.min_replicas
+                    if args.min_replicas is not None
+                    else _cfg_int("params.min_replicas"))
+    max_replicas = (args.max_replicas
+                    if args.max_replicas is not None
+                    else _cfg_int("params.max_replicas"))
     group = (args.consumer_group or cfg.consumer_group or "serving")
     sup = ServingSupervisor(
         cli_worker_factory(args.config, consumer_group=group),
@@ -531,7 +882,9 @@ def supervisor_main(argv=None) -> int:
         retry_times=args.retry_times,
         retry_window_s=args.retry_window_s,
         run_dir=args.run_dir,
-        drain_timeout_s=args.drain_timeout_s)
+        drain_timeout_s=args.drain_timeout_s,
+        min_replicas=min_replicas,
+        max_replicas=max_replicas)
     with degraded_exit():
         summary = sup.run()
     print(json.dumps(summary), flush=True)

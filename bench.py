@@ -22,6 +22,11 @@ records it):
   config 4; ref examples/inception/Train.scala over tfpark).
 * ``serving`` / ``attention`` — cluster-serving throughput (config 5)
   and the Pallas flash-attention long-context kernel.
+* ``serving_engine`` — the v2 engine closed-loop bench: N clients in
+  submit-wait-submit loops over BOTH transports (Redis bulk + HTTP
+  fast path) against one continuously-batching worker; emits
+  per-transport p50/p99 request latency and the achieved batch fill
+  ratio.
 
 Prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline", ...}``
 on success, or a diagnostic JSON line (``"error"`` key, value 0) on
@@ -572,6 +577,141 @@ def bench_serving(n_records: int = 2048, batch_size: int = 32):
     }
 
 
+# ----------------------------------------------------------- serving_engine
+def bench_serving_engine(n_records: int = 1024, batch_size: int = 16,
+                         closed_loop_clients: int = 8):
+    """Serving engine v2 closed-loop bench: N client threads each
+    submit one record and wait for its result before submitting the
+    next — the latency-facing workload shape, vs bench_serving's
+    pre-filled open-loop stream.  Two transports against ONE worker:
+
+    * the Redis bulk path (enqueue → stream → continuous batcher →
+      result poll) over the embedded broker,
+    * the HTTP/JSON fast path (POST /predict → same batcher → same
+      device batch → response on the connection).
+
+    Emits per-transport p50/p99 request latency, throughput, and the
+    batch fill ratio the continuous batcher achieved under the
+    closed-loop load (registry gauge → bench_metrics.json)."""
+    import threading
+
+    import jax
+
+    from analytics_zoo_tpu.models.image.imageclassification import resnet
+    from analytics_zoo_tpu.observability import get_registry
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.serving.client import (
+        InputQueue, OutputQueue, ServingHttpClient)
+    from analytics_zoo_tpu.serving.redis_client import EmbeddedBroker
+    from analytics_zoo_tpu.serving.server import ClusterServing, \
+        ServingConfig
+
+    model = resnet(18, num_classes=1000, input_shape=(64, 64, 3))
+    model.init()
+    im = InferenceModel().load_zoo(model)
+    broker = EmbeddedBroker()
+    serving = ClusterServing(
+        im, ServingConfig(batch_size=batch_size, top_n=5,
+                          http_port=0, batch_max_wait_ms=2.0,
+                          input_shape=(64, 64, 3),
+                          metrics_host="127.0.0.1"),
+        broker=broker)
+    serving.warm_start()         # every bucket AOT-ready before timing
+    rs = np.random.RandomState(0)
+    record = rs.rand(64, 64, 3).astype(np.float32)
+
+    worker = threading.Thread(target=serving.run,
+                              kwargs={"poll_ms": 5}, daemon=True)
+    worker.start()
+
+    def closed_loop(n_total, submit_and_wait):
+        """Drive n_total records through `submit_and_wait` from
+        closed_loop_clients threads; returns (wall_s, latencies)."""
+        lat, errs = [], []
+        lock = threading.Lock()
+        counter = iter(range(n_total))
+
+        def client(cid):
+            while True:
+                with lock:
+                    i = next(counter, None)
+                if i is None:
+                    return
+                t0 = time.perf_counter()
+                try:
+                    submit_and_wait(cid, i)
+                except Exception as e:   # noqa: BLE001 — count + go on
+                    errs.append(e)
+                    continue
+                lat.append(time.perf_counter() - t0)
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(closed_loop_clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.perf_counter() - t0, sorted(lat), errs
+
+    def pct(lat, p):
+        return (lat[min(int(p / 100 * len(lat)), len(lat) - 1)] * 1e3
+                if lat else 0.0)
+
+    # ---- HTTP fast path (closed loop; transport latency = response)
+    http = ServingHttpClient(
+        f"http://127.0.0.1:{serving.http_transport.port}")
+    http.predict_http("default", record)          # connection warm-up
+    http_wall, http_lat, http_errs = closed_loop(
+        n_records, lambda cid, i: http.predict_http("default", record))
+
+    # ---- Redis bulk path (closed loop: enqueue then poll the result)
+    inq = InputQueue(broker=broker)
+    outq = OutputQueue(broker=broker)
+
+    def redis_roundtrip(cid, i):
+        uri = f"cl-{cid}-{i}"
+        inq.enqueue(uri, record)
+        if outq.query(uri, timeout_s=60.0) is None:
+            raise RuntimeError(f"no result for {uri}")
+    redis_wall, redis_lat, redis_errs = closed_loop(
+        n_records, redis_roundtrip)
+
+    fill = get_registry().gauge(
+        "serving_batch_fill_ratio",
+        "real records / batch capacity of the last served batch")
+    fill_ratio = float(fill.value)
+    serving.stop()
+    worker.join(timeout=15)
+
+    dev = jax.devices()[0]
+    http_rps = len(http_lat) / max(http_wall, 1e-9)
+    redis_rps = len(redis_lat) / max(redis_wall, 1e-9)
+    return {
+        "metric": "serving_engine_http_throughput",
+        "value": round(http_rps, 1),
+        "unit": "records/sec/chip",
+        "vs_baseline": None,
+        "workload": "serving_engine",
+        "n_records": n_records,
+        "closed_loop_clients": closed_loop_clients,
+        "batch_size": batch_size,
+        "batch_buckets": list(
+            serving.engine.registry.get("default").buckets),
+        "batch_max_wait_ms": serving.config.batch_max_wait_ms,
+        "http_rps": round(http_rps, 1),
+        "http_latency_p50_ms": round(pct(http_lat, 50), 2),
+        "http_latency_p99_ms": round(pct(http_lat, 99), 2),
+        "http_errors": len(http_errs),
+        "redis_rps": round(redis_rps, 1),
+        "redis_latency_p50_ms": round(pct(redis_lat, 50), 2),
+        "redis_latency_p99_ms": round(pct(redis_lat, 99), 2),
+        "redis_errors": len(redis_errs),
+        "batch_fill_ratio": round(fill_ratio, 3),
+        "device": str(dev),
+        "device_kind": getattr(dev, "device_kind", "?"),
+    }
+
+
 # ----------------------------------------------------------- input_pipeline
 def bench_input_pipeline(n_samples: int = 4096, batch_size: int = 128,
                          image_hw: int = 32):
@@ -667,6 +807,7 @@ WORKLOADS = {
     "ncf": bench_ncf,
     "resnet50": bench_resnet50,
     "serving": bench_serving,
+    "serving_engine": bench_serving_engine,
     "attention": bench_attention,
     "wide_deep": bench_wide_deep,
     "inception": bench_inception,
@@ -679,6 +820,7 @@ METRIC_NAMES = {
     "ncf": "ncf_movielens1m_train_throughput",
     "resnet50": "resnet50_imagenet_train_throughput",
     "serving": "cluster_serving_throughput",
+    "serving_engine": "serving_engine_http_throughput",
     "attention": "flash_attention_tokens_per_sec",
     "wide_deep": "wide_deep_census_train_throughput",
     "inception": "inception_v1_tfpark_train_throughput",

@@ -36,12 +36,19 @@ POISON_EXIT_CODE = 11
 
 class PoisonSensitiveModel:
     """Numpy stand-in for an InferenceModel whose predict DIES on the
-    magic poison payload (the crash class the quarantine exists for)."""
+    magic poison payload (the crash class the quarantine exists for).
+    ``predict_delay`` simulates device time per batch so autoscaler
+    tests can build sustained queue pressure against a fast model."""
+
+    def __init__(self, predict_delay: float = 0.0):
+        self.predict_delay = float(predict_delay)
 
     def predict(self, x, batch_size=None):
         x = np.asarray(x, dtype=np.float32)
         if np.any(np.abs(x) > POISON_THRESHOLD):
             os._exit(POISON_EXIT_CODE)
+        if self.predict_delay > 0:
+            time.sleep(self.predict_delay)
         return np.tile(np.arange(4, dtype=np.float32), (len(x), 1))
 
 
@@ -61,6 +68,7 @@ def main(argv=None) -> int:
     p.add_argument("--reclaim-min-idle-ms", type=int, default=300)
     p.add_argument("--request-deadline-ms", type=int, default=0)
     p.add_argument("--start-delay", type=float, default=0.0)
+    p.add_argument("--predict-delay", type=float, default=0.0)
     args = p.parse_args(argv)
 
     if args.start_delay > 0:
@@ -78,7 +86,8 @@ def main(argv=None) -> int:
         request_deadline_ms=args.request_deadline_ms,
         metrics_port=0,               # /healthz on an ephemeral port,
         metrics_host="127.0.0.1")     # published via the port file
-    serving = ClusterServing(PoisonSensitiveModel(), cfg)
+    serving = ClusterServing(
+        PoisonSensitiveModel(predict_delay=args.predict_delay), cfg)
     serving.install_signal_handlers()     # SIGTERM -> graceful drain
     serving.run(poll_ms=50)
     return 0
