@@ -110,14 +110,28 @@ def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
     float(mloss)                       # D2H sync — see module docstring
     compile_s = time.time() - t_compile
 
+    # Repeat discipline (BENCH_r05 showed a 2.3s/2.3s/5.4s tail
+    # outlier — deferred work billed to whichever repeat ran last):
+    # every repeat window is SYMMETRIC — block_until_ready on the full
+    # output tree before t0 (nothing from the previous dispatch can
+    # leak in) AND before the window closes (nothing this repeat
+    # started can leak out), with the float(mloss) D2H read kept as the
+    # can't-return-early anchor (block_until_ready alone proved
+    # unreliable over the tunneled backend, see module docstring).  One
+    # extra WARMUP repeat runs first and is discarded — it absorbs
+    # one-time tails (executable-cache writes, allocator warm-up) the
+    # post-compile run doesn't fully drain.
     walls = []
-    for r in range(repeats):
+    for r in range(repeats + 1):
+        jax.block_until_ready((params, opt_state, state))
         t0 = time.time()
         params, opt_state, state, mloss = compiled(
             params, opt_state, state, x_dev, y_dev,
             jax.random.fold_in(rng, r))
         loss_val = float(mloss)        # D2H sync
+        jax.block_until_ready((params, opt_state, state))
         walls.append(time.time() - t0)
+    warmup_wall, walls = walls[0], walls[1:]
     wall = min(walls)
 
     if trace_dir:
@@ -170,6 +184,7 @@ def run_resnet_bench(device, batch_size: int = 128, image_size: int = 224,
         "scan_steps": scan_steps,
         "repeats": repeats,
         "wall_s_per_repeat": [round(w, 3) for w in walls],
+        "warmup_repeat_wall_s": round(warmup_wall, 3),
         "compile_time_s": round(compile_s, 2),
         "compute_dtype": compute_dtype,
         "stem": stem,

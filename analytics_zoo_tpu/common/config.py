@@ -27,6 +27,11 @@ _DEFAULTS: Dict[str, Any] = {
     "dtype.compute": "bfloat16",
     # Matmul precision passed to jax ops ("default"|"high"|"highest").
     "dtype.matmul_precision": "default",
+    # Fused kernel suite (ops/fused.py): "auto" = Pallas kernels when
+    # the backend compiles them (one eager capability probe), lax
+    # otherwise; "lax" forces the lax forms; "off" disables the suite
+    # (call sites revert to their unfused pre-suite paths).
+    "ops.fused": "auto",
     # Mesh / distribution ---------------------------------------------
     # Default mesh shape; "auto" = all devices on the data axis,
     # else "data:4,model:2"-style axis sizes.
@@ -58,6 +63,13 @@ _DEFAULTS: Dict[str, Any] = {
     # activations — a win when the step is HBM-bandwidth-bound, and
     # the standard lever for fitting longer sequences / bigger batches.
     "train.remat": False,
+    # Fused optimizer update (ops/fused.py): grad clip + moment update
+    # + param apply in one pass per leaf — replaces the optax
+    # global_norm → update → apply_updates triple traversal (three full
+    # HBM sweeps of params+grads) for SGD/Adam.  Numerically the optax
+    # step (tests/test_fused_kernels.py); unsupported combinations
+    # (optimizer groups, other optimizers) fall back automatically.
+    "train.fused_optimizer": True,
     # Resilience -------------------------------------------------------
     # Elastic recovery: on a classified lost-host failure, re-form the
     # device mesh on the surviving topology, reshard, and resume from

@@ -44,6 +44,16 @@ class ZooModel:
     def summary(self):
         return self.model.summary()
 
+    def quantize(self, calib_data, **kwargs):
+        """Calibrated int8 conversion (KerasNet.quantize): after this,
+        predict/recommend/serving run the int8 MXU path end-to-end."""
+        self.model.quantize(calib_data, **kwargs)
+        return self
+
+    @property
+    def is_quantized(self) -> bool:
+        return self.model.is_quantized
+
     def get_variables(self):
         return self.model.get_variables()
 

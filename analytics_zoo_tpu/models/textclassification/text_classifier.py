@@ -1,11 +1,19 @@
 """Text classifier (ref:
 zoo/models/textclassification/TextClassifier.scala:34-192): embedding →
-encoder (CNN / LSTM / GRU) → dense head."""
+encoder (CNN / LSTM / GRU / transformer) → dense head.
+
+The ``transformer`` encoder is the long-context opt-in: its
+self-attention routes through ``parallel/ring_attention.py`` whenever
+the mesh's ``seq`` axis is populated (MultiHeadSelfAttention's "auto"
+sequence parallelism), so sequence length scales across the ICI ring
+instead of capping at one chip's HBM — the capability the reference's
+single-node encoders lack."""
 
 from __future__ import annotations
 
 from typing import Optional
 
+import jax.numpy as jnp
 import numpy as np
 
 from analytics_zoo_tpu.models.common import ZooModel
@@ -18,13 +26,18 @@ from analytics_zoo_tpu.pipeline.api.keras.layers import (
 
 class TextClassifier(ZooModel):
     """encoder: "cnn" | "lstm" | "gru" (TextClassifier.scala encoder
-    arg); with optional pretrained glove embeddings."""
+    arg) | "transformer" (long-context self-attention; ring-parallel
+    over a populated ``seq`` mesh axis); with optional pretrained
+    glove embeddings.  ``n_head``/``n_block`` apply to the transformer
+    encoder only; its width is ``token_length`` (residual stream), the
+    head keeps ``encoder_output_dim``."""
 
     def __init__(self, class_num: int, token_length: int = 200,
                  sequence_length: int = 500, encoder: str = "cnn",
                  encoder_output_dim: int = 256,
                  max_words_num: int = 5000,
-                 embedding_matrix: Optional[np.ndarray] = None):
+                 embedding_matrix: Optional[np.ndarray] = None,
+                 n_head: int = 4, n_block: int = 1):
         self.class_num = int(class_num)
         self.token_length = int(token_length)
         self.sequence_length = int(sequence_length)
@@ -32,6 +45,13 @@ class TextClassifier(ZooModel):
         self.encoder_output_dim = int(encoder_output_dim)
         self.max_words_num = int(max_words_num)
         self.embedding_matrix = embedding_matrix
+        self.n_head = int(n_head)
+        self.n_block = int(n_block)
+        if self.encoder == "transformer" and \
+                self.token_length % self.n_head:
+            raise ValueError(
+                f"token_length {self.token_length} must divide into "
+                f"n_head {self.n_head} heads")
         super().__init__()
 
     def build_model(self):
@@ -49,10 +69,46 @@ class TextClassifier(ZooModel):
             x = LSTM(self.encoder_output_dim)(x)
         elif self.encoder == "gru":
             x = GRU(self.encoder_output_dim)(x)
+        elif self.encoder == "transformer":
+            x = self._transformer_encoder(inp, x)
         else:
             raise ValueError(f"unknown encoder {self.encoder!r}; "
-                             "use cnn|lstm|gru")
+                             "use cnn|lstm|gru|transformer")
         x = Dropout(0.2)(x)
         x = Dense(128, activation="relu")(x)
         out = Dense(self.class_num)(x)
         return Model(inp, out)
+
+    def _transformer_encoder(self, inp, x):
+        """Learned positions + ``n_block`` encoder blocks + max-pool +
+        a fused LayerNorm→GeLU projection head.  Attention is
+        MultiHeadSelfAttention with "auto" parallelism: on a mesh with
+        ``seq`` > 1 it computes via the ppermute ring
+        (parallel/ring_attention.py) — sequence sharded over ICI —
+        and single-device it takes the flash/dense kernel."""
+        from analytics_zoo_tpu.pipeline.api.keras.layers.attention import (
+            transformer_block)
+        from analytics_zoo_tpu.pipeline.api.keras.layers.core import (
+            Lambda)
+        from analytics_zoo_tpu.pipeline.api.keras.layers.normalization \
+            import LayerNorm
+        d = self.token_length
+        # position ids derived in-graph from the token input (no extra
+        # model input): iota over the sequence axis
+        pos_ids = Lambda(
+            lambda t: jnp.broadcast_to(
+                jnp.arange(t.shape[1], dtype=jnp.int32)[None, :],
+                t.shape),
+            output_shape=(self.sequence_length,))(inp)
+        pos_e = Embedding(self.sequence_length, d,
+                          init="normal")(pos_ids)
+        from analytics_zoo_tpu.pipeline.api.keras.layers.merge import (
+            Merge)
+        x = Merge(mode="sum")([x, pos_e])
+        for _ in range(self.n_block):
+            x = transformer_block(x, None, d, self.n_head, 4 * d,
+                                  dropout=0.1, causal=False)
+        x = GlobalMaxPooling1D()(x)
+        # fused LayerNorm→GeLU epilogue (ops/fused.py layernorm_act)
+        x = LayerNorm(activation="gelu")(x)
+        return Dense(self.encoder_output_dim, activation="relu")(x)

@@ -1,10 +1,13 @@
 """Pallas flash-attention kernels for TPU — forward AND backward.
 
-Single-chip long-context attention: O(T·Tb) VMEM instead of the O(T²)
-logits matrix XLA materialises for plain attention.  Pairs with
-parallel/ring_attention.py (across-chip SP): ring handles the
-inter-chip blocks, this kernel is what each chip should run on its
-local block.
+Part of the fused kernel suite (ops/fused.py holds the elementwise /
+reduction half — fused optimizer update, bias→GeLU, LayerNorm→act —
+and the shared ``pallas_supported()`` capability probe that gates all
+Pallas routing).  Single-chip long-context attention: O(T·Tb) VMEM
+instead of the O(T²) logits matrix XLA materialises for plain
+attention.  Pairs with parallel/ring_attention.py (across-chip SP):
+ring handles the inter-chip blocks, this kernel is what each chip
+should run on its local block.
 
 The public ``flash_attention`` is differentiable: a ``custom_vjp``
 routes the backward through two Pallas kernels (the standard

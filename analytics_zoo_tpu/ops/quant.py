@@ -15,8 +15,11 @@ model object serves f32 and int8.
 
 from __future__ import annotations
 
+from typing import Dict
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def quantize_activation(x, act_scale):
@@ -92,3 +95,64 @@ def quantized_conv(x, kernel_q, kernel_scale, act_scale, *, strides,
         rhs_dilation=rhs_dilation,
         dimension_numbers=dimension_numbers,
         feature_group_count=feature_group_count)
+
+
+# -------------------------------------------------- model-level workflow
+def calibrate_model(model, calib_data, batch_size: int = 32,
+                    max_batches: int = 8) -> Dict[str, float]:
+    """Calibration pass: run eager forwards over ``calib_data``
+    recording each layer's input absmax via the engine's activation
+    taps (ref InferenceModel.scala:400-421's OpenVINO calibration
+    role).  ``calib_data`` is an ndarray/pytree-of-columns or a
+    FeatureSet; returns ``{layer_name: max |input|}``."""
+    from analytics_zoo_tpu.feature.feature_set import FeatureSet
+    from analytics_zoo_tpu.pipeline.api.keras.engine import (
+        record_activations)
+    variables = model.get_variables()
+    if isinstance(calib_data, FeatureSet):
+        batches = (b[0] for b in calib_data.epoch_batches(
+            0, batch_size, train=False))
+    else:
+        n = len(jax.tree_util.tree_leaves(calib_data)[0])
+        batches = (jax.tree_util.tree_map(
+            lambda a: a[i:i + batch_size], calib_data)
+            for i in range(0, n, batch_size))
+    ranges: Dict[str, float] = {}
+    with record_activations() as taps:
+        for i, xb in enumerate(batches):
+            if i >= max_batches:
+                break
+            model.apply(variables["params"], xb,
+                        state=variables["state"], training=False)
+        ranges.update(taps)
+    return ranges
+
+
+def quantize_model(variables, act_ranges, min_size: int = 1024):
+    """Produce the params-driven int8 layout from calibrated ranges:
+    per-layer int8 ``kernel`` + per-output-channel ``kernel_scale``
+    (keepdims — shape ``(1, ..., out)``) + symmetric scalar
+    ``act_scale``.  Layers whose params carry those keys execute
+    ``quantized_matmul``/``quantized_conv`` natively (Dense/conv
+    ``call``); everything else is untouched — the same model object
+    serves f32 and int8."""
+    params = variables["params"]
+    qparams = {}
+    for lname, p in params.items():
+        qp = dict(p) if isinstance(p, dict) else p
+        k = p.get("kernel") if isinstance(p, dict) else None
+        rng_max = act_ranges.get(lname, 0.0)
+        if k is not None and rng_max > 0.0:
+            arr = np.asarray(k)
+            if (arr.dtype == np.float32 and arr.ndim >= 2
+                    and arr.size >= min_size):
+                axes = tuple(range(arr.ndim - 1))
+                w_scale = np.maximum(
+                    np.max(np.abs(arr), axis=axes, keepdims=True)
+                    / 127.0, 1e-12).astype(np.float32)
+                qp["kernel"] = np.clip(
+                    np.round(arr / w_scale), -127, 127).astype(np.int8)
+                qp["kernel_scale"] = w_scale
+                qp["act_scale"] = np.float32(max(rng_max / 127.0, 1e-12))
+        qparams[lname] = qp
+    return {"params": qparams, "state": variables["state"]}

@@ -126,6 +126,18 @@ class DistributedTrainer:
         self.donate = bool(cfg.get("train.donate"))
         self.remat = bool(cfg.get("train.remat"))
         self.grad_sync_dtype = str(cfg.get("train.grad_sync_dtype"))
+        # fused optimizer update (ops/fused.py): clip + moment update +
+        # param apply in ONE pass per leaf instead of the optax
+        # global_norm → update → apply_updates triple traversal (three
+        # full HBM sweeps of params+grads).  None = unsupported
+        # (optimizer groups, exotic transform, or train.fused_optimizer
+        # off) — the optax path below stays the source of truth.
+        self._fused_update = None
+        if (bool(cfg.get("train.fused_optimizer", True))
+                and not self.optim_groups and self.optim is not None):
+            from analytics_zoo_tpu.ops.fused import build_fused_update
+            self._fused_update = build_fused_update(self.optim,
+                                                    self.clip)
         self._train_step = None
         self._train_step_at = None
         self._eval_step = None
@@ -262,6 +274,12 @@ class DistributedTrainer:
 
         return jax.tree_util.tree_map(fix, out)
 
+    @property
+    def fused_optimizer_active(self) -> bool:
+        """Whether steps run the single-pass fused update
+        (ops/fused.py) instead of the optax triple traversal."""
+        return self._fused_update is not None
+
     def _optimizer_update(self, grads, opt_state, params):
         if self.optim_groups:
             groups = _group_params(
@@ -321,9 +339,16 @@ class DistributedTrainer:
             grads = jax.tree_util.tree_map(
                 lambda g: g.astype(jnp.bfloat16).astype(jnp.float32),
                 grads)
-        grads = _apply_clipping(grads, clip)
-        new_params, new_opt_state = self._optimizer_update(
-            grads, opt_state, params)
+        if self._fused_update is not None:
+            # single-pass clip+moments+apply (ops/fused.py), numerically
+            # the optax triple pass below — proven by
+            # tests/test_fused_kernels.py
+            new_params, new_opt_state = self._fused_update(
+                grads, opt_state, params)
+        else:
+            grads = _apply_clipping(grads, clip)
+            new_params, new_opt_state = self._optimizer_update(
+                grads, opt_state, params)
         new_params = mask_frozen_params(model, params, new_params)
         return new_params, new_opt_state, new_state, loss
 

@@ -113,10 +113,14 @@ class MultiHeadSelfAttention(Layer):
         # flash kernel constraints: pallas_call is not GSPMD-partitionable,
         # so only auto-route on a trivial (single-device) mesh; K/V for one
         # (batch, head) must fit VMEM (~4k·128 floats, see pallas_attention)
-        # — training included now that the flash backward kernels exist
+        # — training included now that the flash backward kernels exist.
+        # Availability comes from the kernel suite's ONE capability
+        # probe (ops/fused.pallas_supported — does this backend compile
+        # Pallas?) instead of a backend-name string match.
+        from analytics_zoo_tpu.ops.fused import pallas_supported
         mesh_trivial = math.prod(_mesh().shape.values()) == 1
         use_flash = (not use_sp and mask is None and
-                     jax.default_backend() == "tpu" and mesh_trivial and
+                     pallas_supported() and mesh_trivial and
                      t % 256 == 0 and self.head_dim % 64 == 0 and
                      t * self.head_dim <= 4096 * 128)
         if use_flash:
@@ -194,9 +198,20 @@ class PositionwiseFeedForward(Layer):
         return params
 
     def call(self, params, x, training=False, rng=None):
-        h = _mm(x, params["up_kernel"]) + params["up_bias"]
-        if self.activation is not None:   # get() -> None means identity
-            h = self.activation(h)
+        up = _mm(x, params["up_kernel"])
+        if self.activation is acts.gelu:
+            # fused bias→GeLU epilogue (ops/fused.py) — the FFN tail
+            # without an HBM round trip of the intermediate; the lax
+            # form is exactly gelu(up + bias)
+            from analytics_zoo_tpu.ops import fused
+            if fused.fused_enabled():
+                h = fused.bias_gelu(up, params["up_bias"])
+            else:
+                h = acts.gelu(up + params["up_bias"])
+        else:
+            h = up + params["up_bias"]
+            if self.activation is not None:   # get()->None = identity
+                h = self.activation(h)
         return (_mm(h, params["down_kernel"]) +
                 params["down_bias"]).astype(x.dtype)
 

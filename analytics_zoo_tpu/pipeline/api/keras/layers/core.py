@@ -79,13 +79,19 @@ class Dense(Layer):
     def call(self, params, x, training=False, rng=None):
         if "kernel_scale" in params:
             # calibrated int8 path (ops/quant.py) — params-driven, set
-            # by InferenceModel quantization
+            # by model/InferenceModel quantization
             from analytics_zoo_tpu.ops.quant import quantized_matmul
             y = quantized_matmul(x, params["kernel"],
                                  params["kernel_scale"],
                                  params["act_scale"])
         else:
             y = _matmul(x, params["kernel"])
+        if self.use_bias and self.activation is acts.gelu:
+            # fused bias→GeLU epilogue (ops/fused.py); its lax form is
+            # exactly gelu(y + bias) — same numbers either way
+            from analytics_zoo_tpu.ops import fused
+            if fused.fused_enabled():
+                return fused.bias_gelu(y, params["bias"])
         if self.use_bias:
             y = y + params["bias"]
         if self.activation is not None:

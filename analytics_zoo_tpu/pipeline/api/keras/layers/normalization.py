@@ -92,11 +92,17 @@ class BatchNormalization(Layer):
 
 class LayerNorm(Layer):
     """Layer normalization over the last dim (transformer building block,
-    ref: keras/layers/ internal LayerNorm used by BERT.scala)."""
+    ref: keras/layers/ internal LayerNorm used by BERT.scala).
 
-    def __init__(self, epsilon: float = 1e-5, **kwargs):
+    ``activation`` fuses an elementwise epilogue (e.g. "gelu") into the
+    normalization via the kernel suite (ops/fused.py layernorm_act) —
+    one pass over the activation instead of LN→HBM→activation."""
+
+    def __init__(self, epsilon: float = 1e-5, activation=None, **kwargs):
         super().__init__(**kwargs)
         self.epsilon = float(epsilon)
+        from analytics_zoo_tpu.ops import activations as acts
+        self.activation = acts.get(activation)
 
     def build(self, rng, input_shape) -> Params:
         d = input_shape[-1]
@@ -106,10 +112,19 @@ class LayerNorm(Layer):
         return params
 
     def call(self, params, x, training=False, rng=None):
+        if self.activation is not None:
+            from analytics_zoo_tpu.ops import fused
+            if fused.fused_enabled():
+                return fused.layernorm_act(
+                    x, params["gamma"], params["beta"],
+                    eps=self.epsilon, activation=self.activation)
         mean = jnp.mean(x, axis=-1, keepdims=True)
         var = jnp.var(x, axis=-1, keepdims=True)
         y = (x - mean) / jnp.sqrt(var + self.epsilon)
-        return (y * params["gamma"] + params["beta"]).astype(x.dtype)
+        y = (y * params["gamma"] + params["beta"]).astype(x.dtype)
+        if self.activation is not None:
+            y = self.activation(y)
+        return y
 
 
 class L2Normalization(Layer):

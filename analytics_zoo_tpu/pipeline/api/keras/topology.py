@@ -216,6 +216,38 @@ class KerasNet(Container):
         classes = np.argmax(np.asarray(out), axis=-1)
         return classes if zero_based_label else classes + 1
 
+    # ------------------------------------------------------- quantization
+    def quantize(self, calib_data, batch_size: int = 32,
+                 max_batches: int = 8, min_size: int = 1024):
+        """Calibrated int8 conversion IN PLACE: record per-layer input
+        ranges over ``calib_data`` (eager forwards), rewrite eligible
+        kernels to int8 + per-output-channel scales in the
+        params-driven layout (ops/quant.py), and install the quantized
+        variables on this model — every later ``predict``/serving call
+        executes ``quantized_matmul`` on the MXU (int8 peak is 2x bf16
+        on v5e, and weight HBM traffic drops 4x — the recommendation
+        zoo's bandwidth-starvation lever).  Training on a quantized
+        model is not supported; re-``init`` or reload weights to go
+        back to f32.  Returns self."""
+        from analytics_zoo_tpu.ops.quant import (
+            calibrate_model, quantize_model)
+        ranges = calibrate_model(self, calib_data,
+                                 batch_size=batch_size,
+                                 max_batches=max_batches)
+        self.set_variables(quantize_model(
+            self.get_variables(), ranges, min_size=min_size))
+        # drop the cached inference estimator: its jitted predict was
+        # traced over the f32 params signature
+        if hasattr(self, "_cached_infer_estimator"):
+            del self._cached_infer_estimator
+        return self
+
+    @property
+    def is_quantized(self) -> bool:
+        params = (self._variables or {}).get("params", {})
+        return any("kernel_scale" in p for p in params.values()
+                   if isinstance(p, dict))
+
     def predict_mc(self, x, n_samples: int = 10, batch_size: int = 256,
                    rng=None):
         """Monte-Carlo (training-mode) prediction for uncertainty

@@ -50,59 +50,22 @@ def quantize_params(params, min_size: int = 1024):
 
 def calibrate_activations(model, calib_data, batch_size: int = 32,
                           max_batches: int = 8) -> Dict[str, float]:
-    """Run eager forwards over a calibration set recording each layer's
-    input absmax (ref InferenceModel.scala:400-421's OpenVINO
-    calibration role).  ``calib_data`` is an ndarray/pytree or a
-    FeatureSet."""
-    from analytics_zoo_tpu.feature.feature_set import FeatureSet
-    from analytics_zoo_tpu.pipeline.api.keras.engine import (
-        record_activations)
-    variables = model.get_variables()
-    if isinstance(calib_data, FeatureSet):
-        batches = (b[0] for b in calib_data.epoch_batches(
-            0, batch_size, train=False))
-    else:
-        n = len(jax.tree_util.tree_leaves(calib_data)[0])
-        batches = (jax.tree_util.tree_map(
-            lambda a: a[i:i + batch_size], calib_data)
-            for i in range(0, n, batch_size))
-    ranges: Dict[str, float] = {}
-    with record_activations() as taps:
-        for i, xb in enumerate(batches):
-            if i >= max_batches:
-                break
-            model.apply(variables["params"], xb,
-                        state=variables["state"], training=False)
-        ranges.update(taps)
-    return ranges
+    """Back-compat alias of ``ops.quant.calibrate_model`` (the
+    calibration/quantization workflow now lives with the int8 kernels
+    it feeds)."""
+    from analytics_zoo_tpu.ops.quant import calibrate_model
+    return calibrate_model(model, calib_data, batch_size=batch_size,
+                           max_batches=max_batches)
 
 
 def quantize_params_calibrated(model, variables, act_ranges,
                                min_size: int = 1024):
-    """Per-layer int8 weights (per-output-channel scales) + calibrated
-    symmetric activation scales, in the params-driven layout the Dense/
-    conv layers execute natively (kernel int8 + kernel_scale +
-    act_scale — see ops/quant.py)."""
-    params = variables["params"]
-    qparams = {}
-    for lname, p in params.items():
-        qp = dict(p) if isinstance(p, dict) else p
-        k = p.get("kernel") if isinstance(p, dict) else None
-        rng_max = act_ranges.get(lname, 0.0)
-        if k is not None and rng_max > 0.0:
-            arr = np.asarray(k)
-            if (arr.dtype == np.float32 and arr.ndim >= 2
-                    and arr.size >= min_size):
-                axes = tuple(range(arr.ndim - 1))
-                w_scale = np.maximum(
-                    np.max(np.abs(arr), axis=axes, keepdims=True)
-                    / 127.0, 1e-12).astype(np.float32)
-                qp["kernel"] = np.clip(
-                    np.round(arr / w_scale), -127, 127).astype(np.int8)
-                qp["kernel_scale"] = w_scale
-                qp["act_scale"] = np.float32(max(rng_max / 127.0, 1e-12))
-        qparams[lname] = qp
-    return {"params": qparams, "state": variables["state"]}
+    """Back-compat alias of ``ops.quant.quantize_model`` (which reads
+    only the variables/ranges; ``model`` is kept here for signature
+    compatibility)."""
+    del model
+    from analytics_zoo_tpu.ops.quant import quantize_model
+    return quantize_model(variables, act_ranges, min_size=min_size)
 
 
 def dequantize_params(qparams, scales):

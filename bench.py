@@ -27,6 +27,14 @@ records it):
   fast path) against one continuously-batching worker; emits
   per-transport p50/p99 request latency and the achieved batch fill
   ratio.
+* ``kernels`` — the fused kernel suite (ops/fused.py) + int8 path:
+  fused optimizer update vs the optax triple pass (xla_bytes_per_step
+  both ways, bytes saved, HBM-roofline attainment), the bias→GeLU /
+  LayerNorm→GeLU epilogues, and NCF int8 predict vs f32
+  (rows/sec both paths, ``mfu_vs_deliverable`` for the int8 program).
+  Its metrics are NEW names (``ncf_int8_predict_rows_per_sec``), so
+  ``--compare`` against a pre-suite baseline never reads them as a
+  regression of the f32 numbers.
 
 Prints ONE JSON line ``{"metric", "value", "unit", "vs_baseline", ...}``
 on success, or a diagnostic JSON line (``"error"`` key, value 0) on
@@ -803,8 +811,226 @@ def bench_input_pipeline(n_samples: int = 4096, batch_size: int = 128,
     }
 
 
+# ----------------------------------------------------------------- kernels
+def bench_kernels(update_iters: int = 30, predict_rows: int = 65536,
+                  predict_batch: int = 8192):
+    """Fused kernel suite + int8 inference roofline bench.
+
+    Three sections, all through ``compile.engine_jit`` so the programs
+    land in (and later load from) the persistent executable cache:
+
+    * fused optimizer update (clip+Adam+apply, one pass per leaf) vs
+      the optax triple pass — wall per update, XLA bytes per step both
+      ways (``bytes_saved_per_step`` is the HBM traffic the fusion
+      eliminates), and HBM-roofline attainment of the fused program;
+    * the bias→GeLU and LayerNorm→GeLU epilogues vs their unfused
+      forms;
+    * NCF predict f32 vs calibrated int8 (rows/sec both paths,
+      speedup, ``mfu_vs_deliverable`` of the int8 program).
+
+    Emits ``kernel_bytes_saved_per_step{kernel}`` and
+    ``kernel_roofline_attainment{kernel}`` gauges so
+    ``scripts/obs_report.py`` renders the kernel-suite roofline rows
+    from the recorded snapshot.
+    """
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from analytics_zoo_tpu.benchmarks import (
+        calibrate_chip, cost_of_compiled, mfu_estimate)
+    from analytics_zoo_tpu.compile import engine_jit
+    from analytics_zoo_tpu.observability import get_registry
+    from analytics_zoo_tpu.ops import fused
+    from analytics_zoo_tpu.parallel.trainer import (
+        ClipSpec, _apply_clipping)
+    from analytics_zoo_tpu.pipeline.api.keras.optimizers import Adam
+
+    reg = get_registry()
+    g_saved = reg.gauge(
+        "kernel_bytes_saved_per_step",
+        "HBM bytes/step the fused kernel eliminates vs its unfused "
+        "form (XLA cost analysis)", labels=("kernel",))
+    g_roof = reg.gauge(
+        "kernel_roofline_attainment",
+        "HBM-bandwidth roofline step time / measured step time for "
+        "the fused program (1.0 = at the roofline)",
+        labels=("kernel",))
+
+    calib = calibrate_chip()
+    hbm_gbps = None if calib.get("error") else calib.get("hbm_gbps")
+    dev = jax.devices()[0]
+
+    def timed(fn, *args, iters):
+        out = fn(*args)                    # warm (compile)
+        jax.block_until_ready(out)
+        t0 = time.time()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        return (time.time() - t0) / iters
+
+    # ---- fused optimizer update vs optax triple pass --------------
+    # an NCF-shaped tree: embedding tables + MLP kernels (~4M params)
+    key = jax.random.PRNGKey(0)
+    shapes = [(6041, 64), (3707, 64), (6041, 64), (3707, 64),
+              (256, 128), (128,), (128, 64), (64,), (64, 32), (32,)]
+    params = {f"w{i}": jax.random.normal(
+        jax.random.fold_in(key, i), s, jnp.float32)
+        for i, s in enumerate(shapes)}
+    grads = {k: v * 0.01 for k, v in params.items()}
+    optim = Adam(lr=1e-3)
+    clip = ClipSpec("l2norm", 1.0)
+    opt_state = optim.tx.init(params)
+
+    fused_update = fused.build_fused_update(optim, clip)
+    if fused_update is None:
+        # suite off (ops.fused=off) or the optimizer declined — report
+        # it plainly instead of crashing the workload
+        opt_section = {"disabled": True,
+                       "reason": "build_fused_update declined "
+                                 f"(ops.fused={fused._mode()!r})"}
+    else:
+        fused_prog = engine_jit(
+            lambda g, s, p: fused_update(g, s, p),
+            key_hint="bench_fused_optimizer")
+
+        def unfused(g, s, p):
+            g = _apply_clipping(g, clip)
+            upd, s = optim.tx.update(g, s, p)
+            return optax.apply_updates(p, upd), s
+        unfused_prog = engine_jit(unfused,
+                                  key_hint="bench_unfused_optimizer")
+
+        n_params = sum(int(np.prod(s)) for s in shapes)
+        fused_s = timed(fused_prog, grads, opt_state, params,
+                        iters=update_iters)
+        unfused_s = timed(unfused_prog, grads, opt_state, params,
+                          iters=update_iters)
+        _f, f_bytes = cost_of_compiled(
+            fused_prog.aot(grads, opt_state, params))
+        _u, u_bytes = cost_of_compiled(
+            unfused_prog.aot(grads, opt_state, params))
+        bytes_saved = (u_bytes - f_bytes) if (f_bytes and u_bytes) \
+            else None
+        opt_roofline = None
+        if f_bytes and hbm_gbps:
+            opt_roofline = round(
+                (f_bytes / (hbm_gbps * 1e9)) / fused_s, 3)
+            g_roof.labels("fused_adam").set(opt_roofline)
+        if bytes_saved is not None:
+            g_saved.labels("fused_adam").set(float(bytes_saved))
+
+        opt_section = {
+            "params": n_params,
+            "fused_update_us": round(fused_s * 1e6, 1),
+            "unfused_update_us": round(unfused_s * 1e6, 1),
+            "speedup": round(unfused_s / fused_s, 3),
+            "xla_bytes_per_step_fused": f_bytes,
+            "xla_bytes_per_step_unfused": u_bytes,
+            "bytes_saved_per_step": bytes_saved,
+            "hbm_roofline_attainment": opt_roofline,
+            "pallas": fused._use_pallas(),
+        }
+
+    # ---- epilogue kernels -----------------------------------------
+    x = jax.random.normal(jax.random.fold_in(key, 100),
+                          (4096, 512), jnp.float32)
+    b = jax.random.normal(jax.random.fold_in(key, 101),
+                          (512,), jnp.float32)
+    gamma = jnp.ones((512,), jnp.float32)
+    beta = jnp.zeros((512,), jnp.float32)
+    from analytics_zoo_tpu.ops import activations as acts
+    bg_fused = engine_jit(lambda x, b: fused.bias_gelu(x, b),
+                          key_hint="bench_bias_gelu")
+    bg_unf = engine_jit(lambda x, b: acts.gelu(x + b),
+                        key_hint="bench_bias_gelu_unfused")
+    ln_fused = engine_jit(
+        lambda x, g, bt: fused.layernorm_act(
+            x, g, bt, eps=1e-5, activation=acts.gelu),
+        key_hint="bench_layernorm_gelu")
+    epi_section = {
+        "rows": int(x.shape[0]), "dim": int(x.shape[1]),
+        "bias_gelu_us": round(
+            timed(bg_fused, x, b, iters=50) * 1e6, 1),
+        "bias_gelu_unfused_us": round(
+            timed(bg_unf, x, b, iters=50) * 1e6, 1),
+        "layernorm_gelu_us": round(
+            timed(ln_fused, x, gamma, beta, iters=50) * 1e6, 1),
+    }
+    bg_bytes = cost_of_compiled(bg_fused.aot(x, b))[1]
+    bgu_bytes = cost_of_compiled(bg_unf.aot(x, b))[1]
+    if bg_bytes and bgu_bytes:
+        g_saved.labels("bias_gelu").set(float(bgu_bytes - bg_bytes))
+        epi_section["bytes_saved_per_step"] = bgu_bytes - bg_bytes
+
+    # ---- NCF int8 vs f32 predict ----------------------------------
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    n_users, n_items = 6040, 3706
+    model = NeuralCF(user_count=n_users, item_count=n_items,
+                     class_num=2, user_embed=64, item_embed=64,
+                     mf_embed=64, hidden_layers=(128, 64, 32))
+    rs = np.random.RandomState(0)
+    users = rs.randint(1, n_users + 1, predict_rows)
+    items = rs.randint(1, n_items + 1, predict_rows)
+    feats = model.pair_features(users, items)
+
+    f32_out = model.predict(feats, batch_size=predict_batch)  # compile
+    model.predict(feats, batch_size=predict_batch)   # warm steady
+    t0 = time.time()
+    model.predict(feats, batch_size=predict_batch)
+    f32_rps = predict_rows / (time.time() - t0)
+
+    calib_feats = [a[:4 * 1024] for a in feats]
+    model.quantize(calib_feats, batch_size=1024, max_batches=4)
+    int8_out = model.predict(feats, batch_size=predict_batch)
+    model.predict(feats, batch_size=predict_batch)   # warm steady
+    t0 = time.time()
+    model.predict(feats, batch_size=predict_batch)
+    int8_rps = predict_rows / (time.time() - t0)
+
+    # logit agreement between the paths — the honest "same model" check
+    max_logit_diff = float(np.max(np.abs(
+        np.asarray(f32_out) - np.asarray(int8_out))))
+    q_layers = sum(1 for p in model.get_variables()["params"].values()
+                   if isinstance(p, dict) and "kernel_scale" in p)
+
+    from analytics_zoo_tpu.ops.quant import _int8_conv_supported
+    int8_mfu = None
+    if not calib.get("error") and calib.get("deliverable_tflops"):
+        # MLP matmul FLOPs per row (multiply+add; embeddings are
+        # gathers): concat(128)→128→64→32, head (64 mf ⊕ 32)→2
+        flops_per_row = 2.0 * (128 * 128 + 128 * 64 + 64 * 32 + 96 * 2)
+        step_s = predict_batch / int8_rps     # steady-state per batch
+        int8_mfu = mfu_estimate(
+            flops_per_row * predict_batch, step_s, dev,
+            peak=calib["deliverable_tflops"] * 1e12)
+
+    return {
+        "metric": "ncf_int8_predict_rows_per_sec",
+        "value": round(int8_rps, 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": None,
+        "workload": "kernels",
+        "f32_rows_per_sec": round(f32_rps, 1),
+        "int8_rows_per_sec": round(int8_rps, 1),
+        "int8_speedup": round(int8_rps / f32_rps, 3),
+        "int8_quantized_layers": q_layers,
+        "int8_max_logit_diff": round(max_logit_diff, 5),
+        "int8_conv_supported": _int8_conv_supported(),
+        "mfu_vs_deliverable": int8_mfu,
+        "fused_optimizer": opt_section,
+        "epilogues": epi_section,
+        "pallas_supported": fused.pallas_supported(),
+        "calibration": calib,
+        "device": str(dev),
+        "device_kind": getattr(dev, "device_kind", "?"),
+    }
+
+
 WORKLOADS = {
     "ncf": bench_ncf,
+    "kernels": bench_kernels,
     "resnet50": bench_resnet50,
     "serving": bench_serving,
     "serving_engine": bench_serving_engine,
@@ -818,6 +1044,11 @@ WORKLOADS = {
 # per-metric history aggregates crashed runs as value-0 points
 METRIC_NAMES = {
     "ncf": "ncf_movielens1m_train_throughput",
+    # int8 path = a NEW metric name on purpose: --compare gates only
+    # metrics present in the baseline, so a pre-suite (f32-only)
+    # baseline can never read the int8 numbers as a regression of the
+    # f32 ones (and vice versa)
+    "kernels": "ncf_int8_predict_rows_per_sec",
     "resnet50": "resnet50_imagenet_train_throughput",
     "serving": "cluster_serving_throughput",
     "serving_engine": "serving_engine_http_throughput",

@@ -223,6 +223,37 @@ def render_report(label: str, snap: Dict,
         if evict:
             lines.append(f"  cache entries LRU-evicted: {int(evict)}")
 
+    # ---- fused kernel suite / roofline (docs/perf-tuning.md) -------
+    builds = _labeled(counters, "fused_kernel_builds_total")
+    if builds:
+        saved = {}
+        for lab, v in _labeled(gauges, "kernel_bytes_saved_per_step"):
+            saved[lab.split("=", 1)[-1].strip('"')] = v
+        roof = {}
+        for lab, v in _labeled(gauges, "kernel_roofline_attainment"):
+            roof[lab.split("=", 1)[-1].strip('"')] = v
+        per_kernel: Dict[str, Dict[str, int]] = {}
+        for lab, n in builds:
+            parts = dict(p.split("=", 1) for p in lab.split(","))
+            k = parts.get("kernel", "?").strip('"')
+            path = parts.get("path", "?").strip('"')
+            per_kernel.setdefault(k, {})[path] = int(n)
+        rows = []
+        for k in sorted(set(per_kernel) | set(saved) | set(roof)):
+            paths = per_kernel.get(k, {})
+            path = "+".join(sorted(paths)) or "-"
+            sv = saved.get(k)
+            rf = roof.get(k)
+            rows.append([
+                k, path, sum(paths.values()),
+                _fmt_bytes(sv) + "/step" if sv else "-",
+                f"{rf:.2f}x" if rf is not None else "-"])
+        lines += ["", "fused kernel suite (path=lax means the Pallas "
+                  "probe declined — XLA fuses the same math; roofline "
+                  "1.0 = HBM-bandwidth-bound floor reached):",
+                  _table(rows, ["kernel", "path", "builds",
+                                "bytes saved", "roofline"])]
+
     # ---- health ----------------------------------------------------
     nonfinite = _labeled(counters, "train_nonfinite_total")
     events = _labeled(counters, "watchdog_events_total")

@@ -1,0 +1,173 @@
+"""int8 path (ops/quant.py): calibration → quantize → predict round
+trip, scale-shape contracts, and saturation.
+
+Tolerance contract (docs/perf-tuning.md "Kernel suite" → int8): on an
+NCF-shaped model with calibrated activation scales, int8 softmax
+probabilities agree with f32 within 2e-2 absolute (symmetric per-tensor
+act quantization + per-output-channel weights), and ≥ 97% of argmax
+classes agree.  The kernels themselves are exact int8×int8→int32 with
+an f32 rescale epilogue — the error is all in the 8-bit rounding, not
+the arithmetic.
+"""
+
+import numpy as np
+import pytest
+from unittest import mock
+
+import jax
+import jax.numpy as jnp
+
+import analytics_zoo_tpu.ops.quant as quant
+from analytics_zoo_tpu.ops.quant import (
+    calibrate_model, quantize_activation, quantize_model,
+    quantized_matmul)
+
+
+def _softmax(z):
+    e = np.exp(z - z.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def _ncf(hidden=(128, 64)):
+    from analytics_zoo_tpu.models.recommendation import NeuralCF
+    return NeuralCF(user_count=200, item_count=100, class_num=2,
+                    user_embed=64, item_embed=64, mf_embed=64,
+                    hidden_layers=hidden)
+
+
+class TestQuantPrimitives:
+    def test_clip_saturates_at_127(self):
+        x = jnp.array([1e6, -1e6, 0.0, 1.0], jnp.float32)
+        q = np.asarray(quantize_activation(x, jnp.float32(1.0)))
+        assert q.dtype == np.int8
+        assert q[0] == 127 and q[1] == -127          # symmetric: ±127,
+        assert -128 not in q                          # never -128
+        assert q[2] == 0 and q[3] == 1
+
+    def test_kernel_scale_keepdims_contract(self):
+        """quantize_model emits per-output-channel scales with KEEPDIMS
+        shape (1, ..., out) — the shape quantized_matmul's epilogue
+        reshape contract assumes."""
+        rs = np.random.RandomState(0)
+        m = _ncf()
+        users = rs.randint(1, 201, 256)
+        items = rs.randint(1, 101, 256)
+        feats = m.pair_features(users, items)
+        ranges = calibrate_model(m.model, feats, batch_size=64,
+                                 max_batches=4)
+        assert ranges, "calibration taps recorded nothing"
+        qv = quantize_model(m.get_variables(), ranges)
+        n_q = 0
+        for lname, p in qv["params"].items():
+            if not (isinstance(p, dict) and "kernel_scale" in p):
+                continue
+            n_q += 1
+            k = np.asarray(p["kernel"])
+            s = np.asarray(p["kernel_scale"])
+            assert k.dtype == np.int8
+            assert s.shape == (1,) * (k.ndim - 1) + (k.shape[-1],)
+            assert np.asarray(p["act_scale"]).shape == ()
+            assert np.all(np.abs(k) <= 127)
+            assert np.all(s > 0)
+        assert n_q >= 2, "expected at least the two MLP kernels int8"
+
+    def test_quantized_matmul_dequant_round_trip(self):
+        """int8 matmul with exactly-representable inputs reproduces the
+        f32 product: the arithmetic path (quantize → int32 accumulate →
+        rescale) is exact, only rounding loses information."""
+        rs = np.random.RandomState(1)
+        w = (rs.randint(-127, 128, (32, 16))).astype(np.float32)
+        w_scale = np.ones((1, 16), np.float32)
+        x = rs.randint(-100, 101, (4, 32)).astype(np.float32)
+        got = np.asarray(quantized_matmul(
+            jnp.asarray(x), jnp.asarray(w.astype(np.int8)),
+            jnp.asarray(w_scale), jnp.float32(1.0)))
+        np.testing.assert_allclose(got, x @ w, rtol=1e-6)
+
+
+class TestNcfInt8RoundTrip:
+    def test_predict_agrees_with_f32(self):
+        rs = np.random.RandomState(0)
+        m = _ncf()
+        users = rs.randint(1, 201, 1024)
+        items = rs.randint(1, 101, 1024)
+        feats = m.pair_features(users, items)
+        f32 = np.asarray(m.predict(feats, batch_size=256))
+
+        calls = []
+        orig = quant.quantized_matmul
+        with mock.patch.object(
+                quant, "quantized_matmul",
+                side_effect=lambda *a, **k: calls.append(1) or
+                orig(*a, **k)):
+            m.quantize(feats, batch_size=256, max_batches=4)
+            q = np.asarray(m.predict(feats, batch_size=256))
+        # the int8 kernel was actually traced into the predict program
+        assert calls, "quantized_matmul never executed"
+        assert m.is_quantized
+        diff = np.max(np.abs(_softmax(f32) - _softmax(q)))
+        assert diff < 2e-2, f"int8 prob divergence {diff}"
+        agree = np.mean(np.argmax(f32, -1) == np.argmax(q, -1))
+        assert agree >= 0.97, f"class agreement {agree}"
+
+    def test_recommender_api_runs_quantized(self):
+        """The recommendation surface (predict_user_item_pair) works
+        end-to-end on the quantized model."""
+        rs = np.random.RandomState(1)
+        m = _ncf(hidden=(64, 32))
+        feats = m.pair_features(rs.randint(1, 201, 256),
+                                rs.randint(1, 101, 256))
+        m.quantize(feats, batch_size=64, max_batches=2)
+        from analytics_zoo_tpu.models.recommendation.recommender import (
+            UserItemFeature)
+        pairs = [UserItemFeature(int(u), int(i), {})
+                 for u, i in zip(rs.randint(1, 201, 32),
+                                 rs.randint(1, 101, 32))]
+        preds = m.predict_user_item_pair(pairs, batch_size=32)
+        assert len(preds) == 32
+        assert all(p.prediction in (1, 2) for p in preds)
+        # the head emits logits (pair with *_with_logits losses), so
+        # the reported score is unbounded — just require finite
+        assert all(np.isfinite(p.probability) for p in preds)
+
+    def test_wide_deep_quantizes(self):
+        """Wide&Deep — the other recommendation-zoo model — round
+        trips the same workflow."""
+        from analytics_zoo_tpu.models.recommendation import (
+            ColumnFeatureInfo, WideAndDeep)
+        info = ColumnFeatureInfo(
+            wide_base_cols=["a"], wide_base_dims=[4],
+            embed_cols=["b"], embed_in_dims=[16], embed_out_dims=[8],
+            continuous_cols=["c"])
+        m = WideAndDeep(2, info, model_type="wide_n_deep",
+                        hidden_layers=(64, 32))
+        rs = np.random.RandomState(0)
+        rows = 512
+        cols = {"a": rs.randint(0, 4, rows),
+                "b": rs.randint(0, 16, rows),
+                "c": rs.rand(rows).astype(np.float32)}
+        feats = m.features_from_columns(cols)
+        f32 = np.asarray(m.predict(feats, batch_size=128))
+        m.quantize(feats, batch_size=128, max_batches=4)
+        q = np.asarray(m.predict(feats, batch_size=128))
+        assert m.is_quantized
+        diff = np.max(np.abs(_softmax(f32) - _softmax(q)))
+        assert diff < 2e-2, f"int8 prob divergence {diff}"
+
+    def test_inference_model_calibrated_path_still_works(self):
+        """The InferenceModel facade (serving loads through it) keeps
+        its quantize='calibrated' contract on the relocated
+        calibrate/quantize implementations."""
+        from analytics_zoo_tpu.pipeline.inference.inference_model import (
+            InferenceModel)
+        rs = np.random.RandomState(2)
+        m = _ncf(hidden=(64, 32))
+        feats = m.pair_features(rs.randint(1, 201, 256),
+                                rs.randint(1, 101, 256))
+        im = InferenceModel().load_zoo(m.model, quantize="calibrated",
+                                       calib_set=feats,
+                                       calib_batch_size=64,
+                                       calib_batches=2)
+        assert im.is_quantized
+        out = im.predict(feats, batch_size=128)
+        assert np.asarray(out).shape == (256, 2)
