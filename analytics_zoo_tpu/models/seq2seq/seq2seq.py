@@ -114,42 +114,124 @@ class Seq2seq(KerasNet):
         logits = self.generator.call(params[self.generator.name], x)
         return logits, state
 
+    # ------------------------------------------------- decode primitives
+    # ``prefill`` and ``decode_step`` are the two pure programs the
+    # whole generative story is built from: ``infer`` composes them
+    # into one whole-sequence device loop, while the serving engine's
+    # decode-step scheduler (serving/engine/decode.py) compiles
+    # ``decode_step`` once per batch bucket and calls it once per
+    # ITERATION — admitting and retiring sequences between calls.
+
+    def prefill(self, params: Params, enc_ids):
+        """Encode + bridge: the per-sequence decode state a new
+        sequence enters the decode loop with.  ``enc_ids``
+        (batch, enc_len) int32 → tuple of per-layer LSTM carries,
+        each an ``(h, c)`` pair of (batch, hidden) arrays."""
+        return tuple(self._bridge(params, self._encode(params, enc_ids)))
+
+    def decode_step(self, params: Params, tok, carries):
+        """One greedy decode iteration: last token (batch,) int32 +
+        carries → (next token (batch,) int32, new carries)."""
+        x = self.embedding.call(params[self.embedding.name],
+                                tok[:, None])
+        new_carries = []
+        for dec, carry in zip(self.decoder_rnns, carries):
+            x, nc = dec.run(params[dec.name], x, initial_carry=carry)
+            new_carries.append(nc)
+        logits = self.generator.call(params[self.generator.name],
+                                     x[:, 0])
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, tuple(new_carries)
+
+    def initial_carries(self, batch: int):
+        """Zero decode state shaped like one ``prefill`` row batch —
+        the slot-pool's resting state for unoccupied slots."""
+        return tuple(dec.initial_carry(batch)
+                     for dec in self.decoder_rnns)
+
+    def decode_params(self) -> Params:
+        return self.get_variables()["params"]
+
     # --------------------------------------------------------------- infer
     def infer(self, enc_ids: np.ndarray, start_sign: int,
-              max_seq_len: int = 30, stop_sign: Optional[int] = None
-              ) -> np.ndarray:
-        """Greedy decode as ONE jitted lax.scan program."""
+              max_seq_len: int = 30, stop_sign: Optional[int] = None,
+              early_exit: bool = True, return_steps: bool = False):
+        """Greedy decode as ONE jitted device program.
+
+        With a ``stop_sign`` the decode runs as a ``lax.while_loop``
+        that exits the moment EVERY sequence has emitted the stop
+        token — a batch that finishes at step 5 pays 5 iterations, not
+        ``max_seq_len`` — and the masking (everything after the first
+        stop token reads ``stop_sign``) happens in the device program.
+        The output is bit-identical to the historical
+        scan-then-host-mask path (``early_exit=False`` keeps that
+        exact whole-sequence scan, which is also the honest "naive"
+        baseline the serving bench compares against).
+        ``return_steps=True`` additionally returns how many decode
+        iterations actually executed."""
         params = self.get_variables()["params"]
         enc_ids = jnp.asarray(enc_ids, jnp.int32)
 
-        def decode(params, enc_ids):
-            carries = self._bridge(params, self._encode(params, enc_ids))
+        def decode_scan(params, enc_ids):
+            carries = self.prefill(params, enc_ids)
             batch = enc_ids.shape[0]
             tok0 = jnp.full((batch,), start_sign, jnp.int32)
 
             def step(carry_state, _):
                 tok, carries = carry_state
-                x = self.embedding.call(
-                    params[self.embedding.name], tok[:, None])
-                new_carries = []
-                for dec, carry in zip(self.decoder_rnns, carries):
-                    x, nc = dec.run(params[dec.name], x,
-                                    initial_carry=carry)
-                    new_carries.append(nc)
-                logits = self.generator.call(
-                    params[self.generator.name], x[:, 0])
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return (nxt, tuple(new_carries)), nxt
+                nxt, new_carries = self.decode_step(params, tok,
+                                                    carries)
+                return (nxt, new_carries), nxt
 
-            _, toks = jax.lax.scan(step, (tok0, tuple(carries)), None,
+            _, toks = jax.lax.scan(step, (tok0, carries), None,
                                    length=max_seq_len)
             return jnp.swapaxes(toks, 0, 1)
 
+        def decode_early_exit(params, enc_ids):
+            carries = self.prefill(params, enc_ids)
+            batch = enc_ids.shape[0]
+            tok0 = jnp.full((batch,), start_sign, jnp.int32)
+            # rows never written (the loop exited first) already hold
+            # the masked value, exactly like the host-side mask did
+            out0 = jnp.full((batch, max_seq_len), stop_sign, jnp.int32)
+            stopped0 = jnp.zeros((batch,), bool)
+
+            def cond(state):
+                i, _tok, _carries, _out, stopped = state
+                return (i < max_seq_len) & ~jnp.all(stopped)
+
+            def body(state):
+                i, tok, carries, out, stopped = state
+                nxt, new_carries = self.decode_step(params, tok,
+                                                    carries)
+                # a stopped lane keeps reading stop_sign; live lanes
+                # record the raw argmax (which may BE the stop token —
+                # included, like the cumsum mask included it)
+                emit = jnp.where(stopped, stop_sign, nxt)
+                out = out.at[:, i].set(emit)
+                # the raw token feeds back even on stopped lanes, so
+                # executed iterations match the scan path bit-for-bit
+                return (i + 1, nxt, new_carries, out,
+                        stopped | (emit == stop_sign))
+
+            i, _tok, _carries, out, _stopped = jax.lax.while_loop(
+                cond, body, (jnp.int32(0), tok0, carries, out0,
+                             stopped0))
+            return out, i
+
         from analytics_zoo_tpu.compile import engine_jit
-        out = np.asarray(engine_jit(
-            decode, key_hint="seq2seq_decode")(params, enc_ids))
-        if stop_sign is not None:
-            # mask everything after the first stop token
-            stopped = np.cumsum(out == stop_sign, axis=1) > 0
-            out = np.where(stopped, stop_sign, out)
-        return out
+        if stop_sign is not None and early_exit:
+            out, steps = engine_jit(
+                decode_early_exit,
+                key_hint="seq2seq_decode_early_exit")(params, enc_ids)
+            out, steps = np.asarray(out), int(steps)
+        else:
+            out = np.asarray(engine_jit(
+                decode_scan, key_hint="seq2seq_decode")(params,
+                                                        enc_ids))
+            steps = max_seq_len
+            if stop_sign is not None:
+                # mask everything after the first stop token
+                stopped = np.cumsum(out == stop_sign, axis=1) > 0
+                out = np.where(stopped, stop_sign, out)
+        return (out, steps) if return_steps else out

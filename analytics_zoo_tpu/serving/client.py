@@ -60,10 +60,13 @@ class InputQueue:
 
     def enqueue(self, uri: str, data: np.ndarray,
                 request_id: Optional[str] = None,
-                endpoint: Optional[str] = None) -> str:
+                endpoint: Optional[str] = None,
+                max_tokens: Optional[int] = None) -> str:
         """Arbitrary ndarray input (npy-serialized); returns the
         record's ``request_id``.  ``endpoint`` routes to a registered
-        model on a multi-model worker."""
+        model on a multi-model worker; ``max_tokens`` caps the
+        sequence a *generative* endpoint decodes for this record
+        (ignored by stateless endpoints)."""
         buf = io.BytesIO()
         np.save(buf, np.ascontiguousarray(data), allow_pickle=False)
         rid = self._request_id(request_id)
@@ -71,6 +74,8 @@ class InputQueue:
                   "request_id": rid}
         if endpoint:
             fields["endpoint"] = endpoint
+        if max_tokens:
+            fields["max_tokens"] = str(int(max_tokens))
         self.broker.xadd(INPUT_STREAM, fields)
         return rid
 
@@ -185,6 +190,48 @@ class ServingHttpClient:
         self.retries = int(retries)
         self.timeout_s = float(timeout_s)
 
+    def _open_with_retries(self, req, timeout_s: float, retries: int,
+                           consume=None):
+        """The ONE retry ladder both calls share: connection-class
+        failures (socket errors — the server is gone or mid-restart)
+        are absorbed up to ``retries`` consecutive attempts with
+        exponential backoff + jitter, then the last error re-raises;
+        an HTTP *status* error means the server answered — an
+        application outcome, not an outage — and raises
+        :class:`ServingHttpError` immediately.
+
+        With ``consume`` (a ``response -> value`` callable) the WHOLE
+        exchange retries — a connection dying mid-body-read re-POSTs
+        the idempotent request.  Without it the open response is
+        returned and only *establishing* it retried (the streaming
+        caller: tokens already delivered must not replay)."""
+        import random
+        from urllib import error as urlerror
+        from urllib import request as urlrequest
+        delay, failures = 0.05, 0
+        while True:
+            try:
+                r = urlrequest.urlopen(req, timeout=timeout_s)
+                if consume is None:
+                    return r
+                with r:
+                    return consume(r)
+            except urlerror.HTTPError as e:
+                try:
+                    doc = json.loads(e.read().decode())
+                except Exception:   # noqa: BLE001
+                    doc = {}
+                finally:
+                    e.close()
+                raise ServingHttpError(
+                    e.code, doc.get("error") or str(e), doc) from None
+            except (urlerror.URLError, OSError):
+                failures += 1
+                if failures >= max(int(retries), 1):
+                    raise
+                time.sleep(delay * (0.5 + random.random()))
+                delay = min(delay * 2.0, 2.0)
+
     def predict_http(self, endpoint: str, payload, *,
                      uri: str = "", request_id: Optional[str] = None,
                      timeout_s: Optional[float] = None,
@@ -192,8 +239,6 @@ class ServingHttpClient:
         """Predict one record: ``payload`` is an ndarray (or nested
         list).  Returns the response doc ``{"value": [[class, prob],
         ...], "request_id": ..., "endpoint": ...}``."""
-        import random
-        from urllib import error as urlerror
         from urllib import request as urlrequest
         if timeout_s is None:
             timeout_s = self.timeout_s
@@ -208,27 +253,78 @@ class ServingHttpClient:
         req = urlrequest.Request(
             f"{self.base_url}/predict/{endpoint}", data=body,
             headers={"Content-Type": "application/json"})
-        delay, failures = 0.05, 0
-        while True:
-            try:
-                with urlrequest.urlopen(req, timeout=timeout_s) as r:
-                    return json.loads(r.read().decode())
-            except urlerror.HTTPError as e:
-                # the server ANSWERED: 400/404/500/504 are outcomes
-                try:
-                    doc = json.loads(e.read().decode())
-                except Exception:   # noqa: BLE001
-                    doc = {}
-                finally:
-                    e.close()
-                raise ServingHttpError(
-                    e.code, doc.get("error") or str(e), doc) from None
-            except (urlerror.URLError, OSError) as e:
-                failures += 1
-                if failures >= max(int(retries), 1):
-                    raise
-                time.sleep(delay * (0.5 + random.random()))
-                delay = min(delay * 2.0, 2.0)
+        # the whole exchange retries: the request was idempotent
+        return self._open_with_retries(
+            req, timeout_s, retries,
+            consume=lambda r: json.loads(r.read().decode()))
+
+    def generate(self, endpoint: str, token_ids, *,
+                 max_tokens: Optional[int] = None,
+                 on_token=None, uri: str = "",
+                 request_id: Optional[str] = None,
+                 timeout_s: Optional[float] = None,
+                 retries: Optional[int] = None) -> Dict[str, Any]:
+        """Streaming generate against a generative endpoint
+        (``POST /generate/<endpoint>``, chunked per-token responses):
+        ``token_ids`` is the int input sequence (padded to the
+        endpoint's ``enc_len``).  Each token is surfaced through
+        ``on_token(index, token)`` the moment its chunk arrives;
+        returns the final doc ``{"tokens": [...], "request_id": ...,
+        "endpoint": ...}``.
+
+        Retry contract matches :meth:`predict_http` (they share one
+        ladder): connection-class failures *establishing* the stream
+        are absorbed up to ``retries`` attempts with exponential
+        backoff + jitter (the request was not admitted yet — retrying
+        is safe); an HTTP status error raises
+        :class:`ServingHttpError` immediately.  A connection dropped
+        MID-stream re-raises without retry: tokens were already
+        delivered, and replaying the sequence is the caller's call,
+        not the client's."""
+        from urllib import request as urlrequest
+        if timeout_s is None:
+            timeout_s = self.timeout_s
+        if retries is None:
+            retries = self.retries
+        payload: Dict[str, Any] = {
+            "data": np.asarray(token_ids, np.int64).tolist(),
+            "dtype": "int32",
+            "uri": uri,
+            "request_id": request_id or uuid.uuid4().hex,
+        }
+        if max_tokens:
+            payload["max_tokens"] = int(max_tokens)
+        req = urlrequest.Request(
+            f"{self.base_url}/generate/{endpoint}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        # only ESTABLISHING the stream retries; once chunks flow the
+        # relay below runs exactly once
+        r = self._open_with_retries(req, timeout_s, retries)
+        # relay chunks (urllib undoes the chunked framing; each line
+        # is one JSON event)
+        with r:
+            tokens = []
+            for raw in r:
+                line = raw.strip()
+                if not line:
+                    continue
+                doc = json.loads(line.decode())
+                if "token" in doc:
+                    tokens.append(doc["token"])
+                    if on_token is not None:
+                        on_token(doc.get("index", len(tokens) - 1),
+                                 doc["token"])
+                elif doc.get("error"):
+                    raise ServingHttpError(200, doc["error"], doc)
+                elif doc.get("done"):
+                    doc.setdefault("tokens", tokens)
+                    return doc
+            # stream ended without a final line: the server died
+            # mid-generation
+            raise ServingHttpError(
+                200, "generate stream ended without a final "
+                     "'done' event", {"tokens": tokens})
 
     def endpoints(self) -> Dict[str, Any]:
         """The worker's registered endpoints (``GET /endpoints``)."""

@@ -24,7 +24,17 @@ package decomposes serving into three independently testable layers
   PR 8 ``compile/`` cache makes a replica respawn deserialize in
   seconds).
 
-:class:`ServingEngine` composes the three for embedders.
+A fourth layer serves *generative* models at **iteration**
+granularity (:mod:`.decode`): :class:`GenerativeEndpoint` holds a
+device-resident :class:`DecodeSlotPool` of per-sequence decode state,
+the batcher hands it one decode STEP per scheduling credit, EOS (or a
+per-request token budget) retires a sequence between iterations with
+its freed slot backfilled from the queue the same iteration, and
+every token streams out through the request's ``on_token`` hook — the
+Orca/vLLM-style scheduling that turns the engine from "stateless
+predict at request granularity" into a generative serving stack.
+
+:class:`ServingEngine` composes the layers for embedders.
 """
 
 from analytics_zoo_tpu.serving.engine.batcher import (
@@ -32,10 +42,13 @@ from analytics_zoo_tpu.serving.engine.batcher import (
 from analytics_zoo_tpu.serving.engine.executor import (
     Endpoint, EndpointRegistry, ModelExecutor, default_buckets)
 from analytics_zoo_tpu.serving.engine.core import ServingEngine
+from analytics_zoo_tpu.serving.engine.decode import (
+    DecodeSlotPool, GenerativeEndpoint)
 from analytics_zoo_tpu.serving.engine.transport import HttpTransport
 
 __all__ = [
     "ContinuousBatcher", "Request", "Endpoint", "EndpointRegistry",
     "ModelExecutor", "ServingEngine", "HttpTransport",
+    "DecodeSlotPool", "GenerativeEndpoint",
     "default_buckets",
 ]

@@ -53,6 +53,14 @@ class Request:
     arrival: float = 0.0            # time.perf_counter() at ingress
     result: Any = None
     error: Optional[BaseException] = None
+    #: generative-only: per-sequence token budget (clamped to the
+    #: endpoint's max_seq_len; None = the endpoint default)
+    max_tokens: Optional[int] = None
+    #: generative-only: called (index, token) from the scheduler
+    #: thread the moment each token is emitted — the per-token
+    #: streaming hook.  Must be fast and never raise (it runs between
+    #: decode iterations); errors are swallowed.
+    on_token: Optional[Any] = None
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False)
 
@@ -166,8 +174,13 @@ class ContinuousBatcher:
         self._m_requests.labels(name).inc(len(requests))
         # groups larger than the endpoint's largest bucket are split
         # into bucket-sized atomic chunks (each chunk still serves
-        # together; the transport's wait-all covers all chunks)
-        cap = ep.buckets[-1]
+        # together; the transport's wait-all covers all chunks).
+        # Generative sequences queue individually: slot-pool admission
+        # is per sequence (a half-free pool admits half a group and
+        # keeps the rest queued), and completion is per sequence too —
+        # the transport's wait-all, not co-location, carries the
+        # group's ack semantics.
+        cap = 1 if ep.generative else ep.buckets[-1]
         with self._cv:
             for lo in range(0, len(requests), cap):
                 ep.queue.append(requests[lo:lo + cap])
@@ -185,7 +198,7 @@ class ContinuousBatcher:
         endpoint is out of credit, all credits refill to the weights.
         An endpoint with weight 2 gets two batches for every one of a
         weight-1 peer under contention, and never starves anyone."""
-        pending = [ep for ep in self.registry if ep.queue]
+        pending = [ep for ep in self.registry if ep.has_work]
         if not pending:
             return None
         for ep in pending:
@@ -247,6 +260,14 @@ class ContinuousBatcher:
         return any(self._queued_for(e) >= e.buckets[-1]
                    for e in self.registry if e.queue)
 
+    def _generative_pending(self) -> bool:
+        """Any generative endpoint with work ALSO ends the fill-wait:
+        a sequence's first token must never sit behind a stateless
+        peer's co-rider timer (generative endpoints themselves never
+        fill-wait, and that guarantee has to hold when a stateless
+        endpoint grabbed the idle edge first)."""
+        return any(e.generative and e.has_work for e in self.registry)
+
     # ------------------------------------------------------------ main loop
     def _loop(self) -> None:
         # whether the previous iteration dispatched a batch: work
@@ -267,7 +288,14 @@ class ContinuousBatcher:
                     ep = self._pick_endpoint()
                     if ep is None:
                         continue
-                if not just_executed and self.max_wait_ms > 0.0:
+                if ep.generative:
+                    # generative endpoints never fill-wait: between
+                    # decode iterations every queued sequence is a
+                    # backfill candidate anyway, and a timer here
+                    # would tax inter-token latency, the metric the
+                    # decode scheduler exists to protect
+                    pass
+                elif not just_executed and self.max_wait_ms > 0.0:
                     # the idle edge: the first arrivals may wait
                     # (from the OLDEST queued arrival) for co-riders
                     # toward the largest bucket — ending the moment
@@ -279,7 +307,8 @@ class ContinuousBatcher:
                                     for r in g)
                                 + self.max_wait_ms / 1000.0)
                     while not self._stop.is_set() \
-                            and not self._any_bucket_full():
+                            and not self._any_bucket_full() \
+                            and not self._generative_pending():
                         remaining = deadline - self._clock()
                         if remaining <= 0:
                             break
@@ -287,7 +316,16 @@ class ContinuousBatcher:
                 if self._stop.is_set():
                     break
                 # dispatch NOW, partial or not
-                batch = self._compose(ep)
+                batch = [] if ep.generative else self._compose(ep)
+            if ep.generative:
+                # one decode ITERATION per scheduling credit: step
+                # the active slots, retire finished sequences,
+                # backfill from the queue — then fall back into the
+                # scheduler so stateless peers interleave per
+                # iteration, not per sequence
+                self._execute_decode(ep)
+                just_executed = True
+                continue
             if not batch:
                 continue
             self._m_wait.observe(
@@ -295,6 +333,22 @@ class ContinuousBatcher:
                     0.0))
             self._execute(ep, batch)
             just_executed = True
+
+    def _execute_decode(self, ep) -> None:
+        """One generative scheduler iteration under the same
+        thread-survival guard as :meth:`_execute`: the executor
+        already failed the active sequences on any escape (and reset
+        the pool), so this only has to keep the batcher alive."""
+        self._m_inflight.set(1)
+        try:
+            self.executor.execute_decode(ep)
+        except BaseException:   # noqa: BLE001 — poison contract
+            log.exception("decode iteration escaped for endpoint %s; "
+                          "failed sequences carry the error to their "
+                          "transports", ep.name)
+        finally:
+            self._m_inflight.set(0)
+            self.batches_dispatched += 1
 
     def _execute(self, ep, batch: List[Request]) -> None:
         self._m_inflight.set(1)

@@ -47,6 +47,25 @@ class ServingEngine:
         batch_size, input_shape, weight)."""
         return self.registry.register(name, model, **kwargs)
 
+    def register_generative(self, name: str, model, *, enc_len: int,
+                            start_sign: int,
+                            stop_sign: Optional[int] = None,
+                            max_seq_len: int = 32, slots: int = 4,
+                            buckets=(), weight: int = 1):
+        """Register a *generative* model (the ``Seq2seq`` decode
+        contract: ``decode_params``/``prefill``/``decode_step``/
+        ``initial_carries``) under an endpoint name.  Requests to it
+        are SEQUENCES — admitted into a device-resident slot pool and
+        decoded one iteration at a time, with EOS early-exit and
+        same-iteration backfill (see ``engine.decode``).  ``slots``
+        sizes the pool (the generative analog of ``batch_size``)."""
+        from analytics_zoo_tpu.serving.engine.decode import (
+            GenerativeEndpoint)
+        return self.registry.add(GenerativeEndpoint(
+            name, model, enc_len=enc_len, start_sign=start_sign,
+            stop_sign=stop_sign, max_seq_len=max_seq_len, slots=slots,
+            buckets=buckets, weight=weight))
+
     def endpoints(self) -> List[str]:
         return self.registry.names()
 
@@ -109,6 +128,23 @@ class ServingEngine:
         the top-N result or raises the request's error."""
         req = Request(endpoint=endpoint, uri=uri, data=data,
                       request_id=request_id)
+        self.submit_wait([req], timeout_s=timeout_s)
+        if req.error is not None:
+            raise req.error
+        return req.result
+
+    def generate(self, endpoint: str, enc_ids, *,
+                 max_tokens: Optional[int] = None,
+                 on_token=None, uri: str = "",
+                 request_id: Optional[str] = None,
+                 timeout_s: Optional[float] = None) -> List[int]:
+        """One-sequence convenience against a generative endpoint:
+        returns the emitted token list (EOS included when emitted).
+        ``on_token(index, token)`` streams each token as the decode
+        scheduler emits it."""
+        req = Request(endpoint=endpoint, uri=uri, data=enc_ids,
+                      request_id=request_id, max_tokens=max_tokens,
+                      on_token=on_token)
         self.submit_wait([req], timeout_s=timeout_s)
         if req.error is not None:
             raise req.error

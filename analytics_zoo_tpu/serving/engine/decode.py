@@ -1,0 +1,457 @@
+"""Decode-step scheduler — iteration-level continuous batching for
+generative serving (the Orca/vLLM scheduling idea, sized for the
+seq2seq zoo's RNN decode state instead of a KV cache).
+
+The stateless engine schedules at *request* granularity: a request
+occupies its device-batch slot for exactly one predict.  A generative
+request is a *sequence* — and scheduling those at request granularity
+(``Seq2seq.infer``'s whole-sequence program) means a sequence that
+finishes at step 5 still holds its slot for the full ``max_seq_len``
+scan, and a short request's latency is gated by the longest co-rider.
+This module schedules at *iteration* granularity instead:
+
+* a :class:`DecodeSlotPool` holds per-sequence decode state — the
+  RNN carries and last token — **device-resident** in fixed
+  ``(capacity, ...)`` arrays, so state never round-trips the host
+  between iterations;
+* each scheduler iteration runs ONE decode step over the currently
+  active slots, compacted through a ``slot_ids`` index vector that is
+  bucket-padded on the PR 10 ladder — one AOT-warmed
+  ``(batch_bucket, state_bucket=capacity)`` signature per rung, so no
+  fill level ever recompiles, and the PR 8 persistent cache ships the
+  step executable to replicas warm;
+* a sequence that emits EOS (or exhausts its token budget) retires
+  **between iterations**, freeing its slot, and the queue backfills
+  the freed slot in the same scheduler iteration — the device batch
+  is always as full as the traffic allows;
+* every emitted token is surfaced immediately through the request's
+  ``on_token`` callback — the per-token streaming hook the HTTP fast
+  path's chunked ``/generate`` route rides.
+
+The pool's two device programs are built through ``engine_jit``:
+
+* ``prefill(params, tokens, carries, enc_ids[b,L], slot_ids[b])`` —
+  run the model's encoder/bridge for ``b`` new sequences and scatter
+  their initial state into the pool at ``slot_ids``;
+* ``step(params, tokens, carries, slot_ids[b])`` — gather the active
+  rows, run one ``decode_step``, scatter the updated state back, and
+  emit the ``b`` new tokens (the iteration's single host transfer).
+
+Bucket padding uses the out-of-range sentinel ``capacity`` with
+scatter ``mode="drop"`` (padding lanes write nowhere) and gather
+``mode="clip"`` (padding lanes compute garbage that is dropped on the
+way back) — the same program serves every fill level of its bucket.
+
+The model contract (``Seq2seq`` implements it) is four methods:
+``decode_params()``, ``prefill(params, enc_ids)``,
+``decode_step(params, tok, carries)``, ``initial_carries(batch)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from analytics_zoo_tpu.serving.engine.executor import (
+    Endpoint, bucket_for, parse_buckets)
+
+log = logging.getLogger("analytics_zoo_tpu.serving.engine")
+
+
+@dataclasses.dataclass
+class _ActiveSeq:
+    """Host-side bookkeeping for one occupied slot (the device holds
+    the actual decode state)."""
+    request: Any                    # batcher.Request
+    max_tokens: int
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    admitted_at: float = 0.0        # perf_counter at admission
+    last_token_at: float = 0.0      # perf_counter at last emission
+
+
+class DecodeSlotPool:
+    """Device-resident per-sequence decode state + the bucketed
+    per-step programs over it.
+
+    NOT thread-safe by itself: the batcher's single executor thread is
+    the only caller of :meth:`iterate` (the same single-dispatcher
+    discipline the stateless executor runs under)."""
+
+    def __init__(self, model, *, capacity: int, enc_len: int,
+                 start_sign: int, stop_sign: Optional[int],
+                 max_seq_len: int, buckets=()):
+        import jax
+        import jax.numpy as jnp
+
+        from analytics_zoo_tpu.compile import engine_jit
+        from analytics_zoo_tpu.observability import get_registry
+
+        self.model = model
+        self.capacity = int(capacity)
+        self.enc_len = int(enc_len)
+        self.start_sign = int(start_sign)
+        self.stop_sign = None if stop_sign is None else int(stop_sign)
+        self.max_seq_len = int(max_seq_len)
+        self.buckets = parse_buckets(buckets, self.capacity)
+        self._params = model.decode_params()
+        # the pool: last token per slot + the model's carry pytree,
+        # every leaf sized (capacity, ...) — resident for the pool's
+        # whole life, updated in place by the step program's scatter
+        self._tokens, self._carries = self._fresh_state()
+        self._free: List[int] = list(range(self.capacity))
+        self._active: Dict[int, _ActiveSeq] = {}
+        self.iterations = 0            # device steps executed
+        self.admitted_total = 0
+        #: (iteration, slot) per admission/retirement — the test
+        #: witness for "EOS-freed slot backfilled the same iteration"
+        self.admit_log: List[tuple] = []
+        self.retire_log: List[tuple] = []
+
+        cap = self.capacity
+        model_step = model.decode_step
+        model_prefill = model.prefill
+
+        def step_fn(params, tokens, carries, slot_ids):
+            tok = jnp.take(tokens, slot_ids, mode="clip")
+            sub = jax.tree_util.tree_map(
+                lambda a: jnp.take(a, slot_ids, axis=0, mode="clip"),
+                carries)
+            nxt, new_sub = model_step(params, tok, sub)
+            tokens = tokens.at[slot_ids].set(nxt, mode="drop")
+            carries = jax.tree_util.tree_map(
+                lambda full, rows: full.at[slot_ids].set(
+                    rows, mode="drop"),
+                carries, new_sub)
+            return tokens, carries, nxt
+
+        def prefill_fn(params, tokens, carries, enc_ids, slot_ids):
+            new_sub = model_prefill(params, enc_ids)
+            tok0 = jnp.full((enc_ids.shape[0],), self.start_sign,
+                            jnp.int32)
+            tokens = tokens.at[slot_ids].set(tok0, mode="drop")
+            carries = jax.tree_util.tree_map(
+                lambda full, rows: full.at[slot_ids].set(
+                    rows, mode="drop"),
+                carries, new_sub)
+            return tokens, carries
+
+        # pool state is donated: between iterations exactly ONE copy
+        # of the decode state lives in HBM (MEM009's contract for
+        # state rebound through a jit in a hot loop)
+        self._step = engine_jit(
+            step_fn, donate_argnums=(1, 2),
+            key_hint=f"gen_decode_step_c{cap}")
+        self._prefill = engine_jit(
+            prefill_fn, donate_argnums=(1, 2),
+            key_hint=f"gen_decode_prefill_c{cap}")
+
+        reg = get_registry()
+        self._m_tokens = reg.counter(
+            "serving_tokens_total",
+            "tokens emitted by the generative decode scheduler",
+            labels=("endpoint",))
+        self._m_steps = reg.counter(
+            "serving_decode_steps_total",
+            "decode-step device iterations executed",
+            labels=("endpoint",))
+        self._m_admitted = reg.counter(
+            "serving_decode_admitted_total",
+            "sequences admitted into the decode slot pool",
+            labels=("endpoint",))
+        self._m_retired = reg.counter(
+            "serving_decode_retired_total",
+            "sequences retired from the decode slot pool, by cause",
+            labels=("endpoint", "cause"))
+        self._m_occupancy = reg.gauge(
+            "serving_slot_occupancy",
+            "active decode slots / pool capacity",
+            labels=("endpoint",))
+        self._m_inter_token = reg.histogram(
+            "serving_inter_token_latency_seconds",
+            "gap between successive tokens of one sequence (the "
+            "first gap is admission to first token)")
+        self._m_first_token = reg.histogram(
+            "serving_first_token_latency_seconds",
+            "request arrival to first emitted token")
+        self._endpoint_name = "?"   # set by GenerativeEndpoint
+
+    # ------------------------------------------------------------ geometry
+    def _fresh_state(self):
+        """A brand-new device-resident pool state.  Every leaf is
+        force-copied: the model's ``initial_carries`` may alias one
+        zeros buffer across leaves (LSTM's ``(z, z)``), and the step
+        program DONATES the pool state — the same buffer donated
+        twice is an XLA runtime error."""
+        import jax
+        import jax.numpy as jnp
+        tokens = jnp.full((self.capacity,), self.start_sign,
+                          jnp.int32)
+        carries = jax.tree_util.tree_map(
+            lambda a: jnp.array(a, copy=True),
+            self.model.initial_carries(self.capacity))
+        return tokens, carries
+
+    @property
+    def active_count(self) -> int:
+        return len(self._active)
+
+    def bucket_for(self, n: int) -> int:
+        return bucket_for(self.buckets, n)
+
+    def _pad_ids(self, ids: List[int], bucket: int):
+        # the sentinel ``capacity`` is out of range on purpose:
+        # scatter mode="drop" makes padding lanes write nowhere.
+        # Built as int32 NUMPY (a device_put, not a device
+        # convert_element_type — the latter is a tiny per-shape XLA
+        # compile that would break the zero-post-warm-compiles
+        # contract in a fresh process)
+        return np.asarray(
+            ids + [self.capacity] * (bucket - len(ids)), np.int32)
+
+    # ----------------------------------------------------------- warm start
+    def warm(self) -> int:
+        """AOT warm-start every ``(batch_bucket, capacity)`` rung of
+        BOTH pool programs (step + prefill) — deserialized from the
+        persistent executable cache when one is configured.  After a
+        full warm, no fill level compiles.  Returns #programs
+        readied."""
+        import jax.numpy as jnp
+        warmed = 0
+        for b in self.buckets:
+            ids = jnp.zeros((b,), jnp.int32)
+            enc = jnp.zeros((b, self.enc_len), jnp.int32)
+            try:
+                warmed += bool(self._step.warm(
+                    self._params, self._tokens, self._carries, ids))
+                warmed += bool(self._prefill.warm(
+                    self._params, self._tokens, self._carries, enc,
+                    ids))
+            except Exception:   # noqa: BLE001 — warm is best-effort
+                log.exception("decode warm-up failed for bucket %d",
+                              b)
+        return warmed
+
+    @property
+    def aot_signatures(self) -> int:
+        return (self._step.aot_signatures
+                + self._prefill.aot_signatures)
+
+    # ------------------------------------------------------------ admission
+    def admit(self, requests: List, now: Optional[float] = None
+              ) -> int:
+        """Prefill + scatter up to ``len(self._free)`` new sequences
+        into free slots (one bucket-padded prefill program call).
+        Returns #admitted; the rest stay with the caller."""
+        n = min(len(requests), len(self._free))
+        if n == 0:
+            return 0
+        now = time.perf_counter() if now is None else now
+        batch = requests[:n]
+        slots = [self._free.pop(0) for _ in range(n)]
+        bucket = self.bucket_for(n)
+        enc = np.zeros((bucket, self.enc_len), np.int32)
+        for i, r in enumerate(batch):
+            row = np.asarray(r.data, np.int32).reshape(-1)
+            if row.shape[0] != self.enc_len:
+                # contract: fixed enc_len per endpoint (clients pad);
+                # clamp/pad here so one odd record cannot poison the
+                # whole pool program
+                padded = np.zeros(self.enc_len, np.int32)
+                padded[:min(row.shape[0], self.enc_len)] = \
+                    row[:self.enc_len]
+                row = padded
+            enc[i] = row
+        ids = self._pad_ids(slots, bucket)
+        try:
+            self._tokens, self._carries = self._prefill(
+                self._params, self._tokens, self._carries,
+                np.asarray(enc), ids)
+        except BaseException as e:   # noqa: BLE001 — containment
+            # a failed prefill fails exactly the batch it was
+            # admitting — and CONSUMES it (the caller pops it off the
+            # queue), because re-queueing a deterministically-poison
+            # group would fail every future iteration forever.  The
+            # device state may hold consumed donated buffers: rebuild.
+            self._tokens, self._carries = self._fresh_state()
+            self._free = sorted(set(self._free) | set(slots))
+            for r in batch:
+                self._m_retired.labels(self._endpoint_name,
+                                       "error").inc()
+                if not r.done:
+                    r.fail(e)
+            log.exception("prefill failed; %d admitting sequence(s) "
+                          "failed and consumed", n)
+            if not isinstance(e, Exception):
+                raise      # process-death class: PEL-reclaim contract
+            return n
+        for r, slot in zip(batch, slots):
+            budget = self.max_seq_len
+            if getattr(r, "max_tokens", None):
+                budget = max(1, min(int(r.max_tokens),
+                                    self.max_seq_len))
+            self._active[slot] = _ActiveSeq(
+                request=r, max_tokens=budget, admitted_at=now,
+                last_token_at=now)
+            self.admit_log.append((self.iterations, slot))
+        self.admitted_total += n
+        self._m_admitted.labels(self._endpoint_name).inc(n)
+        self._m_occupancy.labels(self._endpoint_name).set(
+            len(self._active) / self.capacity)
+        return n
+
+    # ------------------------------------------------------------ iteration
+    def step_once(self) -> int:
+        """One decode iteration over the active slots: gather → step →
+        scatter → emit.  Retires EOS/budget-exhausted sequences and
+        frees their slots.  Returns #tokens emitted."""
+        # sweep abandoned sequences first: a transport that timed a
+        # request out already answered its client — decoding its
+        # remaining tokens would burn device steps on a response
+        # nobody reads (the generative twin of the batcher's
+        # compose-time drop)
+        for slot in [s for s, seq in self._active.items()
+                     if seq.request.done]:
+            seq = self._active.pop(slot)
+            self._free.append(slot)
+            self.retire_log.append((self.iterations, slot))
+            self._m_retired.labels(self._endpoint_name,
+                                   "abandoned").inc()
+        if not self._active:
+            self._m_occupancy.labels(self._endpoint_name).set(0.0)
+            return 0
+        slots = sorted(self._active)
+        bucket = self.bucket_for(len(slots))
+        ids = self._pad_ids(slots, bucket)
+        self._tokens, self._carries, emitted = self._step(
+            self._params, self._tokens, self._carries, ids)
+        emitted = np.asarray(emitted)     # the iteration's ONE sync
+        self.iterations += 1
+        now = time.perf_counter()
+        self._m_steps.labels(self._endpoint_name).inc()
+        n_emitted = len(slots)
+        self._m_tokens.labels(self._endpoint_name).inc(n_emitted)
+        for lane, slot in enumerate(slots):
+            seq = self._active[slot]
+            tok = int(emitted[lane])
+            first = not seq.tokens
+            seq.tokens.append(tok)
+            self._m_inter_token.observe(now - seq.last_token_at)
+            if first:
+                self._m_first_token.observe(
+                    now - (seq.request.arrival or seq.admitted_at))
+            seq.last_token_at = now
+            cb = getattr(seq.request, "on_token", None)
+            if cb is not None:
+                try:
+                    cb(len(seq.tokens) - 1, tok)
+                except Exception:   # noqa: BLE001 — streaming is
+                    pass            # best-effort, decode is not
+            if (self.stop_sign is not None
+                    and tok == self.stop_sign):
+                self._retire(slot, "eos")
+            elif len(seq.tokens) >= seq.max_tokens:
+                self._retire(slot, "max_tokens")
+        self._m_occupancy.labels(self._endpoint_name).set(
+            len(self._active) / self.capacity)
+        return n_emitted
+
+    def _retire(self, slot: int, cause: str) -> None:
+        seq = self._active.pop(slot)
+        self._free.append(slot)
+        self.retire_log.append((self.iterations, slot))
+        self._m_retired.labels(self._endpoint_name, cause).inc()
+        seq.request.complete(list(seq.tokens))
+
+    # -------------------------------------------------------------- failure
+    def fail_all(self, exc: BaseException) -> int:
+        """The generative poison contract: the active sequences share
+        one fused device program, so a failed iteration fails them ALL
+        (each request carries the error to its transport) and the pool
+        resets to empty — the endpoint is never wedged on corrupt
+        state."""
+        n = len(self._active)
+        for slot, seq in list(self._active.items()):
+            self._m_retired.labels(self._endpoint_name, "error").inc()
+            if not seq.request.done:
+                seq.request.fail(exc)
+        self._active.clear()
+        self._free = list(range(self.capacity))
+        # the failed call may have consumed the donated state buffers
+        # before raising — rebuild, don't reuse
+        self._tokens, self._carries = self._fresh_state()
+        self._m_occupancy.labels(self._endpoint_name).set(0.0)
+        return n
+
+
+class GenerativeEndpoint(Endpoint):
+    """A served *generative* model: a queue of sequences + the decode
+    slot pool the scheduler iterates.  The batcher treats it like any
+    endpoint for scheduling credits, but routes it through
+    ``ModelExecutor.execute_decode`` (one decode ITERATION per credit)
+    instead of the stateless batch compose."""
+
+    generative = True
+
+    def __init__(self, name: str, model, *, enc_len: int,
+                 start_sign: int, stop_sign: Optional[int] = None,
+                 max_seq_len: int = 32, slots: int = 4,
+                 buckets=(), weight: int = 1):
+        super().__init__(name, model, top_n=1, buckets=buckets,
+                         batch_size=slots,
+                         input_shape=(int(enc_len),), weight=weight)
+        self.pool = DecodeSlotPool(
+            model, capacity=int(slots), enc_len=int(enc_len),
+            start_sign=start_sign, stop_sign=stop_sign,
+            max_seq_len=int(max_seq_len), buckets=self.buckets)
+        self.pool._endpoint_name = name
+        self.max_seq_len = int(max_seq_len)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.queue) or bool(self.pool.active_count)
+
+    def warm(self) -> int:
+        """Warm the full (batch_bucket, state_bucket) program ladder
+        instead of the stateless predict rungs."""
+        return self.pool.warm()
+
+    # ----------------------------------------------------------- scheduling
+    def backfill(self) -> int:
+        """Admit queued sequences into free slots (whole queue-order,
+        skipping requests a transport already timed out).  Queue pops
+        are GIL-atomic deque ops — submit() appends under the
+        batcher's lock, the executor thread pops here without it, the
+        deque itself is the synchronization point."""
+        admitted = 0
+        while self.queue and self.pool._free:
+            group = self.queue[0]
+            live = [r for r in group if not r.done]
+            if not live:
+                self.queue.popleft()
+                continue
+            n = self.pool.admit(live)
+            admitted += n
+            if n < len(live):
+                # pool full mid-group: keep the remainder queued
+                group[:] = live[n:]
+                break
+            self.queue.popleft()
+        return admitted
+
+    def run_iteration(self) -> int:
+        """One scheduler iteration: step the active slots, retire
+        finished sequences, and backfill the freed slots from the
+        queue in the SAME iteration.  Returns #tokens emitted +
+        #sequences admitted (0 = no work left)."""
+        emitted = self.pool.step_once()
+        admitted = self.backfill()
+        if emitted == 0 and admitted:
+            # freshly admitted into an idle pool: run their first
+            # step now rather than waiting for the next credit —
+            # first-token latency is the point of the fast path
+            emitted = self.pool.step_once()
+        return emitted + admitted

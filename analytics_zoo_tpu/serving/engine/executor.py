@@ -45,6 +45,17 @@ def default_buckets(batch_size: int) -> Tuple[int, ...]:
     return tuple(out)
 
 
+def bucket_for(buckets: Sequence[int], n: int) -> int:
+    """Smallest ladder rung that fits ``n`` records — the one
+    bucket-selection rule (shared by stateless endpoints and the
+    decode slot pool, whose ladders come from the same
+    ``parse_buckets``)."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
 def parse_buckets(spec, batch_size: int) -> Tuple[int, ...]:
     """Normalize a bucket spec (``"1,4,16"`` / iterable / None):
     sorted, deduped, capped at ``batch_size``, and always containing
@@ -81,12 +92,19 @@ class Endpoint:
         self.queue: deque = deque()
         self.records_total = 0
 
+    #: generative endpoints override (decode.GenerativeEndpoint) —
+    #: the batcher routes on it without importing the decode module
+    generative = False
+
+    @property
+    def has_work(self) -> bool:
+        """Whether the scheduler should hand this endpoint a credit
+        (generative endpoints also count active decode slots)."""
+        return bool(self.queue)
+
     def bucket_for(self, n: int) -> int:
         """Smallest warmed bucket that fits ``n`` records."""
-        for b in self.buckets:
-            if b >= n:
-                return b
-        return self.buckets[-1]
+        return bucket_for(self.buckets, n)
 
     def warm(self) -> int:
         """AOT warm-start every bucket (no-op without a model ``warm``
@@ -114,12 +132,16 @@ class EndpointRegistry:
         self._lock = threading.Lock()
 
     def register(self, name: str, model, **kwargs) -> Endpoint:
-        ep = Endpoint(name, model, **kwargs)
+        return self.add(Endpoint(name, model, **kwargs))
+
+    def add(self, ep: Endpoint) -> Endpoint:
+        """Register a pre-built endpoint (how generative endpoints,
+        which carry a decode slot pool, enter the registry)."""
         with self._lock:
-            if name in self._endpoints:
+            if ep.name in self._endpoints:
                 raise ValueError(
-                    f"serving endpoint {name!r} already registered")
-            self._endpoints[name] = ep
+                    f"serving endpoint {ep.name!r} already registered")
+            self._endpoints[ep.name] = ep
         return ep
 
     def get(self, name: str) -> Optional[Endpoint]:
@@ -201,6 +223,33 @@ class ModelExecutor:
             r.complete(v)
         ep.records_total += real
         return real
+
+    def execute_decode(self, ep) -> int:
+        """One decode-step scheduler iteration for a generative
+        endpoint: step the active slots, retire EOS/budget-finished
+        sequences, backfill freed slots from the queue — the stateful
+        twin of :meth:`execute`.  Failure contract mirrors the
+        stateless path: a model ``Exception`` fails exactly the
+        sequences whose state shared the fused step program (the pool
+        resets, the thread survives); a non-``Exception`` escape
+        re-raises after failing them, so the Redis transport's loop
+        dies with its batch un-acked — the PEL-reclaim trigger."""
+        self._m_fill.set(ep.pool.active_count / ep.pool.capacity)
+        try:
+            with self._tracer.span(
+                    "serving_decode_step", endpoint=ep.name,
+                    active=ep.pool.active_count,
+                    queued=len(ep.queue)):
+                return ep.run_iteration()
+        except Exception as e:
+            log.exception("decode iteration failed for endpoint %s "
+                          "(%d active)", ep.name,
+                          ep.pool.active_count)
+            ep.pool.fail_all(e)
+            return 0
+        except BaseException as e:   # noqa: BLE001 — process-death class
+            ep.pool.fail_all(e)
+            raise
 
     @staticmethod
     def postprocess(out: np.ndarray, top_n: int) -> List[List]:

@@ -19,8 +19,17 @@ Contract (stdlib-only, JSON over ``ThreadingHTTPServer``):
   ...}``; 404 unknown endpoint, 400 undecodable payload, 500 predict
   error, 504 deadline.  (A stopped engine restarts on submit, so
   there is deliberately no "engine down" status.)
+* ``POST /generate/<endpoint>`` — generative endpoints only: body as
+  above (``data`` = the int token sequence) plus optional
+  ``max_tokens``.  The response STREAMS (chunked transfer): one JSON
+  line per token, ``{"token": t, "index": i}``, the moment the decode
+  scheduler emits it, then a final line ``{"done": true, "tokens":
+  [...], "request_id": ..., "endpoint": ...}`` (or ``{"error": ...}``
+  if decode failed mid-stream).  Pre-stream failures use the predict
+  status contract (400/404/504; 400 also for a non-generative
+  endpoint).
 * ``GET /endpoints`` — the registry listing (name → buckets, top_n,
-  weight, records served).
+  weight, records served; generative endpoints add slots/max_seq_len).
 
 Each handler thread blocks on its own request's completion — HTTP
 concurrency is the transport's in-flight window, the batcher decides
@@ -46,9 +55,9 @@ from analytics_zoo_tpu.serving.engine.core import DEFAULT_ENDPOINT
 log = logging.getLogger("analytics_zoo_tpu.serving.engine")
 
 
-def decode_payload(body: bytes):
-    """JSON body → (ndarray, uri, request_id).  Raises ValueError on
-    anything undecodable (the handler answers 400)."""
+def decode_payload(body: bytes, default_dtype: str = "float32"):
+    """JSON body → (ndarray, uri, request_id, doc).  Raises ValueError
+    on anything undecodable (the handler answers 400)."""
     try:
         doc = json.loads(body or b"{}")
     except json.JSONDecodeError as e:
@@ -61,11 +70,11 @@ def decode_payload(body: bytes):
         raw = base64.b64decode(doc["npy_b64"])
         arr = np.load(io.BytesIO(raw), allow_pickle=False)
     elif "data" in doc:
-        arr = np.asarray(doc["data"],
-                         dtype=np.dtype(doc.get("dtype") or "float32"))
+        arr = np.asarray(doc["data"], dtype=np.dtype(
+            doc.get("dtype") or default_dtype))
     else:
         raise ValueError("payload needs 'data' or 'npy_b64'")
-    return arr, uri, str(rid)
+    return arr, uri, str(rid), doc
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -88,12 +97,18 @@ class _Handler(BaseHTTPRequestHandler):
         if path in ("/endpoints", "/"):
             out = {}
             for ep in engine.registry:
-                out[ep.name] = {
+                entry = {
                     "buckets": list(ep.buckets),
                     "top_n": ep.top_n,
                     "weight": ep.weight,
                     "records_total": ep.records_total,
                 }
+                if ep.generative:
+                    entry.update(generative=True,
+                                 slots=ep.pool.capacity,
+                                 enc_len=ep.pool.enc_len,
+                                 max_seq_len=ep.max_seq_len)
+                out[ep.name] = entry
             self._respond(200, {"endpoints": out})
         else:
             self._respond(404, {"error": f"no route {path!r}"})
@@ -101,14 +116,37 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:   # noqa: N802 — stdlib API
         path = self.path.split("?", 1)[0]
         transport = self.server.transport
-        if path != "/predict" and not path.startswith("/predict/"):
+        for route in ("/predict", "/generate"):
+            if path == route or path.startswith(route + "/"):
+                break
+        else:
             self._respond(404, {"error": f"no route {path!r}"})
             return
-        endpoint = path[len("/predict"):].strip("/") or DEFAULT_ENDPOINT
+        endpoint = path[len(route):].strip("/") or DEFAULT_ENDPOINT
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
+        if route == "/generate":
+            transport.handle_generate(endpoint, body, self)
+            return
         code, doc = transport.handle_predict(endpoint, body)
         self._respond(code, doc)
+
+    # --------------------------------------------------- chunked streaming
+    def start_stream(self, code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+    def stream_line(self, doc: dict) -> None:
+        data = json.dumps(doc).encode() + b"\n"
+        self.wfile.write(f"{len(data):X}\r\n".encode() + data
+                         + b"\r\n")
+        self.wfile.flush()
+
+    def end_stream(self) -> None:
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
 
 
 class HttpTransport:
@@ -174,7 +212,7 @@ class HttpTransport:
         import time
         t0 = time.perf_counter()
         try:
-            arr, uri, rid = decode_payload(body)
+            arr, uri, rid, _doc = decode_payload(body)
         except ValueError as e:
             self._m_requests.labels("bad_request").inc()
             return 400, {"error": str(e)}
@@ -199,3 +237,118 @@ class HttpTransport:
         self._m_requests.labels("ok").inc()
         return 200, {"value": req.result, "request_id": rid,
                      "endpoint": endpoint}
+
+    def handle_generate(self, endpoint: str, body: bytes,
+                        handler) -> None:
+        """One streaming generate request: validate, submit to the
+        decode scheduler, and relay each emitted token onto the
+        connection as a chunked JSON line the moment it arrives —
+        inter-token latency on the wire tracks the device decode
+        step, not the sequence.  ``handler`` is the live request
+        handler (chunked writes need the socket)."""
+        import queue as _queue
+        import time
+        try:
+            arr, uri, rid, doc = decode_payload(body,
+                                                default_dtype="int32")
+        except ValueError as e:
+            self._m_requests.labels("bad_request").inc()
+            handler._respond(400, {"error": str(e)})
+            return
+        ep = self.engine.registry.get(endpoint)
+        if ep is None:
+            self._m_requests.labels("unknown_endpoint").inc()
+            handler._respond(404, {
+                "error": f"unknown endpoint {endpoint!r}",
+                "endpoints": self.engine.endpoints()})
+            return
+        if not ep.generative:
+            self._m_requests.labels("bad_request").inc()
+            handler._respond(400, {
+                "error": f"endpoint {endpoint!r} is not generative; "
+                         f"POST /predict/{endpoint} instead"})
+            return
+        try:
+            max_tokens = int(doc["max_tokens"]) \
+                if doc.get("max_tokens") else None
+        except (TypeError, ValueError):
+            self._m_requests.labels("bad_request").inc()
+            handler._respond(400, {"error": "bad max_tokens"})
+            return
+        emitted: _queue.Queue = _queue.Queue()
+        req = Request(endpoint=endpoint, uri=uri,
+                      data=np.asarray(arr, np.int32).reshape(-1),
+                      request_id=rid, max_tokens=max_tokens,
+                      on_token=lambda i, t: emitted.put((i, t)))
+        with self._tracer.span("serving_http_generate",
+                               endpoint=endpoint, request_id=rid):
+            self.engine.submit([req])
+            # INACTIVITY deadline, reset on every token: a healthy
+            # stream still emitting must never be killed for total
+            # duration — only a stall of timeout_s with no tokens is
+            # a timeout (and a pre-stream stall still gets a clean
+            # 504 status line)
+            deadline = time.monotonic() + self.timeout_s
+            streaming = False
+            try:
+                while True:
+                    try:
+                        i, tok = emitted.get(timeout=0.05)
+                    except _queue.Empty:
+                        if req.done:
+                            break
+                        if time.monotonic() >= deadline:
+                            req.fail(TimeoutError(
+                                f"no tokens within "
+                                f"{self.timeout_s:.1f}s"))
+                            break
+                        continue
+                    deadline = time.monotonic() + self.timeout_s
+                    if not streaming:
+                        handler.start_stream()
+                        streaming = True
+                    handler.stream_line({"token": tok, "index": i})
+                # drain stragglers emitted between the last get and
+                # completion so the final token count matches
+                while True:
+                    try:
+                        i, tok = emitted.get_nowait()
+                    except _queue.Empty:
+                        break
+                    if streaming:
+                        handler.stream_line({"token": tok,
+                                             "index": i})
+                if req.error is not None:
+                    timed_out = isinstance(req.error, TimeoutError)
+                    self._m_requests.labels(
+                        "timeout" if timed_out else "error").inc()
+                    err = {"error": f"{type(req.error).__name__}: "
+                                    f"{req.error}",
+                           "request_id": rid, "endpoint": endpoint}
+                    if streaming:
+                        handler.stream_line(err)
+                        handler.end_stream()
+                    else:
+                        handler._respond(504 if timed_out else 500,
+                                         err)
+                    return
+                if not streaming:
+                    handler.start_stream()
+                handler.stream_line({"done": True,
+                                     "tokens": req.result,
+                                     "request_id": rid,
+                                     "endpoint": endpoint})
+                handler.end_stream()
+                self._m_requests.labels("ok").inc()
+            except (BrokenPipeError, ConnectionError, OSError):
+                # the client hung up mid-stream: mark the request done
+                # so the scheduler's abandoned-sweep retires its slot
+                # instead of decoding tokens nobody reads — a burst of
+                # disconnects must not pin the pool full of dead
+                # sequences until max_seq_len
+                if not req.done:
+                    req.fail(ConnectionError(
+                        "generate client disconnected mid-stream"))
+                log.debug("generate stream client disconnect "
+                          "(endpoint %s, request %s)", endpoint, rid)
+                self._m_requests.labels("client_gone").inc()

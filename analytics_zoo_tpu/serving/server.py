@@ -390,6 +390,14 @@ class ClusterServing:
         self._inflight: set = set()
         # last time the (extra-broker-op) group-lag gauge refreshed
         self._backlog_obs_at = 0.0
+        # THIS worker's last observed backlog.  /healthz and admission
+        # control read this instance field, not the shared
+        # ``serving_queue_depth`` gauge: the gauge is one registry-wide
+        # series, so any other serving instance still draining in the
+        # same process (tests, embedded multi-worker setups) could
+        # overwrite it between a refresh and a readiness probe —
+        # flipping this worker's verdict on someone else's traffic
+        self._backlog_seen = 0.0
         # ---- observability: shared-registry instruments + /metrics --
         reg = get_registry()
         self._m_latency = reg.histogram(
@@ -463,6 +471,28 @@ class ClusterServing:
             buckets=buckets or cfg.batch_buckets,
             batch_size=cfg.batch_size,
             input_shape=input_shape or cfg.input_shape,
+            weight=weight)
+
+    def register_generative_endpoint(self, name: str, model, *,
+                                     enc_len: int, start_sign: int,
+                                     stop_sign: Optional[int] = None,
+                                     max_seq_len: int = 32,
+                                     slots: Optional[int] = None,
+                                     buckets=None, weight: int = 1):
+        """Register a *generative* model (``Seq2seq``'s decode
+        contract) under ``name``: records routed to it are token
+        SEQUENCES served by the decode-step scheduler — admitted into
+        a device-resident slot pool, decoded one iteration at a time
+        with EOS early-exit and same-iteration backfill, their results
+        written as the emitted token list.  Stream records may carry a
+        ``max_tokens`` field (client ``enqueue(..., max_tokens=)``)
+        to cap their own sequence."""
+        cfg = self.config
+        return self.engine.register_generative(
+            name, model, enc_len=enc_len, start_sign=start_sign,
+            stop_sign=stop_sign, max_seq_len=max_seq_len,
+            slots=cfg.batch_size if slots is None else slots,
+            buckets=buckets or cfg.batch_buckets or (),
             weight=weight)
 
     # ----------------------------------------------------------- warm-start
@@ -564,12 +594,19 @@ class ClusterServing:
         xlen, which the XLEN below already fetched."""
         qlen = self.broker.xlen(INPUT_STREAM)
         if not self.config.consumer_group:
-            self._m_queue.set(qlen)
+            self._note_backlog(qlen)
         elif time.perf_counter() - self._backlog_obs_at >= 0.25:
-            self._m_queue.set(self._backlog())
+            self._note_backlog(self._backlog())
             self._backlog_obs_at = time.perf_counter()
         if qlen > self.config.max_stream_len:
             self.broker.xtrim(INPUT_STREAM, self.config.max_stream_len)
+
+    def _note_backlog(self, depth: float) -> None:
+        """Record an observed input-stream backlog: the exported gauge
+        (autoscaler / dashboards) AND this worker's own readiness/
+        admission view of it."""
+        self._backlog_seen = float(depth)
+        self._m_queue.set(depth)
 
     def _write_result(self, uri: str, value: str,
                       retries: Optional[int] = None,
@@ -759,7 +796,7 @@ class ClusterServing:
         chaos = active_chaos()
         if chaos is not None:
             chaos.trip(SITE_SERVING_DECODE, next(self._decode_seq))
-        uris, arrays, rids, eps, failed = [], [], [], [], []
+        uris, arrays, rids, eps, mts, failed = [], [], [], [], [], []
         for entry_id, fields in entries:
             try:
                 uri, arr, rid = decode_field(fields)
@@ -772,7 +809,8 @@ class ClusterServing:
             arrays.append(arr)
             rids.append(rid)
             eps.append(self._endpoint_of(fields))
-        return uris, arrays, failed, rids, eps
+            mts.append(self._max_tokens_of(fields))
+        return uris, arrays, failed, rids, eps, mts
 
     @staticmethod
     def _uri_of(fields) -> str:
@@ -794,6 +832,19 @@ class ClusterServing:
         if isinstance(ep, bytes):
             ep = ep.decode()
         return ep or DEFAULT_ENDPOINT
+
+    @staticmethod
+    def _max_tokens_of(fields) -> Optional[int]:
+        """Generative records may cap their own sequence length
+        (client ``enqueue(..., max_tokens=)``); None elsewhere."""
+        mt = fields.get("max_tokens") if hasattr(fields, "get") \
+            else None
+        if isinstance(mt, bytes):
+            mt = mt.decode()
+        try:
+            return int(mt) if mt else None
+        except (TypeError, ValueError):
+            return None
 
     # ------------------------------------------------- admission control
     @staticmethod
@@ -825,7 +876,7 @@ class ClusterServing:
         if not entries or deadline <= 0:
             return entries
         overloaded = (cfg.healthz_max_queue > 0
-                      and self._m_queue.value > cfg.healthz_max_queue)
+                      and self._backlog_seen > cfg.healthz_max_queue)
         cut = deadline / 2.0 if overloaded else deadline
         now_ms = time.time() * 1000.0
         keep, shed = [], []
@@ -884,16 +935,17 @@ class ClusterServing:
         is acked without a prediction gets an explicit ERROR result so
         its client never blocks forever on a consumed record.
         ``decoded`` is (uris, arrays[, failed[, request_ids[,
-        endpoints]]])."""
+        endpoints[, max_tokens]]]])."""
         uris, arrays, *rest = decoded
         failed = list(rest[0]) if rest else []
         rids = list(rest[1]) if len(rest) > 1 else [None] * len(uris)
         eps = list(rest[2]) if len(rest) > 2 else \
             [DEFAULT_ENDPOINT] * len(uris)
+        mts = list(rest[3]) if len(rest) > 3 else [None] * len(uris)
         real = 0
         try:
             real = self._predict_write(uris, arrays, t_arrival, rids,
-                                       eps)
+                                       eps, mts)
         except Exception as e:
             log.exception("poison batch skipped (%d records)",
                           len(entries))
@@ -914,7 +966,8 @@ class ClusterServing:
         return real
 
     def _predict_write(self, uris, arrays, t_arrival: float,
-                       rids=None, endpoints=None) -> int:
+                       rids=None, endpoints=None,
+                       max_tokens=None) -> int:
         """Submit one decoded bulk batch to the engine as atomic
         per-endpoint groups, wait for the batcher's bucket-padded
         predicts, and write every result; returns #served.
@@ -931,6 +984,8 @@ class ClusterServing:
             rids = [None] * len(uris)
         if endpoints is None:
             endpoints = [DEFAULT_ENDPOINT] * len(uris)
+        if max_tokens is None:
+            max_tokens = [None] * len(uris)
         real = len(arrays)
         # the chaos site fires BEFORE the engine hand-off: a ``kill``
         # here is a replica dying mid-batch with the batch un-acked —
@@ -941,10 +996,12 @@ class ClusterServing:
         # group by endpoint (a bulk read may interleave models); each
         # group rides the engine as one atomic unit
         groups: Dict[str, List[Request]] = {}
-        for uri, arr, rid, ep in zip(uris, arrays, rids, endpoints):
+        for uri, arr, rid, ep, mt in zip(uris, arrays, rids,
+                                         endpoints, max_tokens):
             groups.setdefault(ep or DEFAULT_ENDPOINT, []).append(
                 Request(endpoint=ep or DEFAULT_ENDPOINT, uri=uri,
-                        data=arr, request_id=rid, arrival=t_arrival))
+                        data=arr, request_id=rid, arrival=t_arrival,
+                        max_tokens=mt))
         # the span carries the batch's request ids, so a trace viewer
         # (or the merged cluster timeline) can follow one request from
         # client enqueue through its predict to its result write
@@ -1031,7 +1088,7 @@ class ClusterServing:
             return {"reason": "breaker_open",
                     "cooldown_s": breaker.cooldown_s}
         if cfg.healthz_max_queue > 0:
-            depth = self._m_queue.value
+            depth = self._backlog_seen
             if depth > cfg.healthz_max_queue:
                 return {"reason": "queue_depth",
                         "queue_depth": int(depth),

@@ -186,7 +186,8 @@ class ServingSupervisor:
                  scale_up_sustain_s: Optional[float] = None,
                  scale_down_idle_s: Optional[float] = None,
                  scale_cooldown_s: Optional[float] = None,
-                 autoscale_interval_s: float = 1.0):
+                 autoscale_interval_s: float = 1.0,
+                 clock: Optional[Callable[[], float]] = None):
         if retry_times is None:
             retry_times = int(get_config().get(
                 "serving.supervisor_retry_times", 5))
@@ -196,6 +197,11 @@ class ServingSupervisor:
         if heartbeat_timeout_s is None:
             heartbeat_timeout_s = float(get_config().get(
                 "resilience.heartbeat_timeout_s", 30.0))
+        # every interval/hysteresis decision reads THIS clock (default
+        # monotonic): tests drive scale mechanics deterministically by
+        # injecting a fake clock and calling _tick() directly, instead
+        # of racing wall-clock sustain windows against a loaded CPU
+        self._clock = clock or time.monotonic
         self.worker_factory = worker_factory
         self.replicas = int(replicas)
         self.retry_times = int(retry_times)
@@ -336,7 +342,7 @@ class ServingSupervisor:
         r.proc = subprocess.Popen(cmd, env=full,
                                   preexec_fn=_set_pdeathsig)
         r.incarnation += 1
-        r.spawned_at = time.monotonic()
+        r.spawned_at = self._clock()
         r.next_spawn_at = None
         log.info("replica %d spawned (incarnation %d, pid %d)",
                  r.index, r.incarnation, r.proc.pid)
@@ -384,7 +390,7 @@ class ServingSupervisor:
         # a crash.  Stable-for-a-window replicas restart their
         # backoff ladder from the bottom (the budget itself refills on
         # the same window rule inside RetryBudget.consume)
-        if time.monotonic() - r.spawned_at > self.retry_window_s:
+        if self._clock() - r.spawned_at > self.retry_window_s:
             r.consecutive_failures = 0
         r.consecutive_failures += 1
         if not r.budget.consume():
@@ -394,7 +400,7 @@ class ServingSupervisor:
         delay = min(self.backoff_max_s,
                     self.backoff_base_s
                     * (2 ** (r.consecutive_failures - 1)))
-        r.next_spawn_at = time.monotonic() + delay
+        r.next_spawn_at = self._clock() + delay
         log.warning("replica %d died (%s); restart %d scheduled in "
                     "%.2fs (%d budget left)", r.index, cls,
                     r.incarnation, delay, r.budget.remaining)
@@ -715,7 +721,7 @@ class ServingSupervisor:
 
     # ------------------------------------------------------------- run loop
     def _tick(self) -> None:
-        now = time.monotonic()
+        now = self._clock()
         alive = 0
         for r in self._replicas:
             if r.proc is None:

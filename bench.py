@@ -27,6 +27,11 @@ records it):
   fast path) against one continuously-batching worker; emits
   per-transport p50/p99 request latency and the achieved batch fill
   ratio.
+* ``serving_generative`` — token-level continuous batching: the
+  decode-step scheduler (iteration-level admit/retire + slot pool)
+  vs naive whole-sequence decode on mixed-length traffic — useful
+  tokens/sec both paths, inter-token p50/p99 incl. first-token gaps,
+  device decode-step counts, and the speedup factor.
 * ``kernels`` — the fused kernel suite (ops/fused.py) + int8 path:
   fused optimizer update vs the optax triple pass (xla_bytes_per_step
   both ways, bytes saved, HBM-roofline attainment), the bias→GeLU /
@@ -720,6 +725,146 @@ def bench_serving_engine(n_records: int = 1024, batch_size: int = 16,
     }
 
 
+# ------------------------------------------------------ serving_generative
+def bench_serving_generative(n_requests: int = 64, slots: int = 16,
+                             max_seq_len: int = 32):
+    """Token-level continuous batching vs naive whole-sequence decode
+    (ISSUE 12 acceptance): the SAME Seq2seq, the same mixed-length
+    request burst, decoded two ways —
+
+    * **naive** — request-granularity batches of ``slots`` sequences
+      through ``Seq2seq.infer(early_exit=False)``: every batch pays
+      the full ``max_seq_len`` scan whatever its sequences actually
+      need, and a late request's first token waits for every earlier
+      batch (the pre-ISSUE-12 serving shape);
+    * **scheduled** — the decode-step scheduler: sequences admitted
+      into the AOT-warmed slot pool, retired at EOS / their token
+      budget, freed slots backfilled the same iteration, tokens
+      streamed per iteration.
+
+    Tokens/sec counts USEFUL tokens (up to each request's budget /
+    EOS) for both paths.  Inter-token p99 includes each request's
+    first-token gap — which is where the naive path's
+    wait-for-the-whole-previous-batch latency lives.  All metric
+    names are NEW (``serving_generative_*``), so ``--compare``
+    against a pre-ISSUE-12 baseline can never false-regress."""
+    import jax
+
+    from analytics_zoo_tpu.models.seq2seq import Seq2seq
+    from analytics_zoo_tpu.observability import get_registry
+    from analytics_zoo_tpu.serving.engine import Request, ServingEngine
+
+    VOCAB, STOP, STARTS = 512, 2, 1
+    m = Seq2seq(vocab_size=VOCAB, embed_dim=64, hidden_sizes=(192,))
+    m.init()
+    rs = np.random.RandomState(0)
+    enc_len = 12
+    enc = rs.randint(3, VOCAB, (n_requests, enc_len)).astype(np.int32)
+    # mixed-length traffic: heavy-tailed token budgets, mostly short
+    budgets = rs.choice([4, 6, 8, 12, 16, 24, max_seq_len],
+                        size=n_requests,
+                        p=[.25, .2, .2, .15, .1, .05, .05]).astype(int)
+
+    def useful(row, budget):
+        """Tokens a client actually wanted: cut at the budget and at
+        the first stop token (inclusive) — same accounting both
+        paths."""
+        row = list(row[:budget])
+        if STOP in row:
+            row = row[:row.index(STOP) + 1]
+        return row
+
+    # ---- naive: request-granularity whole-sequence decode ----------
+    m.infer(enc[:slots], start_sign=STARTS, max_seq_len=max_seq_len,
+            stop_sign=STOP, early_exit=False)         # warm the scan
+    naive_gaps, naive_tokens = [], 0
+    t0 = time.perf_counter()
+    for lo in range(0, n_requests, slots):
+        batch = enc[lo:lo + slots]
+        out = m.infer(batch, start_sign=STARTS,
+                      max_seq_len=max_seq_len, stop_sign=STOP,
+                      early_exit=False)
+        done = time.perf_counter()
+        for row, budget in zip(out, budgets[lo:lo + slots]):
+            toks = useful(row, budget)
+            naive_tokens += len(toks)
+            # the whole sequence lands at batch completion: the first
+            # token waited since the burst started, the rest are free
+            naive_gaps.append(done - t0)
+            naive_gaps.extend([0.0] * (len(toks) - 1))
+    naive_wall = time.perf_counter() - t0
+    naive_steps = ((n_requests + slots - 1) // slots) * max_seq_len
+
+    # ---- scheduled: the decode-step scheduler ----------------------
+    eng = ServingEngine()
+    ep = eng.register_generative(
+        "gen", m, enc_len=enc_len, start_sign=STARTS, stop_sign=STOP,
+        max_seq_len=max_seq_len, slots=slots)
+    ep.warm()                     # every (bucket, capacity) rung AOT
+    eng.start()
+    token_times = {i: [] for i in range(n_requests)}
+
+    def on_token(i):
+        return lambda _idx, _tok: token_times[i].append(
+            time.perf_counter())
+
+    t0 = time.perf_counter()
+    reqs = [Request(endpoint="gen", uri=f"g{i}", data=enc[i],
+                    max_tokens=int(budgets[i]), on_token=on_token(i))
+            for i in range(n_requests)]
+    eng.wait_all(eng.submit(reqs), timeout_s=600)
+    sched_wall = time.perf_counter() - t0
+    errors = [r for r in reqs if r.error is not None]
+    sched_tokens = sum(len(r.result) for r in reqs
+                       if r.error is None)
+    sched_gaps = []
+    for i in range(n_requests):
+        times = token_times[i]
+        if not times:
+            continue
+        sched_gaps.append(times[0] - t0)        # first-token gap
+        sched_gaps.extend(np.diff(times).tolist())
+    sched_steps = ep.pool.iterations
+    occupancy = get_registry().gauge(
+        "serving_slot_occupancy",
+        "active decode slots / pool capacity",
+        labels=("endpoint",)).labels("gen").value
+    eng.stop()
+
+    def pct(gaps, p):
+        return float(np.percentile(gaps, p) * 1e3) if gaps else 0.0
+
+    naive_tps = naive_tokens / max(naive_wall, 1e-9)
+    sched_tps = sched_tokens / max(sched_wall, 1e-9)
+    dev = jax.devices()[0]
+    return {
+        "metric": "serving_generative_tokens_per_sec",
+        "value": round(sched_tps, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "workload": "serving_generative",
+        "n_requests": n_requests,
+        "slots": slots,
+        "max_seq_len": max_seq_len,
+        "useful_tokens": sched_tokens,
+        "errors": len(errors),
+        "scheduled_tokens_per_sec": round(sched_tps, 1),
+        "scheduled_decode_steps": sched_steps,
+        "scheduled_inter_token_p50_ms": round(pct(sched_gaps, 50), 2),
+        "scheduled_inter_token_p99_ms": round(pct(sched_gaps, 99), 2),
+        "naive_tokens_per_sec": round(naive_tps, 1),
+        "naive_decode_steps": naive_steps,
+        "naive_inter_token_p50_ms": round(pct(naive_gaps, 50), 2),
+        "naive_inter_token_p99_ms": round(pct(naive_gaps, 99), 2),
+        "speedup_vs_naive": round(sched_tps / max(naive_tps, 1e-9), 2),
+        "step_reduction_vs_naive": round(
+            naive_steps / max(sched_steps, 1), 2),
+        "final_slot_occupancy": round(float(occupancy), 3),
+        "device": str(dev),
+        "device_kind": getattr(dev, "device_kind", "?"),
+    }
+
+
 # ----------------------------------------------------------- input_pipeline
 def bench_input_pipeline(n_samples: int = 4096, batch_size: int = 128,
                          image_hw: int = 32):
@@ -1034,6 +1179,7 @@ WORKLOADS = {
     "resnet50": bench_resnet50,
     "serving": bench_serving,
     "serving_engine": bench_serving_engine,
+    "serving_generative": bench_serving_generative,
     "attention": bench_attention,
     "wide_deep": bench_wide_deep,
     "inception": bench_inception,
@@ -1052,6 +1198,9 @@ METRIC_NAMES = {
     "resnet50": "resnet50_imagenet_train_throughput",
     "serving": "cluster_serving_throughput",
     "serving_engine": "serving_engine_http_throughput",
+    # new metric names on purpose (--compare gates only metrics the
+    # baseline has, so a pre-ISSUE-12 baseline never false-regresses)
+    "serving_generative": "serving_generative_tokens_per_sec",
     "attention": "flash_attention_tokens_per_sec",
     "wide_deep": "wide_deep_census_train_throughput",
     "inception": "inception_v1_tfpark_train_throughput",

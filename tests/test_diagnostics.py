@@ -373,7 +373,12 @@ class TestServingReadiness:
                    "/healthz")
             body = json.load(urllib.request.urlopen(url))
             assert body == {"ready": True}
-            serving._m_queue.set(10)      # backlog beyond threshold
+            # the readiness probe reads THIS instance's observed
+            # backlog (not the shared registry gauge, which another
+            # still-draining serving instance in the same process
+            # could stomp between the set and the probe — the old
+            # contention flake)
+            serving._note_backlog(10)     # backlog beyond threshold
             with pytest.raises(urllib.error.HTTPError) as err:
                 urllib.request.urlopen(url)
             assert err.value.code == 503
@@ -381,7 +386,7 @@ class TestServingReadiness:
             assert reason["ready"] is False
             assert reason["reason"] == "queue_depth"
             assert reason["queue_depth"] == 10
-            serving._m_queue.set(0)       # drains -> ready again
+            serving._note_backlog(0)      # drains -> ready again
             assert json.load(urllib.request.urlopen(url))["ready"]
         finally:
             serving.close()

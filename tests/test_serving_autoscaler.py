@@ -27,21 +27,69 @@ REPLICA_WORKER = os.path.join(os.path.dirname(__file__),
                               "serving_replica_worker.py")
 
 # a stub replica that drains on SIGTERM (exit 0) and otherwise idles —
-# supervisor/autoscaler mechanics don't need a real serving loop
-_DRAIN_STUB = ("import signal, sys, time\n"
+# supervisor/autoscaler mechanics don't need a real serving loop.  It
+# touches STUB_READY_FILE once its handler is installed, so a
+# fake-clock test can order "retire" strictly after "booted" instead
+# of racing python startup.
+_DRAIN_STUB = ("import os, signal, sys, time\n"
                "signal.signal(signal.SIGTERM, lambda *_: sys.exit(0))\n"
+               "rf = os.environ.get('STUB_READY_FILE')\n"
+               "if rf:\n"
+               "    open(rf, 'w').write('1')\n"
                "time.sleep(120)\n")
 
 
-def _stub_factory():
+def _stub_factory(ready_dir=None):
     def factory(index, incarnation):
-        return [sys.executable, "-c", _DRAIN_STUB], {}
+        env = {}
+        if ready_dir:
+            env["STUB_READY_FILE"] = os.path.join(
+                ready_dir, f"ready-{index}-{incarnation}")
+        return [sys.executable, "-c", _DRAIN_STUB], env
     return factory
 
 
-def _scripted_supervisor(signals, **kw):
+def _stubs_ready(sup) -> bool:
+    """Every live stub has installed its SIGTERM drain handler (its
+    ready file exists) — the event a scale-down test must order
+    itself after."""
+    ready_dir = getattr(sup, "_stub_ready_dir", None)
+    if ready_dir is None:
+        return True
+    for r in sup._replicas:
+        if r.proc is None or r.proc.poll() is not None or r.retiring:
+            continue
+        # incarnation was bumped at spawn: the live process wrote
+        # ready-<index>-<incarnation-1>
+        if not os.path.exists(os.path.join(
+                ready_dir, f"ready-{r.index}-{r.incarnation - 1}")):
+            return False
+    return True
+
+
+class FakeClock:
+    """Injectable supervisor clock: the sustain/idle/cooldown windows
+    advance exactly when the test says so — mechanics assertions can
+    never miss under CPU contention, because no wall time is
+    involved."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _scripted_supervisor(signals, clock=None, **kw):
     """A supervisor whose signal collection is a script: ``signals``
-    is a mutable dict the test flips between pressure and idle."""
+    is a mutable dict the test flips between pressure and idle.  The
+    mechanics tests drive ``_tick()`` directly under a
+    :class:`FakeClock` — deterministic event ORDER, no wall-clock
+    thresholds."""
     defaults = dict(
         replicas=1, min_replicas=1, max_replicas=3,
         scale_up_queue_depth=10, scale_up_sustain_s=0.2,
@@ -50,7 +98,11 @@ def _scripted_supervisor(signals, **kw):
         health_interval_s=3600.0, startup_grace_s=3600.0,
         backoff_base_s=0.05, drain_timeout_s=10.0)
     defaults.update(kw)
-    sup = ServingSupervisor(_stub_factory(), **defaults)
+    import tempfile
+    ready_dir = tempfile.mkdtemp(prefix="zoo-stub-ready-")
+    sup = ServingSupervisor(_stub_factory(ready_dir), clock=clock,
+                            **defaults)
+    sup._stub_ready_dir = ready_dir
     sup._collect_signals = lambda: dict(signals)
     # the error-rate gate is probed lazily at scale-up time, and the
     # scale-down readiness interlock reads real /healthz history the
@@ -60,6 +112,27 @@ def _scripted_supervisor(signals, **kw):
     sup._scale_down_allowed = lambda: bool(
         signals.get("scale_down_allowed", True))
     return sup
+
+
+def _spawn_initial(sup):
+    for r in sup._replicas:
+        sup._spawn(r)
+
+
+def _tick_until(sup, clock, cond, dt=0.05, max_ticks=200,
+                settle_s=0.0):
+    """Advance the fake clock tick by tick until ``cond()`` (the
+    deterministic mechanics driver).  Bounded by tick COUNT, not wall
+    time; ``settle_s`` real-sleeps between ticks only where a real
+    subprocess event (stub exit) has to land."""
+    for _ in range(max_ticks):
+        if cond():
+            return True
+        sup._tick()
+        clock.advance(dt)
+        if settle_s:
+            time.sleep(settle_s)
+    return cond()
 
 
 def _wait_for(cond, timeout_s=20.0, interval=0.02):
@@ -72,27 +145,46 @@ def _wait_for(cond, timeout_s=20.0, interval=0.02):
 
 
 class TestAutoscalerMechanics:
+    """Scale mechanics on an injectable clock: every sustain/idle/
+    cooldown window advances ONLY when the test ticks it, so the
+    assertions are event-order facts, not wall-clock races (the PR 11
+    known-flake: these used to miss under whole-suite contention)."""
+
     def test_scales_up_on_sustained_pressure_and_down_on_idle(self):
+        clock = FakeClock()
         signals = {"queue": 100.0, "fill": 1.0, "p50_ms": 0.0,
                    "saw_metrics": True, "error_rate_hold": False}
-        sup = _scripted_supervisor(signals)
-        t = sup.run_background()
+        sup = _scripted_supervisor(signals, clock=clock)
         try:
-            assert _wait_for(lambda: sup._fleet_size() == 3), \
+            _spawn_initial(sup)
+            assert _tick_until(sup, clock,
+                               lambda: sup._fleet_size() == 3), \
                 sup.replica_trajectory
-            # ceiling respected under continued pressure
-            time.sleep(0.5)
+            # ceiling respected under continued pressure: another
+            # sustain window's worth of ticks changes nothing
+            for _ in range(20):
+                sup._tick()
+                clock.advance(0.05)
             assert sup._fleet_size() == 3
             assert len(sup._replicas) == 3
+            # order "retire" strictly after "every stub booted": a
+            # SIGTERM landing before python installs the drain
+            # handler would exit -15, not 0 (an event wait, not a
+            # timing window)
+            assert _wait_for(lambda: _stubs_ready(sup))
             # idle: drain back down to the floor, one retirement at a
             # time (cooldown), each retired replica exiting 0
             signals.update(queue=0.0, fill=0.0)
-            assert _wait_for(lambda: sup._fleet_size() == 1), \
+            assert _tick_until(sup, clock,
+                               lambda: sup._fleet_size() == 1), \
                 sup.replica_trajectory
-            # retirement completes asynchronously: both victims drain
-            # (SIGTERM handler) to exit 0 and are marked done
-            assert _wait_for(lambda: sum(
-                r.done for r in sup._replicas) == 2), sup.summary()
+            # retirement completes when the real stub processes drain
+            # (SIGTERM handler → exit 0): keep ticking until both
+            # exits are reaped — an event wait, not a timing window
+            assert _tick_until(
+                sup, clock,
+                lambda: sum(r.done for r in sup._replicas) == 2,
+                settle_s=0.02), sup.summary()
             retired = [r for r in sup._replicas if r.done]
             assert len(retired) == 2
             assert all(r.last_exit == 0 for r in retired)
@@ -104,99 +196,112 @@ class TestAutoscalerMechanics:
                 "serving_fleet_replicas", "")
             assert fleet.value == 1
         finally:
-            sup.stop()
-            t.join(timeout=20)
-        assert not t.is_alive()
+            sup.drain_fleet()
 
     def test_one_noisy_poll_never_scales(self):
+        clock = FakeClock()
         signals = {"queue": 0.0, "fill": 0.0, "p50_ms": 0.0,
                    "saw_metrics": True, "error_rate_hold": False}
-        sup = _scripted_supervisor(signals, scale_up_sustain_s=5.0,
+        sup = _scripted_supervisor(signals, clock=clock,
+                                   scale_up_sustain_s=5.0,
                                    scale_down_idle_s=3600.0)
-        t = sup.run_background()
         try:
-            _wait_for(lambda: sup._fleet_size() == 1, 5.0)
+            _spawn_initial(sup)
             # a single pressure spike, then back to calm: the sustain
-            # clock resets and no scale event fires
+            # clock resets and no scale event can ever fire
             signals["queue"] = 100.0
-            time.sleep(0.1)
+            sup._tick()
+            clock.advance(0.05)
             signals["queue"] = 0.0
-            time.sleep(0.5)
+            for _ in range(40):
+                sup._tick()
+                clock.advance(0.5)     # 20 fake seconds of calm
             assert sup._fleet_size() == 1
             assert sup.scale_events == []
         finally:
-            sup.stop()
-            t.join(timeout=20)
+            sup.drain_fleet()
 
     def test_error_rate_503_holds_scale_up(self):
+        clock = FakeClock()
         signals = {"queue": 100.0, "fill": 1.0, "p50_ms": 0.0,
                    "saw_metrics": True, "error_rate_hold": True}
-        sup = _scripted_supervisor(signals)
-        t = sup.run_background()
+        sup = _scripted_supervisor(signals, clock=clock)
         try:
-            time.sleep(0.8)      # well past sustain + cooldown
+            _spawn_initial(sup)
+            # far past sustain + cooldown in fake time: still held
+            for _ in range(30):
+                sup._tick()
+                clock.advance(0.1)
             assert sup._fleet_size() == 1, \
                 "scale-up must hold while a replica 503s error_rate"
             # the moment the stream is healthy again, scaling resumes
             signals["error_rate_hold"] = False
-            assert _wait_for(lambda: sup._fleet_size() >= 2)
+            assert _tick_until(sup, clock,
+                               lambda: sup._fleet_size() >= 2)
         finally:
-            sup.stop()
-            t.join(timeout=20)
+            sup.drain_fleet()
 
     def test_latency_slo_knob_scales_up(self):
+        clock = FakeClock()
         signals = {"queue": 0.0, "fill": 0.2, "p50_ms": 900.0,
                    "saw_metrics": True, "error_rate_hold": False}
-        sup = _scripted_supervisor(signals,
+        sup = _scripted_supervisor(signals, clock=clock,
                                    scale_up_latency_p50_ms=250.0,
                                    scale_down_idle_s=3600.0)
-        t = sup.run_background()
         try:
-            assert _wait_for(lambda: sup._fleet_size() >= 2
-                             and bool(sup.scale_events))
+            _spawn_initial(sup)
+            assert _tick_until(sup, clock,
+                               lambda: sup._fleet_size() >= 2
+                               and bool(sup.scale_events))
             assert sup.scale_events[0]["direction"] == "up"
             assert sup.scale_events[0]["signals"]["p50_ms"] == 900.0
         finally:
-            sup.stop()
-            t.join(timeout=20)
+            sup.drain_fleet()
 
     def test_warming_or_not_ready_replica_blocks_scale_down(self):
         """A fleet whose replicas are not all /healthz-200 (warming
         up, breaker open) cannot vouch that the backlog is really
         empty — idle must NOT retire capacity until everyone is
         ready (the cold-boot scale-to-floor guard)."""
+        clock = FakeClock()
         signals = {"queue": 0.0, "fill": 0.0, "p50_ms": 0.0,
                    "saw_metrics": True,
                    "scale_down_allowed": False}
-        sup = _scripted_supervisor(signals, replicas=2,
+        sup = _scripted_supervisor(signals, clock=clock, replicas=2,
                                    min_replicas=1, max_replicas=2)
-        t = sup.run_background()
         try:
-            _wait_for(lambda: sup._fleet_size() == 2, 10.0)
-            time.sleep(0.6)        # well past idle + cooldown
+            _spawn_initial(sup)
+            # far past idle + cooldown in fake time: still blocked
+            for _ in range(30):
+                sup._tick()
+                clock.advance(0.1)
             assert sup._fleet_size() == 2
             assert sup.scale_events == []
+            assert _wait_for(lambda: _stubs_ready(sup))
             signals["scale_down_allowed"] = True
-            assert _wait_for(lambda: sup._fleet_size() == 1)
+            assert _tick_until(sup, clock,
+                               lambda: sup._fleet_size() == 1)
         finally:
-            sup.stop()
-            t.join(timeout=20)
+            sup.drain_fleet()
 
     def test_blind_fleet_never_scales(self):
         """No reachable metrics endpoint = no evidence = no decision
         (a cold fleet must not be scaled off absent signals)."""
+        clock = FakeClock()
         signals = {"queue": 0.0, "fill": 0.0, "p50_ms": 0.0,
                    "saw_metrics": False, "error_rate_hold": False}
-        sup = _scripted_supervisor(signals, scale_down_idle_s=0.05,
+        sup = _scripted_supervisor(signals, clock=clock,
+                                   scale_down_idle_s=0.05,
                                    scale_up_sustain_s=0.05)
-        t = sup.run_background()
         try:
-            time.sleep(0.6)
+            _spawn_initial(sup)
+            for _ in range(30):
+                sup._tick()
+                clock.advance(0.1)
             assert sup._fleet_size() == 1
             assert sup.scale_events == []
         finally:
-            sup.stop()
-            t.join(timeout=20)
+            sup.drain_fleet()
 
     def test_autoscale_off_when_bounds_equal(self):
         sup = ServingSupervisor(_stub_factory(), replicas=2)

@@ -373,7 +373,7 @@ class TestAdmissionControl:
             inq.enqueue(f"mid-{i}", np.zeros(3, np.float32))
         time.sleep(0.4)                      # > deadline/2, < deadline
         # simulate the drowning backlog the last poll observed
-        serving._m_queue.set(10)
+        serving._note_backlog(10)
         assert serving.run_once(block_ms=0) == 0
         sheds = _dead_letters(broker, reason="shed")
         assert len(sheds) == 4
@@ -385,7 +385,7 @@ class TestAdmissionControl:
         for i in range(4):
             inq2.enqueue(f"ok-{i}", np.zeros(3, np.float32))
         time.sleep(0.4)
-        serving2._m_queue.set(1)
+        serving2._note_backlog(1)
         assert serving2.run_once(block_ms=0) == 4
 
     def test_shed_does_not_flip_error_rate_readiness(self):
