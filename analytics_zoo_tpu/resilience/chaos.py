@@ -35,6 +35,12 @@ Sites shipped in this repo:
   since the current plan became active (each newly installed plan sees
   steps 0, 1, 2, …), so ``at_step=0, times=k`` means "the next k
   broker ops fail" — a scripted broker outage window
+* ``serving.http``      — the HTTP fast-path transport (step = POST
+  counter per transport).  A raising kind makes the server DROP the
+  connection with no HTTP response (the transport-layer
+  disconnect class a load balancer or flaky network produces);
+  ``slow`` stalls the response — so HTTP-path faults are scriptable
+  exactly like ``serving.redis``/``serving.predict``
 
 Fault kinds:
 
@@ -78,6 +84,7 @@ SITE_BENCH_PROBE = "bench.probe"
 SITE_SERVING_DECODE = "serving.decode"
 SITE_SERVING_PREDICT = "serving.predict"
 SITE_SERVING_REDIS = "serving.redis"
+SITE_SERVING_HTTP = "serving.http"
 
 KINDS = ("raise", "drop_collective", "poison", "lose_host", "kill",
          "hang", "slow")

@@ -150,8 +150,14 @@ class OutputQueue:
                 def dec(v):
                     return v.decode() if isinstance(v, bytes) else v
                 rid = fields.get("request_id")
+                # received_monotonic: stamped INSIDE the client the
+                # moment the result hash was read, so an open-loop
+                # load generator can compute latency from its own
+                # scheduled time without wrapping (and re-timing) the
+                # poll/retry ladder
                 return {"value": json.loads(dec(fields.get("value"))),
-                        "request_id": dec(rid) if rid else None}
+                        "request_id": dec(rid) if rid else None,
+                        "received_monotonic": time.monotonic()}
             if time.monotonic() >= deadline:
                 return None
             time.sleep(poll_delay)
@@ -191,7 +197,7 @@ class ServingHttpClient:
         self.timeout_s = float(timeout_s)
 
     def _open_with_retries(self, req, timeout_s: float, retries: int,
-                           consume=None):
+                           consume=None, ts=None):
         """The ONE retry ladder both calls share: connection-class
         failures (socket errors — the server is gone or mid-restart)
         are absorbed up to ``retries`` consecutive attempts with
@@ -204,18 +210,34 @@ class ServingHttpClient:
         exchange retries — a connection dying mid-body-read re-POSTs
         the idempotent request.  Without it the open response is
         returned and only *establishing* it retried (the streaming
-        caller: tokens already delivered must not replay)."""
+        caller: tokens already delivered must not replay).
+
+        ``ts`` (a dict) receives monotonic timestamps stamped AT the
+        socket, not around the ladder: ``sent_monotonic`` (the start
+        of the attempt that ultimately landed — overwritten per
+        retry), ``first_byte_monotonic`` (response headers arrived),
+        ``received_monotonic`` (body consumed; only with
+        ``consume``).  Open-loop load generators read these instead
+        of re-timing the whole call, which would fold backoff sleeps
+        into the server-facing number."""
         import random
         from urllib import error as urlerror
         from urllib import request as urlrequest
         delay, failures = 0.05, 0
         while True:
             try:
+                if ts is not None:
+                    ts["sent_monotonic"] = time.monotonic()
                 r = urlrequest.urlopen(req, timeout=timeout_s)
+                if ts is not None:
+                    ts["first_byte_monotonic"] = time.monotonic()
                 if consume is None:
                     return r
                 with r:
-                    return consume(r)
+                    out = consume(r)
+                if ts is not None:
+                    ts["received_monotonic"] = time.monotonic()
+                return out
             except urlerror.HTTPError as e:
                 try:
                     doc = json.loads(e.read().decode())
@@ -254,9 +276,14 @@ class ServingHttpClient:
             f"{self.base_url}/predict/{endpoint}", data=body,
             headers={"Content-Type": "application/json"})
         # the whole exchange retries: the request was idempotent
-        return self._open_with_retries(
+        ts: Dict[str, float] = {}
+        doc = self._open_with_retries(
             req, timeout_s, retries,
-            consume=lambda r: json.loads(r.read().decode()))
+            consume=lambda r: json.loads(r.read().decode()), ts=ts)
+        if isinstance(doc, dict):
+            # socket-level monotonic stamps for open-loop measurement
+            doc.setdefault("client_ts", ts)
+        return doc
 
     def generate(self, endpoint: str, token_ids, *,
                  max_tokens: Optional[int] = None,
@@ -300,7 +327,8 @@ class ServingHttpClient:
             headers={"Content-Type": "application/json"})
         # only ESTABLISHING the stream retries; once chunks flow the
         # relay below runs exactly once
-        r = self._open_with_retries(req, timeout_s, retries)
+        ts: Dict[str, float] = {}
+        r = self._open_with_retries(req, timeout_s, retries, ts=ts)
         # relay chunks (urllib undoes the chunked framing; each line
         # is one JSON event)
         with r:
@@ -319,6 +347,8 @@ class ServingHttpClient:
                     raise ServingHttpError(200, doc["error"], doc)
                 elif doc.get("done"):
                     doc.setdefault("tokens", tokens)
+                    ts["received_monotonic"] = time.monotonic()
+                    doc.setdefault("client_ts", ts)
                     return doc
             # stream ended without a final line: the server died
             # mid-generation

@@ -39,6 +39,24 @@ from typing import Any, List, Optional, Sequence
 log = logging.getLogger("analytics_zoo_tpu.serving.engine")
 
 
+class ShedError(TimeoutError):
+    """Admission control dropped the request before it burned device
+    capacity (deadline passed while queued).  A ``TimeoutError``
+    subclass on purpose: the HTTP transport's status mapping answers
+    504 for the timeout class, and the message carries the ``shed:``
+    marker clients and the loadgen verdict key on.  ``age_ms`` /
+    ``deadline_ms`` carry the justification so the Redis transport
+    can dead-letter the shed with the same evidence fields the
+    stream-path shed records (the verdict proves every shed was
+    deadline-earned from exactly these)."""
+
+    def __init__(self, message: str, age_ms: float = 0.0,
+                 deadline_ms: float = 0.0):
+        super().__init__(message)
+        self.age_ms = float(age_ms)
+        self.deadline_ms = float(deadline_ms)
+
+
 @dataclasses.dataclass
 class Request:
     """One record flowing through the engine, transport-agnostic.

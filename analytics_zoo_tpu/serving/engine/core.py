@@ -51,20 +51,25 @@ class ServingEngine:
                             start_sign: int,
                             stop_sign: Optional[int] = None,
                             max_seq_len: int = 32, slots: int = 4,
-                            buckets=(), weight: int = 1):
+                            buckets=(), weight: int = 1,
+                            request_deadline_ms: float = 0.0):
         """Register a *generative* model (the ``Seq2seq`` decode
         contract: ``decode_params``/``prefill``/``decode_step``/
         ``initial_carries``) under an endpoint name.  Requests to it
         are SEQUENCES — admitted into a device-resident slot pool and
         decoded one iteration at a time, with EOS early-exit and
         same-iteration backfill (see ``engine.decode``).  ``slots``
-        sizes the pool (the generative analog of ``batch_size``)."""
+        sizes the pool (the generative analog of ``batch_size``);
+        ``request_deadline_ms`` > 0 sheds sequences still queued past
+        the deadline before they burn a slot (the stateless path's
+        admission-control contract, applied at the slot-pool gate)."""
         from analytics_zoo_tpu.serving.engine.decode import (
             GenerativeEndpoint)
         return self.registry.add(GenerativeEndpoint(
             name, model, enc_len=enc_len, start_sign=start_sign,
             stop_sign=stop_sign, max_seq_len=max_seq_len, slots=slots,
-            buckets=buckets, weight=weight))
+            buckets=buckets, weight=weight,
+            request_deadline_ms=request_deadline_ms))
 
     def endpoints(self) -> List[str]:
         return self.registry.names()

@@ -56,6 +56,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.serving.engine.batcher import ShedError
 from analytics_zoo_tpu.serving.engine.executor import (
     Endpoint, bucket_for, parse_buckets)
 
@@ -399,7 +400,8 @@ class GenerativeEndpoint(Endpoint):
     def __init__(self, name: str, model, *, enc_len: int,
                  start_sign: int, stop_sign: Optional[int] = None,
                  max_seq_len: int = 32, slots: int = 4,
-                 buckets=(), weight: int = 1):
+                 buckets=(), weight: int = 1,
+                 request_deadline_ms: float = 0.0):
         super().__init__(name, model, top_n=1, buckets=buckets,
                          batch_size=slots,
                          input_shape=(int(enc_len),), weight=weight)
@@ -409,6 +411,18 @@ class GenerativeEndpoint(Endpoint):
             max_seq_len=int(max_seq_len), buckets=self.buckets)
         self.pool._endpoint_name = name
         self.max_seq_len = int(max_seq_len)
+        # generative admission control (the PR 9 shed contract, which
+        # /generate and Redis generative groups used to bypass): a
+        # sequence still QUEUED — not yet admitted into a slot — past
+        # request_deadline_ms is shed before it burns a slot.  An
+        # ADMITTED sequence is never shed: its slot is already paid
+        # for and tokens may already be on the wire.  0 disables.
+        self.request_deadline_ms = float(request_deadline_ms or 0.0)
+        from analytics_zoo_tpu.observability import get_registry
+        self._m_shed = get_registry().counter(
+            "serving_shed_total",
+            "records shed by admission control instead of predicted",
+            labels=("cause",))
 
     @property
     def has_work(self) -> bool:
@@ -426,6 +440,7 @@ class GenerativeEndpoint(Endpoint):
         are GIL-atomic deque ops — submit() appends under the
         batcher's lock, the executor thread pops here without it, the
         deque itself is the synchronization point."""
+        self.shed_expired()
         admitted = 0
         while self.queue and self.pool._free:
             group = self.queue[0]
@@ -441,6 +456,39 @@ class GenerativeEndpoint(Endpoint):
                 break
             self.queue.popleft()
         return admitted
+
+    def shed_expired(self) -> int:
+        """Generative admission control (the PR 9 shed contract,
+        which ``/generate`` and Redis generative groups used to
+        bypass): a sequence still QUEUED — not yet admitted into a
+        slot — past ``request_deadline_ms`` is failed with
+        :class:`~.batcher.ShedError` and counted under
+        ``serving_shed_total{cause="deadline"}`` before it burns a
+        slot.  Runs every scheduler iteration, full pool included:
+        that is exactly when queue waits age sequences out, and the
+        client deserves its 504 now, not when a slot finally frees.
+        An ADMITTED sequence is never shed — its slot is already paid
+        for and tokens may already be on the wire.  Returns #shed."""
+        ddl_s = self.request_deadline_ms / 1000.0
+        if ddl_s <= 0 or not self.queue:
+            return 0
+        now = time.perf_counter()
+        shed = 0
+        for group in list(self.queue):
+            for r in group:
+                if r.done or not r.arrival \
+                        or now - r.arrival <= ddl_s:
+                    continue
+                age_ms = (now - r.arrival) * 1e3
+                self._m_shed.labels("deadline").inc()
+                shed += 1
+                r.fail(ShedError(
+                    f"shed: deadline ({age_ms:.0f}ms queued, "
+                    f"deadline {self.request_deadline_ms:.0f}ms) — "
+                    f"sequence never admitted",
+                    age_ms=age_ms,
+                    deadline_ms=self.request_deadline_ms))
+        return shed
 
     def run_iteration(self) -> int:
         """One scheduler iteration: step the active slots, retire

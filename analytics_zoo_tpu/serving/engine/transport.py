@@ -40,8 +40,10 @@ from __future__ import annotations
 
 import base64
 import io
+import itertools
 import json
 import logging
+import socket
 import threading
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -49,6 +51,8 @@ from typing import Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.resilience.chaos import (
+    SITE_SERVING_HTTP, InjectedFault, active_chaos)
 from analytics_zoo_tpu.serving.engine.batcher import Request
 from analytics_zoo_tpu.serving.engine.core import DEFAULT_ENDPOINT
 
@@ -116,6 +120,21 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:   # noqa: N802 — stdlib API
         path = self.path.split("?", 1)[0]
         transport = self.server.transport
+        # chaos site ``serving.http``: transport-layer faults, fired
+        # BEFORE the request is even read.  A raising kind drops the
+        # connection with no HTTP response (the network-disconnect
+        # class the client's retry ladder must absorb); ``slow``
+        # already slept inside trip — the straggling-proxy class.
+        try:
+            transport._trip_chaos()
+        except InjectedFault:
+            transport._m_requests.labels("chaos_dropped").inc()
+            self.close_connection = True
+            try:
+                self.connection.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            return
         for route in ("/predict", "/generate"):
             if path == route or path.startswith(route + "/"):
                 break
@@ -164,6 +183,14 @@ class HttpTransport:
         self.port: Optional[int] = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # chaos-site step counter (``serving.http``): POSTs arrive on
+        # handler threads — itertools.count.__next__ is GIL-atomic.
+        # Steps reset per installed plan (the serving.redis
+        # convention), so ``at_step=0, times=k`` always means "the
+        # next k POSTs" no matter how much traffic ran before a
+        # scenario armed its plan.
+        self._chaos_seq = itertools.count()
+        self._chaos_plan = None
         self._tracer = get_tracer()
         reg = get_registry()
         self._m_requests = reg.counter(
@@ -203,6 +230,20 @@ class HttpTransport:
     def url(self) -> Optional[str]:
         return (f"http://{self._host}:{self.port}"
                 if self.port else None)
+
+    def _trip_chaos(self) -> None:
+        """Fire the ``serving.http`` site for one POST.  Step counts
+        attempted POSTs since the CURRENT plan was installed (each new
+        plan sees steps 0, 1, 2, … — mirroring
+        ``BreakerClient._trip_chaos``)."""
+        plan = active_chaos()
+        if plan is None:
+            self._chaos_plan = None
+            return
+        if plan is not self._chaos_plan:
+            self._chaos_plan = plan
+            self._chaos_seq = itertools.count()
+        plan.trip(SITE_SERVING_HTTP, next(self._chaos_seq))
 
     # --------------------------------------------------------------- serve
     def handle_predict(self, endpoint: str, body: bytes):

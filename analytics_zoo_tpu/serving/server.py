@@ -41,7 +41,8 @@ from analytics_zoo_tpu.observability import (
 from analytics_zoo_tpu.resilience.chaos import (
     SITE_SERVING_DECODE, SITE_SERVING_PREDICT, active_chaos)
 from analytics_zoo_tpu.resilience.detector import HostHeartbeat
-from analytics_zoo_tpu.serving.engine.batcher import Request
+from analytics_zoo_tpu.serving.engine.batcher import (Request,
+                                                      ShedError)
 from analytics_zoo_tpu.serving.engine.core import (
     DEFAULT_ENDPOINT, ServingEngine)
 from analytics_zoo_tpu.serving.engine.transport import HttpTransport
@@ -488,12 +489,17 @@ class ClusterServing:
         ``max_tokens`` field (client ``enqueue(..., max_tokens=)``)
         to cap their own sequence."""
         cfg = self.config
+        # the worker's request_deadline_ms covers this endpoint too:
+        # queued (not-yet-admitted) sequences past the deadline are
+        # shed at the slot-pool gate instead of bypassing the PR 9
+        # admission-control contract the stateless path honors
         return self.engine.register_generative(
             name, model, enc_len=enc_len, start_sign=start_sign,
             stop_sign=stop_sign, max_seq_len=max_seq_len,
             slots=cfg.batch_size if slots is None else slots,
             buckets=buckets or cfg.batch_buckets or (),
-            weight=weight)
+            weight=weight,
+            request_deadline_ms=cfg.request_deadline_ms)
 
     # ----------------------------------------------------------- warm-start
     def warm_start(self) -> bool:
@@ -726,6 +732,21 @@ class ClusterServing:
         real = served = 0
         for entry_id, fields in entries:
             key = self._rid_of(fields) or str(entry_id)
+            # idempotent completion (found by the ISSUE 14 storm
+            # harness): a record whose result ALREADY sits in the
+            # result table under its own request_id was fully served
+            # by a pass whose ACK the broker outage swallowed — the
+            # only thing left to do is finish the ack.  Re-serving it
+            # would double-predict; worse, letting it ride the poison
+            # judgment would eventually QUARANTINE an innocent record
+            # and overwrite its delivered result with an error (the
+            # mark-before-serve attempt count below persists across
+            # the interrupted pass by design — a crash mid-serve must
+            # count — so outage-interrupted passes accumulate blame
+            # the record never earned).
+            if self._reclaim_already_served(entry_id, fields, key):
+                served += 1
+                continue
             attempts = int(counts.get(key, 0) or 0)
             # total deliveries so far = the original XREADGROUP
             # delivery + `attempts` reclaim re-serves; would this
@@ -755,6 +776,38 @@ class ClusterServing:
                  "%d error-resulted, %d quarantined)", len(entries),
                  real, served - real, len(entries) - served)
         return real
+
+    def _reclaim_already_served(self, entry_id, fields,
+                                key: str) -> bool:
+        """Whether this reclaimed record's result is already written
+        UNDER ITS OWN request_id — i.e. an earlier serve completed
+        and only the ack was lost to a broker outage.  If so, finish
+        the ack and clear the poison-attempt mark; returns True
+        (nothing left to serve).  Records without a request_id cannot
+        be safely matched (result keys are per-uri, and a client may
+        legitimately reuse a uri), so they take the normal path.
+        Broker failures while CHECKING propagate like any reclaim op
+        — the run loop's outage idle handles them."""
+        rid = self._rid_of(fields)
+        uri = self._uri_of(fields)
+        if not rid or not uri:
+            return False
+        existing = self.broker.hgetall(RESULT_PREFIX + uri)
+        got = existing.get("request_id",
+                           existing.get(b"request_id"))
+        if isinstance(got, bytes):
+            got = got.decode()
+        if got != rid:
+            return False
+        log.info("reclaimed record %s (request_id=%s) was already "
+                 "served; finishing its lost ack instead of "
+                 "re-serving", entry_id, rid)
+        self._ack([(entry_id, fields)])
+        try:
+            self.broker.hdel(POISON_ATTEMPTS_KEY, key)
+        except Exception:   # noqa: BLE001 — orphan count is benign
+            pass            # once the record is acked out of the PEL
+        return True
 
     def _quarantine(self, entry_id, fields, deliveries: int) -> None:
         """Dead-letter a record that keeps killing its workers
@@ -1021,6 +1074,34 @@ class ClusterServing:
         written = predicted = failed = 0
         for req in requests:
             if req.error is not None:
+                if isinstance(req.error, ShedError):
+                    # an ENGINE-level admission drop (generative
+                    # queue-wait past request_deadline_ms): the same
+                    # contract as the stream path's _shed_expired —
+                    # dead-lettered with its age/deadline evidence
+                    # (the verdict proves every shed was
+                    # deadline-earned from these fields), an explicit
+                    # error result, and kept OUT of the error
+                    # accounting/readiness window: a deliberate drop
+                    # is not a worker failure
+                    self.dead_letter(
+                        "shed", uri=req.uri,
+                        request_id=req.request_id, cause="deadline",
+                        extra={
+                            "age_ms": f"{req.error.age_ms:.0f}",
+                            "deadline_ms":
+                                f"{req.error.deadline_ms:.0f}"})
+                    # serving_shed_total{deadline} was already
+                    # counted by the engine at the moment it shed
+                    try:
+                        if req.uri:
+                            self._write_result(req.uri, json.dumps(
+                                {"error": str(req.error)}),
+                                request_id=req.request_id)
+                    except Exception:
+                        log.exception("could not write shed result "
+                                      "for %s", req.uri)
+                    continue
                 # predict failed for this record's group: explicit
                 # error result, error accounting, readiness window 0
                 # — same consumed-record contract as a decode failure

@@ -32,6 +32,14 @@ records it):
   vs naive whole-sequence decode on mixed-length traffic — useful
   tokens/sec both paths, inter-token p50/p99 incl. first-token gaps,
   device decode-step counts, and the speedup factor.
+* ``serving_storm`` — the ISSUE 14 open-loop adversarial harness: the
+  loadgen ``diurnal`` ramp against one continuously-batching worker,
+  latency measured from each request's SCHEDULED time (coordinated-
+  omission-safe; the from-sent basis is emitted beside it so the gap
+  is visible), plus the SLO verdict and the fitted capacity plan
+  (req/s per replica at the target p99).  All ``serving_storm_*``
+  names are NEW so ``--compare`` against pre-storm baselines cannot
+  false-regress.
 * ``kernels`` — the fused kernel suite (ops/fused.py) + int8 path:
   fused optimizer update vs the optax triple pass (xla_bytes_per_step
   both ways, bytes saved, HBM-roofline attainment), the bias→GeLU /
@@ -865,6 +873,116 @@ def bench_serving_generative(n_requests: int = 64, slots: int = 16,
     }
 
 
+# ------------------------------------------------------------ serving_storm
+def bench_serving_storm(compress: float = 0.6,
+                        predict_delay_s: float = 0.0):
+    """Open-loop adversarial traffic (ISSUE 14): the loadgen harness'
+    ``diurnal`` ramp against one in-process serving worker with a real
+    jitted model, measured the coordinated-omission-safe way — every
+    latency from the request's SCHEDULED fire time, not from when an
+    unblocked client got around to sending.  Emits BOTH bases (the gap
+    is the omission a closed-loop bench hides), the SLO verdict, and
+    the fitted capacity plan (req/s per replica at the target p99 →
+    replicas needed per offered rate).
+
+    All metric names are NEW (``serving_storm_*``), so ``--compare``
+    against a pre-ISSUE-14 baseline can never read the open-loop
+    numbers — measured under deliberately hostile arrival schedules —
+    as a regression of the polite closed-loop ones."""
+    import threading
+
+    import jax
+
+    from analytics_zoo_tpu.models.image.imageclassification import \
+        resnet
+    from analytics_zoo_tpu.pipeline.inference import InferenceModel
+    from analytics_zoo_tpu.serving.loadgen import (
+        SCENARIOS, evaluate, pending_count, run_scenario)
+    from analytics_zoo_tpu.serving.loadgen.loadgen import \
+        PayloadFactory
+    from analytics_zoo_tpu.serving.redis_client import EmbeddedBroker
+    from analytics_zoo_tpu.serving.server import ClusterServing, \
+        ServingConfig
+
+    model = resnet(18, num_classes=1000, input_shape=(64, 64, 3))
+    model.init()
+    im = InferenceModel().load_zoo(model)
+    broker = EmbeddedBroker()
+    serving = ClusterServing(
+        im, ServingConfig(batch_size=16, top_n=5,
+                          consumer_group="storm", consumer_name="w0",
+                          request_deadline_ms=10000,
+                          input_shape=(64, 64, 3),
+                          batch_max_wait_ms=2.0,
+                          metrics_host="127.0.0.1"),
+        broker=broker)
+    serving.warm_start()        # every bucket AOT-ready before timing
+    worker = threading.Thread(target=serving.run,
+                              kwargs={"poll_ms": 5}, daemon=True)
+    worker.start()
+
+    from analytics_zoo_tpu.serving.loadgen import SloSpec
+    # pass/fail bound loose (the bench runs on whatever chip/CPU the
+    # driver has; a saturated ramp is DATA here, not a failure) while
+    # the capacity fit keeps a tight 2s target so the replicas-per-rps
+    # plan stays meaningful
+    scenario = SCENARIOS["diurnal"](
+        base_rate=6.0, peak_rate=60.0, period_s=15.0,
+        slo=SloSpec(p99_from_scheduled_ms=30000.0,
+                    target_capacity_p99_ms=2000.0))
+    t0 = time.perf_counter()
+    run = run_scenario(
+        scenario, compress=compress,
+        broker_factory=lambda: broker,
+        payloads=PayloadFactory(shape=(64, 64, 3)),
+        result_timeout_s=30.0)
+    wall = time.perf_counter() - t0
+    # the loadgen sees results the moment they are written, which is
+    # BEFORE the worker acks the batch — give the final acks a moment
+    # or the exactly-once check reads a transiently non-empty PEL
+    settle_deadline = time.perf_counter() + 5.0
+    while pending_count(broker, group="storm") \
+            and time.perf_counter() < settle_deadline:
+        time.sleep(0.1)
+    verdict = evaluate(run, scenario.slo,
+                       pending=pending_count(broker, group="storm"))
+    serving.stop()
+    worker.join(timeout=15)
+
+    cap = verdict.capacity or {}
+    counts = run.counts()
+    dev = jax.devices()[0]
+    per_replica = cap.get("rps_per_replica_at_slo") or 0.0
+    return {
+        "metric": "serving_storm_rps_per_replica_at_slo",
+        "value": round(per_replica, 1),
+        "unit": "records/sec/replica",
+        "vs_baseline": None,
+        "workload": "serving_storm",
+        "scenario": scenario.name,
+        "compress": compress,
+        "requests": len(run.records),
+        "offered_wall_s": round(wall, 2),
+        "verdict_passed": verdict.passed,
+        "storm_p50_from_scheduled_ms": round(
+            run.percentile(50) * 1e3, 2),
+        "storm_p99_from_scheduled_ms": round(
+            run.percentile(99) * 1e3, 2),
+        "storm_p50_from_sent_ms": round(
+            run.percentile(50, basis="sent") * 1e3, 2),
+        "storm_p99_from_sent_ms": round(
+            run.percentile(99, basis="sent") * 1e3, 2),
+        "storm_lost": counts.get("lost", 0)
+        + counts.get("send_failed", 0),
+        "storm_errors": counts.get("error", 0),
+        "storm_shed": counts.get("shed", 0),
+        "capacity_target_p99_ms": cap.get("target_p99_ms"),
+        "capacity_replicas_for": cap.get("replicas_for", {}),
+        "device": str(dev),
+        "device_kind": getattr(dev, "device_kind", "?"),
+    }
+
+
 # ----------------------------------------------------------- input_pipeline
 def bench_input_pipeline(n_samples: int = 4096, batch_size: int = 128,
                          image_hw: int = 32):
@@ -1180,6 +1298,7 @@ WORKLOADS = {
     "serving": bench_serving,
     "serving_engine": bench_serving_engine,
     "serving_generative": bench_serving_generative,
+    "serving_storm": bench_serving_storm,
     "attention": bench_attention,
     "wide_deep": bench_wide_deep,
     "inception": bench_inception,
@@ -1201,6 +1320,10 @@ METRIC_NAMES = {
     # new metric names on purpose (--compare gates only metrics the
     # baseline has, so a pre-ISSUE-12 baseline never false-regresses)
     "serving_generative": "serving_generative_tokens_per_sec",
+    # open-loop storm numbers are NEW names too: measured under
+    # hostile arrival schedules, they must never gate the polite
+    # closed-loop serving metrics a pre-ISSUE-14 baseline holds
+    "serving_storm": "serving_storm_rps_per_replica_at_slo",
     "attention": "flash_attention_tokens_per_sec",
     "wide_deep": "wide_deep_census_train_throughput",
     "inception": "inception_v1_tfpark_train_throughput",

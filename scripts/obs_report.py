@@ -20,6 +20,12 @@ snapshots and exits 1 when a higher-is-better metric (throughput, MFU)
 dropped, or a latency p50 rose, by more than ``--threshold`` (default
 10%) — the offline half of ``bench.py --compare``.
 
+Loadtest mode (auto-detected): a ``zoo-loadtest`` report JSON
+(``scripts/zoo-loadtest ... --out report.json``) renders its SLO
+verdict and the capacity-planning table (replicas needed per req/s at
+the target p99), then falls through to the standard report over the
+run's embedded registry snapshot (loadgen latency histograms etc.).
+
 Multi-host mode: ``obs_report.py --merge-hosts <run_dir>`` federates a
 launcher run directory (one ``host-<k>/`` slot per worker, written by
 ``zoo-launch --run-dir``): per-host step-time skew table, named
@@ -306,6 +312,68 @@ def render_report(label: str, snap: Dict,
     return "\n".join(lines)
 
 
+# ------------------------------------------------------------- loadtest
+def _peek_loadtest(path: Optional[str]) -> Optional[Dict]:
+    """The loadtest-report document, when ``path`` is one (the
+    ``kind`` tag, or a capacity_planning section); None otherwise."""
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if isinstance(doc, dict) and (
+            doc.get("kind") == "zoo_loadtest_report"
+            or "capacity_planning" in doc):
+        return doc
+    return None
+
+
+def render_loadtest_report(label: str, doc: Dict) -> str:
+    """Render a ``zoo-loadtest`` report document: the SLO verdict
+    check-by-check, then the capacity-planning table fitted from the
+    run's ramp."""
+    lines = [f"== loadtest report: {label} "
+             f"(scenario {doc.get('scenario', '?')}) =="]
+    verdict = doc.get("verdict") or {}
+    lines.append(f"verdict: "
+                 f"{'PASS' if verdict.get('passed') else 'FAIL'}")
+    for c in verdict.get("checks", []):
+        mark = ("SKIP" if c.get("skipped")
+                else "ok  " if c.get("passed") else "FAIL")
+        lines.append(f"  [{mark}] {c.get('name')}: {c.get('detail')}")
+    lat = verdict.get("latency") or {}
+    if lat:
+        lines.append(
+            "latency (from SCHEDULED is the coordinated-omission-"
+            "safe basis the verdict gates on): "
+            + "  ".join(f"{k}={v:.1f}ms"
+                        for k, v in sorted(lat.items())))
+    cap = doc.get("capacity_planning") or {}
+    rows = [[w["window_s"][0], w["offered_rps"], w["replicas"],
+             w["rps_per_replica"], w["p99_from_scheduled_ms"],
+             "yes" if w["met_slo"] else "NO"]
+            for w in cap.get("windows", [])]
+    if rows:
+        lines += ["", f"capacity fit (target p99 <= "
+                  f"{cap.get('target_p99_ms', 0):.0f}ms):",
+                  _table(rows, ["t0", "offered rps", "replicas",
+                                "rps/replica", "p99 ms", "met SLO"])]
+    per = cap.get("rps_per_replica_at_slo")
+    if per:
+        needed = cap.get("replicas_for", {})
+        lines.append(
+            f"plan: {per:.1f} req/s per replica at the target — "
+            + "  ".join(f"{k}rps needs {v}"
+                        for k, v in needed.items()))
+    else:
+        lines.append("plan: NO window met the target SLO — the fit "
+                     "has no feasible point (add capacity or relax "
+                     "the target)")
+    return "\n".join(lines)
+
+
 # ------------------------------------------------------------ multi-host
 def _load_aggregator_module():
     """Load observability/aggregator.py by FILE PATH (not package
@@ -536,6 +604,13 @@ def main(argv=None) -> int:
         snaps = [("cluster", merged)]
         if args.snapshot:
             snaps += load_snapshots(args.snapshot, args.workload)
+    elif (doc := _peek_loadtest(args.snapshot)) is not None:
+        # a zoo-loadtest report: verdict + capacity table first, then
+        # the embedded registry snapshot through the standard report
+        print(render_loadtest_report(args.snapshot, doc))
+        print()
+        snaps = ([(args.snapshot, doc["metrics"])]
+                 if _is_snapshot(doc.get("metrics")) else [])
     else:
         snaps = load_snapshots(args.snapshot, args.workload)
     trace_events = None

@@ -67,6 +67,14 @@ def main(argv=None) -> int:
     p.add_argument("--poison-max-attempts", type=int, default=2)
     p.add_argument("--reclaim-min-idle-ms", type=int, default=300)
     p.add_argument("--request-deadline-ms", type=int, default=0)
+    p.add_argument("--healthz-max-queue", type=int, default=0)
+    # breaker-failures=0 builds the DELIBERATELY BROKEN fleet the
+    # loadgen teeth test runs: a raw (breaker-less) broker connection
+    # never reconnects after a transport failure, so a broker outage
+    # wedges the replica forever — exactly the defect the SLO verdict
+    # must catch
+    p.add_argument("--breaker-failures", type=int, default=None)
+    p.add_argument("--breaker-cooldown-s", type=float, default=None)
     p.add_argument("--start-delay", type=float, default=0.0)
     p.add_argument("--predict-delay", type=float, default=0.0)
     args = p.parse_args(argv)
@@ -84,6 +92,9 @@ def main(argv=None) -> int:
         poison_max_attempts=args.poison_max_attempts,
         reclaim_min_idle_ms=args.reclaim_min_idle_ms,
         request_deadline_ms=args.request_deadline_ms,
+        healthz_max_queue=args.healthz_max_queue or None,
+        breaker_failures=args.breaker_failures,
+        breaker_cooldown_s=args.breaker_cooldown_s,
         metrics_port=0,               # /healthz on an ephemeral port,
         metrics_host="127.0.0.1")     # published via the port file
     serving = ClusterServing(

@@ -1,0 +1,625 @@
+"""Adversarial traffic harness unit tests (ISSUE 14).
+
+Covers the open-loop load generator (incl. the coordinated-omission
+proof the acceptance demands: a stalled server shows
+p99-from-SCHEDULED ≫ p99-from-sent, and the verdict gates on the
+former), the scenario DSL's determinism and the canned storms, the
+SLO verdict checks against synthetic evidence, the new
+``serving.http`` chaos site, the generative admission-control shed,
+and the client monotonic-timestamp surface.
+
+Part of the CI ``storm`` shard (dev/run-tests storm)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.resilience.chaos import (
+    SITE_SERVING_HTTP, ChaosPlan, FaultSpec, clear_chaos,
+    install_chaos)
+from analytics_zoo_tpu.serving.client import (
+    InputQueue, OutputQueue, ServingHttpClient)
+from analytics_zoo_tpu.serving.engine import Request, ServingEngine
+from analytics_zoo_tpu.serving.engine.batcher import ShedError
+from analytics_zoo_tpu.serving.loadgen import (
+    LoadGenerator, Phase, SCENARIOS, Scenario, ScenarioEvent,
+    ScheduledRequest, SloSpec, capacity_report, evaluate,
+    pending_count, read_dead_letters, run_scenario)
+from analytics_zoo_tpu.serving.loadgen.loadgen import (
+    LoadgenRun, RequestRecord)
+from analytics_zoo_tpu.serving.redis_client import EmbeddedBroker
+from analytics_zoo_tpu.serving.server import (ClusterServing,
+                                              ServingConfig)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_chaos():
+    clear_chaos()
+    yield
+    clear_chaos()
+
+
+class OkModel:
+    def predict(self, x, batch_size=None):
+        return np.tile(np.arange(4, dtype=np.float32), (len(x), 1))
+
+
+class DelayModel(OkModel):
+    def __init__(self, delay_s=0.0):
+        self.delay_s = delay_s
+
+    def predict(self, x, batch_size=None):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return super().predict(x, batch_size)
+
+
+def _serving(model=None, **cfg):
+    broker = EmbeddedBroker()
+    serving = ClusterServing(
+        model or OkModel(),
+        ServingConfig(batch_size=4, consumer_group="lg",
+                      consumer_name="w0", http_port=0,
+                      metrics_host="127.0.0.1", **cfg),
+        broker=broker)
+    t = threading.Thread(target=serving.run, kwargs={"poll_ms": 5},
+                         daemon=True)
+    t.start()
+    return serving, broker, t
+
+
+def _stop(serving, t):
+    serving.stop()
+    t.join(timeout=15)
+    assert not t.is_alive()
+
+
+# ------------------------------------------------------------ scenario DSL
+class TestScenarioDSL:
+    def test_schedule_is_deterministic_and_replayable(self):
+        s = SCENARIOS["flash_burst_with_outage"]()
+        a, b = s.schedule(0.5), s.schedule(0.5)
+        assert [r.offset_s for r in a] == [r.offset_s for r in b]
+        assert [r.kind for r in a] == [r.kind for r in b]
+        # a different seed is a different storm
+        s2 = SCENARIOS["flash_burst_with_outage"](seed=99)
+        assert [r.offset_s for r in s2.schedule(0.5)] \
+            != [r.offset_s for r in a]
+
+    def test_compress_scales_durations_not_rates(self):
+        s = SCENARIOS["diurnal"](base_rate=5.0, peak_rate=20.0,
+                                 period_s=12.0)
+        full, half = s.schedule(1.0), s.schedule(0.5)
+        assert s.duration_s(0.5) == pytest.approx(6.0)
+        assert max(r.offset_s for r in half) < 6.0
+        # same rates over half the time → roughly half the requests
+        # (heavy-tailed gaps make the count noisy; the bound only has
+        # to rule out "rates were scaled instead of durations")
+        assert 0.25 < len(half) / max(len(full), 1) < 0.8
+
+    def test_canned_scenarios_have_teeth(self):
+        flash = SCENARIOS["flash_burst_with_outage"]()
+        assert any(e.kind == "broker_outage" for e in flash.events)
+        assert any(r.kind == "poison" for r in flash.schedule(1.0))
+        # the burst really is ~10x the warmup rate
+        warm = next(p for p in flash.phases if p.name == "warmup")
+        burst = next(p for p in flash.phases if p.name == "burst")
+        assert burst.rate_rps >= 10 * warm.rate_rps * 0.99
+        flood = SCENARIOS["poison_flood_drain"]()
+        kinds = {r.kind for r in flood.schedule(1.0)}
+        assert {"ok", "poison", "malformed"} <= kinds
+        assert set(SCENARIOS) == {
+            "diurnal", "flash_burst_with_outage",
+            "poison_flood_drain"}
+
+    def test_phase_window_anchors_the_burst(self):
+        s = SCENARIOS["flash_burst_with_outage"](warmup_s=3.0,
+                                                 burst_s=5.0)
+        lo, hi = s.phase_window("burst", compress=0.5)
+        assert lo == pytest.approx(1.5)
+        assert hi == pytest.approx(4.0)
+        with pytest.raises(KeyError):
+            s.phase_window("nope")
+
+
+# --------------------------------------------------------------- loadgen
+class TestLoadGenerator:
+    def test_redis_roundtrip_and_structured_log(self, tmp_path):
+        serving, broker, t = _serving()
+        try:
+            sched = [ScheduledRequest(offset_s=i * 0.02)
+                     for i in range(12)]
+            gen = LoadGenerator(sched,
+                                broker_factory=lambda: broker,
+                                result_timeout_s=20.0)
+            run = gen.run()
+            assert run.counts() == {"ok": 12}
+            for r in run.records:
+                assert r.sent is not None and r.done is not None
+                assert r.done >= r.sent >= run.started_monotonic
+                assert r.latency_from_scheduled_s >= 0
+            path = tmp_path / "records.jsonl"
+            run.to_jsonl(str(path))
+            lines = [json.loads(x) for x
+                     in path.read_text().splitlines()]
+            assert lines[0]["started_wall"] > 0
+            assert len(lines) == 13
+            assert all(x["status"] == "ok" for x in lines[1:])
+        finally:
+            _stop(serving, t)
+
+    def test_malformed_and_poison_get_terminal_outcomes(self):
+        serving, broker, t = _serving()
+        try:
+            sched = [
+                ScheduledRequest(offset_s=0.0, kind="malformed"),
+                ScheduledRequest(offset_s=0.02),
+                ScheduledRequest(offset_s=0.04, kind="malformed",
+                                 transport="http"),
+            ]
+            gen = LoadGenerator(
+                sched, broker_factory=lambda: broker,
+                http_url=f"http://127.0.0.1:"
+                         f"{serving.http_transport.port}",
+                result_timeout_s=20.0)
+            run = gen.run()
+            counts = run.counts()
+            assert counts.get("ok") == 1
+            assert counts.get("error") == 2        # nothing silent
+            assert not [r for r in run.records
+                        if r.status in ("lost", "send_failed")]
+        finally:
+            _stop(serving, t)
+
+    def test_open_loop_coordinated_omission_proof(self):
+        """The acceptance demonstration: one blocking sender (the
+        closed-loop degenerate) against a server whose FIRST request
+        stalls 1.2s via the new ``serving.http`` chaos site.  Every
+        request keeps its scheduled fire time, so on the SCHEDULED
+        basis the stall is charged to the whole window of traffic
+        queued behind the blocked sender — while on the sent basis
+        (what a closed-loop bench reports) only the one stalled
+        request is slow and the p99 over 150 samples stays flat.  The
+        verdict gates on the scheduled basis and FAILS on a bound the
+        sent basis satisfies comfortably."""
+        serving, broker, t = _serving()
+        try:
+            install_chaos(ChaosPlan([FaultSpec(
+                site=SITE_SERVING_HTTP, at_step=0, kind="slow",
+                sleep_s=1.2)]))
+            n = 150
+            sched = [ScheduledRequest(offset_s=i * 0.01,
+                                      transport="http")
+                     for i in range(n)]
+            gen = LoadGenerator(
+                sched, broker_factory=lambda: broker,
+                http_url=f"http://127.0.0.1:"
+                         f"{serving.http_transport.port}",
+                senders=1,                 # a coordinated client
+                result_timeout_s=20.0)
+            run = gen.run()
+            assert run.counts() == {"ok": n}
+            p99_sched = run.percentile(99)
+            p99_sent = run.percentile(99, basis="sent")
+            assert p99_sched > 0.8          # the stall, fully charged
+            assert p99_sent < 0.4           # ...hidden from this basis
+            assert p99_sched > 3 * p99_sent
+            # the verdict reads the scheduled basis: a bound the sent
+            # basis satisfies still FAILS
+            bound_ms = max(p99_sent * 1e3 * 2, 500.0)
+            assert bound_ms < p99_sched * 1e3
+            verdict = evaluate(
+                run, SloSpec(p99_from_scheduled_ms=bound_ms))
+            assert not verdict.check("p99_from_scheduled").passed
+            assert not verdict.passed
+        finally:
+            _stop(serving, t)
+
+    def test_scenario_events_fire_in_timeline_order(self):
+        serving, broker, t = _serving()
+        try:
+            fired = []
+            scen = Scenario(
+                "ev", phases=[Phase("p", 0.4, 20.0, heavy_tail=0.0)],
+                events=[ScenarioEvent(at_s=0.1, kind="mark",
+                                      duration_s=0.1)])
+            run = run_scenario(
+                scen,
+                hooks={"mark": lambda ev, edge:
+                       fired.append((edge, time.monotonic()))},
+                broker_factory=lambda: broker,
+                result_timeout_s=20.0)
+            assert [e for e, _ in fired] == ["start", "end"]
+            assert fired[1][1] - fired[0][1] >= 0.08
+            assert run.counts().get("ok", 0) > 0
+        finally:
+            _stop(serving, t)
+
+
+# ------------------------------------------------------- http chaos site
+class TestServingHttpChaosSite:
+    def test_drop_disconnects_and_slow_delays(self):
+        serving, broker, t = _serving()
+        url = f"http://127.0.0.1:{serving.http_transport.port}"
+        rec = np.zeros(3, np.float32)
+        try:
+            client = ServingHttpClient(url, retries=1)
+            client.predict_http("default", rec)     # healthy first
+            # a raising kind = transport-layer drop: no HTTP response,
+            # the connection just dies — a retries=1 client surfaces it
+            install_chaos(ChaosPlan([FaultSpec(
+                site=SITE_SERVING_HTTP, at_step=0, kind="raise",
+                times=1)]))
+            with pytest.raises(OSError):
+                client.predict_http("default", rec)
+            clear_chaos()
+            # the retry ladder absorbs a scripted drop: same fault,
+            # retries=3 lands on the second attempt
+            install_chaos(ChaosPlan([FaultSpec(
+                site=SITE_SERVING_HTTP, at_step=0, kind="raise",
+                times=1)]))
+            doc = ServingHttpClient(url, retries=3).predict_http(
+                "default", rec)
+            assert doc["value"]
+            clear_chaos()
+            # slow: the response arrives, late
+            install_chaos(ChaosPlan([FaultSpec(
+                site=SITE_SERVING_HTTP, at_step=0, kind="slow",
+                sleep_s=0.4)]))
+            t0 = time.monotonic()
+            doc = client.predict_http("default", rec)
+            assert doc["value"]
+            assert time.monotonic() - t0 >= 0.4
+        finally:
+            _stop(serving, t)
+
+
+# ------------------------------------------------ generative admission
+class _ToyGenModel:
+    """Minimal pure-jnp model honoring the decode contract: each step
+    emits last_token + 1 (deterministic, no EOS)."""
+
+    def decode_params(self):
+        return {}
+
+    def initial_carries(self, batch):
+        import jax.numpy as jnp
+        return {"h": jnp.zeros((batch, 2), jnp.float32)}
+
+    def prefill(self, params, enc_ids):
+        import jax.numpy as jnp
+        return {"h": jnp.zeros((enc_ids.shape[0], 2), jnp.float32)}
+
+    def decode_step(self, params, tok, carries):
+        return tok + 1, carries
+
+
+class TestGenerativeAdmissionShed:
+    def test_queued_past_deadline_is_shed_before_a_slot(self):
+        from analytics_zoo_tpu.observability import get_registry
+        shed_counter = get_registry().counter(
+            "serving_shed_total",
+            "records shed by admission control instead of predicted",
+            labels=("cause",))
+        before = shed_counter.labels("deadline").value
+        eng = ServingEngine()
+        ep = eng.register_generative(
+            "gen", _ToyGenModel(), enc_len=4, start_sign=1,
+            max_seq_len=4, slots=2, request_deadline_ms=50)
+        # batcher NOT started: we drive the scheduler directly
+        stale = [Request(endpoint="gen", uri=f"s{i}",
+                         data=np.ones(4, np.int32),
+                         arrival=time.perf_counter() - 1.0)
+                 for i in range(3)]
+        fresh = [Request(endpoint="gen", uri=f"f{i}",
+                         data=np.ones(4, np.int32),
+                         arrival=time.perf_counter())
+                 for i in range(2)]
+        ep.queue.append(list(stale))
+        ep.queue.append(list(fresh))
+        admitted = ep.backfill()
+        # every stale sequence shed with reason=shed, NO slot burnt
+        for r in stale:
+            assert isinstance(r.error, ShedError)
+            assert "shed: deadline" in str(r.error)
+        assert ep.pool.admitted_total == 2       # only the fresh pair
+        assert admitted == 2
+        assert shed_counter.labels("deadline").value == before + 3
+
+    def test_admitted_sequences_are_never_shed(self):
+        eng = ServingEngine()
+        ep = eng.register_generative(
+            "gen2", _ToyGenModel(), enc_len=4, start_sign=1,
+            max_seq_len=3, slots=2, request_deadline_ms=50)
+        reqs = [Request(endpoint="gen2", uri=f"a{i}",
+                        data=np.ones(4, np.int32),
+                        arrival=time.perf_counter())
+                for i in range(2)]
+        ep.queue.append(list(reqs))
+        assert ep.backfill() == 2
+        # age them past the deadline IN their slots: they must decode
+        # to completion, not be shed mid-flight
+        for r in reqs:
+            r.arrival = time.perf_counter() - 1.0
+        for _ in range(5):
+            ep.run_iteration()
+        for r in reqs:
+            assert r.error is None
+            assert r.result == [2, 3, 4]        # start 1 → +1 per step
+
+    def test_redis_generative_shed_is_dead_lettered(self):
+        """The Redis transport gives an engine-level shed the SAME
+        evidence trail as a stream-path shed: a reason=shed dead
+        letter carrying age_ms/deadline_ms (what the verdict's
+        justification check reads), an explicit error result, and NO
+        error accounting — a deliberate drop is not a worker
+        failure."""
+        broker = EmbeddedBroker()
+        serving = ClusterServing(
+            None, ServingConfig(batch_size=2,
+                                request_deadline_ms=50),
+            broker=broker)
+        try:
+            serving.register_generative_endpoint(
+                "gen", _ToyGenModel(), enc_len=4, start_sign=1,
+                max_seq_len=4, slots=1)
+            old = time.perf_counter() - 1.0   # queued 1s > 50ms ddl
+            written = serving._predict_write(
+                ["g0"], [np.ones(4, np.int32)], old,
+                rids=["rid-shed"], endpoints=["gen"],
+                max_tokens=[None])
+            assert written == 0
+            dl = read_dead_letters(broker, reason="shed")
+            assert len(dl) == 1
+            assert dl[0]["request_id"] == "rid-shed"
+            assert dl[0]["cause"] == "deadline"
+            assert float(dl[0]["age_ms"]) > 50
+            assert float(dl[0]["deadline_ms"]) == 50
+            res = OutputQueue(broker=broker).query("g0")
+            assert isinstance(res, dict) and "shed" in res["error"]
+            # deliberate drop: the readiness error window stays empty
+            assert not list(serving._recent_outcomes)
+            # ...and the verdict's justification check accepts it
+            run = _mk_run([(0.1, "ok", "shed", 0.3)])
+            assert evaluate(run, SloSpec(), dead_letters=dl) \
+                .check("sheds_deadline_justified").passed
+        finally:
+            serving.close()
+
+    def test_full_pool_still_sheds_aging_queue(self):
+        """The queue-wait case: the pool is saturated, later arrivals
+        age out while waiting — they get their shed verdict NOW, not
+        when a slot finally frees."""
+        eng = ServingEngine()
+        ep = eng.register_generative(
+            "gen3", _ToyGenModel(), enc_len=4, start_sign=1,
+            max_seq_len=16, slots=1, request_deadline_ms=40)
+        occupant = Request(endpoint="gen3", uri="occ",
+                           data=np.ones(4, np.int32),
+                           arrival=time.perf_counter())
+        ep.queue.append([occupant])
+        assert ep.backfill() == 1               # pool now full
+        waiter = Request(endpoint="gen3", uri="wait",
+                         data=np.ones(4, np.int32),
+                         arrival=time.perf_counter())
+        ep.queue.append([waiter])
+        time.sleep(0.06)                        # > deadline
+        ep.run_iteration()                      # pool still full
+        assert isinstance(waiter.error, ShedError)
+        assert occupant.error is None
+
+
+# --------------------------------------------------- client timestamps
+class TestClientTimestamps:
+    def test_query_meta_and_http_expose_monotonic_stamps(self):
+        serving, broker, t = _serving()
+        try:
+            inq = InputQueue(broker=broker)
+            outq = OutputQueue(broker=broker)
+            inq.enqueue("ts-0", np.zeros(3, np.float32))
+            t0 = time.monotonic()
+            meta = outq.query_meta("ts-0", timeout_s=20.0)
+            assert meta is not None
+            assert t0 <= meta["received_monotonic"] \
+                <= time.monotonic()
+            client = ServingHttpClient(
+                f"http://127.0.0.1:{serving.http_transport.port}")
+            doc = client.predict_http("default",
+                                      np.zeros(3, np.float32))
+            ts = doc["client_ts"]
+            assert ts["sent_monotonic"] \
+                <= ts["first_byte_monotonic"] \
+                <= ts["received_monotonic"]
+        finally:
+            _stop(serving, t)
+
+
+# ------------------------------------------------------ verdict checks
+def _mk_run(specs_and_outcomes, started=100.0):
+    """Synthetic LoadgenRun: [(offset, kind, status, latency_s)]."""
+    records = []
+    for off, kind, status, lat in specs_and_outcomes:
+        spec = ScheduledRequest(offset_s=off, kind=kind)
+        rec = RequestRecord(spec=spec, scheduled=started + off,
+                            status=status)
+        if lat is not None:
+            rec.sent = started + off
+            rec.done = started + off + lat
+        records.append(rec)
+    return LoadgenRun(records, started, 1000.0, started + 60.0)
+
+
+class TestVerdict:
+    def test_lost_request_fails_exactly_once(self):
+        ok = _mk_run([(0.1, "ok", "ok", 0.05)])
+        assert evaluate(ok, SloSpec()).check("exactly_once").passed
+        lost = _mk_run([(0.1, "ok", "ok", 0.05),
+                        (0.2, "ok", "lost", None)])
+        v = evaluate(lost, SloSpec())
+        assert not v.check("exactly_once").passed
+        assert not v.passed
+
+    def test_pending_pel_and_duplicates_fail_exactly_once(self):
+        run = _mk_run([(0.1, "ok", "ok", 0.05)])
+        assert not evaluate(run, SloSpec(), pending=3) \
+            .check("exactly_once").passed
+        rid = run.records[0].spec.request_id
+        dl = [{"reason": "shed", "request_id": rid},
+              {"reason": "shed", "request_id": rid}]
+        v = evaluate(run, SloSpec(), dead_letters=dl)
+        assert not v.check("exactly_once").passed
+
+    def test_served_and_dead_lettered_is_a_duplicate(self):
+        run = _mk_run([(0.1, "ok", "ok", 0.05)])
+        dl = [{"reason": "shed",
+               "request_id": run.records[0].spec.request_id}]
+        assert not evaluate(run, SloSpec(), dead_letters=dl) \
+            .check("exactly_once").passed
+
+    def test_shed_justification(self):
+        run = _mk_run([(0.1, "ok", "shed", 0.3)])
+        just = [{"reason": "shed", "request_id": "x", "cause":
+                 "deadline", "age_ms": "250", "deadline_ms": "200"}]
+        assert evaluate(run, SloSpec(), dead_letters=just) \
+            .check("sheds_deadline_justified").passed
+        # shed BEFORE its deadline: the server dropped a request it
+        # had no right to drop
+        unjust = [{"reason": "shed", "request_id": "x", "cause":
+                   "deadline", "age_ms": "80", "deadline_ms": "200"}]
+        assert not evaluate(run, SloSpec(), dead_letters=unjust) \
+            .check("sheds_deadline_justified").passed
+        # overload halves the cut
+        over = [{"reason": "shed", "request_id": "x", "cause":
+                 "overload", "age_ms": "120", "deadline_ms": "200"}]
+        assert evaluate(run, SloSpec(), dead_letters=over) \
+            .check("sheds_deadline_justified").passed
+
+    def test_quarantine_exactness(self):
+        run = _mk_run([(0.1, "poison", "quarantined", 0.5)])
+        exact = [{"reason": "poison", "request_id": "p",
+                  "deliveries": "2"}]
+        v = evaluate(run, SloSpec(poison_max_attempts=2),
+                     dead_letters=exact)
+        assert v.check("quarantine_exact").passed
+        wrong = [{"reason": "poison", "request_id": "p",
+                  "deliveries": "5"}]
+        v = evaluate(run, SloSpec(poison_max_attempts=2),
+                     dead_letters=wrong)
+        assert not v.check("quarantine_exact").passed
+
+    def test_poison_leak_fails(self):
+        leak = _mk_run([(0.1, "poison", "ok", 0.05)])
+        assert not evaluate(leak, SloSpec()) \
+            .check("poison_contained").passed
+
+    def test_autoscaler_lag_and_flap(self):
+        run = _mk_run([(i * 0.5, "ok", "ok", 0.05)
+                       for i in range(10)])
+        wall0 = run.started_wall
+        good = {"trajectory": [
+            (wall0, 2, "initial"),
+            (wall0 + 2.5, 3, "scale_up"),
+            (wall0 + 8.0, 2, "scale_down")]}
+        v = evaluate(run, SloSpec(scale_up_lag_s=3.0), fleet=good,
+                     burst_start_offset_s=2.0)
+        assert v.check("scale_up_lag").passed
+        assert v.check("no_flap").passed
+        late = {"trajectory": [(wall0, 2, "initial"),
+                               (wall0 + 9.0, 3, "scale_up")]}
+        v = evaluate(run, SloSpec(scale_up_lag_s=3.0), fleet=late,
+                     burst_start_offset_s=2.0)
+        assert not v.check("scale_up_lag").passed
+        flappy = {"trajectory": [
+            (wall0, 2, "initial"),
+            (wall0 + 2.5, 3, "scale_up"),
+            (wall0 + 4.0, 2, "scale_down"),
+            (wall0 + 5.0, 3, "scale_up")]}
+        v = evaluate(run, SloSpec(scale_up_lag_s=3.0), fleet=flappy,
+                     burst_start_offset_s=2.0)
+        assert not v.check("no_flap").passed
+
+    def test_error_fraction_ignores_hostile_kinds(self):
+        run = _mk_run([(0.1, "ok", "ok", 0.05),
+                       (0.2, "poison", "error", 0.05),
+                       (0.3, "malformed", "error", 0.05)])
+        assert evaluate(run, SloSpec(max_error_fraction=0.0)) \
+            .check("error_fraction").passed
+
+    def test_capacity_report_fits_the_ramp(self):
+        # 2s at 5 rps then 2s at 20 rps, flat 50ms latency, 2 replicas
+        specs = [(i * 0.2, "ok", "ok", 0.05) for i in range(10)]
+        specs += [(2.0 + i * 0.05, "ok", "ok", 0.05)
+                  for i in range(40)]
+        run = _mk_run(specs)
+        traj = [(run.started_wall, 2, "initial")]
+        cap = capacity_report(run, target_p99_ms=200.0,
+                              trajectory=traj, windows=4)
+        assert cap["rps_per_replica_at_slo"] == pytest.approx(10.0,
+                                                              rel=0.2)
+        assert cap["replicas_for"]["100"] in (10, 11)
+        assert all(w["met_slo"] for w in cap["windows"])
+        # a window violating the target is excluded from the fit
+        specs_bad = specs[:10] + [(2.0 + i * 0.05, "ok", "ok", 5.0)
+                                  for i in range(40)]
+        cap2 = capacity_report(_mk_run(specs_bad),
+                               target_p99_ms=200.0,
+                               trajectory=traj, windows=4)
+        assert cap2["rps_per_replica_at_slo"] \
+            < cap["rps_per_replica_at_slo"]
+
+    def test_pending_count_reads_the_pel(self):
+        broker = EmbeddedBroker()
+        broker.xgroup_create("serving_stream", "g")
+        inq = InputQueue(broker=broker)
+        for i in range(3):
+            inq.enqueue(f"p-{i}", np.zeros(3, np.float32))
+        broker.xreadgroup("g", "dead", "serving_stream", count=3)
+        assert pending_count(broker, group="g") == 3
+        assert pending_count(broker, group="absent") == 0
+
+
+# -------------------------------------------------- in-process scenario
+class TestScenarioAgainstWorker:
+    def test_poison_flood_drain_verdict(self):
+        """The canned hostile-client flood against an in-process
+        worker: every hostile record gets a terminal outcome, healthy
+        co-traffic completes, and the verdict's containment checks
+        really ran (not vacuous skips)."""
+        broker = EmbeddedBroker()
+
+        class InProcPoison(OkModel):
+            def predict(self, x, batch_size=None):
+                if np.any(np.abs(np.asarray(x)) > 1e8):
+                    raise ValueError("poison payload rejected")
+                return super().predict(x, batch_size)
+
+        serving = ClusterServing(
+            InProcPoison(),
+            ServingConfig(batch_size=4, consumer_group="lg",
+                          consumer_name="w0",
+                          metrics_host="127.0.0.1"),
+            broker=broker)
+        t = threading.Thread(target=serving.run,
+                             kwargs={"poll_ms": 5}, daemon=True)
+        t.start()
+        try:
+            scen = SCENARIOS["poison_flood_drain"](
+                base_rate=10.0, steady_s=1.0, flood_s=1.5,
+                drain_s=1.0)
+            run = run_scenario(scen, compress=1.0,
+                               broker_factory=lambda: broker,
+                               result_timeout_s=25.0)
+            time.sleep(0.3)
+            verdict = evaluate(
+                run, scen.slo,
+                dead_letters=read_dead_letters(broker),
+                pending=pending_count(broker, group="lg"))
+            assert verdict.passed, verdict.render()
+            poison_check = verdict.check("poison_contained")
+            assert not poison_check.skipped
+            assert run.counts().get("error", 0) > 0   # flood landed
+        finally:
+            _stop(serving, t)

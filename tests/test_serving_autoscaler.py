@@ -19,6 +19,7 @@ import numpy as np
 import pytest
 
 from analytics_zoo_tpu.observability import get_registry
+from analytics_zoo_tpu.resilience.policy import DegradedTraining
 from analytics_zoo_tpu.serving.client import InputQueue, OutputQueue
 from analytics_zoo_tpu.serving.redis_client import BrokerServer, connect
 from analytics_zoo_tpu.serving.supervisor import ServingSupervisor
@@ -312,6 +313,66 @@ class TestAutoscalerMechanics:
         with pytest.raises(ValueError):
             ServingSupervisor(_stub_factory(), min_replicas=3,
                               max_replicas=1)
+
+
+class TestBudgetExhaustionDuringScaleUp:
+    """ISSUE 14 satellite: the degraded path and the scale path were
+    only ever tested separately.  Here the restart budget exhausts on
+    a replica WHILE an autoscaler scale-up is active: the supervisor
+    must still end the fleet structured (DegradedTraining naming the
+    culprit), the scale event must survive in the introspection
+    surface the loadgen verdict reads, and the degraded slot must
+    drop out of the live fleet size."""
+
+    def test_degrade_mid_scale_up_stays_structured(self):
+        clock = FakeClock()
+        signals = {"queue": 50.0, "fill": 1.0, "p50_ms": 0.0,
+                   "saw_metrics": True}      # sustained pressure
+        sup = _scripted_supervisor(
+            signals, clock=clock, replicas=1, min_replicas=1,
+            max_replicas=2, retry_times=1, retry_window_s=60.0,
+            scale_up_sustain_s=0.2, scale_cooldown_s=0.1)
+        _spawn_initial(sup)
+        try:
+            # pressure sustains → the autoscaler grows the fleet to 2
+            assert _tick_until(
+                sup, clock, lambda: sup._fleet_size() == 2,
+                settle_s=0.01)
+            assert [e["direction"] for e in sup.scale_events] == ["up"]
+            grown = sup._replicas[1]
+            assert grown.proc is not None
+
+            # the scaled-up replica crash-loops while pressure still
+            # holds: first death consumes the whole budget
+            # (retry_times=1) and schedules a respawn...
+            grown.proc.kill()
+            grown.proc.wait()
+            assert _tick_until(
+                sup, clock,
+                lambda: grown.proc is not None
+                and grown.proc.poll() is None,
+                settle_s=0.01)
+            assert sup.restarts_total == 1
+            # ...the second death exhausts it MID-scale-up: the fleet
+            # must end structured, not wedge or silently shrink
+            grown.proc.kill()
+            grown.proc.wait()
+            with pytest.raises(DegradedTraining) as ei:
+                _tick_until(sup, clock, lambda: False, max_ticks=50,
+                            settle_s=0.01)
+            rec = ei.value.result
+            assert rec["replica"] == 1
+            assert rec["status"] == "degraded"
+            # the introspection surface the verdict reads is intact:
+            # the scale-up is on record, the degraded slot left the
+            # live fleet, and the original replica survived
+            assert sup.summary()["degraded"] == [1]
+            assert [e["direction"] for e in sup.scale_events] == ["up"]
+            assert sup._fleet_size() == 1
+            assert sup._replicas[0].proc is not None
+            assert sup._replicas[0].proc.poll() is None
+        finally:
+            sup.drain_fleet()
 
 
 class TestFleetAutoscaleAcceptance:

@@ -495,6 +495,61 @@ class TestPoisonQuarantine:
         assert not broker._groups[("serving_stream", "serve")]["pending"]
         assert rid_poison not in broker.hgetall(POISON_ATTEMPTS_KEY)
 
+    def test_reclaim_of_already_served_record_finishes_the_ack(self):
+        """The ISSUE 14 storm finding: a record whose serve COMPLETED
+        (result written under its request_id) but whose ack was lost
+        to a broker outage must not be re-served — and must never
+        ride the poison judgment, which would eventually quarantine
+        an innocent and overwrite its delivered result with an
+        error.  The reclaim pass finishes the lost ack instead."""
+        broker = EmbeddedBroker()
+        broker.xgroup_create(INPUT_STREAM, "serve")
+        inq = InputQueue(broker=broker)
+        rid = inq.enqueue("done-0", np.zeros(3, np.float32))
+        # a previous life: read, served (result written with the
+        # echoed request_id), attempt marked... and died before XACK
+        broker.xreadgroup("serve", "w-dead", INPUT_STREAM, count=1)
+        broker.hset("result:done-0",
+                    {"value": json.dumps([[0, 1.0]]),
+                     "request_id": rid})
+        broker.hset(POISON_ATTEMPTS_KEY, {rid: "1"})
+        model = CountingModel()
+        w = ClusterServing(
+            model, ServingConfig(batch_size=4, consumer_group="serve",
+                                 consumer_name="w2",
+                                 poison_max_attempts=2),
+            broker=broker)
+        assert w._reclaim_stale(min_idle_ms=0) == 0
+        assert model.calls == 0                 # no double predict
+        # acked out of the PEL, attempt mark forgiven, result intact
+        assert not broker._groups[(INPUT_STREAM, "serve")]["pending"]
+        assert broker.hgetall(POISON_ATTEMPTS_KEY) == {}
+        assert not _dead_letters(broker, reason="poison")
+        res = OutputQueue(broker=broker).query_meta("done-0")
+        assert res["value"] == [[0, 1.0]]
+        assert res["request_id"] == rid
+
+    def test_reclaim_uri_reuse_with_new_request_id_still_serves(self):
+        """The guard keys on request_id, not uri: a NEW record
+        reusing an old uri must still be predicted."""
+        broker = EmbeddedBroker()
+        broker.xgroup_create(INPUT_STREAM, "serve")
+        inq = InputQueue(broker=broker)
+        broker.hset("result:reuse", {"value": json.dumps([[9, 9.0]]),
+                                     "request_id": "old-rid"})
+        inq.enqueue("reuse", np.zeros(3, np.float32),
+                    request_id="new-rid")
+        broker.xreadgroup("serve", "w-dead", INPUT_STREAM, count=1)
+        model = CountingModel()
+        w = ClusterServing(
+            model, ServingConfig(batch_size=4, consumer_group="serve",
+                                 consumer_name="w2"),
+            broker=broker)
+        assert w._reclaim_stale(min_idle_ms=0) == 1
+        assert model.calls == 1
+        res = OutputQueue(broker=broker).query_meta("reuse")
+        assert res["request_id"] == "new-rid"
+
     def test_clean_reclaims_do_not_accumulate_attempts(self):
         """A healthy record reclaimed from a dead worker is served once
         and its delivery count cleared — no quarantine creep."""
