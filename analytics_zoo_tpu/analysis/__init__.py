@@ -13,8 +13,16 @@ INTERPROCEDURAL (``project.py`` links every analyzed file into a
 module graph + call graph, so helper calls no longer hide findings)
 and includes the sharding/HBM/deadlock families
 (SHARD007/MEM009/LOCK010, ``rules_graph.py``) with the static
-comm/HBM cost models in ``comms.py``.  Rule catalog + workflow:
-docs/static-analysis.md.
+comm/HBM cost models in ``comms.py``.  Since v3 it is also
+FLOW-SENSITIVE: ``cfg.py`` builds an intraprocedural CFG with
+exception edges and a forward typestate engine, powering the
+obligation families in ``rules_flow.py`` — DONATE012
+(use-after-donate, the CPU-silent/TPU-fatal class), ACK013
+(exactly-once record/Request discharge in serving/), RES015
+(exception-path resource release: probe slots, manual acquires,
+spawned processes/threads).  Rule catalog + workflow:
+docs/static-analysis.md (the catalog table in ``analysis/README.md``
+is generated from the registry — see ``cli.rule_catalog``).
 """
 
 from analytics_zoo_tpu.analysis.baseline import (
@@ -23,6 +31,11 @@ from analytics_zoo_tpu.analysis.baseline import (
     diff_findings,
     load_baseline,
     write_baseline,
+)
+from analytics_zoo_tpu.analysis.cfg import (
+    CFG,
+    build_cfg,
+    run_forward,
 )
 from analytics_zoo_tpu.analysis.comms import (
     all_gather_bytes,
@@ -47,6 +60,9 @@ from analytics_zoo_tpu.analysis.project import (
 )
 
 __all__ = [
+    "CFG",
+    "build_cfg",
+    "run_forward",
     "Finding",
     "ModuleContext",
     "ProjectContext",

@@ -1,0 +1,453 @@
+"""zoolint v3 — intraprocedural control-flow graph + forward
+typestate framework.
+
+PR 5/7's engine walks each function ONCE (or twice for loops) with
+ad-hoc branch merging — enough for value-reuse rules (RNG006) but
+structurally unable to express *path-sensitive obligation* protocols:
+"this probe slot must be released on EVERY outgoing edge, exception
+edges included" (the PR 9 breaker leak), "this record must be
+discharged exactly once per iteration" (the PR 13 reclaim defect), or
+"this buffer is gone after the donating call on SOME path" (the class
+CPU tier-1 runs can never fail on, because donation is a no-op
+off-TPU).  This module supplies the missing layer:
+
+- :func:`build_cfg` — a statement-granularity CFG over ``ast`` with
+  explicit **edge kinds**: ``next`` (fallthrough), ``true``/``false``
+  (branches, loop iterate/exhaust), ``exc`` (exception edges).  It
+  models ``if``/``for``/``while`` (``else`` clauses included),
+  ``try``/``except``/``else``/``finally``, ``with``, ``break``/
+  ``continue``/``return``/``raise``.  ``finally`` bodies are
+  **duplicated per continuation** (normal, exception, return, break,
+  continue), so a state that leaves a ``try`` abnormally flows
+  through its own copy of the cleanup — no infeasible
+  normal-path-into-raise-exit joins.
+- :func:`run_forward` — a worklist fixpoint engine over the CFG for
+  monotone forward analyses.  States are ``{key: frozenset}`` maps
+  joined by key-wise union; transfer functions may return
+  **different out-states per edge kind** (an assignment does not
+  rebind on its exception edge; a guard refines its true/false arms).
+
+Exception-edge policy (documented, deliberately asymmetric):
+
+- a statement *can raise* iff it contains a ``Call``, is a
+  ``Raise``/``Assert``, or is a ``with`` header (context-manager
+  entry) — attribute access / arithmetic raising is ignored
+  (precision over recall, the PR 5 contract);
+- inside a ``try`` **with handlers**, exception edges go to every
+  handler (static type dispatch is not attempted) and nowhere else —
+  the escaping path out of such a ``try`` exists only through an
+  explicit (re-)``raise`` in a handler.  A ``try``/``finally`` with
+  no handlers routes exception edges through the ``finally`` copy to
+  the enclosing target (outer handlers, or the function's
+  ``raise`` exit).
+
+Stdlib-only; never imports jax (the ``scripts/zoolint`` contract).
+"""
+
+from __future__ import annotations
+
+import ast
+from collections import deque
+from typing import (Callable, Dict, FrozenSet, List, Optional, Sequence,
+                    Tuple)
+
+#: edge kinds
+NEXT = "next"
+TRUE = "true"
+FALSE = "false"
+EXC = "exc"
+
+#: a dataflow state: key -> frozenset of abstract facts
+State = Dict[str, FrozenSet]
+
+
+class CFGNode:
+    """One CFG node: a simple statement, a compound-statement header
+    (``if``/``while`` test, ``for`` iterator, ``with`` items), an
+    ``except`` handler entry, or a synthetic node (``entry``/``exit``/
+    ``raise``/``reraise``)."""
+
+    __slots__ = ("idx", "kind", "stmt", "exprs", "line", "copy")
+
+    def __init__(self, idx: int, kind: str,
+                 stmt: Optional[ast.AST] = None,
+                 exprs: Sequence[ast.AST] = ()):
+        self.idx = idx
+        self.kind = kind          # "stmt" | "if" | "while" | "for" |
+        #                           "with" | "handler" | "entry" |
+        #                           "exit" | "raise" | "reraise"
+        self.stmt = stmt
+        #: the expression roots evaluated AT this node (what typestate
+        #: transfer functions scan for reads/calls) — for a compound
+        #: statement this is the header only, never the nested body
+        self.exprs = list(exprs)
+        self.line = getattr(stmt, "lineno", 0)
+        #: >1 when the same source statement appears again as a
+        #: duplicated ``finally`` copy (one copy per continuation)
+        self.copy = 1
+
+    def label(self) -> str:
+        if self.kind in ("entry", "exit", "raise"):
+            return self.kind
+        name = "reraise" if self.kind == "reraise" else (
+            type(self.stmt).__name__ if self.stmt is not None
+            else self.kind)
+        suffix = f"#{self.copy}" if self.copy > 1 else ""
+        return f"{name}@{self.line}{suffix}"
+
+
+class CFG:
+    """The graph: ``nodes`` by index, ``succs[idx] -> [(idx, kind)]``,
+    and the three synthetic anchors ``entry``/``exit``/``raise_exit``
+    (normal return vs propagating exception leave through different
+    exits — obligation rules treat them differently)."""
+
+    def __init__(self) -> None:
+        self.nodes: List[CFGNode] = []
+        self.succs: Dict[int, List[Tuple[int, str]]] = {}
+        self._copies: Dict[Tuple[str, int], int] = {}
+        self.entry = self._new("entry").idx
+        self.exit = self._new("exit").idx
+        self.raise_exit = self._new("raise").idx
+
+    def _new(self, kind: str, stmt: Optional[ast.AST] = None,
+             exprs: Sequence[ast.AST] = ()) -> CFGNode:
+        node = CFGNode(len(self.nodes), kind, stmt, exprs)
+        if stmt is not None:
+            key = (kind, id(stmt))
+            self._copies[key] = self._copies.get(key, 0) + 1
+            node.copy = self._copies[key]
+        self.nodes.append(node)
+        self.succs[node.idx] = []
+        return node
+
+    def add_edge(self, src: int, dst: int, kind: str = NEXT) -> None:
+        if (dst, kind) not in self.succs[src]:
+            self.succs[src].append((dst, kind))
+
+    def edges(self) -> List[str]:
+        """Human-readable sorted edge list — the unit-test witness
+        (``'Assign@3 ->exc handler@5'``).  Only edges reachable from
+        ``entry`` are listed: a ``finally`` continuation copy no path
+        uses (e.g. the normal-completion copy of a body that always
+        returns) is construction residue, not semantics."""
+        reach = self.reachable()
+        out = []
+        for src in sorted(reach):
+            for dst, kind in self.succs[src]:
+                out.append(f"{self.nodes[src].label()} ->{kind} "
+                           f"{self.nodes[dst].label()}")
+        return sorted(out)
+
+    def reachable(self) -> "set":
+        seen = {self.entry}
+        stack = [self.entry]
+        while stack:
+            cur = stack.pop()
+            for dst, _k in self.succs[cur]:
+                if dst not in seen:
+                    seen.add(dst)
+                    stack.append(dst)
+        return seen
+
+    def preds(self) -> Dict[int, List[Tuple[int, str]]]:
+        out: Dict[int, List[Tuple[int, str]]] = {
+            i: [] for i in range(len(self.nodes))}
+        for src, edges in self.succs.items():
+            for dst, kind in edges:
+                out[dst].append((src, kind))
+        return out
+
+
+def _stmt_can_raise(stmt: ast.AST) -> bool:
+    """Can this SIMPLE statement raise?  Calls anywhere inside it (its
+    own expressions only — nested defs/classes define, they don't
+    run), explicit asserts."""
+    if isinstance(stmt, (ast.Assert, ast.Raise)):
+        return True
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return any(isinstance(sub, ast.Call)
+                   for dec in stmt.decorator_list
+                   for sub in ast.walk(dec))
+    for sub in ast.walk(stmt):
+        if isinstance(sub, ast.Call):
+            return True
+    return False
+
+
+def _exprs_can_raise(exprs: Sequence[ast.AST]) -> bool:
+    return any(isinstance(sub, ast.Call)
+               for e in exprs for sub in ast.walk(e))
+
+
+class _Env:
+    """Continuation record threaded through the recursive builder.
+    ``exc()`` yields the exception targets (handler nodes, or the
+    raise exit — possibly through a ``finally`` copy); ``ret``/
+    ``brk``/``cont`` yield the single target for ``return``/
+    ``break``/``continue``.  All are thunks so ``finally`` wrapping
+    composes lazily and copies are built only for transfers that
+    actually occur."""
+
+    __slots__ = ("exc", "ret", "brk", "cont")
+
+    def __init__(self, exc: Callable[[], List[int]],
+                 ret: Callable[[], int],
+                 brk: Optional[Callable[[], int]] = None,
+                 cont: Optional[Callable[[], int]] = None):
+        self.exc = exc
+        self.ret = ret
+        self.brk = brk
+        self.cont = cont
+
+
+class _Builder:
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+
+    # ------------------------------------------------------------ helpers
+    def _exc_edges(self, node: CFGNode, env: _Env) -> None:
+        for target in env.exc():
+            self.cfg.add_edge(node.idx, target, EXC)
+
+    def _seq(self, stmts: Sequence[ast.stmt], env: _Env,
+             follow: int) -> int:
+        """Wire ``stmts`` so control reaches ``follow`` afterwards;
+        returns the entry node index (``follow`` itself when empty).
+        Built back-to-front so each statement knows its successor."""
+        entry = follow
+        for stmt in reversed(stmts):
+            entry = self._stmt(stmt, env, entry)
+        return entry
+
+    # ------------------------------------------------------------ stmts
+    def _stmt(self, stmt: ast.stmt, env: _Env, follow: int) -> int:
+        cfg = self.cfg
+        if isinstance(stmt, ast.If):
+            node = cfg._new("if", stmt, [stmt.test])
+            body = self._seq(stmt.body, env, follow)
+            orelse = self._seq(stmt.orelse, env, follow)
+            cfg.add_edge(node.idx, body, TRUE)
+            cfg.add_edge(node.idx, orelse, FALSE)
+            if _exprs_can_raise(node.exprs):
+                self._exc_edges(node, env)
+            return node.idx
+
+        if isinstance(stmt, ast.While):
+            node = cfg._new("while", stmt, [stmt.test])
+            # loop exhaustion (test false) runs the else clause;
+            # break skips it and lands straight on follow
+            orelse = self._seq(stmt.orelse, env, follow)
+            body_env = _Env(env.exc, env.ret,
+                            brk=lambda: follow,
+                            cont=lambda: node.idx)
+            body = self._seq(stmt.body, body_env, node.idx)
+            cfg.add_edge(node.idx, body, TRUE)
+            cfg.add_edge(node.idx, orelse, FALSE)
+            if _exprs_can_raise(node.exprs):
+                self._exc_edges(node, env)
+            return node.idx
+
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            node = cfg._new("for", stmt, [stmt.iter])
+            orelse = self._seq(stmt.orelse, env, follow)
+            body_env = _Env(env.exc, env.ret,
+                            brk=lambda: follow,
+                            cont=lambda: node.idx)
+            body = self._seq(stmt.body, body_env, node.idx)
+            cfg.add_edge(node.idx, body, TRUE)      # next item bound
+            cfg.add_edge(node.idx, orelse, FALSE)   # exhausted
+            if _exprs_can_raise(node.exprs):
+                self._exc_edges(node, env)
+            return node.idx
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            node = cfg._new(
+                "with", stmt, [item.context_expr for item in stmt.items])
+            body = self._seq(stmt.body, env, follow)
+            cfg.add_edge(node.idx, body, NEXT)
+            # context-manager entry can raise; body exceptions ride
+            # the body statements' own edges (non-suppressing managers
+            # assumed — precision over recall)
+            self._exc_edges(node, env)
+            return node.idx
+
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, env, follow)
+
+        if isinstance(stmt, ast.Return):
+            node = cfg._new("stmt", stmt,
+                            [stmt.value] if stmt.value else [])
+            cfg.add_edge(node.idx, env.ret(), NEXT)
+            if _exprs_can_raise(node.exprs):
+                self._exc_edges(node, env)
+            return node.idx
+
+        if isinstance(stmt, ast.Raise):
+            node = cfg._new("stmt", stmt,
+                            [e for e in (stmt.exc, stmt.cause) if e])
+            self._exc_edges(node, env)   # no normal successor
+            return node.idx
+
+        if isinstance(stmt, ast.Break):
+            node = cfg._new("stmt", stmt)
+            if env.brk is not None:
+                cfg.add_edge(node.idx, env.brk(), NEXT)
+            return node.idx
+
+        if isinstance(stmt, ast.Continue):
+            node = cfg._new("stmt", stmt)
+            if env.cont is not None:
+                cfg.add_edge(node.idx, env.cont(), NEXT)
+            return node.idx
+
+        # simple statement (assign/expr/aug/ann/pass/del/import/defs…)
+        node = cfg._new("stmt", stmt, [stmt])
+        cfg.add_edge(node.idx, follow, NEXT)
+        if _stmt_can_raise(stmt):
+            self._exc_edges(node, env)
+        return node.idx
+
+    # -------------------------------------------------------------- try
+    def _try(self, stmt: ast.Try, env: _Env, follow: int) -> int:
+        cfg = self.cfg
+        final = stmt.finalbody
+
+        # ---- finally wrapping: every way OUT of the try region runs
+        # its own copy of the cleanup, so abnormal and normal leavings
+        # never share a path through it
+        copies: Dict[Tuple[str, Tuple[int, ...]], int] = {}
+
+        def through_final(kind: str, target: int) -> int:
+            if not final:
+                return target
+            key = (kind, (target,))
+            if key not in copies:
+                copies[key] = self._seq(final, env, target)
+            return copies[key]
+
+        def exc_through_final() -> List[int]:
+            outer = env.exc()
+            if not final:
+                return outer
+            key = ("exc", tuple(outer))
+            if key not in copies:
+                if len(outer) == 1:
+                    copies[key] = self._seq(final, env, outer[0])
+                else:
+                    # one cleanup copy, then the pending exception
+                    # re-dispatches to every outer handler
+                    rr = cfg._new("reraise", stmt)
+                    for t in outer:
+                        cfg.add_edge(rr.idx, t, EXC)
+                    copies[key] = self._seq(final, env, rr.idx)
+            return [copies[key]]
+
+        outer_env = _Env(
+            exc_through_final,
+            ret=lambda: through_final("ret", env.ret()),
+            brk=(None if env.brk is None
+                 else lambda: through_final("brk", env.brk())),
+            cont=(None if env.cont is None
+                  else lambda: through_final("cont", env.cont())))
+        normal_follow = through_final("next", follow)
+
+        # ---- handlers: bodies run under the OUTER continuations (a
+        # raise inside a handler propagates out, through the finally)
+        handler_nodes: List[int] = []
+        for h in stmt.handlers:
+            h_node = cfg._new("handler", h,
+                              [h.type] if h.type is not None else [])
+            h_entry = self._seq(h.body, outer_env, normal_follow)
+            cfg.add_edge(h_node.idx, h_entry, NEXT)
+            handler_nodes.append(h_node.idx)
+
+        # ---- body: exceptions go to the handlers (all of them — no
+        # static type dispatch) or, with none, through the finally out
+        body_env = _Env(
+            (lambda: list(handler_nodes)) if handler_nodes
+            else exc_through_final,
+            ret=outer_env.ret, brk=outer_env.brk, cont=outer_env.cont)
+        # else clause runs after the body completes normally; ITS
+        # exceptions are NOT caught by this try's handlers
+        orelse_entry = self._seq(stmt.orelse, outer_env, normal_follow)
+        return self._seq(stmt.body, body_env, orelse_entry)
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for one ``FunctionDef``/``AsyncFunctionDef`` (or any object
+    with a statement-list ``body``)."""
+    cfg = CFG()
+    builder = _Builder(cfg)
+    env = _Env(exc=lambda: [cfg.raise_exit], ret=lambda: cfg.exit)
+    first = builder._seq(list(fn.body), env, cfg.exit)
+    cfg.add_edge(cfg.entry, first, NEXT)
+    # copy ordinals count REACHABLE duplicates only (in creation
+    # order) — an unused eagerly-built finally continuation must not
+    # shift the labels of the copies paths actually take
+    reach = cfg.reachable()
+    counts: Dict[Tuple[str, int], int] = {}
+    for node in cfg.nodes:
+        if node.stmt is None or node.idx not in reach:
+            continue
+        key = (node.kind, id(node.stmt))
+        counts[key] = counts.get(key, 0) + 1
+        node.copy = counts[key]
+    return cfg
+
+
+# ---------------------------------------------------------------- engine
+
+
+def join(a: State, b: State) -> State:
+    """Key-wise union — the may-analysis join."""
+    if not a:
+        return dict(b)
+    out = dict(a)
+    for k, v in b.items():
+        cur = out.get(k)
+        out[k] = v if cur is None else (cur | v)
+    return out
+
+
+def _covers(a: State, b: State) -> bool:
+    """Does ``a`` already contain everything in ``b``?"""
+    for k, v in b.items():
+        cur = a.get(k)
+        if cur is None or not v <= cur:
+            return False
+    return True
+
+
+def run_forward(cfg: CFG, initial: State,
+                transfer: Callable[[CFGNode, State],
+                                   Dict[Optional[str], State]],
+                max_steps: int = 100000) -> Dict[int, State]:
+    """Worklist fixpoint: returns the joined IN-state per node.
+
+    ``transfer(node, in_state)`` returns out-states keyed by edge
+    kind; ``None`` is the default for kinds not listed.  The lattice
+    (key-wise frozenset union) is finite and the transfer functions
+    the rules use are monotone, so this terminates; ``max_steps`` is
+    a safety net, not a tuning knob."""
+    in_states: Dict[int, State] = {cfg.entry: dict(initial)}
+    work = deque([cfg.entry])
+    steps = 0
+    while work and steps < max_steps:
+        steps += 1
+        idx = work.popleft()
+        node = cfg.nodes[idx]
+        out = transfer(node, in_states.get(idx, {}))
+        default = out.get(None, {})
+        for dst, kind in cfg.succs[idx]:
+            state = out.get(kind, default)
+            cur = in_states.get(dst)
+            if cur is None:
+                in_states[dst] = dict(state)
+                work.append(dst)
+            elif not _covers(cur, state):
+                in_states[dst] = join(cur, state)
+                if dst not in work:
+                    work.append(dst)
+    return in_states

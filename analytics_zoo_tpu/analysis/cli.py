@@ -4,13 +4,20 @@ Usage (see docs/static-analysis.md for the workflow)::
 
     zoolint analytics_zoo_tpu scripts examples
     zoolint --jobs 4 analytics_zoo_tpu ...   # parallel rule runs
+    zoolint --changed-only analytics_zoo_tpu ...   # ~1s pre-commit loop
     zoolint --baseline .zoolint-baseline.json analytics_zoo_tpu ...
     zoolint --json pkg/ > report.json
+    zoolint --sarif report.sarif pkg/        # SARIF 2.1.0 alongside
     zoolint --diff main-report.json pkg/     # PR gate: new findings only
     zoolint --write-baseline .zoolint-baseline.json pkg/
     zoolint --explain-comms --mesh data=8 --param-count 1000000 pkg/
     zoolint --explain-hbm --param-bytes 4000000 pkg/
     zoolint --list-rules
+
+The ``--help`` epilog and ``analysis/README.md``'s catalog table are
+GENERATED from the live rule registry (:func:`rule_catalog`) — a new
+rule family can never silently miss the docs again (the PR 7 help
+text stopped at COMPILE011 for two releases).
 
 Exit codes (stable — CI depends on them):
 
@@ -18,7 +25,8 @@ Exit codes (stable — CI depends on them):
 0     clean (no findings / none beyond the baseline or diff base)
 1     findings (new findings, stale baseline entries, or
       unparseable files)
-2     bad invocation / unreadable baseline
+2     bad invocation / unreadable baseline / not a git work tree
+      (--changed-only)
 ====  ==========================================================
 """
 
@@ -26,14 +34,105 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set, Tuple
 
 from analytics_zoo_tpu.analysis import baseline as baseline_mod
 from analytics_zoo_tpu.analysis.core import (
     Finding, all_rule_classes, analyze_paths)
 
 JSON_VERSION = 1
+
+
+# ------------------------------------------------------- rule catalog
+
+
+def rule_catalog() -> List[Tuple[str, str, str]]:
+    """(rule_id, severity, doc) for EVERY registered rule — module
+    rules and project rules — sorted by id.  The single source the
+    ``--help`` epilog, ``--list-rules``, the SARIF driver metadata
+    and ``analysis/README.md``'s table are generated from."""
+    from analytics_zoo_tpu.analysis.project import project_rule_classes
+    classes = list(all_rule_classes()) + list(project_rule_classes())
+    return sorted({(c.rule_id, c.severity, " ".join(c.doc.split()))
+                   for c in classes})
+
+
+def catalog_lines() -> List[str]:
+    return [f"{rid}  {severity:7s}  {doc}"
+            for rid, severity, doc in rule_catalog()]
+
+
+def readme_rule_table() -> str:
+    """The markdown table embedded in ``analysis/README.md`` (a test
+    regenerates it and diffs, so the file cannot drift)."""
+    rows = ["| rule | severity | what it catches |",
+            "| --- | --- | --- |"]
+    for rid, severity, doc in rule_catalog():
+        rows.append(f"| {rid} | {severity} | {doc} |")
+    return "\n".join(rows)
+
+
+# -------------------------------------------------------- changed-only
+
+
+def _is_git_ref(root: str, value: str) -> bool:
+    """Does ``value`` resolve to a commit in ``root``'s repository?
+    False too when ``root`` is not a git tree (the later
+    ``changed_relpaths`` call reports that case loudly)."""
+    import subprocess
+    proc = subprocess.run(
+        ["git", "-C", root, "rev-parse", "--verify", "--quiet",
+         f"{value}^{{commit}}"], capture_output=True, text=True)
+    return proc.returncode == 0
+
+
+def changed_relpaths(root: str, ref: str = "HEAD") -> Set[str]:
+    """``root``-relative (POSIX) paths changed vs ``ref`` — tracked
+    modifications (staged + unstaged) plus untracked files.  Raises
+    ``RuntimeError`` when ``root`` is not a git work tree or the ref
+    is unknown: a broken fast path must fail loudly, never silently
+    lint nothing.
+
+    Path bases differ per git command — ``diff --name-only`` reports
+    TOPLEVEL-relative, ``ls-files --others`` reports cwd-relative —
+    so both are rebased onto ``root`` explicitly (with ``--root``
+    below the git top, naive joining silently matched nothing and
+    the fast path linted nothing at all).  Changes outside ``root``
+    are dropped: they cannot correspond to an analyzed file."""
+    import subprocess
+
+    def run(*args: str) -> str:
+        # config-proofing: core.quotePath (default ON) octal-escapes
+        # non-ASCII names and diff.relative rebases the output — both
+        # would make the rebasing below match nothing and the fast
+        # path silently lint nothing
+        proc = subprocess.run(
+            ["git", "-C", root, "-c", "core.quotePath=off",
+             "-c", "diff.relative=false", *args],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"--changed-only: git {args[0]} failed: "
+                f"{proc.stderr.strip() or 'not a git work tree?'}")
+        return proc.stdout
+
+    toplevel = run("rev-parse", "--show-toplevel").strip()
+    root_abs = os.path.abspath(root)
+    out: Set[str] = set()
+    for base, text in (
+            (toplevel, run("diff", "--name-only", ref, "--")),
+            (root_abs, run("ls-files", "--others",
+                           "--exclude-standard"))):
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            rel = os.path.relpath(os.path.join(base, line.strip()),
+                                  root_abs)
+            if not rel.startswith(".."):
+                out.add(rel.replace(os.sep, "/"))
+    return out
 
 
 def _report_json(findings: List[Finding], errors: List[str]) -> dict:
@@ -51,18 +150,39 @@ def _report_json(findings: List[Finding], errors: List[str]) -> dict:
 
 
 def build_parser() -> argparse.ArgumentParser:
+    # description/epilog are GENERATED from the rule registry so the
+    # help text tracks the shipped rule set by construction
+    catalog = "\n".join("  " + line for line in catalog_lines())
     ap = argparse.ArgumentParser(
         prog="zoolint",
-        description="JAX/TPU-aware static analysis (interprocedural): "
-                    "jit purity, host-sync hygiene, recompile safety, "
-                    "donation, thread safety, PRNG key reuse, "
-                    "sharding specs, HBM live buffers, lock ordering",
-        epilog="suppress one line with "
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        description=f"JAX/TPU-aware static analysis (interprocedural "
+                    f"+ flow-sensitive typestate): "
+                    f"{len(rule_catalog())} rule families",
+        epilog="rules (generated from the registry):\n"
+               f"{catalog}\n\n"
+               "suppress one line with "
                "'# zoolint: disable=RULE — reason'")
     ap.add_argument("paths", nargs="*", default=[],
                     help="files or directories to analyze")
     ap.add_argument("--json", action="store_true",
                     help="emit the machine-readable report on stdout")
+    ap.add_argument("--sarif", metavar="FILE", default=None,
+                    help="also write the (post-baseline/diff) "
+                         "findings as a SARIF 2.1.0 document")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD",
+                    default=None, metavar="GITREF",
+                    help="report only on files changed vs a git ref "
+                         "(default HEAD, untracked included); the "
+                         "whole project is still parsed and linked, "
+                         "so changed files see full facts — the "
+                         "pre-commit fast loop.  A value naming an "
+                         "existing path (and no ref) is treated as a "
+                         "swallowed positional path; a value naming "
+                         "BOTH fails loudly — disambiguate with "
+                         "./path or a qualified ref.  Stale-baseline "
+                         "enforcement is skipped (unchanged files "
+                         "are not re-checked)")
     ap.add_argument("--baseline", metavar="FILE", default=None,
                     help="acknowledged-debt file; findings it covers "
                          "pass, stale entries fail (only-shrink)")
@@ -107,6 +227,14 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def _write_sarif(path: str, findings: List[Finding],
+                 errors: List[str]) -> None:
+    from analytics_zoo_tpu.analysis.sarif import sarif_report
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(sarif_report(findings, errors), f, indent=2)
+        f.write("\n")
+
+
 def _explain(args) -> int:
     """The --explain-comms / --explain-hbm report modes: link the
     project, find the jitted train steps, price them with the stdlib
@@ -138,12 +266,30 @@ def _explain(args) -> int:
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
+    # argparse's nargs='?' lets a bare --changed-only swallow the
+    # FIRST positional path as its GITREF ('zoolint --changed-only
+    # analytics_zoo_tpu ...' is the documented form).  A captured
+    # value that names an existing path AND is not a ref was a path;
+    # a value that is BOTH a valid ref and an existing path is
+    # genuinely ambiguous and must fail loudly — silently picking
+    # either side lints the wrong thing (prefix the path with ./ or
+    # spell the ref as e.g. origin/NAME to disambiguate).  Must run
+    # before the no-paths check: the swallowed path may be the ONLY
+    # one.
+    if args.changed_only not in (None, "HEAD") and \
+            os.path.exists(args.changed_only):
+        if _is_git_ref(args.root, args.changed_only):
+            print(f"zoolint: --changed-only value "
+                  f"{args.changed_only!r} names both a git ref and "
+                  f"an existing path — disambiguate (./path or a "
+                  f"qualified ref)", file=sys.stderr)
+            return 2
+        args.paths.insert(0, args.changed_only)
+        args.changed_only = "HEAD"
+
     if args.list_rules:
-        from analytics_zoo_tpu.analysis.project import (
-            project_rule_classes)
-        classes = all_rule_classes() + project_rule_classes()
-        for cls in sorted(classes, key=lambda c: c.rule_id):
-            print(f"{cls.rule_id}  {cls.severity:7s}  {cls.doc}")
+        for line in catalog_lines():
+            print(line)
         return 0
     if not args.paths:
         print("zoolint: no paths given (try: zoolint "
@@ -152,11 +298,68 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.explain_comms or args.explain_hbm:
         return _explain(args)
 
+    only: Optional[Set[str]] = None
+    if args.changed_only is not None:
+        missing = [p for p in args.paths if not os.path.exists(p)]
+        if missing:
+            # the no-changes fast path below must not outrun the
+            # missing-target contract: a typo'd CLI target fails the
+            # full run and must fail the fast path too — with the
+            # same machine-readable outputs the full path produces
+            merrs = [f"{p}: no such file or directory"
+                     for p in missing]
+            if args.sarif:
+                _write_sarif(args.sarif, [], merrs)
+            if args.json:
+                json.dump(_report_json([], merrs), sys.stdout,
+                          indent=2)
+                sys.stdout.write("\n")
+            else:
+                for e in merrs:
+                    print(f"zoolint: ERROR {e}", file=sys.stderr)
+            return 1
+        if args.write_baseline:
+            # the baseline records the WHOLE tree's acknowledged debt;
+            # writing it from a changed-files-only run would silently
+            # discard every unchanged file's entry
+            print("zoolint: --write-baseline needs a full run "
+                  "(drop --changed-only)", file=sys.stderr)
+            return 2
+        try:
+            only = changed_relpaths(args.root, args.changed_only)
+        except RuntimeError as e:
+            print(f"zoolint: {e}", file=sys.stderr)
+            return 2
+        if not only:
+            # nothing changed — nothing to judge, by definition
+            if args.json:
+                json.dump(_report_json([], []), sys.stdout, indent=2)
+                sys.stdout.write("\n")
+            else:
+                print("zoolint: clean (no files changed vs "
+                      f"{args.changed_only})")
+            if args.sarif:
+                _write_sarif(args.sarif, [], [])
+            return 0
+
     rule_ids = ([r.strip() for r in args.rules.split(",") if r.strip()]
                 if args.rules else None)
     findings, errors = analyze_paths(args.paths, root=args.root,
                                      rule_ids=rule_ids,
-                                     jobs=max(1, args.jobs))
+                                     jobs=max(1, args.jobs),
+                                     only_relpaths=only)
+    if only is not None:
+        # errors are "<path>: <reason>"; keep unreadable/unparseable
+        # reports only for CHANGED files (compared path-for-path —
+        # substring matching misfiled 'a.py' onto 'data.py').
+        # Missing CLI targets never reach here: they returned rc 1
+        # before analyze_paths ran.
+        def _changed_error(e: str) -> bool:
+            epath = e.split(": ", 1)[0]
+            rel = os.path.relpath(epath, args.root).replace(
+                os.sep, "/")
+            return rel in only
+        errors = [e for e in errors if _changed_error(e)]
 
     if args.write_baseline:
         prev_total = None
@@ -184,6 +387,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
         shown, stale = baseline_mod.apply_baseline(findings, base)
+        if only is not None:
+            # unchanged files were not re-analyzed — their baseline
+            # entries are unmatched by construction, not fixed
+            stale = []
     elif args.diff:
         try:
             with open(args.diff, encoding="utf-8") as f:
@@ -193,6 +400,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
         shown = baseline_mod.diff_findings(findings, base_report)
+
+    if args.sarif:
+        _write_sarif(args.sarif, shown, errors)
 
     if args.json:
         report = _report_json(shown, errors)

@@ -141,14 +141,17 @@ def register_rule(cls: Type[Rule]) -> Type[Rule]:
 
 
 def all_rule_classes() -> List[Type[Rule]]:
-    # rules.py / rules_graph.py register on import; imported lazily so
-    # ``core`` stays importable standalone (scripts/zoolint file-path
-    # loading).  Both imports run UNCONDITIONALLY (idempotent via
-    # sys.modules) — guarding on ``_RULE_CLASSES`` being empty once
-    # silently dropped the rules_graph families whenever rules.py had
-    # already been imported through another path (project.py's link
-    # pass), i.e. in every fresh CLI process.
+    # rules.py / rules_graph.py / rules_flow.py register on import;
+    # imported lazily so ``core`` stays importable standalone
+    # (scripts/zoolint file-path loading).  All imports run
+    # UNCONDITIONALLY (idempotent via sys.modules) — guarding on
+    # ``_RULE_CLASSES`` being empty once silently dropped the
+    # rules_graph families whenever rules.py had already been
+    # imported through another path (project.py's link pass), i.e. in
+    # every fresh CLI process.
     from analytics_zoo_tpu.analysis import rules as _rules  # noqa: F401
+    from analytics_zoo_tpu.analysis import (  # noqa: F401
+        rules_flow as _rules_flow)
     from analytics_zoo_tpu.analysis import (  # noqa: F401
         rules_graph as _rules_graph)
     return list(_RULE_CLASSES)
@@ -877,7 +880,8 @@ def _jobs_worker(i: int) -> List[Finding]:
 
 def analyze_paths(paths: Sequence[str], root: str = ".",
                   rule_ids: Optional[Iterable[str]] = None,
-                  jobs: int = 1
+                  jobs: int = 1,
+                  only_relpaths: Optional[Set[str]] = None
                   ) -> Tuple[List[Finding], List[str]]:
     """Analyze files/dirs.  Returns (findings, unparseable-file
     errors).  Unparseable files are surfaced, not silently skipped —
@@ -887,7 +891,15 @@ def analyze_paths(paths: Sequence[str], root: str = ".",
     project pass (serial — it needs the whole module graph); (2) run
     the per-module rules, fanned out over ``jobs`` fork-started
     worker processes when ``jobs > 1``.  Output is sorted either way,
-    so ``--jobs`` never changes what the gate sees."""
+    so ``--jobs`` never changes what the gate sees.
+
+    ``only_relpaths`` (the ``--changed-only`` contract) restricts the
+    per-module rule runs — and the project-rule findings — to the
+    given repo-relative paths, while the parse + interprocedural link
+    still covers EVERYTHING: a changed file is judged with the full
+    project facts (imported jits, the axis universe, lock kinds), so
+    the fast pre-commit loop can never disagree with the full gate
+    about a changed file."""
     findings: List[Finding] = []
     contexts, errors = parse_contexts(paths, root=root)
 
@@ -897,14 +909,20 @@ def analyze_paths(paths: Sequence[str], root: str = ".",
     for ctx in contexts:
         ctx.apply_facts(facts.get(ctx.relpath, {}))
 
-    def run_project_rules() -> List[Finding]:
-        return project_mod.project_findings(proj, rule_ids)
+    run_contexts = contexts if only_relpaths is None else \
+        [c for c in contexts if c.relpath in only_relpaths]
 
-    if jobs > 1 and len(contexts) > 1:
-        findings.extend(_run_rules_pool(contexts, rule_ids, jobs,
+    def run_project_rules() -> List[Finding]:
+        out = project_mod.project_findings(proj, rule_ids)
+        if only_relpaths is not None:
+            out = [f for f in out if f.path in only_relpaths]
+        return out
+
+    if jobs > 1 and len(run_contexts) > 1:
+        findings.extend(_run_rules_pool(run_contexts, rule_ids, jobs,
                                         overlap=run_project_rules))
     else:
-        for ctx in contexts:
+        for ctx in run_contexts:
             findings.extend(_run_rules(ctx, rule_ids))
         findings.extend(run_project_rules())
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))

@@ -1,0 +1,84 @@
+"""SARIF 2.1.0 export for zoolint findings.
+
+SARIF (Static Analysis Results Interchange Format) is what code-review
+surfaces ingest — GitHub code scanning, VS Code SARIF viewers, Jenkins
+warnings-ng — so the CI gate's findings can annotate the diff instead
+of living in a console log.  One runs[] entry; the rule catalog is
+emitted from the live registry (``cli.rule_catalog``) so the metadata
+can never drift from the rules actually run.  Stdlib-only, like the
+rest of the analyzer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from analytics_zoo_tpu.analysis.core import Finding
+
+SARIF_VERSION = "2.1.0"
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+
+#: zoolint severity -> SARIF level
+_LEVELS = {"error": "error", "warning": "warning"}
+
+
+def sarif_report(findings: Sequence[Finding],
+                 errors: Sequence[str] = ()) -> Dict:
+    """The findings (post-baseline/diff — what the run actually FAILS
+    on) as one SARIF 2.1.0 document.  Unparseable-file errors ride
+    along as tool-level notifications: a file the linter could not
+    read is a result consumers must see too."""
+    from analytics_zoo_tpu.analysis.cli import rule_catalog
+    rules: List[Dict] = []
+    seen = set()
+    for rid, severity, doc in rule_catalog():
+        if rid in seen:
+            continue
+        seen.add(rid)
+        rules.append({
+            "id": rid,
+            "shortDescription": {"text": doc},
+            "defaultConfiguration": {
+                "level": _LEVELS.get(severity, "warning")},
+        })
+    results = []
+    for f in findings:
+        results.append({
+            "ruleId": f.rule,
+            "level": _LEVELS.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "partialFingerprints": {"zoolintKey/v1": f.key()},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": max(f.line, 1),
+                               "startColumn": f.col + 1},
+                },
+                "logicalLocations": ([{"name": f.symbol}]
+                                     if f.symbol else []),
+            }],
+        })
+    notifications = [{"level": "error", "message": {"text": e}}
+                     for e in errors]
+    # no informationUri: SARIF 2.1.0 requires an ABSOLUTE URI for it
+    # and this repo has no canonical public URL — strict ingesters
+    # (github code scanning) reject relative values, and the
+    # property is optional
+    run: Dict = {
+        "tool": {"driver": {
+            "name": "zoolint",
+            "rules": rules,
+        }},
+        "results": results,
+    }
+    if notifications:
+        run["invocations"] = [{
+            "executionSuccessful": False,
+            "toolExecutionNotifications": notifications,
+        }]
+    return {
+        "$schema": _SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [run],
+    }

@@ -113,37 +113,41 @@ def main(argv=None):
     t.start()
 
     # --- micro-batch consumer loop ------------------------------------
-    from analytics_zoo_tpu.feature.image import decode_image_bytes
-    served, last_id, idle = 0, "0-0", 0
-    while served < args.frames and idle < 200:
-        entries = broker.xread(stream, last_id, count=args.batch,
-                               block_ms=50)
-        if not entries:
-            idle += 1
-            continue
-        idle = 0
-        last_id = entries[-1][0]
-        uris, batch_imgs = [], []
-        for _id, fields in entries:
-            uris.append(fields["uri"].decode()
-                        if isinstance(fields["uri"], bytes)
-                        else fields["uri"])
-            raw = base64.b64decode(fields["image"])
-            img = decode_image_bytes(raw)
-            batch_imgs.append(img.astype(np.float32) / 255.0)
-        x = np.stack(batch_imgs)
-        if len(x) < args.batch:        # pad to the jitted batch shape
-            pad = np.zeros((args.batch - len(x),) + x.shape[1:],
-                           x.dtype)
-            x = np.concatenate([x, pad])
-        dets = det.detect(x)[:len(uris)]
-        for uri, (db, dscore, dlabel) in zip(uris, dets):
-            broker.hset(results + uri, {"value": json.dumps({
-                "boxes": np.round(db, 3).tolist(),
-                "scores": np.round(dscore, 3).tolist(),
-                "labels": dlabel.tolist()})})
-            served += 1
-    t.join()
+    # joined in a finally: a consumer failure must not leave the
+    # non-daemon producer blocking interpreter exit (RES015)
+    try:
+        from analytics_zoo_tpu.feature.image import decode_image_bytes
+        served, last_id, idle = 0, "0-0", 0
+        while served < args.frames and idle < 200:
+            entries = broker.xread(stream, last_id, count=args.batch,
+                                   block_ms=50)
+            if not entries:
+                idle += 1
+                continue
+            idle = 0
+            last_id = entries[-1][0]
+            uris, batch_imgs = [], []
+            for _id, fields in entries:
+                uris.append(fields["uri"].decode()
+                            if isinstance(fields["uri"], bytes)
+                            else fields["uri"])
+                raw = base64.b64decode(fields["image"])
+                img = decode_image_bytes(raw)
+                batch_imgs.append(img.astype(np.float32) / 255.0)
+            x = np.stack(batch_imgs)
+            if len(x) < args.batch:    # pad to the jitted batch shape
+                pad = np.zeros((args.batch - len(x),) + x.shape[1:],
+                               x.dtype)
+                x = np.concatenate([x, pad])
+            dets = det.detect(x)[:len(uris)]
+            for uri, (db, dscore, dlabel) in zip(uris, dets):
+                broker.hset(results + uri, {"value": json.dumps({
+                    "boxes": np.round(db, 3).tolist(),
+                    "scores": np.round(dscore, 3).tolist(),
+                    "labels": dlabel.tolist()})})
+                served += 1
+    finally:
+        t.join()
 
     # --- check: detections should land near the ground-truth squares --
     hits = 0

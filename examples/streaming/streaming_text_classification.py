@@ -103,19 +103,23 @@ def main(argv=None):
     t = threading.Thread(target=producer)
     t.start()          # produce concurrently with the serving drain
 
-    outq = OutputQueue(broker=broker)
-    correct = served = 0
-    deadline = time.time() + 60
-    for i in range(args.stream_rows):
-        res = None
-        while res is None and time.time() < deadline:
-            res = outq.query(f"line-{i}", timeout_s=5.0)
-        if res is None:
-            continue
-        served += 1
-        pred = res[0][0] if isinstance(res, list) else res
-        correct += int(int(pred) == int(stream_labels[i]))
-    t.join()
+    # joined in a finally: a drain failure must not leave the
+    # non-daemon producer blocking interpreter exit (RES015)
+    try:
+        outq = OutputQueue(broker=broker)
+        correct = served = 0
+        deadline = time.time() + 60
+        for i in range(args.stream_rows):
+            res = None
+            while res is None and time.time() < deadline:
+                res = outq.query(f"line-{i}", timeout_s=5.0)
+            if res is None:
+                continue
+            served += 1
+            pred = res[0][0] if isinstance(res, list) else res
+            correct += int(int(pred) == int(stream_labels[i]))
+    finally:
+        t.join()
     serving.stop()
     worker.join(timeout=10)
 

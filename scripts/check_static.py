@@ -24,7 +24,9 @@ Usage::
     python scripts/check_static.py                 # both passes
     python scripts/check_static.py --skip-metrics  # zoolint only
     python scripts/check_static.py --jobs 4        # parallel zoolint
+    python scripts/check_static.py --changed-only  # pre-commit loop
     python scripts/check_static.py --json > static_report.json
+    python scripts/check_static.py --sarif static_report.sarif
     python scripts/check_static.py --zoolint-args="--rules LOCK010"
 
 ``--json`` emits ONE merged machine-readable document (zoolint's
@@ -134,9 +136,8 @@ def run_json(args) -> int:
     doc = {"version": JSON_VERSION, "tool": "check_static"}
     rc = 0
     if not args.skip_zoolint:
-        zargs = shlex.split(args.zoolint_args) + ["--json"]
-        if args.jobs > 1:
-            zargs += ["--jobs", str(args.jobs)]
+        zargs = shlex.split(args.zoolint_args) + ["--json"] \
+            + _zoolint_passthrough(args)
         buf = io.StringIO()
         with contextlib.redirect_stdout(buf):
             zrc = run_zoolint(zargs)
@@ -178,6 +179,18 @@ def run_json(args) -> int:
     return rc
 
 
+def _zoolint_passthrough(args) -> List[str]:
+    """The zoolint flags check_static forwards verbatim."""
+    out: List[str] = []
+    if args.jobs > 1:
+        out += ["--jobs", str(args.jobs)]
+    if args.changed_only is not None:
+        out += ["--changed-only", args.changed_only]
+    if args.sarif:
+        out += ["--sarif", args.sarif]
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="check_static",
@@ -189,6 +202,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="merged machine-readable report on stdout")
     ap.add_argument("--jobs", type=int, default=1, metavar="N",
                     help="parallelize zoolint's per-file rule runs")
+    ap.add_argument("--changed-only", nargs="?", const="HEAD",
+                    default=None, metavar="GITREF",
+                    help="zoolint reports only on files changed vs a "
+                         "git ref (default HEAD) — the pre-commit "
+                         "fast path (full project facts still load)")
+    ap.add_argument("--sarif", metavar="FILE", default=None,
+                    help="zoolint also writes a SARIF 2.1.0 document "
+                         "(archived by the Jenkinsfile next to "
+                         "static_report.json)")
     ap.add_argument("--zoolint-args", default="",
                     help="extra args passed through to zoolint "
                          "(quoted string)")
@@ -206,9 +228,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     rc = 0
     if not args.skip_zoolint:
         print("== zoolint ==")
-        zargs = shlex.split(args.zoolint_args)
-        if args.jobs > 1:
-            zargs += ["--jobs", str(args.jobs)]
+        zargs = shlex.split(args.zoolint_args) \
+            + _zoolint_passthrough(args)
         rc = max(rc, run_zoolint(zargs))
     if not args.skip_metrics:
         print("== metrics_lint ==")
