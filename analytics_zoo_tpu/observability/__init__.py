@@ -60,10 +60,21 @@ from analytics_zoo_tpu.observability.aggregator import (
     WorkerSource,
     flush_worker_observability,
     init_worker_observability,
+    merge_requests,
     merge_snapshots,
     merge_traces,
     reset_worker_observability,
     straggler_report,
+)
+from analytics_zoo_tpu.observability.reqtrace import (
+    TRACE_FIELD,
+    TRACE_HEADER,
+    RequestLog,
+    RequestTimeline,
+    TraceContext,
+    get_request_log,
+    merge_timeline_dicts,
+    reset_request_log,
 )
 from analytics_zoo_tpu.observability.collectives import (
     estimate_train_step_collectives,
@@ -97,10 +108,19 @@ __all__ = [
     "WorkerSource",
     "flush_worker_observability",
     "init_worker_observability",
+    "merge_requests",
     "merge_snapshots",
     "merge_traces",
     "reset_worker_observability",
     "straggler_report",
+    "TRACE_FIELD",
+    "TRACE_HEADER",
+    "RequestLog",
+    "RequestTimeline",
+    "TraceContext",
+    "get_request_log",
+    "merge_timeline_dicts",
+    "reset_request_log",
     "estimate_train_step_collectives",
     "record_step_collectives",
 ]

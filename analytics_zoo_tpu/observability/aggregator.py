@@ -50,6 +50,7 @@ CLUSTER_FILE = "cluster.json"      # written by the launcher
 META_FILE = "meta.json"            # written by each worker
 METRICS_FILE = "metrics.jsonl"     # registry snapshots, append-only
 TRACE_FILE = "trace.json"          # Chrome trace per worker
+REQUESTS_FILE = "requests.json"    # request-timeline log per worker
 
 # env contract injected by the launcher (parallel/launcher.py)
 ENV_RUN_DIR = "ZOO_TPU_RUN_DIR"
@@ -627,6 +628,83 @@ def merge_traces(run_dir: str, out_path: Optional[str] = None) -> Dict:
     return merged
 
 
+# ---------------------------------------------------------- request merge
+def _load_reqtrace_module():
+    """Path-load ``reqtrace.py`` beside this file.  This module is
+    itself path-loaded by ``scripts/obs_report.py`` (where the package
+    may not be importable at all), so the merge logic cannot use a
+    package import — and reqtrace's module level is deliberately
+    stdlib-only to make this load safe anywhere."""
+    import importlib.util
+    import sys
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "reqtrace.py")
+    spec = importlib.util.spec_from_file_location(
+        "_zoo_reqtrace_offline", path)
+    mod = importlib.util.module_from_spec(spec)
+    # must be registered BEFORE exec: dataclass field-annotation
+    # resolution looks the defining module up in sys.modules
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def merge_requests(run_dir: str,
+                   out_path: Optional[str] = None) -> Dict:
+    """Merge per-host ``requests.json`` request-timeline logs into one
+    document: timelines sharing a trace_id across replicas (or the
+    client process) are joined and re-anchored on the earliest
+    ``wall0`` (see ``reqtrace.merge_timeline_dicts``).  Host selection
+    follows :func:`merge_traces`: the ``cluster.json`` manifest names
+    THIS run's workers; directory scanning is the fallback only when
+    no manifest exists.  Accepts a single ``requests.json`` FILE path
+    too (the loadgen's ``--requests-out`` artifact)."""
+    docs: List[Dict] = []
+    hosts = 0
+    if os.path.isfile(run_dir):
+        try:
+            with open(run_dir) as f:
+                docs.append(json.load(f))
+            hosts = 1
+        except Exception:
+            pass
+    else:
+        entries = None
+        try:
+            with open(os.path.join(run_dir, CLUSTER_FILE)) as f:
+                manifest = json.load(f)
+            entries = sorted(
+                w.get("dir", host_dir_name(w.get("process_index", 0)))
+                for w in manifest.get("workers", []))
+        except Exception:
+            entries = None
+        if not entries:
+            entries = sorted(os.listdir(run_dir)) \
+                if os.path.isdir(run_dir) else []
+        for entry in entries:
+            wdir = os.path.join(run_dir, entry)
+            if not (entry.startswith("host-") and os.path.isdir(wdir)):
+                continue
+            try:
+                with open(os.path.join(wdir, REQUESTS_FILE)) as f:
+                    docs.append(json.load(f))
+                hosts += 1
+            except Exception:
+                continue
+    reqtrace = _load_reqtrace_module()
+    merged = {
+        "kind": "zoo_request_timelines",
+        "hosts_merged": hosts,
+        "kept": sum(int(d.get("kept", 0)) for d in docs),
+        "dropped": sum(int(d.get("dropped", 0)) for d in docs),
+        "timelines": reqtrace.merge_timeline_dicts(docs),
+    }
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+    return merged
+
+
 # --------------------------------------------------- worker-side bring-up
 # bring-up state is check-then-act shared between the caller's thread,
 # atexit, and tests' reset — the lock makes init idempotence and
@@ -749,6 +827,12 @@ def flush_worker_observability() -> Optional[str]:
         get_tracer().export_chrome_trace(os.path.join(wdir, TRACE_FILE))
     except Exception:
         log.exception("worker trace flush failed")
+    try:
+        from analytics_zoo_tpu.observability.reqtrace import \
+            get_request_log
+        get_request_log().export(os.path.join(wdir, REQUESTS_FILE))
+    except Exception:
+        log.exception("worker request-log flush failed")
     return wdir
 
 

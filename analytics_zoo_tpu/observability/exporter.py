@@ -2,8 +2,12 @@
 Prometheus text exposition plus JSON snapshot and Chrome-trace views.
 
 Routes:
-    /metrics          Prometheus text exposition 0.0.4 (scrape target)
+    /metrics          Prometheus text exposition 0.0.4 (scrape target);
+                      ``?exemplars=1`` appends OpenMetrics-style
+                      exemplars (bucket → representative trace_id)
     /metrics.json     registry snapshot as JSON
+    /requests.json    the request log's kept timelines (tail-sampled
+                      per-request station waterfalls, newest last)
     /metrics/cluster  federated CLUSTER view (host 0 of a multi-host
                       run, when a ClusterAggregator is attached):
                       counters summed across hosts, histograms merged,
@@ -56,14 +60,22 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         try:
             if path in ("/metrics", "/"):
-                body = self.server.registry.prometheus_text().encode()
+                exemplars = "exemplars=1" in query.split("&")
+                body = self.server.registry.prometheus_text(
+                    exemplars=exemplars).encode()
                 self._respond(body, PROM_CONTENT_TYPE)
             elif path == "/metrics.json":
                 body = json.dumps(self.server.registry.snapshot(),
                                   indent=2).encode()
+                self._respond(body, "application/json")
+            elif path == "/requests.json":
+                from analytics_zoo_tpu.observability.reqtrace import (
+                    get_request_log)
+                body = json.dumps(
+                    get_request_log().snapshot()).encode()
                 self._respond(body, "application/json")
             elif path in ("/metrics/cluster", "/metrics/cluster.json"):
                 agg = getattr(self.server, "aggregator", None)

@@ -75,6 +75,16 @@ def _format_value(v: float) -> str:
     return repr(float(v))
 
 
+def _exemplar_suffix(ex: Optional[Tuple[str, float, float]]) -> str:
+    """OpenMetrics exemplar clause appended to a bucket/counter line:
+    ``# {trace_id="..."} <value> <unix ts>``."""
+    if not ex:
+        return ""
+    tid, v, ts = ex
+    return (f' # {{trace_id="{_escape_label_value(tid)}"}} '
+            f"{_format_value(v)} {ts:.3f}")
+
+
 class _Child:
     """One labeled time series of a metric family."""
 
@@ -85,17 +95,25 @@ class _Child:
 
 
 class _CounterChild(_Child):
-    __slots__ = ("value",)
+    __slots__ = ("value", "exemplar")
 
     def __init__(self):
         super().__init__()
         self.value = 0.0
+        # last exemplar: (trace_id, observed increment, unix ts) — the
+        # OpenMetrics bridge from a counter series to one inspectable
+        # request timeline (reqtrace.py)
+        self.exemplar: Optional[Tuple[str, float, float]] = None
 
-    def inc(self, amount: float = 1.0) -> None:
+    def inc(self, amount: float = 1.0,
+            exemplar: Optional[str] = None) -> None:
         if amount < 0:
             raise ValueError("counters can only increase")
         with self._lock:
             self.value += amount
+            if exemplar:
+                self.exemplar = (str(exemplar), float(amount),
+                                 time.time())
 
 
 class _GaugeChild(_Child):
@@ -118,7 +136,7 @@ class _GaugeChild(_Child):
 
 
 class _HistogramChild(_Child):
-    __slots__ = ("buckets", "counts", "sum", "count")
+    __slots__ = ("buckets", "counts", "sum", "count", "exemplars")
 
     def __init__(self, buckets: Tuple[float, ...]):
         super().__init__()
@@ -126,8 +144,14 @@ class _HistogramChild(_Child):
         self.counts = [0] * len(buckets)   # per-bucket (non-cumulative)
         self.sum = 0.0
         self.count = 0
+        # per-bucket last exemplar (index len(buckets) = +Inf):
+        # (trace_id, observed value, unix ts) — so a p99 bucket links
+        # directly to one inspectable request timeline (reqtrace.py)
+        self.exemplars: List[Optional[Tuple[str, float, float]]] = \
+            [None] * (len(buckets) + 1)
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
         v = float(value)
         i = bisect.bisect_left(self.buckets, v)
         with self._lock:
@@ -135,6 +159,8 @@ class _HistogramChild(_Child):
                 self.counts[i] += 1
             self.sum += v
             self.count += 1
+            if exemplar:
+                self.exemplars[i] = (str(exemplar), v, time.time())
 
     def cumulative(self) -> List[int]:
         out, acc = [], 0
@@ -273,8 +299,14 @@ class _Family:
         return self.labels()
 
     # convenience passthroughs so label-free metrics read naturally
-    def inc(self, amount: float = 1.0) -> None:
-        self._default().inc(amount)
+    # (the exemplar kw is forwarded only when given: gauges don't
+    # take one, and a bare inc() must keep working on every kind)
+    def inc(self, amount: float = 1.0,
+            exemplar: Optional[str] = None) -> None:
+        if exemplar is not None:
+            self._default().inc(amount, exemplar=exemplar)
+        else:
+            self._default().inc(amount)
 
     def dec(self, amount: float = 1.0) -> None:
         self._default().dec(amount)
@@ -282,8 +314,9 @@ class _Family:
     def set(self, value: float) -> None:
         self._default().set(value)
 
-    def observe(self, value: float) -> None:
-        self._default().observe(value)
+    def observe(self, value: float,
+                exemplar: Optional[str] = None) -> None:
+        self._default().observe(value, exemplar=exemplar)
 
     @property
     def value(self):
@@ -391,8 +424,15 @@ class MetricsRegistry:
                                    buckets)
 
     # -------------------------------------------------------- exposition
-    def prometheus_text(self) -> str:
-        """Prometheus text exposition format 0.0.4."""
+    def prometheus_text(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition format 0.0.4.
+
+        ``exemplars=True`` appends OpenMetrics-style exemplar clauses
+        (``# {trace_id="..."} value ts``) to histogram bucket and
+        counter lines that have one.  Off by default: the plain
+        ``/metrics`` route keeps serving strict 0.0.4 (some scrapers
+        reject the clause); the exporter serves the exemplar rendering
+        under ``/metrics?exemplars=1``."""
         lines: List[str] = []
         with self._lock:
             families = sorted(self._families.values(),
@@ -414,15 +454,23 @@ class MetricsRegistry:
             for values, child in sorted(items):
                 if fam.kind == "histogram":
                     cum = child.cumulative()
-                    for bound, c in zip(fam.buckets, cum):
+                    for i, (bound, c) in enumerate(
+                            zip(fam.buckets, cum)):
                         lab = _format_labels(
                             fam.label_names, values,
                             ("le", _format_value(bound)), const=fconst)
-                        lines.append(f"{fam.name}_bucket{lab} {c}")
+                        line = f"{fam.name}_bucket{lab} {c}"
+                        if exemplars:
+                            line += _exemplar_suffix(
+                                child.exemplars[i])
+                        lines.append(line)
                     lab = _format_labels(fam.label_names, values,
                                          ("le", "+Inf"), const=fconst)
-                    lines.append(
-                        f"{fam.name}_bucket{lab} {child.count}")
+                    line = f"{fam.name}_bucket{lab} {child.count}"
+                    if exemplars:
+                        line += _exemplar_suffix(
+                            child.exemplars[len(fam.buckets)])
+                    lines.append(line)
                     plain = _format_labels(fam.label_names, values,
                                            const=fconst)
                     lines.append(f"{fam.name}_sum{plain} "
@@ -432,8 +480,12 @@ class MetricsRegistry:
                 else:
                     lab = _format_labels(fam.label_names, values,
                                          const=fconst)
-                    lines.append(f"{fam.name}{lab} "
-                                 f"{_format_value(child.value)}")
+                    line = (f"{fam.name}{lab} "
+                            f"{_format_value(child.value)}")
+                    if exemplars and fam.kind == "counter":
+                        line += _exemplar_suffix(
+                            getattr(child, "exemplar", None))
+                    lines.append(line)
         return "\n".join(lines) + "\n"
 
     # ---------------------------------------------------------- snapshot
@@ -463,7 +515,7 @@ class MetricsRegistry:
                 elif fam.kind == "gauge":
                     out["gauges"][key] = child.value
                 else:
-                    out["histograms"][key] = {
+                    entry = {
                         "count": child.count,
                         "sum": round(child.sum, 6),
                         "p50": child.percentile(50),
@@ -474,6 +526,17 @@ class MetricsRegistry:
                         "le": list(fam.buckets),
                         "cum": child.cumulative(),
                     }
+                    exs = {}
+                    for i, ex in enumerate(child.exemplars):
+                        if ex is None:
+                            continue
+                        bound = (_format_value(fam.buckets[i])
+                                 if i < len(fam.buckets) else "+Inf")
+                        exs[bound] = {"trace_id": ex[0],
+                                      "value": ex[1]}
+                    if exs:
+                        entry["exemplars"] = exs
+                    out["histograms"][key] = entry
         return out
 
     def write_jsonl(self, path: str) -> None:

@@ -119,6 +119,37 @@ class Tracer:
                 **({"args": args} if args else {}),
             })
 
+    def flow_start(self, name: str, flow_id: str, **args) -> None:
+        """Open a flow (``ph: "s"``) — a causal arrow OUT of the
+        enclosing slice on this thread.  Pair with :meth:`flow_end`
+        under the same ``flow_id`` on the receiving thread and
+        Perfetto draws the arrow across the two lanes (e.g. a serving
+        request handed from its transport thread to the batcher's
+        executor thread).  ``cat`` is mandatory on flow events."""
+        self._flow(name, flow_id, "s", args)
+
+    def flow_end(self, name: str, flow_id: str, **args) -> None:
+        """Close a flow (``ph: "f"`` with ``bp: "e"`` — bind to the
+        ENCLOSING slice, the post-Chrome-M47 convention Perfetto
+        expects)."""
+        self._flow(name, flow_id, "f", args)
+
+    def _flow(self, name: str, flow_id: str, ph: str, args) -> None:
+        if not self.enabled:
+            return
+        ev = {
+            "name": name, "ph": ph, "cat": "flow",
+            "id": str(flow_id),
+            "ts": (time.perf_counter() - self._t0) * 1e6,
+            "pid": os.getpid(), "tid": threading.get_ident(),
+        }
+        if ph == "f":
+            ev["bp"] = "e"
+        if args:
+            ev["args"] = dict(args)
+        with self._lock:
+            self._events.append(ev)
+
     def current_span(self) -> Optional[str]:
         stack = self._stack()
         return stack[-1] if stack else None

@@ -15,8 +15,30 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.observability.reqtrace import (
+    TRACE_FIELD, TRACE_HEADER, TraceContext, get_request_log)
 from analytics_zoo_tpu.serving.redis_client import connect
 from analytics_zoo_tpu.serving.server import INPUT_STREAM, RESULT_PREFIX
+
+
+def _stamp_trace(rid: str, trace=None,
+                 transport: str = "redis") -> Optional[TraceContext]:
+    """The client half of request tracing: resolve the context this
+    send carries (an explicit :class:`TraceContext`, a wire string, or
+    a freshly stamped one when tracing is on) and record its
+    ``enqueue`` station.  None when tracing is off and no explicit
+    trace was given — the request is served untraced."""
+    if isinstance(trace, TraceContext):
+        ctx = trace
+    elif isinstance(trace, str) and trace:
+        ctx = TraceContext.from_wire(trace, request_id=rid)
+    else:
+        reqlog = get_request_log()
+        ctx = TraceContext.new(rid) if reqlog.enabled else None
+    if ctx is not None:
+        get_request_log().begin(ctx, transport=transport,
+                                station="enqueue")
+    return ctx
 
 
 class InputQueue:
@@ -32,13 +54,17 @@ class InputQueue:
 
     def enqueue_image(self, uri: str, image,
                       request_id: Optional[str] = None,
-                      endpoint: Optional[str] = None) -> str:
+                      endpoint: Optional[str] = None,
+                      trace=None) -> str:
         """image: ndarray (HWC uint8) or path or raw JPEG bytes.
         Returns the record's ``request_id`` (generated when not
         given) — correlate it against the server's spans and the
         ``request_id`` field echoed beside the result.  ``endpoint``
         routes the record to a registered model on a multi-model
-        worker (absent = the worker's default model)."""
+        worker (absent = the worker's default model).  ``trace`` (a
+        :class:`TraceContext` or wire string) propagates an existing
+        trace; absent, one is stamped automatically while tracing is
+        on."""
         if isinstance(image, str):
             with open(image, "rb") as f:
                 raw = f.read()
@@ -55,18 +81,25 @@ class InputQueue:
                   "request_id": rid}
         if endpoint:
             fields["endpoint"] = endpoint
+        ctx = _stamp_trace(rid, trace)
+        if ctx is not None:
+            fields[TRACE_FIELD] = ctx.to_wire()
         self.broker.xadd(INPUT_STREAM, fields)
         return rid
 
     def enqueue(self, uri: str, data: np.ndarray,
                 request_id: Optional[str] = None,
                 endpoint: Optional[str] = None,
-                max_tokens: Optional[int] = None) -> str:
+                max_tokens: Optional[int] = None,
+                trace=None) -> str:
         """Arbitrary ndarray input (npy-serialized); returns the
         record's ``request_id``.  ``endpoint`` routes to a registered
         model on a multi-model worker; ``max_tokens`` caps the
         sequence a *generative* endpoint decodes for this record
-        (ignored by stateless endpoints)."""
+        (ignored by stateless endpoints); ``trace`` propagates an
+        existing :class:`TraceContext` (absent, one is stamped while
+        tracing is on — its wire string rides the record's ``trace``
+        field)."""
         buf = io.BytesIO()
         np.save(buf, np.ascontiguousarray(data), allow_pickle=False)
         rid = self._request_id(request_id)
@@ -76,6 +109,9 @@ class InputQueue:
             fields["endpoint"] = endpoint
         if max_tokens:
             fields["max_tokens"] = str(int(max_tokens))
+        ctx = _stamp_trace(rid, trace)
+        if ctx is not None:
+            fields[TRACE_FIELD] = ctx.to_wire()
         self.broker.xadd(INPUT_STREAM, fields)
         return rid
 
@@ -257,24 +293,33 @@ class ServingHttpClient:
     def predict_http(self, endpoint: str, payload, *,
                      uri: str = "", request_id: Optional[str] = None,
                      timeout_s: Optional[float] = None,
-                     retries: Optional[int] = None) -> Dict[str, Any]:
+                     retries: Optional[int] = None,
+                     trace=None) -> Dict[str, Any]:
         """Predict one record: ``payload`` is an ndarray (or nested
         list).  Returns the response doc ``{"value": [[class, prob],
-        ...], "request_id": ..., "endpoint": ...}``."""
+        ...], "request_id": ..., "endpoint": ...}``.  ``trace``
+        propagates an existing :class:`TraceContext` in the
+        traceparent header; absent, one is stamped while tracing is
+        on (the same wire string re-sent on every retry)."""
         from urllib import request as urlrequest
         if timeout_s is None:
             timeout_s = self.timeout_s
         if retries is None:
             retries = self.retries
+        rid = request_id or uuid.uuid4().hex
         body = json.dumps({
             "data": np.asarray(payload).tolist(),
             "dtype": str(np.asarray(payload).dtype),
             "uri": uri,
-            "request_id": request_id or uuid.uuid4().hex,
+            "request_id": rid,
         }).encode()
+        headers = {"Content-Type": "application/json"}
+        ctx = _stamp_trace(rid, trace, transport="http")
+        if ctx is not None:
+            headers[TRACE_HEADER] = ctx.to_wire()
         req = urlrequest.Request(
             f"{self.base_url}/predict/{endpoint}", data=body,
-            headers={"Content-Type": "application/json"})
+            headers=headers)
         # the whole exchange retries: the request was idempotent
         ts: Dict[str, float] = {}
         doc = self._open_with_retries(
@@ -290,7 +335,8 @@ class ServingHttpClient:
                  on_token=None, uri: str = "",
                  request_id: Optional[str] = None,
                  timeout_s: Optional[float] = None,
-                 retries: Optional[int] = None) -> Dict[str, Any]:
+                 retries: Optional[int] = None,
+                 trace=None) -> Dict[str, Any]:
         """Streaming generate against a generative endpoint
         (``POST /generate/<endpoint>``, chunked per-token responses):
         ``token_ids`` is the int input sequence (padded to the
@@ -313,18 +359,23 @@ class ServingHttpClient:
             timeout_s = self.timeout_s
         if retries is None:
             retries = self.retries
+        rid = request_id or uuid.uuid4().hex
         payload: Dict[str, Any] = {
             "data": np.asarray(token_ids, np.int64).tolist(),
             "dtype": "int32",
             "uri": uri,
-            "request_id": request_id or uuid.uuid4().hex,
+            "request_id": rid,
         }
         if max_tokens:
             payload["max_tokens"] = int(max_tokens)
+        headers = {"Content-Type": "application/json"}
+        ctx = _stamp_trace(rid, trace, transport="http")
+        if ctx is not None:
+            headers[TRACE_HEADER] = ctx.to_wire()
         req = urlrequest.Request(
             f"{self.base_url}/generate/{endpoint}",
             data=json.dumps(payload).encode(),
-            headers={"Content-Type": "application/json"})
+            headers=headers)
         # only ESTABLISHING the stream retries; once chunks flow the
         # relay below runs exactly once
         ts: Dict[str, float] = {}

@@ -79,8 +79,21 @@ class Request:
     #: streaming hook.  Must be fast and never raise (it runs between
     #: decode iterations); errors are swallowed.
     on_token: Optional[Any] = None
+    #: request-scoped tracing context (observability.reqtrace): a
+    #: TraceContext (or bare trace_id) the transport decoded from the
+    #: wire; None = untraced.  The batcher/executor/decode layers mark
+    #: their lifecycle stations against it.
+    trace: Optional[Any] = None
     _done: threading.Event = dataclasses.field(
         default_factory=threading.Event, repr=False)
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        if self.trace is None:
+            return None
+        if isinstance(self.trace, str):
+            return self.trace
+        return getattr(self.trace, "trace_id", None)
 
     def complete(self, result: Any) -> None:
         self.result = result
@@ -190,6 +203,23 @@ class ContinuousBatcher:
                     "mixed endpoints in one submitted group"))
             return requests
         self._m_requests.labels(name).inc(len(requests))
+        if any(r.trace is not None for r in requests):
+            from analytics_zoo_tpu.observability.reqtrace import (
+                get_request_log)
+            from analytics_zoo_tpu.observability.tracing import (
+                get_tracer)
+            reqlog = get_request_log()
+            tracer = get_tracer()
+            for r in requests:
+                tid = r.trace_id
+                if not tid:
+                    continue
+                reqlog.mark(tid, "batch_queue_enter", t=now,
+                            endpoint=name)
+                # flow OUT of the transport thread's slice; the
+                # executor thread closes it at batch compose, giving
+                # Perfetto its causal arrow across the two lanes
+                tracer.flow_start("serving_request", tid)
         # groups larger than the endpoint's largest bucket are split
         # into bucket-sized atomic chunks (each chunk still serves
         # together; the transport's wait-all covers all chunks).

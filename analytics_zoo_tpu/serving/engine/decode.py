@@ -63,6 +63,17 @@ from analytics_zoo_tpu.serving.engine.executor import (
 log = logging.getLogger("analytics_zoo_tpu.serving.engine")
 
 
+def _mark(request, station: str, **attrs) -> None:
+    """Record a reqtrace station for a traced request (no-op when the
+    request carries no TraceContext or tracing is off)."""
+    trace = getattr(request, "trace", None)
+    if trace is None:
+        return
+    from analytics_zoo_tpu.observability.reqtrace import (
+        get_request_log)
+    get_request_log().mark(trace, station, **attrs)
+
+
 @dataclasses.dataclass
 class _ActiveSeq:
     """Host-side bookkeeping for one occupied slot (the device holds
@@ -298,6 +309,7 @@ class DecodeSlotPool:
                 request=r, max_tokens=budget, admitted_at=now,
                 last_token_at=now)
             self.admit_log.append((self.iterations, slot))
+            _mark(r, "prefill", t=now, slot=slot, bucket=bucket)
         self.admitted_total += n
         self._m_admitted.labels(self._endpoint_name).inc(n)
         self._m_occupancy.labels(self._endpoint_name).set(
@@ -345,6 +357,9 @@ class DecodeSlotPool:
                 self._m_first_token.observe(
                     now - (seq.request.arrival or seq.admitted_at))
             seq.last_token_at = now
+            _mark(seq.request, "decode_step", t=now,
+                  iteration=self.iterations,
+                  token_index=len(seq.tokens) - 1)
             cb = getattr(seq.request, "on_token", None)
             if cb is not None:
                 try:
@@ -365,6 +380,8 @@ class DecodeSlotPool:
         self._free.append(slot)
         self.retire_log.append((self.iterations, slot))
         self._m_retired.labels(self._endpoint_name, cause).inc()
+        _mark(seq.request, "retire", cause=cause,
+              tokens=len(seq.tokens))
         seq.request.complete(list(seq.tokens))
 
     # -------------------------------------------------------------- failure

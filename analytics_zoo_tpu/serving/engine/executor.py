@@ -196,6 +196,31 @@ class ModelExecutor:
         self._m_fill = reg.gauge(
             "serving_batch_fill_ratio",
             "real records / batch capacity of the last served batch")
+        # monotonic batch id stamped on every request's batch_compose
+        # station, so a waterfall can group co-riders of one device
+        # batch across timelines
+        self._batch_seq = 0
+
+    def _mark_batch(self, requests: List, bucket: int,
+                    real: int) -> None:
+        """Station marks for a composed batch (no-op for untraced
+        requests): ``batch_compose`` with batch id + fill ratio +
+        co-rider count on the executor thread, closing the flow the
+        transport thread opened at submit."""
+        if not any(r.trace is not None for r in requests):
+            return
+        from analytics_zoo_tpu.observability.reqtrace import (
+            get_request_log)
+        self._batch_seq += 1
+        reqlog = get_request_log()
+        for r in requests:
+            tid = r.trace_id
+            if not tid:
+                continue
+            self._tracer.flow_end("serving_request", tid)
+            reqlog.mark(tid, "batch_compose", batch=self._batch_seq,
+                        fill=round(real / bucket, 4),
+                        co_riders=real - 1)
 
     def execute(self, ep: Endpoint, requests: List) -> int:
         real = len(requests)
@@ -203,15 +228,29 @@ class ModelExecutor:
             return 0
         try:
             bucket = ep.bucket_for(real)
+            self._mark_batch(requests, bucket, real)
             x = pad_to_batch(np.stack([r.data for r in requests]),
                              bucket)
             self._m_fill.set(real / ep.buckets[-1])
+            traced = [r for r in requests if r.trace_id]
+            if traced:
+                from analytics_zoo_tpu.observability.reqtrace import (
+                    get_request_log)
+                reqlog = get_request_log()
+                now = time.perf_counter()
+                for r in traced:
+                    reqlog.mark(r.trace_id, "dispatch", t=now,
+                                bucket=bucket)
             with self._tracer.span(
                     "serving_execute", endpoint=ep.name, records=real,
                     bucket=bucket,
                     request_ids=[r.request_id for r in requests
                                  if r.request_id][:16]):
                 out = np.asarray(ep.model.predict(x))[:real]
+            if traced:
+                now = time.perf_counter()
+                for r in traced:
+                    reqlog.mark(r.trace_id, "device_done", t=now)
             values = self.postprocess(out, ep.top_n)
         except Exception as e:
             log.exception("predict failed for endpoint %s "

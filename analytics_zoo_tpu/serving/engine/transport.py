@@ -51,9 +51,12 @@ from typing import Optional
 
 import numpy as np
 
+from analytics_zoo_tpu.observability.reqtrace import (
+    TRACE_HEADER, TraceContext, get_request_log)
 from analytics_zoo_tpu.resilience.chaos import (
     SITE_SERVING_HTTP, InjectedFault, active_chaos)
-from analytics_zoo_tpu.serving.engine.batcher import Request
+from analytics_zoo_tpu.serving.engine.batcher import (Request,
+                                                      ShedError)
 from analytics_zoo_tpu.serving.engine.core import DEFAULT_ENDPOINT
 
 log = logging.getLogger("analytics_zoo_tpu.serving.engine")
@@ -144,10 +147,13 @@ class _Handler(BaseHTTPRequestHandler):
         endpoint = path[len(route):].strip("/") or DEFAULT_ENDPOINT
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length else b""
+        trace_header = self.headers.get(TRACE_HEADER)
         if route == "/generate":
-            transport.handle_generate(endpoint, body, self)
+            transport.handle_generate(endpoint, body, self,
+                                      trace_header=trace_header)
             return
-        code, doc = transport.handle_predict(endpoint, body)
+        code, doc = transport.handle_predict(
+            endpoint, body, trace_header=trace_header)
         self._respond(code, doc)
 
     # --------------------------------------------------- chunked streaming
@@ -246,10 +252,40 @@ class HttpTransport:
         plan.trip(SITE_SERVING_HTTP, next(self._chaos_seq))
 
     # --------------------------------------------------------------- serve
-    def handle_predict(self, endpoint: str, body: bytes):
+    @staticmethod
+    def _trace_begin(trace_header, rid: str, endpoint: str,
+                     t0: float):
+        """Build this request's TraceContext (the client's via
+        :data:`TRACE_HEADER`, else a server-stamped one) and open its
+        timeline with the HTTP arrival stations.  None when tracing is
+        off or the header is malformed AND no context can be minted."""
+        reqlog = get_request_log()
+        if not reqlog.enabled:
+            return None
+        ctx = (TraceContext.from_wire(trace_header, request_id=rid)
+               if trace_header else TraceContext.new(rid))
+        if ctx is not None:
+            reqlog.begin(ctx, transport="http", endpoint=endpoint,
+                         station="transport_receive", t=t0)
+            reqlog.mark(ctx, "decode")
+        return ctx
+
+    @staticmethod
+    def _outcome_of(error) -> str:
+        if error is None:
+            return "ok"
+        if isinstance(error, ShedError):
+            return "shed"
+        if isinstance(error, TimeoutError):
+            return "timeout"
+        return "error"
+
+    def handle_predict(self, endpoint: str, body: bytes,
+                       trace_header: Optional[str] = None):
         """One fast-path request → (http status, response doc).
         Separated from the handler class so tests can drive the full
-        path without a socket."""
+        path without a socket (``trace_header`` stands in for the
+        :data:`TRACE_HEADER` value ``do_POST`` forwards)."""
         import time
         t0 = time.perf_counter()
         try:
@@ -257,13 +293,16 @@ class HttpTransport:
         except ValueError as e:
             self._m_requests.labels("bad_request").inc()
             return 400, {"error": str(e)}
+        ctx = self._trace_begin(trace_header, rid, endpoint, t0)
+        reqlog = get_request_log()
         if self.engine.registry.get(endpoint) is None:
             self._m_requests.labels("unknown_endpoint").inc()
+            reqlog.finish(ctx, "error", station="respond")
             return 404, {
                 "error": f"unknown endpoint {endpoint!r}",
                 "endpoints": self.engine.endpoints()}
         req = Request(endpoint=endpoint, uri=uri, data=arr,
-                      request_id=rid)
+                      request_id=rid, trace=ctx)
         with self._tracer.span("serving_http_predict",
                                endpoint=endpoint, request_id=rid):
             self.engine.submit_wait([req], timeout_s=self.timeout_s)
@@ -271,16 +310,25 @@ class HttpTransport:
             timed_out = isinstance(req.error, TimeoutError)
             self._m_requests.labels(
                 "timeout" if timed_out else "error").inc()
+            reqlog.finish(ctx, self._outcome_of(req.error),
+                          station="respond")
             return (504 if timed_out else 500), {
                 "error": f"{type(req.error).__name__}: {req.error}",
                 "request_id": rid, "endpoint": endpoint}
-        self._m_latency.observe(time.perf_counter() - t0)
+        self._m_latency.observe(
+            time.perf_counter() - t0,
+            exemplar=ctx.trace_id if ctx else None)
         self._m_requests.labels("ok").inc()
-        return 200, {"value": req.result, "request_id": rid,
-                     "endpoint": endpoint}
+        reqlog.finish(ctx, "ok", station="respond")
+        out = {"value": req.result, "request_id": rid,
+               "endpoint": endpoint}
+        if ctx is not None:
+            out["trace_id"] = ctx.trace_id
+        return 200, out
 
     def handle_generate(self, endpoint: str, body: bytes,
-                        handler) -> None:
+                        handler,
+                        trace_header: Optional[str] = None) -> None:
         """One streaming generate request: validate, submit to the
         decode scheduler, and relay each emitted token onto the
         connection as a chunked JSON line the moment it arrives —
@@ -289,6 +337,7 @@ class HttpTransport:
         handler (chunked writes need the socket)."""
         import queue as _queue
         import time
+        t0 = time.perf_counter()
         try:
             arr, uri, rid, doc = decode_payload(body,
                                                 default_dtype="int32")
@@ -296,15 +345,19 @@ class HttpTransport:
             self._m_requests.labels("bad_request").inc()
             handler._respond(400, {"error": str(e)})
             return
+        ctx = self._trace_begin(trace_header, rid, endpoint, t0)
+        reqlog = get_request_log()
         ep = self.engine.registry.get(endpoint)
         if ep is None:
             self._m_requests.labels("unknown_endpoint").inc()
+            reqlog.finish(ctx, "error", station="respond")
             handler._respond(404, {
                 "error": f"unknown endpoint {endpoint!r}",
                 "endpoints": self.engine.endpoints()})
             return
         if not ep.generative:
             self._m_requests.labels("bad_request").inc()
+            reqlog.finish(ctx, "error", station="respond")
             handler._respond(400, {
                 "error": f"endpoint {endpoint!r} is not generative; "
                          f"POST /predict/{endpoint} instead"})
@@ -314,12 +367,14 @@ class HttpTransport:
                 if doc.get("max_tokens") else None
         except (TypeError, ValueError):
             self._m_requests.labels("bad_request").inc()
+            reqlog.finish(ctx, "error", station="respond")
             handler._respond(400, {"error": "bad max_tokens"})
             return
         emitted: _queue.Queue = _queue.Queue()
         req = Request(endpoint=endpoint, uri=uri,
                       data=np.asarray(arr, np.int32).reshape(-1),
                       request_id=rid, max_tokens=max_tokens,
+                      trace=ctx,
                       on_token=lambda i, t: emitted.put((i, t)))
         with self._tracer.span("serving_http_generate",
                                endpoint=endpoint, request_id=rid):
@@ -363,6 +418,8 @@ class HttpTransport:
                     timed_out = isinstance(req.error, TimeoutError)
                     self._m_requests.labels(
                         "timeout" if timed_out else "error").inc()
+                    reqlog.finish(ctx, self._outcome_of(req.error),
+                                  station="respond")
                     err = {"error": f"{type(req.error).__name__}: "
                                     f"{req.error}",
                            "request_id": rid, "endpoint": endpoint}
@@ -375,12 +432,19 @@ class HttpTransport:
                     return
                 if not streaming:
                     handler.start_stream()
-                handler.stream_line({"done": True,
-                                     "tokens": req.result,
-                                     "request_id": rid,
-                                     "endpoint": endpoint})
+                done_line = {"done": True,
+                             "tokens": req.result,
+                             "request_id": rid,
+                             "endpoint": endpoint}
+                if ctx is not None:
+                    done_line["trace_id"] = ctx.trace_id
+                handler.stream_line(done_line)
                 handler.end_stream()
+                self._m_latency.observe(
+                    time.perf_counter() - t0,
+                    exemplar=ctx.trace_id if ctx else None)
                 self._m_requests.labels("ok").inc()
+                reqlog.finish(ctx, "ok", station="respond")
             except (BrokenPipeError, ConnectionError, OSError):
                 # the client hung up mid-stream: mark the request done
                 # so the scheduler's abandoned-sweep retires its slot
@@ -393,3 +457,5 @@ class HttpTransport:
                 log.debug("generate stream client disconnect "
                           "(endpoint %s, request %s)", endpoint, rid)
                 self._m_requests.labels("client_gone").inc()
+                reqlog.finish(ctx, "error", station="respond",
+                              cause="client_gone")

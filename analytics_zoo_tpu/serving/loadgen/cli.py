@@ -93,6 +93,12 @@ def main(argv=None) -> int:
     ap.add_argument("--records-out", default=None,
                     help="write the per-request structured log "
                          "(JSONL) here")
+    ap.add_argument("--requests-out", default=None,
+                    help="export the request log's station timelines "
+                         "(requests.json) here; self-contained mode "
+                         "captures client AND server stations in one "
+                         "process — render with scripts/obs_report.py "
+                         "--requests")
     ap.add_argument("--redis-url", default=None,
                     help="target an external broker instead of the "
                          "self-contained worker")
@@ -186,6 +192,12 @@ def main(argv=None) -> int:
                   flush=True)
         if args.records_out:
             run.to_jsonl(args.records_out)
+        if args.requests_out:
+            from analytics_zoo_tpu.observability.reqtrace import \
+                get_request_log
+            get_request_log().export(args.requests_out)
+            print(f"request timelines written to {args.requests_out}",
+                  flush=True)
         if args.out:
             write_report(args.out, report_document(
                 args.scenario, verdict, slo=scenario.slo,

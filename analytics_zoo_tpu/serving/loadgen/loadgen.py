@@ -58,6 +58,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from analytics_zoo_tpu.observability.reqtrace import (
+    TRACE_FIELD, TRACE_HEADER, TraceContext)
+
 log = logging.getLogger("analytics_zoo_tpu.serving.loadgen")
 
 #: terminal statuses a record can end in.  ``lost`` (no result before
@@ -110,6 +113,14 @@ class RequestRecord:
         return self.status in TERMINAL
 
     @property
+    def trace_id(self) -> str:
+        # request_id is uuid4().hex, which is already a valid 32-hex
+        # trace id — the loadgen stamps it verbatim on the wire, so
+        # the id in this record joins directly against the serving
+        # plane's /requests.json timelines.
+        return self.spec.request_id
+
+    @property
     def latency_from_scheduled_s(self) -> Optional[float]:
         if self.done is None:
             return None
@@ -124,6 +135,7 @@ class RequestRecord:
     def to_dict(self) -> Dict[str, Any]:
         return {
             "request_id": self.spec.request_id,
+            "trace_id": self.trace_id,
             "uri": self.spec.uri,
             "endpoint": self.spec.endpoint,
             "transport": self.spec.transport,
@@ -180,6 +192,11 @@ class PayloadFactory:
             fields["endpoint"] = spec.endpoint
         if spec.max_tokens:
             fields["max_tokens"] = str(int(spec.max_tokens))
+        # trace context rides the record itself; the fields dict is
+        # built ONCE per request, so a send retry after a broker
+        # outage re-sends the byte-identical wire value
+        fields[TRACE_FIELD] = TraceContext.new(
+            spec.request_id).to_wire()
         return fields
 
     def http_body(self, spec: ScheduledRequest) -> bytes:
@@ -403,10 +420,10 @@ class LoadGenerator:
         self._m_requests.labels(status).inc()
         lat = rec.latency_from_scheduled_s
         if lat is not None:
-            self._m_sched.observe(lat)
+            self._m_sched.observe(lat, exemplar=rec.trace_id)
         lat = rec.latency_from_sent_s
         if lat is not None:
-            self._m_sent.observe(lat)
+            self._m_sent.observe(lat, exemplar=rec.trace_id)
 
     # --------------------------------------------------------------- senders
     def _sender_loop(self) -> None:
@@ -472,7 +489,13 @@ class LoadGenerator:
         body = self.payloads.http_body(rec.spec)
         req = urlrequest.Request(
             f"{client.base_url}/predict/{rec.spec.endpoint}",
-            data=body, headers={"Content-Type": "application/json"})
+            data=body, headers={
+                "Content-Type": "application/json",
+                # Request object is built once: every retry re-sends
+                # the byte-identical traceparent
+                TRACE_HEADER: TraceContext.new(
+                    rec.spec.request_id).to_wire(),
+            })
         rec.sent = self._clock()
         try:
             ts: Dict[str, float] = {}

@@ -75,9 +75,18 @@ class CheckResult:
     passed: bool
     detail: str
     skipped: bool = False
+    #: forensic handles: the trace_ids of the requests that drove
+    #: this finding (p99-region requests, exactly-once violators).
+    #: Feed them to ``obs_report --requests RUN_DIR`` to see each
+    #: one's station waterfall.
+    trace_ids: Tuple[str, ...] = ()
 
     def to_dict(self) -> Dict[str, Any]:
-        return dataclasses.asdict(self)
+        out = {"name": self.name, "passed": self.passed,
+               "detail": self.detail, "skipped": self.skipped}
+        if self.trace_ids:
+            out["trace_ids"] = list(self.trace_ids)
+        return out
 
 
 class Verdict:
@@ -188,12 +197,24 @@ def _check_latency(run: LoadgenRun, slo: SloSpec) -> CheckResult:
     p99 = run.percentile(99) * 1e3
     p99_sent = run.percentile(99, basis="sent") * 1e3
     ok = p99 <= slo.p99_from_scheduled_ms
+    # name the requests that ARE the tail: everything at/above the
+    # p99 value, slowest first — the handles a forensics pass feeds
+    # to ``obs_report --requests`` to see where the time went
+    tail = sorted(
+        ((r.latency_from_scheduled_s or 0.0) * 1e3, r.trace_id)
+        for r in run.records
+        if r.latency_from_scheduled_s is not None
+        and r.latency_from_scheduled_s * 1e3 >= p99)
+    tail_ids = tuple(t for _lat, t in reversed(tail))[:5]
     return CheckResult(
         "p99_from_scheduled", ok,
         f"p99 {p99:.0f}ms from SCHEDULED (bound "
         f"{slo.p99_from_scheduled_ms:.0f}ms; from-sent p99 "
         f"{p99_sent:.0f}ms — the gap is the coordinated omission a "
-        f"closed-loop bench would have hidden)")
+        f"closed-loop bench would have hidden)"
+        + (f"; slowest trace_ids {list(tail_ids)}" if tail_ids
+           else ""),
+        trace_ids=tail_ids)
 
 
 def _check_exactly_once(run: LoadgenRun,
@@ -212,12 +233,19 @@ def _check_exactly_once(run: LoadgenRun,
                   if r.status == "ok"
                   and by_rid.get(r.spec.request_id))
     ok = lost == 0 and pending == 0 and not dupes and not both
+    # the violators themselves, by trace_id (== request_id on the
+    # loadgen wire): lost/unsent first, then duplicate/double-served
+    lost_ids = [r.trace_id for r in run.records
+                if r.status in ("lost", "send_failed")]
+    violators = tuple((lost_ids + dupes + both)[:8])
     return CheckResult(
         "exactly_once", ok,
         f"{lost} lost/unsent of {len(run.records)}, {pending} still "
         f"pending in the PEL, {len(dupes)} duplicate dead-letter "
         f"request_ids, {len(both)} served-AND-dead-lettered"
-        + (f" (e.g. {(dupes + both)[:3]})" if dupes or both else ""))
+        + (f"; violator trace_ids {list(violators)}"
+           if violators else ""),
+        trace_ids=violators)
 
 
 def _check_error_fraction(run: LoadgenRun, slo: SloSpec

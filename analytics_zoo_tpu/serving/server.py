@@ -38,6 +38,8 @@ from analytics_zoo_tpu.common.fsutil import atomic_write_text
 from analytics_zoo_tpu.data.stages import WorkerPool
 from analytics_zoo_tpu.observability import (
     MetricsServer, TelemetrySampler, get_registry, get_tracer)
+from analytics_zoo_tpu.observability.reqtrace import (
+    TRACE_FIELD, TraceContext, get_request_log)
 from analytics_zoo_tpu.resilience.chaos import (
     SITE_SERVING_DECODE, SITE_SERVING_PREDICT, active_chaos)
 from analytics_zoo_tpu.resilience.detector import HostHeartbeat
@@ -829,6 +831,14 @@ class ClusterServing:
                 request_id=rid)
         self._m_quarantined.inc()
         self._m_errors.inc()
+        ctx = TraceContext.from_wire(self._trace_of(fields),
+                                     request_id=rid)
+        if ctx is not None:
+            reqlog = get_request_log()
+            reqlog.begin(ctx, transport="redis",
+                         station="transport_receive")
+            reqlog.finish(ctx, "quarantined", station="result_write",
+                          deliveries=deliveries)
         with self._outcomes_lock:
             self._recent_outcomes.append(0)
         self._ack([(entry_id, fields)])
@@ -850,6 +860,7 @@ class ClusterServing:
         if chaos is not None:
             chaos.trip(SITE_SERVING_DECODE, next(self._decode_seq))
         uris, arrays, rids, eps, mts, failed = [], [], [], [], [], []
+        traces = []
         for entry_id, fields in entries:
             try:
                 uri, arr, rid = decode_field(fields)
@@ -857,13 +868,23 @@ class ClusterServing:
                 log.exception("undecodable record %s", entry_id)
                 failed.append((self._uri_of(fields),
                                self._rid_of(fields), e))
+                ctx = TraceContext.from_wire(
+                    self._trace_of(fields),
+                    request_id=self._rid_of(fields))
+                if ctx is not None:
+                    reqlog = get_request_log()
+                    reqlog.begin(ctx, transport="redis",
+                                 station="transport_receive")
+                    reqlog.finish(ctx, "error",
+                                  station="result_write")
                 continue
             uris.append(uri)
             arrays.append(arr)
             rids.append(rid)
             eps.append(self._endpoint_of(fields))
             mts.append(self._max_tokens_of(fields))
-        return uris, arrays, failed, rids, eps, mts
+            traces.append(self._trace_of(fields))
+        return uris, arrays, failed, rids, eps, mts, traces
 
     @staticmethod
     def _uri_of(fields) -> str:
@@ -875,6 +896,16 @@ class ClusterServing:
         rid = fields.get("request_id") if hasattr(fields, "get") \
             else None
         return rid.decode() if isinstance(rid, bytes) else rid
+
+    @staticmethod
+    def _trace_of(fields):
+        """The record's ``trace`` wire string (client-stamped
+        TraceContext); None for records enqueued without one.  Rides
+        XAUTOCLAIM unchanged, so a reclaimed record keeps its original
+        trace_id."""
+        tw = fields.get(TRACE_FIELD) if hasattr(fields, "get") \
+            else None
+        return tw.decode() if isinstance(tw, bytes) else tw
 
     @staticmethod
     def _endpoint_of(fields) -> str:
@@ -952,6 +983,14 @@ class ClusterServing:
                              f"deadline {deadline:.0f}ms)"}),
                     request_id=rid)
             self._m_shed.labels(cause).inc()
+            ctx = TraceContext.from_wire(self._trace_of(fields),
+                                         request_id=rid)
+            if ctx is not None:
+                reqlog = get_request_log()
+                reqlog.begin(ctx, transport="redis",
+                             station="transport_receive")
+                reqlog.finish(ctx, "shed", station="result_write",
+                              cause=cause, age_ms=round(age, 1))
         if shed:
             # shed records are deliberate drops, not worker errors —
             # they are acked (consumed) but kept OUT of the /healthz
@@ -988,17 +1027,18 @@ class ClusterServing:
         is acked without a prediction gets an explicit ERROR result so
         its client never blocks forever on a consumed record.
         ``decoded`` is (uris, arrays[, failed[, request_ids[,
-        endpoints[, max_tokens]]]])."""
+        endpoints[, max_tokens[, traces]]]]])."""
         uris, arrays, *rest = decoded
         failed = list(rest[0]) if rest else []
         rids = list(rest[1]) if len(rest) > 1 else [None] * len(uris)
         eps = list(rest[2]) if len(rest) > 2 else \
             [DEFAULT_ENDPOINT] * len(uris)
         mts = list(rest[3]) if len(rest) > 3 else [None] * len(uris)
+        traces = list(rest[4]) if len(rest) > 4 else [None] * len(uris)
         real = 0
         try:
             real = self._predict_write(uris, arrays, t_arrival, rids,
-                                       eps, mts)
+                                       eps, mts, traces)
         except Exception as e:
             log.exception("poison batch skipped (%d records)",
                           len(entries))
@@ -1020,7 +1060,7 @@ class ClusterServing:
 
     def _predict_write(self, uris, arrays, t_arrival: float,
                        rids=None, endpoints=None,
-                       max_tokens=None) -> int:
+                       max_tokens=None, traces=None) -> int:
         """Submit one decoded bulk batch to the engine as atomic
         per-endpoint groups, wait for the batcher's bucket-padded
         predicts, and write every result; returns #served.
@@ -1039,6 +1079,8 @@ class ClusterServing:
             endpoints = [DEFAULT_ENDPOINT] * len(uris)
         if max_tokens is None:
             max_tokens = [None] * len(uris)
+        if traces is None:
+            traces = [None] * len(uris)
         real = len(arrays)
         # the chaos site fires BEFORE the engine hand-off: a ``kill``
         # here is a replica dying mid-batch with the batch un-acked —
@@ -1048,13 +1090,30 @@ class ClusterServing:
             chaos.trip(SITE_SERVING_PREDICT, next(self._predict_seq))
         # group by endpoint (a bulk read may interleave models); each
         # group rides the engine as one atomic unit
+        reqlog = get_request_log()
+        now = time.perf_counter()
         groups: Dict[str, List[Request]] = {}
-        for uri, arr, rid, ep, mt in zip(uris, arrays, rids,
-                                         endpoints, max_tokens):
+        for uri, arr, rid, ep, mt, tw in zip(uris, arrays, rids,
+                                             endpoints, max_tokens,
+                                             traces):
+            ctx = None
+            if reqlog.enabled:
+                # a client-stamped trace rides the record's ``trace``
+                # field; untraced records get a server-side context so
+                # the replica's forensics cover ALL traffic (malformed
+                # wires stay untraced, per from_wire's contract)
+                ctx = (TraceContext.from_wire(tw, request_id=rid)
+                       if tw else TraceContext.new(rid))
+                if ctx is not None:
+                    reqlog.begin(
+                        ctx, transport="redis",
+                        endpoint=ep or DEFAULT_ENDPOINT,
+                        station="transport_receive", t=t_arrival)
+                    reqlog.mark(ctx, "decode", t=now)
             groups.setdefault(ep or DEFAULT_ENDPOINT, []).append(
                 Request(endpoint=ep or DEFAULT_ENDPOINT, uri=uri,
                         data=arr, request_id=rid, arrival=t_arrival,
-                        max_tokens=mt))
+                        max_tokens=mt, trace=ctx))
         # the span carries the batch's request ids, so a trace viewer
         # (or the merged cluster timeline) can follow one request from
         # client enqueue through its predict to its result write
@@ -1101,6 +1160,8 @@ class ClusterServing:
                     except Exception:
                         log.exception("could not write shed result "
                                       "for %s", req.uri)
+                    reqlog.finish(req.trace, "shed",
+                                  station="result_write")
                     continue
                 # predict failed for this record's group: explicit
                 # error result, error accounting, readiness window 0
@@ -1115,13 +1176,22 @@ class ClusterServing:
                 except Exception:
                     log.exception("could not write error result "
                                   "for %s", req.uri)
+                reqlog.finish(req.trace, "error",
+                              station="result_write")
                 continue
             predicted += 1
             if self._write_result(req.uri, json.dumps(req.result),
                                   request_id=req.request_id):
                 written += 1
                 self.latencies.append(done - t_arrival)
-                self._m_latency.observe(done - t_arrival)
+                self._m_latency.observe(done - t_arrival,
+                                        exemplar=req.trace_id)
+                reqlog.finish(req.trace, "ok",
+                              station="result_write")
+            else:
+                # abandoned write: the client never sees this result
+                reqlog.finish(req.trace, "error",
+                              station="result_write")
         if failed:
             self._m_errors.inc(failed)
             with self._outcomes_lock:

@@ -685,6 +685,25 @@ def bench_serving_engine(n_records: int = 1024, batch_size: int = 16,
     http_wall, http_lat, http_errs = closed_loop(
         n_records, lambda cid, i: http.predict_http("default", record))
 
+    # ---- tracing-overhead guard: the SAME HTTP leg with
+    # observability.reqtrace off (a disabled RequestLog no-ops every
+    # call); the p50 delta is the request-tracing tentpole's hot-path
+    # cost, and --compare fails the run when it exceeds 5%
+    from analytics_zoo_tpu.common.config import get_config
+    from analytics_zoo_tpu.observability.reqtrace import \
+        reset_request_log
+    zoo_cfg = get_config()
+    prev_reqtrace = zoo_cfg.get("observability.reqtrace", True)
+    zoo_cfg.set("observability.reqtrace", False)
+    reset_request_log()
+    try:
+        _, http_lat_off, http_errs_off = closed_loop(
+            n_records,
+            lambda cid, i: http.predict_http("default", record))
+    finally:
+        zoo_cfg.set("observability.reqtrace", prev_reqtrace)
+        reset_request_log()
+
     # ---- Redis bulk path (closed loop: enqueue then poll the result)
     inq = InputQueue(broker=broker)
     outq = OutputQueue(broker=broker)
@@ -723,6 +742,12 @@ def bench_serving_engine(n_records: int = 1024, batch_size: int = 16,
         "http_latency_p50_ms": round(pct(http_lat, 50), 2),
         "http_latency_p99_ms": round(pct(http_lat, 99), 2),
         "http_errors": len(http_errs),
+        "http_latency_p50_ms_untraced": round(pct(http_lat_off, 50),
+                                              2),
+        "http_errors_untraced": len(http_errs_off),
+        "reqtrace_p50_overhead_fraction": round(
+            (pct(http_lat, 50) / pct(http_lat_off, 50) - 1.0)
+            if pct(http_lat_off, 50) > 0 else 0.0, 4),
         "redis_rps": round(redis_rps, 1),
         "redis_latency_p50_ms": round(pct(redis_lat, 50), 2),
         "redis_latency_p99_ms": round(pct(redis_lat, 99), 2),
@@ -1597,12 +1622,17 @@ def _compare_against_baseline(baseline_path, threshold=0.10):
         baseline = {}
     current = {}
     cur_compile = {}
+    cur_trace_overhead = {}
     try:
         with open(ARTIFACT_PATH) as f:
             for r in json.load(f).get("results", []):
                 current[r.get("metric")] = r.get("value")
                 if isinstance(r.get("compile_time_s"), (int, float)):
                     cur_compile[r.get("metric")] = r["compile_time_s"]
+                if isinstance(r.get("reqtrace_p50_overhead_fraction"),
+                              (int, float)):
+                    cur_trace_overhead[r.get("metric")] = \
+                        r["reqtrace_p50_overhead_fraction"]
     except Exception:  # noqa: BLE001
         pass
     # compile-time changes are INFORMATIONAL, never a regression: a
@@ -1633,6 +1663,16 @@ def _compare_against_baseline(baseline_path, threshold=0.10):
             regressions.append({
                 "metric": metric, "baseline": base_v, "current": cur_v,
                 "change": round(cur_v / base_v - 1.0, 4)})
+    # request-tracing overhead self-gate (baseline-independent): the
+    # serving bench measured the same leg traced and untraced in ONE
+    # run, so the bound is absolute — >5% p50 cost from tracing is a
+    # regression even when every baseline-relative metric held
+    for metric, frac in sorted(cur_trace_overhead.items()):
+        if frac > 0.05:
+            regressions.append({
+                "metric": metric + ":reqtrace_p50_overhead_fraction",
+                "baseline": 0.05, "current": round(frac, 4),
+                "change": round(frac, 4)})
     _emit({"compare": baseline_path, "threshold": threshold,
            "metrics_compared": compared, "regressions": regressions,
            "skipped": skipped,

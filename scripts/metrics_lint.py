@@ -13,7 +13,12 @@ scrape into silent garbage:
 * histogram ``le`` bucket labels out of order or non-numeric;
 * sample lines whose value doesn't parse as a float;
 * ``reserved`` label collisions (``le`` used outside histogram
-  buckets).
+  buckets);
+* OpenMetrics-style exemplars (`` # {trace_id="..."} value ts``, what
+  ``/metrics?exemplars=1`` serves): allowed only on histogram
+  ``_bucket`` lines and counter samples, exemplar label names must be
+  in-charset, the exemplar value must parse as a float, and a bucket
+  exemplar's value must not exceed its own ``le`` bound.
 
 A tier-1 test runs this against a LIVE registry dump, so a bad metric
 name added anywhere in the codebase fails CI rather than surfacing as
@@ -44,6 +49,12 @@ _LABEL_PAIR_RE = re.compile(
 _SAMPLE_RE = re.compile(
     r"^(?P<name>[^\s{]+)(?:\{(?P<labels>.*)\})?\s+(?P<value>\S+)"
     r"(?:\s+(?P<ts>\S+))?$")
+# OpenMetrics exemplar suffix: ' # {labels} value [ts]' appended to a
+# bucket/counter sample line (what prometheus_text(exemplars=True)
+# emits).  Anchored at end-of-line so a ' # ' INSIDE a quoted label
+# value never matches.
+_EXEMPLAR_RE = re.compile(
+    r" # \{(?P<labels>[^}]*)\} (?P<value>\S+)(?: (?P<ts>\S+))?$")
 
 COUNTER_SUFFIX = "_total"
 
@@ -90,6 +101,9 @@ def lint_exposition(text: str) -> List[str]:
             continue
         if line.startswith("#"):
             continue
+        exemplar = _EXEMPLAR_RE.search(line)
+        if exemplar is not None:
+            line = line[: exemplar.start()]
         m = _SAMPLE_RE.match(line)
         if not m:
             issues.append(f"line {lineno}: unparseable sample: "
@@ -131,6 +145,50 @@ def lint_exposition(text: str) -> List[str]:
             issues.append(
                 f"line {lineno}: non-numeric value "
                 f"{m.group('value')!r} for {name}")
+        if exemplar is not None:
+            # exemplars only make sense on bucket/counter samples
+            # (the OpenMetrics placement rule); a TYPE-less _total
+            # series is given the benefit of the doubt
+            allowed = (name.endswith("_bucket")
+                       or types.get(name) == "counter"
+                       or (name not in types
+                           and name.endswith(COUNTER_SUFFIX)))
+            if not allowed:
+                issues.append(
+                    f"line {lineno}: exemplar on a non-bucket/"
+                    f"non-counter sample {name}")
+            for k, _v in _parse_labels(exemplar.group("labels")):
+                if not LABEL_NAME_RE.match(k):
+                    issues.append(
+                        f"line {lineno}: invalid exemplar label "
+                        f"name {k!r} on {name}")
+            ex_val = None
+            try:
+                ex_val = float(exemplar.group("value"))
+            except ValueError:
+                issues.append(
+                    f"line {lineno}: non-numeric exemplar value "
+                    f"{exemplar.group('value')!r} on {name}")
+            ts = exemplar.group("ts")
+            if ts is not None:
+                try:
+                    float(ts)
+                except ValueError:
+                    issues.append(
+                        f"line {lineno}: non-numeric exemplar "
+                        f"timestamp {ts!r} on {name}")
+            if ex_val is not None and name.endswith("_bucket"):
+                le = dict(labels).get("le")
+                if le is not None:
+                    try:
+                        le_f = float(le.replace("+Inf", "inf"))
+                    except ValueError:
+                        le_f = None
+                    if le_f is not None and ex_val > le_f:
+                        issues.append(
+                            f"line {lineno}: exemplar value "
+                            f"{ex_val} above its bucket bound "
+                            f"le={le} on {name}")
         # duplicate-series detection (le participates: bucket lines
         # are distinct series per bound)
         key = name + "{" + ",".join(
@@ -163,8 +221,15 @@ def lint_exposition(text: str) -> List[str]:
 
 
 def lint_registry(registry) -> List[str]:
-    """Lint a live ``MetricsRegistry`` (what the tier-1 test calls)."""
-    return lint_exposition(registry.prometheus_text())
+    """Lint a live ``MetricsRegistry`` (what the tier-1 test calls).
+    The exemplar-enabled exposition is a strict superset of the plain
+    one, so linting it covers both views in one pass; registries
+    predating the ``exemplars`` kwarg fall back to the plain text."""
+    try:
+        text = registry.prometheus_text(exemplars=True)
+    except TypeError:
+        text = registry.prometheus_text()
+    return lint_exposition(text)
 
 
 def main(argv=None) -> int:

@@ -374,6 +374,96 @@ def render_loadtest_report(label: str, doc: Dict) -> str:
     return "\n".join(lines)
 
 
+# ---------------------------------------------------------- req forensics
+def _segments(stations: List[Dict]) -> List[Tuple[str, float, float, Dict]]:
+    """(station, offset_s, segment_s, attrs) per mark, time-ordered.
+    A segment is the time since the previous station — the wait the
+    request spent to REACH this station — so the segments sum to the
+    timeline's measured latency by construction."""
+    marks = sorted(stations, key=lambda s: float(s.get("t", 0.0)))
+    out = []
+    prev = marks[0].get("t", 0.0) if marks else 0.0
+    for m in marks:
+        t = float(m.get("t", 0.0))
+        attrs = {k: v for k, v in m.items()
+                 if k not in ("station", "t")}
+        out.append((m.get("station", "?"), t, max(t - prev, 0.0),
+                    attrs))
+        prev = t
+    return out
+
+
+def render_requests_report(label: str, doc: Dict,
+                           top: int = 10) -> str:
+    """The slowest-request waterfall: per-station breakdown of where
+    each tail request's time went, plus the aggregate station profile
+    of the tail.  ``doc`` is a merged ``requests.json`` document
+    (``aggregator.merge_requests``)."""
+    tls = doc.get("timelines") or []
+    lines = [f"== request forensics: {label} =="]
+    hosts = doc.get("hosts_merged")
+    lines.append(
+        f"{len(tls)} timeline(s) kept"
+        + (f" across {hosts} host(s)" if hosts else "")
+        + f"; sampler kept {doc.get('kept', len(tls))} / dropped "
+          f"{doc.get('dropped', 0)} (tail-based: errors/sheds/"
+          f"quarantines + slowest-K always survive)")
+    by_outcome: Dict[str, int] = {}
+    for tl in tls:
+        oc = tl.get("outcome", "?")
+        by_outcome[oc] = by_outcome.get(oc, 0) + 1
+    if by_outcome:
+        lines.append("outcomes: " + "  ".join(
+            f"{k}={v}" for k, v in sorted(by_outcome.items())))
+    if not tls:
+        lines.append("no timelines — was the run traced? "
+                     "(observability.reqtrace on, requests.json "
+                     "flushed/exported)")
+        return "\n".join(lines)
+
+    ranked = sorted(tls, key=lambda t: -float(t.get("latency_s", 0.0)))
+    shown = ranked[:top]
+    # aggregate tail profile: which station dominates the slow set
+    agg: Dict[str, float] = {}
+    for tl in shown:
+        for st, _off, seg, _a in _segments(tl.get("stations") or []):
+            agg[st] = agg.get(st, 0.0) + seg
+    lines.append("")
+    lines.append(f"slowest {len(shown)} request(s) — station "
+                 f"waterfall (segment = time to REACH the station; "
+                 f"segments sum to the measured latency):")
+    for i, tl in enumerate(shown, 1):
+        segs = _segments(tl.get("stations") or [])
+        lat = float(tl.get("latency_s", 0.0))
+        dominant = max(segs, key=lambda s: s[2])[0] if segs else "-"
+        lines.append(
+            f"\n#{i}  trace {tl.get('trace_id', '?')}  "
+            f"[{tl.get('outcome', '?')}]  "
+            f"{tl.get('transport') or '?'}:"
+            f"{tl.get('endpoint') or 'default'}  "
+            f"latency {_fmt_seconds(lat)}  dominant={dominant}")
+        rows = []
+        for st, off, seg, attrs in segs:
+            extra = "  ".join(f"{k}={v}" for k, v
+                              in sorted(attrs.items()))
+            bar = "#" * min(int(round(40 * seg / lat))
+                            if lat > 0 else 0, 40)
+            rows.append([st, f"+{_fmt_seconds(off)}",
+                         _fmt_seconds(seg), bar, extra])
+        lines.append(_table(rows, ["station", "offset", "segment",
+                                   "", "attrs"]))
+        ssum = sum(s[2] for s in segs)
+        lines.append(f"    segments sum {_fmt_seconds(ssum)} vs "
+                     f"measured {_fmt_seconds(lat)}")
+    total = sum(agg.values()) or 1e-12
+    rows = [[st, _fmt_seconds(v), f"{100 * v / total:.0f}%"]
+            for st, v in sorted(agg.items(), key=lambda kv: -kv[1])]
+    lines += ["", "tail profile (summed over the slowest set — the "
+              "station to fix first):",
+              _table(rows, ["station", "total", "share"])]
+    return "\n".join(lines)
+
+
 # ------------------------------------------------------------ multi-host
 def _load_aggregator_module():
     """Load observability/aggregator.py by FILE PATH (not package
@@ -386,6 +476,10 @@ def _load_aggregator_module():
     spec = importlib.util.spec_from_file_location("_zoo_aggregator",
                                                   path)
     mod = importlib.util.module_from_spec(spec)
+    # register before exec: modules the aggregator itself path-loads
+    # (reqtrace.py) define dataclasses, whose field-annotation
+    # resolution needs the defining module present in sys.modules
+    sys.modules[spec.name] = mod
     spec.loader.exec_module(mod)
     return mod
 
@@ -589,10 +683,32 @@ def main(argv=None) -> int:
                     help="where --merge-hosts writes the merged "
                          "Chrome trace (default "
                          "RUN_DIR/merged_trace.json)")
+    ap.add_argument("--requests", metavar="RUN_DIR_OR_FILE",
+                    default=None,
+                    help="render the slowest-request station "
+                         "waterfall from requests.json timelines: a "
+                         "single requests.json, or a run directory "
+                         "whose host-<k>/requests.json are merged "
+                         "(partial timelines sharing a trace_id are "
+                         "joined)")
+    ap.add_argument("--slowest", type=int, default=10,
+                    help="--requests: how many of the slowest "
+                         "requests to waterfall (default 10)")
     args = ap.parse_args(argv)
 
-    if args.merge_hosts is None and args.snapshot is None:
-        ap.error("need a snapshot file or --merge-hosts RUN_DIR")
+    if args.merge_hosts is None and args.snapshot is None \
+            and args.requests is None:
+        ap.error("need a snapshot file, --merge-hosts RUN_DIR, or "
+                 "--requests RUN_DIR")
+
+    if args.requests:
+        agg = _load_aggregator_module()
+        merged_reqs = agg.merge_requests(args.requests)
+        print(render_requests_report(args.requests, merged_reqs,
+                                     top=args.slowest))
+        print()
+        if args.merge_hosts is None and args.snapshot is None:
+            return 0
 
     if args.merge_hosts:
         text, merged = render_cluster_report(

@@ -1,0 +1,608 @@
+"""Request-scoped distributed tracing (PR 16): context propagation,
+tail sampling, the station waterfall, exemplars, verdict citations.
+
+The contracts under test:
+
+* the trace wire format round-trips byte-identically over BOTH
+  transports — the HTTP header and the Redis stream field carry the
+  same string, a send retry re-sends it unchanged, and a PEL reclaim
+  hands the ORIGINAL trace back (XAUTOCLAIM returns the original
+  fields);
+* the per-replica ring is bounded and the tail sampler always keeps
+  non-ok outcomes and the slowest-K of a window while down-sampling
+  the healthy majority;
+* a served request's station waterfall sums to its measured latency
+  (stations are offsets from the first mark, so this holds by
+  construction — the test proves the instrumentation preserves it
+  end to end);
+* flow events pair the transport thread's submit with the executor
+  thread's batch composition under the request's trace id;
+* the SLO verdict cites violator trace_ids non-vacuously;
+* exemplar exposition passes metrics_lint, and the lint catches the
+  malformed variants.
+"""
+
+import importlib.util
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.observability import (
+    get_registry, get_tracer, reset_registry, reset_tracer)
+from analytics_zoo_tpu.observability.reqtrace import (
+    TRACE_FIELD, TRACE_HEADER, RequestLog, TraceContext,
+    get_request_log, merge_timeline_dicts, reset_request_log)
+from analytics_zoo_tpu.serving.client import (
+    InputQueue, OutputQueue, ServingHttpClient)
+from analytics_zoo_tpu.serving.redis_client import EmbeddedBroker
+from analytics_zoo_tpu.serving.server import (
+    ClusterServing, ServingConfig)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_observability():
+    reset_request_log()
+    yield
+    reset_request_log()
+    reset_registry()
+    reset_tracer()
+
+
+import sys
+
+
+def _load_script(name):
+    path = os.path.join(REPO_ROOT, "scripts", name)
+    spec = importlib.util.spec_from_file_location(
+        name.replace(".py", ""), path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class OkModel:
+    def predict(self, x, batch_size=None):
+        return np.tile(np.arange(4, dtype=np.float32),
+                       (len(np.asarray(x)), 1))
+
+
+def _serving(**cfg):
+    broker = EmbeddedBroker()
+    serving = ClusterServing(
+        OkModel(),
+        ServingConfig(batch_size=4, consumer_group="rt",
+                      consumer_name="w0", http_port=0,
+                      metrics_host="127.0.0.1", **cfg),
+        broker=broker)
+    t = threading.Thread(target=serving.run, kwargs={"poll_ms": 5},
+                         daemon=True)
+    t.start()
+    return serving, broker, t
+
+
+def _stop(serving, t):
+    serving.stop()
+    t.join(timeout=15)
+
+
+def _timeline(tid):
+    for tl in get_request_log().snapshot()["timelines"]:
+        if tl["trace_id"] == tid:
+            return tl
+    return None
+
+
+def _station_names(tl):
+    return [s["station"] for s in tl["stations"]]
+
+
+# ------------------------------------------------------------- wire codec
+class TestWireCodec:
+    def test_roundtrip_is_byte_identical(self):
+        import uuid
+        rid = uuid.uuid4().hex
+        ctx = TraceContext.new(rid)
+        # a uuid4-hex request_id IS the trace id — one identifier
+        # joins the loadgen record, the stream record and the verdict
+        assert ctx.trace_id == rid
+        wire = ctx.to_wire()
+        again = TraceContext.from_wire(wire, request_id=rid)
+        assert again.to_wire() == wire
+        assert (again.trace_id, again.span_id) == (ctx.trace_id,
+                                                   ctx.span_id)
+        # bytes off the broker parse to the same context
+        frombytes = TraceContext.from_wire(wire.encode())
+        assert frombytes.to_wire() == wire
+
+    def test_malformed_wire_means_untraced_not_an_error(self):
+        assert TraceContext.from_wire(None) is None
+        assert TraceContext.from_wire("") is None
+        assert TraceContext.from_wire("not-a-traceparent") is None
+        assert TraceContext.from_wire("00-XYZ-123-01") is None
+        assert TraceContext.from_wire(b"\xff\xfe") is None
+
+    def test_non_hex_request_id_gets_fresh_trace_id(self):
+        ctx = TraceContext.new("my-request-7")
+        assert ctx.request_id == "my-request-7"
+        assert ctx.trace_id != "my-request-7"
+        assert len(ctx.trace_id) == 32
+
+
+# ----------------------------------------------------------- request log
+class TestRequestLog:
+    def test_begin_is_idempotent_per_trace_id(self):
+        log = RequestLog()
+        ctx = TraceContext.new()
+        a = log.begin(ctx, transport="redis", station="enqueue")
+        b = log.begin(ctx, station="transport_receive")
+        assert a is b
+        assert _station_names(a.to_dict()) == ["enqueue",
+                                               "transport_receive"]
+
+    def test_active_set_is_bounded_and_evicts_oldest(self):
+        log = RequestLog(capacity=3)
+        ids = [TraceContext.new() for _ in range(5)]
+        for ctx in ids:
+            log.begin(ctx, station="enqueue")
+        snap = log.snapshot()
+        assert snap["active"] == 3
+        evicted = [tl for tl in snap["timelines"]
+                   if tl["outcome"] == "evicted"]
+        assert sorted(tl["trace_id"] for tl in evicted) == \
+            sorted(c.trace_id for c in ids[:2])
+
+    def test_tail_sampler_keeps_errors_and_slowest(self):
+        log = RequestLog(capacity=100, slowest_k=1, window_s=1000.0,
+                         sample_every=1000)
+        def finish(outcome, lat):
+            ctx = TraceContext.new()
+            log.begin(ctx, station="enqueue", t=0.0)
+            log.finish(ctx, outcome, station="respond", t=lat)
+            return ctx.trace_id
+        slow = finish("ok", 1.0)        # first ok: seeds slowest-K
+        shed = finish("shed", 0.001)    # non-ok: always kept
+        err = finish("error", 0.001)
+        fast = [finish("ok", 0.001) for _ in range(50)]
+        slower = finish("ok", 2.0)      # beats the window's slowest
+        kept = {tl["trace_id"] for tl
+                in log.snapshot()["timelines"]}
+        assert {slow, shed, err, slower} <= kept
+        assert not (set(fast) & kept)   # healthy majority sampled out
+        assert log.dropped == 50
+
+    def test_disabled_log_is_a_noop(self):
+        log = RequestLog(enabled=False)
+        ctx = TraceContext.new()
+        assert log.begin(ctx, station="enqueue") is None
+        log.mark(ctx, "decode")
+        log.finish(ctx, "ok")
+        assert log.snapshot()["timelines"] == []
+
+    def test_unknown_trace_mark_and_finish_are_noops(self):
+        log = RequestLog()
+        log.mark("0" * 32, "decode")
+        log.finish("0" * 32, "ok")
+        assert log.snapshot()["timelines"] == []
+
+
+# ------------------------------------------------- redis-path propagation
+class TestRedisPropagation:
+    def test_retry_and_reclaim_keep_the_original_wire_bytes(self):
+        """The field dict is built once per request, so a send retry
+        re-XADDs the identical wire value; XAUTOCLAIM returns the
+        ORIGINAL fields, so a reclaimed record keeps its trace_id."""
+        from analytics_zoo_tpu.serving.loadgen.loadgen import (
+            PayloadFactory, ScheduledRequest)
+        spec = ScheduledRequest(offset_s=0.0)
+        fields = PayloadFactory().redis_fields(spec)
+        wire = fields[TRACE_FIELD]
+        assert TraceContext.from_wire(wire).trace_id == \
+            spec.request_id
+        broker = EmbeddedBroker()
+        broker.xgroup_create("serving_stream", "g")
+        broker.xadd("serving_stream", fields)
+        broker.xadd("serving_stream", fields)      # the "retry"
+        def wires(entries):
+            # the embedded broker hands values back as bytes, exactly
+            # as real Redis would — decode to compare with the source
+            out = []
+            for _i, fields in entries:
+                v = fields[TRACE_FIELD]
+                out.append(v.decode() if isinstance(v, bytes) else v)
+            return out
+        read = broker.xreadgroup("g", "c0", "serving_stream",
+                                 count=10)
+        assert wires(read) == [wire, wire]
+        # crash before ack: another consumer reclaims the SAME fields
+        reclaimed = broker.xautoclaim("serving_stream", "g", "c1",
+                                      min_idle_ms=0)
+        assert wires(reclaimed) == [wire, wire]
+
+    def test_end_to_end_timeline_covers_every_station(self):
+        serving, broker, t = _serving()
+        try:
+            inq = InputQueue(broker=broker)
+            outq = OutputQueue(broker=broker)
+            t0 = time.perf_counter()
+            rid = inq.enqueue("rt-0", np.zeros(4, np.float32))
+            assert outq.query("rt-0", timeout_s=20.0) is not None
+            wall = time.perf_counter() - t0
+            deadline = time.time() + 5.0
+            tl = None
+            while tl is None and time.time() < deadline:
+                tl = _timeline(rid)
+                time.sleep(0.01)
+            assert tl is not None, "timeline never finished"
+            assert tl["outcome"] == "ok"
+            assert tl["transport"] == "redis"
+            names = _station_names(tl)
+            for station in ("enqueue", "transport_receive", "decode",
+                            "batch_queue_enter", "batch_compose",
+                            "dispatch", "device_done", "result_write"):
+                assert station in names, (station, names)
+            # batch_compose carries the composition evidence
+            comp = next(s for s in tl["stations"]
+                        if s["station"] == "batch_compose")
+            assert comp["fill"] > 0 and comp["co_riders"] >= 0 \
+                and comp["batch"] >= 1
+            # offsets-from-first-mark: the waterfall sums to the
+            # measured latency by construction, and the whole
+            # timeline fits inside the client-observed wall time
+            offs = [s["t"] for s in tl["stations"]]
+            assert tl["latency_s"] == pytest.approx(max(offs))
+            assert 0.0 < tl["latency_s"] <= wall + 0.05
+        finally:
+            _stop(serving, t)
+
+    def test_undecodable_record_finishes_as_error_timeline(self):
+        serving, broker, t = _serving()
+        try:
+            ctx = TraceContext.new()
+            broker.xadd("serving_stream", {
+                "uri": "rt-bad", "data": b"!!not-an-ndarray!!",
+                "request_id": "bad-req", TRACE_FIELD: ctx.to_wire()})
+            outq = OutputQueue(broker=broker)
+            res = outq.query("rt-bad", timeout_s=20.0)
+            assert res is not None
+            deadline = time.time() + 5.0
+            tl = None
+            while tl is None and time.time() < deadline:
+                tl = _timeline(ctx.trace_id)
+                time.sleep(0.01)
+            assert tl is not None and tl["outcome"] == "error"
+        finally:
+            _stop(serving, t)
+
+
+# -------------------------------------------------- http-path propagation
+class TestHttpPropagation:
+    def test_client_stamp_roundtrips_and_response_names_the_trace(self):
+        serving, _broker, t = _serving()
+        try:
+            http = ServingHttpClient(
+                f"http://127.0.0.1:{serving.http_transport.port}")
+            ctx = TraceContext.new()
+            doc = http.predict_http("default",
+                                    np.zeros(4, np.float32),
+                                    trace=ctx)
+            assert doc["trace_id"] == ctx.trace_id
+            tl = _timeline(ctx.trace_id)
+            assert tl is not None
+            assert tl["outcome"] == "ok"
+            assert tl["transport"] == "http"
+            names = _station_names(tl)
+            for station in ("enqueue", "transport_receive", "decode",
+                            "batch_queue_enter", "batch_compose",
+                            "dispatch", "device_done", "respond"):
+                assert station in names, (station, names)
+            offs = [s["t"] for s in tl["stations"]]
+            assert tl["latency_s"] == pytest.approx(max(offs))
+        finally:
+            _stop(serving, t)
+
+    def test_auto_stamp_when_client_sends_no_header(self):
+        """An untraced request is minted a context server-side, so
+        forensics cover 100% of traffic, not just cooperating
+        clients."""
+        serving, _broker, t = _serving()
+        try:
+            port = serving.http_transport.port
+            body = json.dumps({
+                "data": [0.0, 0.0, 0.0, 0.0], "dtype": "float32",
+                "uri": "raw-0", "request_id": "raw-req"}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/predict/default",
+                data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                doc = json.loads(resp.read().decode())
+            tid = doc["trace_id"]
+            tl = _timeline(tid)
+            assert tl is not None and tl["outcome"] == "ok"
+            assert tl["request_id"] == "raw-req"
+        finally:
+            _stop(serving, t)
+
+    def test_flow_events_pair_submit_with_batch_composition(self):
+        serving, _broker, t = _serving()
+        try:
+            http = ServingHttpClient(
+                f"http://127.0.0.1:{serving.http_transport.port}")
+            ctx = TraceContext.new()
+            http.predict_http("default", np.zeros(4, np.float32),
+                              trace=ctx)
+            flows = [e for e in get_tracer().events()
+                     if e.get("cat") == "flow"
+                     and e.get("id") == ctx.trace_id]
+            starts = [e for e in flows if e["ph"] == "s"]
+            ends = [e for e in flows if e["ph"] == "f"]
+            assert len(starts) == 1 and len(ends) == 1
+            assert ends[0]["bp"] == "e"       # bind-to-enclosing
+            assert starts[0]["name"] == ends[0]["name"] \
+                == "serving_request"
+            # the arrow crosses threads: transport handler -> the
+            # batcher's executor thread
+            assert starts[0]["tid"] != ends[0]["tid"]
+        finally:
+            _stop(serving, t)
+
+
+# -------------------------------------------------------- generative path
+class TestGenerativeStations:
+    def test_prefill_decode_step_retire_are_marked(self):
+        class _ToyGenModel:
+            def decode_params(self):
+                return {}
+
+            def initial_carries(self, batch):
+                import jax.numpy as jnp
+                return {"h": jnp.zeros((batch, 2), jnp.float32)}
+
+            def prefill(self, params, enc_ids):
+                import jax.numpy as jnp
+                return {"h": jnp.zeros((enc_ids.shape[0], 2),
+                                       jnp.float32)}
+
+            def decode_step(self, params, tok, carries):
+                return tok + 1, carries
+
+        serving, broker, t = _serving()
+        try:
+            serving.register_generative_endpoint(
+                "gen", _ToyGenModel(), enc_len=4, start_sign=1,
+                max_seq_len=4, slots=1)
+            inq = InputQueue(broker=broker)
+            outq = OutputQueue(broker=broker)
+            rid = inq.enqueue("rt-gen", np.ones(4, np.int32),
+                              endpoint="gen", max_tokens=3)
+            assert outq.query("rt-gen", timeout_s=30.0) is not None
+            deadline = time.time() + 5.0
+            tl = None
+            while tl is None and time.time() < deadline:
+                tl = _timeline(rid)
+                time.sleep(0.01)
+            assert tl is not None
+            names = _station_names(tl)
+            assert "prefill" in names
+            assert names.count("decode_step") >= 1
+            retire = next(s for s in tl["stations"]
+                          if s["station"] == "retire")
+            assert retire["cause"]
+        finally:
+            _stop(serving, t)
+
+
+# ------------------------------------------------- waterfall + aggregation
+class TestWaterfallReport:
+    def test_merge_joins_partial_timelines_on_trace_id(self):
+        tid = "ab" * 16
+        client_part = {"timelines": [{
+            "trace_id": tid, "request_id": tid, "endpoint": "",
+            "transport": "", "outcome": "pending", "wall0": 100.0,
+            "latency_s": 0.0,
+            "stations": [{"station": "enqueue", "t": 0.0}]}]}
+        server_part = {"timelines": [{
+            "trace_id": tid, "request_id": tid, "endpoint": "default",
+            "transport": "redis", "outcome": "ok", "wall0": 100.01,
+            "latency_s": 0.05,
+            "stations": [{"station": "transport_receive", "t": 0.0},
+                         {"station": "result_write", "t": 0.05}]}]}
+        merged = merge_timeline_dicts([client_part, server_part])
+        assert len(merged) == 1
+        tl = merged[0]
+        assert tl["outcome"] == "ok"
+        assert tl["transport"] == "redis"
+        assert _station_names(tl) == ["enqueue", "transport_receive",
+                                      "result_write"]
+        # re-anchored on the earliest wall0: server offsets shift by
+        # the 10ms clock gap, and the merged latency covers the span
+        assert tl["latency_s"] == pytest.approx(0.06)
+
+    def test_waterfall_sums_to_measured_latency(self, tmp_path):
+        """The acceptance contract: obs_report --requests renders a
+        slowest-request waterfall whose per-station segments sum to
+        the measured latency (within 5%) with a dominant station
+        named."""
+        serving, broker, t = _serving()
+        try:
+            inq = InputQueue(broker=broker)
+            outq = OutputQueue(broker=broker)
+            rids = [inq.enqueue(f"wf-{i}", np.zeros(4, np.float32))
+                    for i in range(6)]
+            for i in range(6):
+                assert outq.query(f"wf-{i}", timeout_s=20.0) \
+                    is not None
+            deadline = time.time() + 5.0
+            while time.time() < deadline and not all(
+                    _timeline(r) for r in rids):
+                time.sleep(0.01)
+        finally:
+            _stop(serving, t)
+        path = tmp_path / "requests.json"
+        get_request_log().export(str(path))
+        obs = _load_script("obs_report.py")
+        merged = obs._load_aggregator_module().merge_requests(
+            str(path))
+        assert merged["hosts_merged"] == 1
+        tls = [tl for tl in merged["timelines"]
+               if tl["trace_id"] in rids]
+        assert tls
+        for tl in tls:
+            segs = obs._segments(tl["stations"])
+            ssum = sum(seg for _st, _off, seg, _a in segs)
+            assert ssum == pytest.approx(tl["latency_s"],
+                                         rel=0.05, abs=1e-9)
+        text = obs.render_requests_report(str(path), merged, top=5)
+        assert "dominant=" in text
+        assert "segments sum" in text
+        assert any(tl["trace_id"] in text for tl in tls)
+
+    def test_obs_report_cli_requests_mode(self, tmp_path):
+        log = RequestLog()
+        ctx = TraceContext.new()
+        log.begin(ctx, transport="http", endpoint="default",
+                  station="transport_receive", t=0.0)
+        log.mark(ctx, "dispatch", t=0.010)
+        log.finish(ctx, "ok", station="respond", t=0.015)
+        path = tmp_path / "requests.json"
+        log.export(str(path))
+        obs = _load_script("obs_report.py")
+        rc = obs.main(["--requests", str(path)])
+        assert rc == 0
+
+
+# -------------------------------------------------------- verdict ties-in
+class TestVerdictCitations:
+    def _run(self):
+        from analytics_zoo_tpu.serving.loadgen.loadgen import (
+            LoadgenRun, RequestRecord, ScheduledRequest)
+
+        def rec(offset, done, status):
+            r = RequestRecord(
+                spec=ScheduledRequest(offset_s=offset))
+            r.scheduled, r.sent = offset, offset
+            r.done, r.status = done, status
+            return r
+        records = [rec(i * 0.01, i * 0.01 + 0.005, "ok")
+                   for i in range(20)]
+        slow = rec(0.5, 2.5, "ok")            # the 2s p99 outlier
+        lost = rec(0.6, None, "lost")
+        records += [slow, lost]
+        return (LoadgenRun(records, 0.0, 0.0, 5.0),
+                slow.trace_id, lost.trace_id)
+
+    def test_p99_and_exactly_once_cite_violator_trace_ids(self):
+        from analytics_zoo_tpu.serving.loadgen.verdict import (
+            SloSpec, evaluate)
+        run, slow_tid, lost_tid = self._run()
+        verdict = evaluate(run, SloSpec(p99_from_scheduled_ms=100.0))
+        lat = verdict.check("p99_from_scheduled")
+        assert not lat.passed
+        assert slow_tid in lat.trace_ids      # non-vacuous citation
+        assert slow_tid in lat.detail
+        eo = verdict.check("exactly_once")
+        assert not eo.passed
+        assert lost_tid in eo.trace_ids
+        doc = verdict.to_dict()
+        by_name = {c["name"]: c for c in doc["checks"]}
+        assert slow_tid in by_name["p99_from_scheduled"]["trace_ids"]
+        assert lost_tid in by_name["exactly_once"]["trace_ids"]
+
+    def test_passing_latency_check_still_names_the_tail(self):
+        from analytics_zoo_tpu.serving.loadgen.verdict import (
+            SloSpec, evaluate)
+        run, slow_tid, _lost = self._run()
+        verdict = evaluate(run,
+                           SloSpec(p99_from_scheduled_ms=10000.0))
+        lat = verdict.check("p99_from_scheduled")
+        assert lat.passed and slow_tid in lat.trace_ids
+
+
+# ------------------------------------------------------------- exemplars
+class TestExemplars:
+    def test_exposition_gains_exemplars_only_when_asked(self):
+        reg = get_registry()
+        h = reg.histogram("rt_latency_seconds", "d")
+        h.observe(0.01, exemplar="ab" * 16)
+        plain = reg.prometheus_text()
+        assert " # {" not in plain            # strict 0.0.4 stays
+        rich = reg.prometheus_text(exemplars=True)
+        assert ' # {trace_id="' + "ab" * 16 + '"} 0.01' in rich
+
+    def test_live_registry_with_exemplars_lints_clean(self):
+        lint = _load_script("metrics_lint.py")
+        reg = get_registry()
+        h = reg.histogram("rt_lint_seconds", "d")
+        h.observe(0.25, exemplar="cd" * 16)
+        c = reg.counter("rt_lint_total", "d")
+        c.inc(exemplar="ef" * 16)
+        assert lint.lint_registry(reg) == []
+
+    def test_lint_flags_malformed_exemplars(self):
+        lint = _load_script("metrics_lint.py")
+        text = "\n".join([
+            '# TYPE g gauge',
+            'g 1 # {trace_id="x"} 1 1',                   # placement
+            '# TYPE h histogram',
+            'h_bucket{le="1.0"} 3 # {0bad="x"} 0.5 1.0',  # label name
+            'h_bucket{le="2.0"} 3 # {trace_id="x"} 5.0',  # > le bound
+            'h_bucket{le="+Inf"} 3 # {trace_id="x"} nope',  # value
+            'h_sum 1.5',
+            'h_count 3',
+        ])
+        issues = lint.lint_exposition(text)
+        assert any("non-bucket/non-counter" in i for i in issues)
+        assert any("invalid exemplar label" in i for i in issues)
+        assert any("above its bucket bound" in i for i in issues)
+        assert any("non-numeric exemplar value" in i for i in issues)
+        # a well-formed exemplar document stays clean
+        good = "\n".join([
+            '# TYPE h histogram',
+            'h_bucket{le="1.0"} 3 # {trace_id="abc"} 0.5 1.2',
+            'h_bucket{le="+Inf"} 3',
+            'h_sum 1.5',
+            'h_count 3',
+            '# TYPE c_total counter',
+            'c_total 5 # {trace_id="abc"} 1 1.2',
+        ])
+        assert lint.lint_exposition(good) == []
+
+
+# ------------------------------------------------------- metrics endpoint
+class TestEndpoint:
+    def test_requests_json_and_exemplar_query(self):
+        from analytics_zoo_tpu.observability import MetricsServer
+        reg = get_registry()
+        reg.histogram("rt_ep_seconds", "d").observe(
+            0.5, exemplar="aa" * 16)
+        log = get_request_log()
+        ctx = TraceContext.new()
+        log.begin(ctx, transport="http", station="transport_receive",
+                  t=0.0)
+        log.finish(ctx, "error", station="respond", t=0.01)
+        server = MetricsServer(port=0, host="127.0.0.1",
+                               registry=reg).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            with urllib.request.urlopen(base + "/requests.json",
+                                        timeout=5) as r:
+                doc = json.loads(r.read().decode())
+            assert doc["kind"] == "zoo_request_timelines"
+            assert any(tl["trace_id"] == ctx.trace_id
+                       for tl in doc["timelines"])
+            with urllib.request.urlopen(base + "/metrics",
+                                        timeout=5) as r:
+                assert " # {" not in r.read().decode()
+            with urllib.request.urlopen(
+                    base + "/metrics?exemplars=1", timeout=5) as r:
+                assert ' # {trace_id="' in r.read().decode()
+        finally:
+            server.stop()
