@@ -398,21 +398,26 @@ class UseAfterDonateRule(Rule):
 # ================================================================= ACK013
 
 
-#: call-name tails that discharge a consumed stream record
+#: call-name tails that discharge a consumed stream record / leased
+#: shard (the batchjobs ledger settles by commit or release)
 _ACK_NAMES = {
     "xack", "ack", "_ack", "dead_letter", "_dead_letter",
     "quarantine", "_quarantine",
+    "commit_shard", "_commit_shard", "release_shard",
+    "_release_shard",
 }
 #: claim sources: reading one of these hands the caller records it
 #: now OWES an ack for (XREADGROUP delivers exactly-once; XAUTOCLAIM
-#: re-delivers another worker's pending entries)
-_CLAIM_NAMES = {"xreadgroup", "xautoclaim"}
+#: re-delivers another worker's pending entries; claim_shards leases
+#: batch shards that must be committed or released)
+_CLAIM_NAMES = {"xreadgroup", "xautoclaim", "claim_shards"}
 
 
 @register_rule
 class AckObligationRule(Rule):
     """Exactly-once discharge of consumed stream records + the
-    ``engine.Request`` completion contract, in ``serving/``.
+    ``engine.Request`` completion contract, in ``serving/`` — and the
+    same obligation over leased batch shards in ``batchjobs/``.
 
     Why: every protocol bug the chaos/storm harnesses caught lately
     was a *path-sensitive obligation* bug — a record claimed on one
@@ -428,15 +433,25 @@ class AckObligationRule(Rule):
     transport timeout.  A path that ends in a propagating raise is
     NOT a leak: the Redis loop dying un-acked IS the PEL-reclaim
     contract ("a re-raise that reaches the loop boundary").
+
+    The batchjobs claim→settle loop carries the identical shape: a
+    shard returned by ``claim_shards`` must reach ``commit_shard`` /
+    ``release_shard`` or propagate a raise on every path — a shard
+    that completes an iteration still OWNED is leased-but-never-
+    settled, invisible to peers until the lease times out, and a
+    double settle is the duplicate-commit race the O_EXCL marker
+    exists to absorb.  Same rule, second ledger, so ``batchjobs/`` is
+    in scope too.
     """
 
     rule_id = "ACK013"
     severity = "error"
-    doc = ("serving record/Request obligation: consumed record not "
-           "discharged exactly once, or a Request that can miss "
-           "complete()/fail() on some path")
+    doc = ("serving/batchjobs obligation: consumed record or leased "
+           "shard not discharged exactly once, or a Request that can "
+           "miss complete()/fail() on some path")
 
-    SCOPE = "analytics_zoo_tpu/serving/"
+    SCOPE = ("analytics_zoo_tpu/serving/",
+             "analytics_zoo_tpu/batchjobs/")
 
     def check_module(self, ctx: ModuleContext) -> None:
         if not ctx.relpath.startswith(self.SCOPE):

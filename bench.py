@@ -1099,6 +1099,94 @@ def bench_input_pipeline(n_samples: int = 4096, batch_size: int = 128,
     }
 
 
+# ------------------------------------------------------------ batch_scoring
+def bench_batch_scoring(rows: int = 4096, rows_per_shard: int = 512,
+                        batch_size: int = 128, workers: int = 2):
+    """Offline batch scoring tier (analytics_zoo_tpu/batchjobs/):
+    a real coordinator + worker fleet scoring the demo job end to end
+    through the shard manifest / lease / exactly-once commit
+    protocol.  Two runs:
+
+    * an uninterrupted control — its rows/sec/chip is the headline
+      (NEW ``batch_scoring_*`` metric name on purpose: --compare
+      gates only metrics the baseline has, so a pre-batch-tier
+      baseline can never read these as a regression);
+    * a kill-and-resume drill — a worker chaos-killed mid-shard, the
+      ledger reclaimed; its resume-overhead fraction (recomputed rows
+      / committed rows) rides as an informational field, NOT the
+      gated value (it is lower-is-better and would false-regress
+      under the higher-is-better gate).
+    """
+    import shutil
+    import tempfile
+
+    from analytics_zoo_tpu.batchjobs.coordinator import run_job
+    from analytics_zoo_tpu.batchjobs.demo import demo_job
+    from analytics_zoo_tpu.resilience.chaos import ChaosPlan, FaultSpec
+
+    repo_root = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo_root + os.pathsep + \
+        env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    root = tempfile.mkdtemp(prefix="bench-batch-")
+    try:
+        # ---- control: clean run, the throughput headline ----------
+        control = run_job(
+            demo_job(os.path.join(root, "out-control"), num_rows=rows,
+                     rows_per_shard=rows_per_shard,
+                     batch_size=batch_size),
+            os.path.join(root, "run-control"), num_workers=workers,
+            env=env, timeout_s=240)
+
+        # ---- drill: chaos-kill one worker mid-shard, resume -------
+        drill_rows = max(rows // 4, 4 * rows_per_shard // 4)
+        drill = run_job(
+            demo_job(os.path.join(root, "out-drill"),
+                     num_rows=drill_rows,
+                     rows_per_shard=max(rows_per_shard // 2, batch_size),
+                     batch_size=batch_size, delay_s=0.1,
+                     lease_timeout_s=1.5),
+            os.path.join(root, "run-drill"), num_workers=workers,
+            env=env, timeout_s=240,
+            chaos=ChaosPlan([FaultSpec(site="worker.step", at_step=1,
+                                       kind="kill",
+                                       process_index=0)]))
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    import jax
+    dev = jax.devices()[0]
+    return {
+        "metric": "batch_scoring_rows_per_sec_per_chip",
+        "value": round(control["rows_per_sec_per_chip"], 1),
+        "unit": "rows/sec/chip",
+        "vs_baseline": None,
+        "workload": "batch_scoring",
+        "rows": rows,
+        "rows_per_shard": rows_per_shard,
+        "batch_size": batch_size,
+        "workers": workers,
+        "batch_scoring_rows_per_sec": round(control["rows_per_sec"], 1),
+        "batch_scoring_shards": control["shards_committed"],
+        "batch_scoring_chips_for_target":
+            control["chips_for"].get(
+                f"{control['target_deadline_s']:g}"),
+        # the drill's numbers are informational: resume cost, bounded
+        # by the acceptance test at < 1 shard per preemption
+        "batch_scoring_resume_overhead_fraction":
+            drill["resume"]["resume_overhead_fraction"],
+        "batch_scoring_resume_rows_recomputed":
+            drill["resume"]["rows_recomputed"],
+        "batch_scoring_resume_restarts": drill["restarts"],
+        "batch_scoring_resume_duplicate_commits":
+            drill["resume"]["duplicate_commits"],
+        "device": str(dev),
+        "device_kind": getattr(dev, "device_kind", "?"),
+    }
+
+
 # ----------------------------------------------------------------- kernels
 def bench_kernels(update_iters: int = 30, predict_rows: int = 65536,
                   predict_batch: int = 8192):
@@ -1328,6 +1416,7 @@ WORKLOADS = {
     "wide_deep": bench_wide_deep,
     "inception": bench_inception,
     "input_pipeline": bench_input_pipeline,
+    "batch_scoring": bench_batch_scoring,
 }
 
 # keep failure-path metric names identical to the success paths so a
@@ -1353,6 +1442,9 @@ METRIC_NAMES = {
     "wide_deep": "wide_deep_census_train_throughput",
     "inception": "inception_v1_tfpark_train_throughput",
     "input_pipeline": "input_pipeline_throughput",
+    # batch tier numbers are NEW names too (see bench_batch_scoring):
+    # a pre-batch-tier baseline must never gate them
+    "batch_scoring": "batch_scoring_rows_per_sec_per_chip",
 }
 
 

@@ -484,6 +484,64 @@ def _load_aggregator_module():
     return mod
 
 
+def _load_batchjobs_report_module():
+    """Load batchjobs/report.py (stdlib-only by contract) as a
+    synthetic package by file path — same jax-free trick as the
+    aggregator loader, but with a package shell so the module's
+    relative imports (spec.py, manifest.py) resolve."""
+    import importlib.util
+    import types
+    pkg_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "analytics_zoo_tpu", "batchjobs")
+    name = "_zoo_batchjobs"
+    if name + ".report" in sys.modules:
+        return sys.modules[name + ".report"]
+    pkg = types.ModuleType(name)
+    pkg.__path__ = [pkg_dir]
+    sys.modules[name] = pkg
+    spec = importlib.util.spec_from_file_location(
+        name + ".report", os.path.join(pkg_dir, "report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def render_job_report(run_dir: str) -> str:
+    """The --job section: shard progress table + capacity/cost report
+    from the job ledger, then the fleet's batch_* counters and the
+    per-host straggler callout joined from the merged host snapshots
+    (when the workers left any)."""
+    batch = _load_batchjobs_report_module()
+    lines = [f"== batch job report: {run_dir} ==", "",
+             batch.render_job_section(run_dir)]
+    try:
+        agg = _load_aggregator_module()
+        aggregator = agg.ClusterAggregator.from_run_dir(run_dir,
+                                                        offline=True)
+        host_snaps, merged = aggregator.cluster_view()
+    except Exception:
+        host_snaps, merged = {}, None
+    if host_snaps and merged:
+        counters = {k: v for k, v in
+                    merged.get("counters", {}).items()
+                    if k.startswith("batch_")}
+        if counters:
+            lines += ["", "fleet batch counters (merged over "
+                      f"{len(host_snaps)} host snapshot(s)):"]
+            for k in sorted(counters):
+                lines.append(f"  {k} = {counters[k]:g}")
+        cluster = merged.get("cluster", {})
+        if cluster.get("straggler"):
+            lines.append(
+                f"  STRAGGLER (step-time skew): "
+                f"{cluster['straggler']} "
+                f"(+{cluster.get('skew_fraction', 0.0):.0%} vs "
+                f"median)")
+    return "\n".join(lines)
+
+
 def _fmt_bytes(v: float) -> str:
     for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
         if abs(v) < 1024.0 or unit == "TiB":
@@ -694,12 +752,24 @@ def main(argv=None) -> int:
     ap.add_argument("--slowest", type=int, default=10,
                     help="--requests: how many of the slowest "
                          "requests to waterfall (default 10)")
+    ap.add_argument("--job", metavar="RUN_DIR", default=None,
+                    help="batch job run directory (zoo-batch): render "
+                         "the shard progress table, capacity/cost "
+                         "report and per-host straggler callout from "
+                         "the job ledger + merged host snapshots")
     args = ap.parse_args(argv)
 
     if args.merge_hosts is None and args.snapshot is None \
-            and args.requests is None:
-        ap.error("need a snapshot file, --merge-hosts RUN_DIR, or "
-                 "--requests RUN_DIR")
+            and args.requests is None and args.job is None:
+        ap.error("need a snapshot file, --merge-hosts RUN_DIR, "
+                 "--requests RUN_DIR, or --job RUN_DIR")
+
+    if args.job:
+        print(render_job_report(args.job))
+        print()
+        if args.merge_hosts is None and args.snapshot is None \
+                and args.requests is None:
+            return 0
 
     if args.requests:
         agg = _load_aggregator_module()
