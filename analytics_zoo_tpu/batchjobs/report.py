@@ -102,6 +102,16 @@ def build_report(run_dir: str, *, num_chips: int,
         "rows_per_sec": round(rows_per_sec, 4),
         "rows_per_sec_per_chip": round(per_chip, 4),
         "target_deadline_s": target,
+        # job-level SLO (ISSUE 18): the deadline is the batch plane's
+        # objective; "budget remaining" is the unspent fraction of it,
+        # the same vocabulary the serving SLO engine publishes
+        "slo": {
+            "deadline_met": (bool(elapsed_s <= target)
+                             if target > 0 else None),
+            "deadline_budget_remaining": (
+                round(1.0 - float(elapsed_s) / target, 4)
+                if target > 0 else None),
+        },
         "chips_for": chips_for,
         "resume": {
             "rows_recomputed": recomputed,
@@ -177,6 +187,15 @@ def render_report(report: Dict[str, Any]) -> str:
         f"  throughput: {report['rows_per_sec']:.1f} rows/s"
         f" on {report['num_chips']} chip(s)"
         f" = {report['rows_per_sec_per_chip']:.1f} rows/s/chip")
+    slo = report.get("slo") or {}
+    if slo.get("deadline_met") is not None:
+        lines.append(
+            f"  job SLO: deadline {report['target_deadline_s']:g}s — "
+            + (f"MET with {100 * slo['deadline_budget_remaining']:.0f}%"
+               f" budget remaining" if slo["deadline_met"]
+               else f"MISSED by "
+                    f"{-100 * slo['deadline_budget_remaining']:.0f}%"
+                    f" of the deadline"))
     res = report.get("resume", {})
     lines.append(
         f"  resume overhead: {res.get('rows_recomputed', 0)} rows"

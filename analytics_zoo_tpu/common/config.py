@@ -182,6 +182,19 @@ _DEFAULTS: Dict[str, Any] = {
     # bytes into estimated collective_seconds_total{op}; 0 disables the
     # time estimate (bytes are still counted).
     "observability.ici_gbps": 0.0,
+    # Embedded telemetry time-series store (observability/tsdb.py):
+    # a background sampler appends registry snapshots to ring-retained
+    # segment files under the worker's run-dir slot — the memory the
+    # SLO burn-rate engine and the drift watch read.  Off = the run
+    # dir keeps only point-in-time snapshots.
+    "observability.tsdb": True,
+    # Scrape period (jittered ±20% so a fleet never thunders in
+    # phase); flush_worker_observability always appends one more.
+    "observability.tsdb_interval_s": 10.0,
+    # Ring retention: oldest closed segments are deleted past either
+    # bound (bytes across the segment dir / age of the segment).
+    "observability.tsdb_retention_mb": 64,
+    "observability.tsdb_retention_age_s": 86400.0,
     # Serving readiness (/healthz -> 503): input-stream backlog above
     # which the worker reports not-ready (0 = disabled) and the error
     # fraction over the most recent records (0 = disabled).

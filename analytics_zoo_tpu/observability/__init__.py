@@ -80,6 +80,29 @@ from analytics_zoo_tpu.observability.collectives import (
     estimate_train_step_collectives,
     record_step_collectives,
 )
+from analytics_zoo_tpu.observability.tsdb import (
+    SeriesStore,
+    TsdbSampler,
+    TsdbWriter,
+    flush_active_tsdb,
+    get_active_tsdb,
+    init_tsdb,
+    reset_tsdb,
+)
+from analytics_zoo_tpu.observability.slo import (
+    BurnWindow,
+    SloEngine,
+    SloObjective,
+    SloStatus,
+    evaluate_timeline,
+    load_slo_yaml,
+    parse_slo_specs,
+)
+from analytics_zoo_tpu.observability.drift import (
+    DriftDetector,
+    DriftWatch,
+    drift_report,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
@@ -123,4 +146,21 @@ __all__ = [
     "reset_request_log",
     "estimate_train_step_collectives",
     "record_step_collectives",
+    "SeriesStore",
+    "TsdbSampler",
+    "TsdbWriter",
+    "flush_active_tsdb",
+    "get_active_tsdb",
+    "init_tsdb",
+    "reset_tsdb",
+    "BurnWindow",
+    "SloEngine",
+    "SloObjective",
+    "SloStatus",
+    "evaluate_timeline",
+    "load_slo_yaml",
+    "parse_slo_specs",
+    "DriftDetector",
+    "DriftWatch",
+    "drift_report",
 ]

@@ -800,6 +800,27 @@ def init_worker_observability(run_dir: Optional[str] = None,
         with open(os.path.join(wdir, META_FILE), "w") as f:
             json.dump(meta, f, indent=2)
 
+        # the historical layer (ISSUE 18): a tsdb writer + jittered
+        # background sampler in this worker's slot, so burn-rate /
+        # drift questions have data the moment the run dir exists
+        try:
+            from analytics_zoo_tpu.common.config import get_config
+            from analytics_zoo_tpu.observability import tsdb as _tsdb
+            cfg = get_config()
+            if bool(cfg.get("observability.tsdb", True)):
+                _tsdb.init_tsdb(
+                    os.path.join(wdir, _tsdb.TSDB_DIRNAME),
+                    interval_s=float(
+                        cfg.get("observability.tsdb_interval_s", 10.0)),
+                    retention_bytes=int(float(cfg.get(
+                        "observability.tsdb_retention_mb", 64))
+                        * 1024 * 1024),
+                    retention_age_s=float(cfg.get(
+                        "observability.tsdb_retention_age_s", 86400.0)),
+                    registry=registry)
+        except Exception:
+            log.exception("worker tsdb bring-up failed")
+
         _worker_state.update({"dir": wdir, "meta": meta,
                               "server": server, "run_dir": run_dir})
     if register_atexit:
@@ -833,6 +854,12 @@ def flush_worker_observability() -> Optional[str]:
         get_request_log().export(os.path.join(wdir, REQUESTS_FILE))
     except Exception:
         log.exception("worker request-log flush failed")
+    try:
+        from analytics_zoo_tpu.observability.tsdb import \
+            flush_active_tsdb
+        flush_active_tsdb()   # the run dir ends on a fresh sample
+    except Exception:
+        log.exception("worker tsdb flush failed")
     return wdir
 
 
@@ -846,3 +873,8 @@ def reset_worker_observability() -> None:
             except Exception:
                 pass
         _worker_state.clear()
+    try:
+        from analytics_zoo_tpu.observability.tsdb import reset_tsdb
+        reset_tsdb()
+    except Exception:
+        pass

@@ -8,6 +8,10 @@ Routes:
     /metrics.json     registry snapshot as JSON
     /requests.json    the request log's kept timelines (tail-sampled
                       per-request station waterfalls, newest last)
+    /tsdb.json        the embedded time-series store's recent samples
+                      (``?selector=name{label="v"}``, ``?start=``/
+                      ``?end=`` unix seconds filter the answer) — the
+                      live query face of ``observability/tsdb.py``
     /metrics/cluster  federated CLUSTER view (host 0 of a multi-host
                       run, when a ClusterAggregator is attached):
                       counters summed across hosts, histograms merged,
@@ -38,6 +42,7 @@ import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import unquote as _unquote
 
 from analytics_zoo_tpu.observability.metrics import (
     MetricsRegistry, get_registry)
@@ -77,6 +82,36 @@ class _Handler(BaseHTTPRequestHandler):
                 body = json.dumps(
                     get_request_log().snapshot()).encode()
                 self._respond(body, "application/json")
+            elif path == "/tsdb.json":
+                from analytics_zoo_tpu.observability import tsdb
+                writer = tsdb.get_active_tsdb()
+                if writer is None:
+                    self._respond(
+                        b"no tsdb writer active (init_worker_"
+                        b"observability starts one inside a run dir)",
+                        "text/plain", 404)
+                else:
+                    params = {}
+                    for part in query.split("&"):
+                        k, _, v = part.partition("=")
+                        if v:
+                            params[k] = _unquote(v)
+                    store = tsdb.SeriesStore.from_writer(writer)
+                    t0, t1 = store.time_range()
+                    start = float(params.get("start") or t0 or 0.0)
+                    end = float(params.get("end") or t1 or 0.0)
+                    sel = params.get("selector")
+                    doc = {"start": start, "end": end,
+                           "samples": len(store.samples)}
+                    if sel:
+                        doc["series"] = store.query(sel, start, end)
+                    else:
+                        # no selector: index answer — the keys
+                        # present, not every point
+                        doc["counter_keys"] = store.counter_keys("")
+                        doc["gauge_keys"] = store.gauge_keys("")
+                    body = json.dumps(doc).encode()
+                    self._respond(body, "application/json")
             elif path in ("/metrics/cluster", "/metrics/cluster.json"):
                 agg = getattr(self.server, "aggregator", None)
                 if agg is None:

@@ -102,6 +102,7 @@ class TrainingWatchdog:
         self._nonfinite_seen = 0
         self._diverged_fired = False
         self._stall_fired = False
+        self._drift_fired: set = set()   # series currently in episode
         self._last_beat = clock()
         self._halted = False
         self._stop = threading.Event()
@@ -191,6 +192,28 @@ class TrainingWatchdog:
             self._since_improve = 0       # re-arm: one event per window
             self._push("plateau", best=self._best, window=self.window,
                        min_delta=self.min_delta)
+
+    def observe_drift(self, series: str, score: float) -> None:
+        """Advisory drift signal from ``observability/drift.py``: a
+        normalized score (1.0 = at the detector's z-threshold) for a
+        watched metric series.  Like plateau/stall, drift never halts
+        — a distribution shift is a reason to LOOK at a run, not to
+        kill it — but it rides the same issue queue and
+        ``watchdog_events_total{kind="drift"}`` counter so the driver
+        loop and obs_report surface it next to loss-health events.
+        One event per episode: re-arms when the series drops back
+        under threshold."""
+        try:
+            score = float(score)
+        except (TypeError, ValueError):
+            return
+        if score < 1.0:
+            self._drift_fired.discard(series)
+            return
+        if series in self._drift_fired:
+            return
+        self._drift_fired.add(series)
+        self._push("drift", series=series, score=round(score, 3))
 
     # ---------------------------------------------------- stall monitor
     def check_stall(self) -> bool:

@@ -21,4 +21,4 @@ from analytics_zoo_tpu.serving.loadgen.scenarios import (  # noqa: F401
 from analytics_zoo_tpu.serving.loadgen.verdict import (  # noqa: F401
     CheckResult, SloSpec, Verdict, capacity_report, evaluate,
     fleet_snapshot, pending_count, read_dead_letters,
-    report_document, write_report)
+    report_document, run_series_store, write_report)

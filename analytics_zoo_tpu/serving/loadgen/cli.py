@@ -69,6 +69,29 @@ def _self_contained_worker(args):
     return serving, broker, t
 
 
+def _write_slo_report(path, run, scenario, verdict) -> None:
+    """slo_report.json: the declarative objectives, their verdict
+    checks, and the full replayed burn/budget timeline — what the CI
+    storm stage archives beside capacity_report.json and
+    ``obs_report --slo`` renders."""
+    import json
+    from analytics_zoo_tpu.observability.slo import evaluate_timeline
+    from analytics_zoo_tpu.serving.loadgen.verdict import \
+        run_series_store
+    store = run_series_store(run)
+    timeline = evaluate_timeline(store, scenario.objectives)
+    doc = {
+        "kind": "zoo_slo_report",
+        "scenario": scenario.name,
+        "objectives": [o.name for o in scenario.objectives],
+        "checks": [c.to_dict() for c in verdict.checks
+                   if c.name.startswith("slo:")],
+        "timeline": [[s.to_dict() for s in row] for row in timeline],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2)
+
+
 def main(argv=None) -> int:
     from analytics_zoo_tpu.serving.loadgen import (
         SCENARIOS, evaluate, read_dead_letters, report_document,
@@ -117,6 +140,18 @@ def main(argv=None) -> int:
     ap.add_argument("--p99-ms", type=float, default=None,
                     help="override the scenario's p99-from-scheduled "
                          "SLO bound")
+    ap.add_argument("--slo-spec", default=None,
+                    help="YAML file of declarative SLO objectives "
+                         "(slo.yaml); each becomes an slo:<name> "
+                         "verdict check evaluated over the recorded "
+                         "run with the production burn-rate math")
+    ap.add_argument("--slo-scale", type=float, default=None,
+                    help="scale every --slo-spec time window by this "
+                         "factor (compressed storms reuse production "
+                         "specs; default: the --compress factor)")
+    ap.add_argument("--slo-out", default=None,
+                    help="write the evaluated SLO statuses + burn "
+                         "timeline JSON here (slo_report.json)")
     ap.add_argument("--seed", type=int, default=None)
     args = ap.parse_args(argv)
 
@@ -133,6 +168,16 @@ def main(argv=None) -> int:
     if args.p99_ms is not None:
         scenario.slo.p99_from_scheduled_ms = float(args.p99_ms)
     scenario.slo.request_deadline_ms = float(args.deadline_ms)
+    if args.slo_spec:
+        from analytics_zoo_tpu.observability.slo import load_slo_yaml
+        scale = (args.slo_scale if args.slo_scale is not None
+                 else args.compress)
+        scenario.objectives = [
+            obj.scaled(scale) if scale != 1.0 else obj
+            for obj in load_slo_yaml(args.slo_spec)]
+        print(f"zoo-loadtest: {len(scenario.objectives)} SLO "
+              f"objective(s) from {args.slo_spec} "
+              f"(windows scaled x{scale:g})", flush=True)
 
     serving = worker_thread = None
     external = args.redis_url or args.http_url
@@ -180,8 +225,12 @@ def main(argv=None) -> int:
             pass
         verdict = evaluate(run, scenario.slo, dead_letters=dead,
                            pending=pending,
-                           burst_start_offset_s=burst)
+                           burst_start_offset_s=burst,
+                           objectives=scenario.objectives)
         print(verdict.render(), flush=True)
+        if args.slo_out:
+            _write_slo_report(args.slo_out, run, scenario, verdict)
+            print(f"slo report written to {args.slo_out}", flush=True)
         cap = verdict.capacity or {}
         if cap.get("rps_per_replica_at_slo"):
             print(f"capacity: {cap['rps_per_replica_at_slo']:.1f} "

@@ -115,18 +115,27 @@ class PinnedRequest:
 
 
 class Scenario:
-    """Phases + pins + events + the SLO this scenario must meet."""
+    """Phases + pins + events + the SLO this scenario must meet.
+
+    ``objectives`` are declarative :class:`~analytics_zoo_tpu
+    .observability.slo.SloObjective` specs (or YAML entries loadable
+    via ``--slo-spec``): the verdict evaluates each over the run's
+    recorded window with the production burn-rate math and emits one
+    ``slo:<name>`` check per objective.  Default scenarios declare
+    none — a spec is an opt-in claim, not a free pass."""
 
     def __init__(self, name: str, phases: Sequence[Phase],
                  events: Sequence[ScenarioEvent] = (),
                  pins: Sequence[PinnedRequest] = (),
-                 seed: int = 0, slo: Optional[SloSpec] = None):
+                 seed: int = 0, slo: Optional[SloSpec] = None,
+                 objectives: Sequence[Any] = ()):
         self.name = name
         self.phases = list(phases)
         self.events = sorted(events, key=lambda e: e.at_s)
         self.pins = list(pins)
         self.seed = int(seed)
         self.slo = slo or SloSpec()
+        self.objectives = list(objectives)
 
     # ------------------------------------------------------------- geometry
     def duration_s(self, compress: float = 1.0) -> float:

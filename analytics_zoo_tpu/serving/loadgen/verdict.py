@@ -371,6 +371,138 @@ def _check_autoscaler(run: LoadgenRun, slo: SloSpec,
     return out
 
 
+# ------------------------------------------------- declarative SLO specs
+#: latency bucket bounds (seconds) for the synthesized run histogram —
+#: a latency_quantile objective's threshold should sit on one of these
+RUN_SERIES_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def run_series_store(run: LoadgenRun, *, max_samples: int = 240):
+    """Synthesize an ``observability.tsdb.SeriesStore`` from the
+    loadgen log, so declarative :class:`~analytics_zoo_tpu
+    .observability.slo.SloObjective` specs evaluate against the run
+    with the SAME burn-rate math production uses.
+
+    Series (well-formed ``kind=="ok"`` traffic only — hostile traffic
+    is EXPECTED to error and must not burn the availability budget):
+
+    * ``loadgen_requests_total`` / ``loadgen_requests_bad_total``
+      (bad = ANY non-ok outcome, the client's view) /
+      ``loadgen_requests_error_total`` (broken responses only —
+      deadline-justified sheds are admission control doing its job
+      and have their own verdict check, so production specs usually
+      burn availability on errors and let the latency objective
+      carry the pain sheds trade away);
+    * ``loadgen_latency_seconds_count`` / ``_sum`` /
+      ``_bucket{le=...}`` over :data:`RUN_SERIES_BUCKETS`, from the
+      scheduled basis (coordinated-omission-safe, same as the p99
+      check).
+
+    Completions are bucketed onto a bounded time grid (cumulative
+    counters, one sample per grid point, a leading zero sample so the
+    first window has a baseline)."""
+    from analytics_zoo_tpu.observability.tsdb import (
+        SeriesStore, format_series_key)
+    events = []     # (wall_done, bad, error, latency_s)
+    for r in run.records:
+        if r.spec.kind != "ok":
+            continue
+        mono = r.done if r.done is not None else run.started_monotonic \
+            + r.spec.offset_s
+        bad = r.status != "ok"
+        err = r.status not in ("ok", "shed")
+        events.append((run.wall_of(mono), bad, err,
+                       r.latency_from_scheduled_s))
+    events.sort()
+    t_start = run.wall_of(run.started_monotonic)
+    t_end = max([t for (t, _b, _e, _l) in events] + [t_start + 1e-3])
+    grid = max((t_end - t_start) / max_samples, 1e-3)
+    total = bad_n = err_n = lat_count = 0
+    lat_sum = 0.0
+    bucket_counts = [0] * len(RUN_SERIES_BUCKETS)
+
+    def counters() -> Dict[str, float]:
+        c = {"loadgen_requests_total": float(total),
+             "loadgen_requests_bad_total": float(bad_n),
+             "loadgen_requests_error_total": float(err_n),
+             "loadgen_latency_seconds_count": float(lat_count),
+             "loadgen_latency_seconds_sum": lat_sum}
+        for le, n in zip(RUN_SERIES_BUCKETS, bucket_counts):
+            c[format_series_key("loadgen_latency_seconds_bucket",
+                                {"le": f"{le:g}"})] = float(n)
+        c[format_series_key("loadgen_latency_seconds_bucket",
+                            {"le": "+Inf"})] = float(lat_count)
+        return c
+
+    samples = [{"t": t_start, "counters": counters(), "gauges": {}}]
+    cursor = t_start + grid
+    for (t, bad, err, lat) in events:
+        while t > cursor:
+            samples.append({"t": cursor, "counters": counters(),
+                            "gauges": {}})
+            cursor += grid
+        total += 1
+        if bad:
+            bad_n += 1
+        if err:
+            err_n += 1
+        if lat is not None:
+            lat_count += 1
+            lat_sum += lat
+            for i, le in enumerate(RUN_SERIES_BUCKETS):
+                if lat <= le:
+                    bucket_counts[i] += 1
+    samples.append({"t": max(t_end, cursor), "counters": counters(),
+                    "gauges": {}})
+    return SeriesStore(samples)
+
+
+def _check_slo_objectives(run: LoadgenRun, objectives: Sequence
+                          ) -> List[CheckResult]:
+    """One ``slo:<name>`` check per declared objective: the run's
+    recorded window must not exhaust the objective's error budget.
+    Violating requests are cited by trace_id (PR 16 forensic handles,
+    same contract as the p99 check)."""
+    if not objectives:
+        return []
+    from analytics_zoo_tpu.observability.slo import SloEngine
+    store = run_series_store(run)
+    _t0, t1 = store.time_range()
+    engine = SloEngine(list(objectives), registry=None)
+    statuses = engine.evaluate(store, now=t1)
+    bad_ids = tuple(r.trace_id for r in run.records
+                    if r.spec.kind == "ok" and r.status != "ok")[:5]
+    err_ids = tuple(r.trace_id for r in run.records
+                    if r.spec.kind == "ok"
+                    and r.status not in ("ok", "shed"))[:5]
+    slow_ids = tuple(
+        t for (_lat, t) in sorted(
+            ((r.latency_from_scheduled_s, r.trace_id)
+             for r in run.records
+             if r.latency_from_scheduled_s is not None),
+            reverse=True))[:5]
+    by_name = {o.name: o for o in objectives}
+    out = []
+    for st in statuses:
+        ok = st.budget_remaining > 0.0
+        obj = by_name.get(st.name)
+        # cite the requests this OBJECTIVE counts as bad: sheds are
+        # not violations of an errors-only availability spec
+        errors_only = (obj is not None and
+                       obj.bad == "loadgen_requests_error_total")
+        ids = () if ok else (
+            slow_ids if st.detail == "latency_quantile"
+            else err_ids if errors_only else bad_ids)
+        out.append(CheckResult(
+            f"slo:{st.slo_key}", ok,
+            f"bad_fraction {st.bad_fraction:.2%} vs target "
+            f"{st.target:.2%} -> budget_remaining "
+            f"{st.budget_remaining:.2f}, alert={st.alert}"
+            + (f"; violating trace_ids {list(ids)}" if ids else ""),
+            trace_ids=ids))
+    return out
+
+
 # ----------------------------------------------------------- capacity fit
 def capacity_report(run: LoadgenRun, *, target_p99_ms: float,
                     trajectory: Optional[Sequence[Tuple]] = None,
@@ -445,12 +577,16 @@ def evaluate(run: LoadgenRun, slo: SloSpec, *,
              dead_letters: Sequence[Dict] = (),
              pending: int = 0,
              burst_start_offset_s: Optional[float] = None,
+             objectives: Sequence = (),
              trajectory_for_capacity: Optional[Sequence[Tuple]]
              = None) -> Verdict:
     """Compute the full verdict.  ``pending`` is the broker's
     remaining PEL depth after the run settled (exactly-once evidence
     the client log alone cannot provide); ``burst_start_offset_s``
-    anchors the autoscaler lag bound on the scenario's burst phase."""
+    anchors the autoscaler lag bound on the scenario's burst phase;
+    ``objectives`` are declarative SLO specs (scenario-declared or
+    ``--slo-spec``-loaded) evaluated over the recorded window with
+    the production burn-rate math."""
     poison_scheduled = sum(1 for r in run.records
                            if r.spec.kind == "poison")
     checks = [
@@ -463,6 +599,7 @@ def evaluate(run: LoadgenRun, slo: SloSpec, *,
     ]
     checks.extend(_check_autoscaler(run, slo, fleet,
                                     burst_start_offset_s))
+    checks.extend(_check_slo_objectives(run, objectives))
     target = slo.target_capacity_p99_ms or slo.p99_from_scheduled_ms
     capacity = capacity_report(
         run, target_p99_ms=target,

@@ -187,6 +187,7 @@ class ServingSupervisor:
                  scale_down_idle_s: Optional[float] = None,
                  scale_cooldown_s: Optional[float] = None,
                  autoscale_interval_s: float = 1.0,
+                 slo_signal: Optional[Callable[[], Dict]] = None,
                  clock: Optional[Callable[[], float]] = None):
         if retry_times is None:
             retry_times = int(get_config().get(
@@ -246,6 +247,14 @@ class ServingSupervisor:
         self.scale_down_idle_s = float(scale_down_idle_s)
         self.scale_cooldown_s = float(scale_cooldown_s)
         self.autoscale_interval_s = float(autoscale_interval_s)
+        # SLO feed (observability/slo.py): a callable returning the
+        # latest {"alert": "ok"|"warn"|"page", "budget_remaining":
+        # float} for the serving SLO.  A paging burn rate is a
+        # scale-up pressure source in its own right (the budget is
+        # burning faster than queue depth alone admits), and an
+        # exhausted error budget HOLDS scale-down — retiring capacity
+        # while the budget is spent converts a warn into an outage.
+        self._slo_signal = slo_signal
         # hysteresis state: when each condition STARTED holding
         self._pressure_since: Optional[float] = None
         self._idle_since: Optional[float] = None
@@ -288,6 +297,10 @@ class ServingSupervisor:
         self._m_scale = reg.counter(
             "serving_scale_events_total",
             "autoscaler scale decisions", labels=("direction",))
+        self._m_slo_hold = reg.counter(
+            "serving_slo_hold_total",
+            "autoscaler decisions vetoed by the SLO signal, by kind",
+            labels=("kind",))
         self._record_fleet_size("initial")
 
     # -------------------------------------------------------------- control
@@ -544,6 +557,18 @@ class ServingSupervisor:
         return bool(live) and all(r.last_health == "ok"
                                   for r in live)
 
+    def _read_slo_signal(self) -> Dict:
+        """The latest SLO verdict from the injected feed, or {} when
+        no feed is wired / the feed raises (a broken SLO evaluator
+        must never take down the autoscaler with it)."""
+        if self._slo_signal is None:
+            return {}
+        try:
+            return dict(self._slo_signal() or {})
+        except Exception:   # noqa: BLE001 — advisory signal
+            log.exception("autoscaler: slo_signal raised; ignoring")
+            return {}
+
     def _autoscale(self, now: float) -> None:
         if not self.autoscale or self._stop.is_set():
             return
@@ -557,9 +582,19 @@ class ServingSupervisor:
             # blind window can never accumulate into a scale event
             self._pressure_since = self._idle_since = None
             return
-        pressure = sig["queue"] > self.scale_up_queue_depth or (
-            self.scale_up_latency_p50_ms > 0
-            and sig["p50_ms"] > self.scale_up_latency_p50_ms)
+        slo = self._read_slo_signal()
+        if slo:
+            # ride the scale-event record so forensics can see WHICH
+            # signal fired each decision
+            sig["slo_alert"] = str(slo.get("alert", "ok"))
+            if slo.get("budget_remaining") is not None:
+                sig["slo_budget_remaining"] = float(
+                    slo["budget_remaining"])
+        slo_page = sig.get("slo_alert") == "page"
+        pressure = slo_page \
+            or sig["queue"] > self.scale_up_queue_depth or (
+                self.scale_up_latency_p50_ms > 0
+                and sig["p50_ms"] > self.scale_up_latency_p50_ms)
         # idle keys on the live backlog alone: the fill gauge holds
         # the LAST batch's ratio, so a full final batch would read
         # stale-high forever and wedge scale-down.  Fill still rides
@@ -588,6 +623,18 @@ class ServingSupervisor:
         elif idle and size > self.min_replicas and not in_cooldown \
                 and now - self._idle_since >= self.scale_down_idle_s \
                 and self._scale_down_allowed():
+            budget = sig.get("slo_budget_remaining")
+            if budget is not None and budget <= 0:
+                # error budget exhausted: the queue may be empty only
+                # because users are being turned away — retiring
+                # capacity now bakes the outage in.  Hold until the
+                # budget recovers above zero.
+                self._m_slo_hold.labels("scale_down").inc()
+                log.warning(
+                    "autoscaler: scale-down held — SLO error budget "
+                    "exhausted (remaining=%.3f, alert=%s)", budget,
+                    sig.get("slo_alert", "?"))
+                return
             self._scale_down(now, sig)
 
     def _scale_up(self, now: float, sig: Dict) -> None:

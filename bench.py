@@ -946,6 +946,22 @@ def bench_serving_storm(compress: float = 0.6,
                               kwargs={"poll_ms": 5}, daemon=True)
     worker.start()
 
+    # ISSUE 18: the embedded TSDB sampler rides the storm, scraping
+    # the live registry on a tight interval while the worker is under
+    # load — its p50 scrape cost over the interval is the telemetry
+    # tax every production worker pays, self-gated at 2% by --compare
+    import shutil
+    import tempfile
+
+    from analytics_zoo_tpu.observability import get_registry
+    from analytics_zoo_tpu.observability.tsdb import (
+        TsdbSampler, TsdbWriter)
+    tsdb_root = tempfile.mkdtemp(prefix="bench-tsdb-")
+    tsdb_interval_s = 0.25
+    tsdb_writer = TsdbWriter(os.path.join(tsdb_root, "host-0", "tsdb"))
+    tsdb_sampler = TsdbSampler(tsdb_writer, interval_s=tsdb_interval_s,
+                               registry=get_registry()).start()
+
     from analytics_zoo_tpu.serving.loadgen import SloSpec
     # pass/fail bound loose (the bench runs on whatever chip/CPU the
     # driver has; a saturated ramp is DATA here, not a failure) while
@@ -973,6 +989,43 @@ def bench_serving_storm(compress: float = 0.6,
                        pending=pending_count(broker, group="storm"))
     serving.stop()
     worker.join(timeout=15)
+    tsdb_sampler.stop()
+    tsdb_scrapes = len(tsdb_sampler._scrape_costs)
+    tsdb_overhead = tsdb_sampler.overhead_p50() / tsdb_interval_s
+    tsdb_writer.close()
+    shutil.rmtree(tsdb_root, ignore_errors=True)
+
+    # the checked-in production SLO specs (slo.yaml), windows scaled
+    # onto the storm's wall clock, evaluated over the recorded run
+    # with the burn-rate engine — all slo_* fields are NEW names so
+    # --compare against a pre-SLO baseline can never false-regress
+    slo_fields = {}
+    try:
+        from analytics_zoo_tpu.observability.slo import (
+            SloEngine, load_slo_yaml)
+        from analytics_zoo_tpu.serving.loadgen import run_series_store
+        spec_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "slo.yaml")
+        objectives = [o.scaled(0.005) for o in load_slo_yaml(spec_path)]
+        store = run_series_store(run)
+        _t0, t1 = store.time_range()
+        statuses = SloEngine(objectives, registry=None).evaluate(
+            store, now=t1)
+        order = {lvl: i for i, lvl in
+                 enumerate(("ok", "warn", "page"))}
+        slo_fields = {
+            "slo_objectives": [s.slo_key for s in statuses],
+            "slo_worst_alert": max(
+                (s.alert for s in statuses),
+                key=lambda a: order.get(a, 0), default="ok"),
+            "slo_min_budget_remaining": round(
+                min((s.budget_remaining for s in statuses),
+                    default=1.0), 4),
+            "slo_checks_passed": all(
+                s.budget_remaining > 0.0 for s in statuses),
+        }
+    except Exception:  # noqa: BLE001 — SLO fields are informational
+        pass
 
     cap = verdict.capacity or {}
     counts = run.counts()
@@ -1001,6 +1054,10 @@ def bench_serving_storm(compress: float = 0.6,
         + counts.get("send_failed", 0),
         "storm_errors": counts.get("error", 0),
         "storm_shed": counts.get("shed", 0),
+        "tsdb_sampler_scrapes": tsdb_scrapes,
+        "tsdb_sampler_interval_s": tsdb_interval_s,
+        "tsdb_sampler_p50_overhead_fraction": round(tsdb_overhead, 5),
+        **slo_fields,
         "capacity_target_p99_ms": cap.get("target_p99_ms"),
         "capacity_replicas_for": cap.get("replicas_for", {}),
         "device": str(dev),
@@ -1715,6 +1772,7 @@ def _compare_against_baseline(baseline_path, threshold=0.10):
     current = {}
     cur_compile = {}
     cur_trace_overhead = {}
+    cur_tsdb_overhead = {}
     try:
         with open(ARTIFACT_PATH) as f:
             for r in json.load(f).get("results", []):
@@ -1725,6 +1783,11 @@ def _compare_against_baseline(baseline_path, threshold=0.10):
                               (int, float)):
                     cur_trace_overhead[r.get("metric")] = \
                         r["reqtrace_p50_overhead_fraction"]
+                if isinstance(
+                        r.get("tsdb_sampler_p50_overhead_fraction"),
+                        (int, float)):
+                    cur_tsdb_overhead[r.get("metric")] = \
+                        r["tsdb_sampler_p50_overhead_fraction"]
     except Exception:  # noqa: BLE001
         pass
     # compile-time changes are INFORMATIONAL, never a regression: a
@@ -1764,6 +1827,16 @@ def _compare_against_baseline(baseline_path, threshold=0.10):
             regressions.append({
                 "metric": metric + ":reqtrace_p50_overhead_fraction",
                 "baseline": 0.05, "current": round(frac, 4),
+                "change": round(frac, 4)})
+    # TSDB sampler self-gate (ISSUE 18), same shape: the storm bench
+    # measured the sampler's p50 scrape cost against its own interval
+    # in ONE run, so >2% steady-state telemetry tax is an absolute
+    # regression no baseline needs to witness
+    for metric, frac in sorted(cur_tsdb_overhead.items()):
+        if frac > 0.02:
+            regressions.append({
+                "metric": metric + ":tsdb_sampler_p50_overhead_fraction",
+                "baseline": 0.02, "current": round(frac, 4),
                 "change": round(frac, 4)})
     _emit({"compare": baseline_path, "threshold": threshold,
            "metrics_compared": compared, "regressions": regressions,

@@ -24,11 +24,17 @@ A tier-1 test runs this against a LIVE registry dump, so a bad metric
 name added anywhere in the codebase fails CI rather than surfacing as
 a Prometheus scrape error in production.
 
+``--tsdb DIR`` lints the embedded time-series store's segment files
+instead (schema header, monotonic timestamps, non-decreasing
+counters, series-key charsets) — ``check_static
+--metrics-args='--tsdb RUN_DIR'`` wires it into the static lane.
+
 Usage::
 
     python scripts/metrics_lint.py metrics.txt
     curl -s host:9090/metrics | python scripts/metrics_lint.py -
     python scripts/metrics_lint.py --url http://host:9090/metrics
+    python scripts/metrics_lint.py --tsdb /runs/exp7
 
 Exit code 1 when any issue is found.  Pure stdlib.
 """
@@ -220,6 +226,139 @@ def lint_exposition(text: str) -> List[str]:
     return issues
 
 
+# ------------------------------------------------------------- tsdb lint
+_SERIES_KEY_RE = re.compile(
+    r'^(?P<name>[^\s{]+)(?:\{(?P<labels>.*)\})?$')
+
+
+def _lint_series_key(key: str, where: str) -> List[str]:
+    m = _SERIES_KEY_RE.match(key)
+    if not m:
+        return [f"{where}: unparseable series key {key[:80]!r}"]
+    issues = []
+    if not METRIC_NAME_RE.match(m.group("name")):
+        issues.append(f"{where}: invalid metric name "
+                      f"{m.group('name')!r}")
+    for k, _v in _parse_labels(m.group("labels")):
+        if not LABEL_NAME_RE.match(k):
+            issues.append(f"{where}: invalid label name {k!r} on "
+                          f"{m.group('name')}")
+    return issues
+
+
+def _tsdb_roots(directory: str) -> List[str]:
+    """Accept a tsdb dir, a host-<k> slot, or a run dir with
+    ``host-*/tsdb`` (the same resolution ``tsdb.read_samples``
+    does)."""
+    import os
+    if os.path.isdir(os.path.join(directory, "tsdb")):
+        return [os.path.join(directory, "tsdb")]
+    if os.path.isdir(directory):
+        hosts = [os.path.join(directory, n, "tsdb")
+                 for n in sorted(os.listdir(directory))
+                 if n.startswith("host-")]
+        hosts = [h for h in hosts if os.path.isdir(h)]
+        return hosts if hosts else [directory]
+    return []
+
+
+def lint_tsdb(directory: str, schema: int = 1) -> List[str]:
+    """Lint the embedded TSDB's segment files (``seg-*.jsonl``):
+
+    * first parseable line must be a schema header with the expected
+      ``tsdb_schema`` version;
+    * sample timestamps non-decreasing within a segment;
+    * reconstructed absolute counters non-decreasing (a reset is only
+      legal on a ``full`` sample — a negative delta is corruption);
+    * counter/gauge series keys within the Prometheus charsets;
+    * unparseable NON-final lines flagged (a torn final line is the
+      crash-safety contract working as designed and is allowed).
+    """
+    import json as _json
+    import os
+    issues: List[str] = []
+    roots = _tsdb_roots(directory)
+    if not roots:
+        return [f"{directory}: no tsdb directory found"]
+    seen_segments = 0
+    for root in roots:
+        try:
+            segs = sorted(n for n in os.listdir(root)
+                          if n.startswith("seg-")
+                          and n.endswith(".jsonl"))
+        except OSError as e:
+            issues.append(f"{root}: unreadable ({e})")
+            continue
+        for seg in segs:
+            seen_segments += 1
+            path = os.path.join(root, seg)
+            with open(path) as f:
+                lines = f.read().splitlines()
+            header_seen = False
+            last_t = None
+            abs_counters: Dict[str, float] = {}
+            have_base = False
+            checked_keys = set()
+            for i, line in enumerate(lines, 1):
+                where = f"{path}:{i}"
+                try:
+                    rec = _json.loads(line)
+                except ValueError:
+                    if i == len(lines):
+                        continue    # torn tail: allowed by design
+                    issues.append(f"{where}: unparseable non-final "
+                                  f"line")
+                    continue
+                if not header_seen:
+                    if rec.get("tsdb_schema") != schema:
+                        issues.append(
+                            f"{where}: first record is not a "
+                            f"tsdb_schema={schema} header "
+                            f"(got {rec.get('tsdb_schema')!r})")
+                    header_seen = True
+                    if "tsdb_schema" in rec:
+                        continue
+                if "tsdb_schema" in rec:
+                    issues.append(f"{where}: duplicate schema header")
+                    continue
+                t = rec.get("t")
+                if not isinstance(t, (int, float)):
+                    issues.append(f"{where}: sample without a "
+                                  f"numeric 't'")
+                    continue
+                if last_t is not None and t < last_t:
+                    issues.append(
+                        f"{where}: timestamp {t} < previous {last_t} "
+                        f"(non-monotonic within segment)")
+                last_t = t
+                full = bool(rec.get("full"))
+                for key, v in (rec.get("c") or {}).items():
+                    if key not in checked_keys:
+                        checked_keys.add(key)
+                        issues.extend(_lint_series_key(key, where))
+                    if full:
+                        abs_counters[key] = float(v)
+                    elif have_base:
+                        if float(v) < 0:
+                            issues.append(
+                                f"{where}: negative counter delta "
+                                f"{v} for {key} outside a full "
+                                f"sample")
+                        abs_counters[key] = abs_counters.get(
+                            key, 0.0) + float(v)
+                if full:
+                    have_base = True
+                for key in (rec.get("g") or {}):
+                    if key not in checked_keys:
+                        checked_keys.add(key)
+                        issues.extend(_lint_series_key(key, where))
+            if lines and not header_seen:
+                issues.append(f"{path}: no parseable records")
+    if not seen_segments:
+        issues.append(f"{directory}: no tsdb segments found")
+    return issues
+
+
 def lint_registry(registry) -> List[str]:
     """Lint a live ``MetricsRegistry`` (what the tier-1 test calls).
     The exemplar-enabled exposition is a strict superset of the plain
@@ -241,7 +380,25 @@ def main(argv=None) -> int:
                     help="exposition file, or '-' for stdin")
     ap.add_argument("--url", default=None,
                     help="scrape this /metrics URL instead of a file")
+    ap.add_argument("--tsdb", metavar="DIR", default=None,
+                    help="lint an embedded-TSDB directory (a run "
+                         "dir's host-<k>/tsdb segment files) instead "
+                         "of an exposition: schema header, monotonic "
+                         "timestamps, non-decreasing counters, "
+                         "series-key charsets; wire through "
+                         "check_static with "
+                         "--metrics-args='--tsdb RUN_DIR'")
     args = ap.parse_args(argv)
+
+    if args.tsdb:
+        issues = lint_tsdb(args.tsdb)
+        for issue in issues:
+            print(issue)
+        if issues:
+            print(f"{len(issues)} issue(s)")
+            return 1
+        print("clean")
+        return 0
 
     if args.url:
         with urllib.request.urlopen(args.url, timeout=5.0) as resp:

@@ -464,6 +464,160 @@ def render_requests_report(label: str, doc: Dict,
     return "\n".join(lines)
 
 
+# ----------------------------------------------------------------- SLO
+def _load_obs_module(name: str):
+    """Load ``observability/<name>.py`` by FILE PATH (tsdb/slo/drift
+    are stdlib-only by contract) — the same jax-free trick as the
+    aggregator loader."""
+    import importlib.util
+    modname = f"_zoo_{name}"
+    if modname in sys.modules:
+        return sys.modules[modname]
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "analytics_zoo_tpu", "observability", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _spark(values: List[float], width: int = 40) -> str:
+    """A one-line ASCII timeline: 8-level bars, newest right."""
+    if not values:
+        return ""
+    if len(values) > width:
+        step = len(values) / width
+        values = [values[int(i * step)] for i in range(width)]
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    bars = " .:-=+*#@"
+    return "".join(
+        bars[int((v - lo) / span * (len(bars) - 1))] for v in values)
+
+
+def _find_slo_spec(target: str, explicit: Optional[str]) -> Optional[str]:
+    """--slo-spec wins; else slo.yaml beside the run dir, else the
+    repo's checked-in slo.yaml."""
+    if explicit:
+        return explicit
+    candidates = [os.path.join(target, "slo.yaml")] \
+        if os.path.isdir(target) else []
+    candidates.append(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "slo.yaml"))
+    for c in candidates:
+        if os.path.isfile(c):
+            return c
+    return None
+
+
+def render_slo_report(target: str,
+                      spec_path: Optional[str] = None) -> str:
+    """The ``--slo`` section: error-budget timelines, burn-rate
+    tables, alert transitions, and drift callouts — from a run dir's
+    tsdb segments (``host-<k>/tsdb/``) or a ``slo_report.json``
+    written by ``zoo-loadtest --slo-out``.  Entirely jax-free: tsdb/
+    slo/drift load by file path."""
+    # a slo_report.json document renders directly
+    if os.path.isfile(target):
+        with open(target) as f:
+            doc = json.load(f)
+        return _render_slo_doc(target, doc)
+    tsdb = _load_obs_module("tsdb")
+    slo = _load_obs_module("slo")
+    drift = _load_obs_module("drift")
+    store = tsdb.SeriesStore.from_run_dir(target)
+    lines = [f"== SLO report: {target} =="]
+    if not store.samples:
+        lines.append(
+            "no tsdb samples found (expected host-<k>/tsdb/seg-*."
+            "jsonl — is observability.tsdb on and the run flushed?)")
+        return "\n".join(lines)
+    t0, t1 = store.time_range()
+    lines.append(f"{len(store.samples)} sample(s) over "
+                 f"{t1 - t0:.1f}s; {len(store.counter_keys(''))} "
+                 f"counter / {len(store.gauge_keys(''))} gauge series")
+    spec = _find_slo_spec(target, spec_path)
+    if spec is None:
+        lines.append("no SLO spec (--slo-spec slo.yaml) — rendering "
+                     "drift only")
+        objectives = []
+    else:
+        objectives = slo.load_slo_yaml(spec)
+        lines.append(f"spec: {spec} ({len(objectives)} objective(s))")
+    if objectives:
+        engine = slo.SloEngine(objectives)
+        times = sorted({s["t"] for s in store.samples})
+        history: Dict[str, List] = {}
+        for t in times:
+            for st in engine.evaluate(store, now=t):
+                history.setdefault(st.slo_key, []).append(st)
+        for key in sorted(history):
+            sts = history[key]
+            last = sts[-1]
+            lines += ["", f"objective {key} [{last.detail}] "
+                      f"target {last.target:.2%}:"]
+            lines.append(
+                f"  now: alert={last.alert}  budget_remaining="
+                f"{last.budget_remaining:.2f}  bad_fraction="
+                f"{last.bad_fraction:.2%}")
+            rows = [[w, f"{b['long']:.2f}", f"{b['short']:.2f}"]
+                    for w, b in sorted(last.burn.items())]
+            lines.append(_table(rows, ["window", "burn(long)",
+                                       "burn(short)"]))
+            budgets = [s.budget_remaining for s in sts]
+            lines.append(f"  budget timeline [{min(budgets):.2f}.."
+                         f"{max(budgets):.2f}]: "
+                         f"{_spark(budgets)}")
+            trans = engine.transitions(last.name, last.group)
+            if trans:
+                lines.append("  transitions: " + "  ".join(
+                    f"+{t - t0:.1f}s->{lvl}" for t, lvl in trans))
+    callouts = drift.drift_report(store, [""])
+    drifting = [c for c in callouts if c["drifting"]]
+    lines += ["", f"drift: {len(drifting)} of {len(callouts)} "
+              f"series flagged (score >= 1.0 at peak)"]
+    for c in (drifting or callouts[:3]):
+        peak_off = (f"+{c['peak_at'] - t0:.1f}s"
+                    if c.get("peak_at") is not None else "-")
+        lines.append(
+            f"  {'DRIFT ' if c['drifting'] else ''}{c['series']}: "
+            f"peak {c['peak_score']:.2f} at {peak_off} "
+            f"(last {c['score']:.2f}, {c['points']} pts)")
+    return "\n".join(lines)
+
+
+def _render_slo_doc(label: str, doc: Dict) -> str:
+    """Render a ``zoo-loadtest --slo-out`` document."""
+    lines = [f"== SLO report: {label} "
+             f"(scenario {doc.get('scenario', '?')}) =="]
+    for c in doc.get("checks", []):
+        mark = "ok  " if c.get("passed") else "FAIL"
+        lines.append(f"  [{mark}] {c.get('name')}: {c.get('detail')}")
+    timeline = doc.get("timeline") or []
+    if timeline:
+        by_key: Dict[str, List[Dict]] = {}
+        for row in timeline:
+            for st in row:
+                key = st.get("name", "?")
+                if st.get("group"):
+                    key += f"/{st['group']}"
+                by_key.setdefault(key, []).append(st)
+        for key in sorted(by_key):
+            sts = by_key[key]
+            budgets = [s.get("budget_remaining", 0.0) for s in sts]
+            worst = max(sts, key=lambda s: {"ok": 0, "warn": 1,
+                                            "page": 2}.get(
+                                                s.get("alert"), 0))
+            lines.append(
+                f"  {key}: worst alert={worst.get('alert')}  budget "
+                f"[{min(budgets):.2f}..{max(budgets):.2f}] "
+                f"{_spark(budgets)}")
+    return "\n".join(lines)
+
+
 # ------------------------------------------------------------ multi-host
 def _load_aggregator_module():
     """Load observability/aggregator.py by FILE PATH (not package
@@ -757,12 +911,29 @@ def main(argv=None) -> int:
                          "the shard progress table, capacity/cost "
                          "report and per-host straggler callout from "
                          "the job ledger + merged host snapshots")
+    ap.add_argument("--slo", metavar="RUN_DIR_OR_FILE", default=None,
+                    help="render error-budget timelines, burn-rate "
+                         "tables and drift callouts from a run dir's "
+                         "tsdb segments (host-<k>/tsdb/), or a "
+                         "slo_report.json from zoo-loadtest --slo-out")
+    ap.add_argument("--slo-spec", metavar="SLO_YAML", default=None,
+                    help="--slo: SLO objective spec file (default: "
+                         "<run_dir>/slo.yaml, then the repo slo.yaml)")
     args = ap.parse_args(argv)
 
     if args.merge_hosts is None and args.snapshot is None \
-            and args.requests is None and args.job is None:
+            and args.requests is None and args.job is None \
+            and args.slo is None:
         ap.error("need a snapshot file, --merge-hosts RUN_DIR, "
-                 "--requests RUN_DIR, or --job RUN_DIR")
+                 "--requests RUN_DIR, --job RUN_DIR, or --slo "
+                 "RUN_DIR")
+
+    if args.slo:
+        print(render_slo_report(args.slo, args.slo_spec))
+        print()
+        if args.merge_hosts is None and args.snapshot is None \
+                and args.requests is None and args.job is None:
+            return 0
 
     if args.job:
         print(render_job_report(args.job))

@@ -482,3 +482,89 @@ class TestFleetAutoscaleAcceptance:
         assert summary["degraded"] == []
         assert summary["replica_trajectory"][0] == 1
         json.dumps(summary)          # the CLI prints this — JSON-safe
+
+
+class TestSloSignalAutoscaler:
+    """The SLO engine's verdict as an autoscaler input (ISSUE 18): a
+    page-level burn is scale-up pressure even with an empty queue, an
+    exhausted error budget holds scale-down (retiring capacity during
+    an outage bakes the outage in), and a broken evaluator is
+    advisory-only — it can never take the control loop down."""
+
+    def test_page_alert_is_scale_up_pressure(self):
+        clock = FakeClock()
+        signals = {"queue": 0.0, "fill": 0.0, "p50_ms": 0.0,
+                   "saw_metrics": True, "error_rate_hold": False}
+        slo = {"alert": "page", "budget_remaining": 0.4}
+        sup = _scripted_supervisor(signals, clock=clock,
+                                   slo_signal=lambda: dict(slo))
+        try:
+            _spawn_initial(sup)
+            # queue is EMPTY (sheds keep it drained during an outage)
+            # yet the burn-rate page scales the fleet up anyway
+            assert _tick_until(sup, clock,
+                               lambda: sup._fleet_size() == 2), \
+                sup.replica_trajectory
+            up = [e for e in sup.scale_events
+                  if e["direction"] == "up"]
+            assert up and up[0]["signals"]["slo_alert"] == "page"
+            assert up[0]["signals"]["slo_budget_remaining"] == \
+                pytest.approx(0.4)
+            # the page clears: pressure is gone, nothing else fires
+            # this window — and the empty queue now reads idle, so
+            # the fleet drains back down
+            slo["alert"] = "ok"
+            assert _tick_until(sup, clock,
+                               lambda: sup._fleet_size() == 1), \
+                sup.replica_trajectory
+        finally:
+            sup.drain_fleet()
+
+    def test_exhausted_budget_holds_scale_down_until_recovery(self):
+        clock = FakeClock()
+        signals = {"queue": 0.0, "fill": 0.0, "p50_ms": 0.0,
+                   "saw_metrics": True, "error_rate_hold": False}
+        slo = {"alert": "warn", "budget_remaining": -0.2}
+        sup = _scripted_supervisor(signals, clock=clock,
+                                   replicas=2, max_replicas=3,
+                                   slo_signal=lambda: dict(slo))
+        hold = sup._m_slo_hold.labels("scale_down")
+        held_before = hold.value
+        try:
+            _spawn_initial(sup)
+            # idle queue + exhausted budget: every would-be
+            # retirement is held and counted, the fleet stays put
+            for _ in range(30):
+                sup._tick()
+                clock.advance(0.05)
+            assert sup._fleet_size() == 2
+            assert hold.value > held_before
+            # budget back above zero: the SAME idle signal now drains
+            slo["budget_remaining"] = 0.1
+            assert _tick_until(sup, clock,
+                               lambda: sup._fleet_size() == 1), \
+                sup.replica_trajectory
+        finally:
+            sup.drain_fleet()
+
+    def test_broken_slo_feed_is_ignored(self):
+        clock = FakeClock()
+        signals = {"queue": 0.0, "fill": 0.0, "p50_ms": 0.0,
+                   "saw_metrics": True, "error_rate_hold": False}
+
+        def boom():
+            raise RuntimeError("slo evaluator fell over")
+        sup = _scripted_supervisor(signals, clock=clock, replicas=2,
+                                   slo_signal=boom)
+        try:
+            _spawn_initial(sup)
+            # the raising feed is swallowed: plain queue-idle
+            # mechanics still drive the fleet down
+            assert _tick_until(sup, clock,
+                               lambda: sup._fleet_size() == 1), \
+                sup.replica_trajectory
+            down = [e for e in sup.scale_events
+                    if e["direction"] == "down"]
+            assert down and "slo_alert" not in down[0]["signals"]
+        finally:
+            sup.drain_fleet()
