@@ -212,26 +212,29 @@ class _Evaluator:
                 bad = max(0.0, total - good)
             return min(1.0, max(0.0, bad / total))
         if obj.objective == "latency_quantile":
-            base = self._sel(obj.histogram)
-            total = self.store.increase(f"{base}_count", t0, t1)
+            # suffix the bare histogram name FIRST, then apply the
+            # group label: the stored keys are hist_count{endpoint=..}
+            total = self.store.increase(
+                self._sel(f"{obj.histogram}_count"), t0, t1)
             if total <= 0:
                 return 0.0
-            le = self._bucket_le(base)
+            le = self._bucket_le(self._sel(f"{obj.histogram}_bucket"))
             if le is None:       # threshold beyond the ladder
                 return 0.0
             good = self.store.increase(
-                _with_label(f"{base}_bucket", "le", le), t0, t1)
+                _with_label(self._sel(f"{obj.histogram}_bucket"),
+                            "le", le), t0, t1)
             return min(1.0, max(0.0, (total - good) / total))
         if obj.objective == "freshness":
             return self._staleness_fraction(t0, t1)
         raise ValueError(f"unknown objective kind: {obj.objective!r}")
 
-    def _bucket_le(self, base: str) -> Optional[str]:
+    def _bucket_le(self, bucket_sel: str) -> Optional[str]:
         """The smallest bucket bound >= threshold — requests at or
         under it are the 'good' events."""
         threshold_s = self.obj.threshold_ms / 1000.0
         best: Optional[float] = None
-        for key in self.store.counter_keys(f"{base}_bucket"):
+        for key in self.store.counter_keys(bucket_sel):
             _, labels = _parse_key(key)
             raw = labels.get("le", "")
             if raw in ("+Inf", "inf", ""):
@@ -314,8 +317,15 @@ class SloEngine:
             f"{obj.histogram}_count" if obj.histogram else None)
         if base is None:
             return [None]
+        keys = list(store.counter_keys(base))
+        # freshness objectives usually watch gauges (heartbeat/up
+        # series) — without this union group_by silently collapses
+        # to one ungrouped budget
+        gauge_keys = getattr(store, "gauge_keys", None)
+        if gauge_keys is not None:
+            keys.extend(gauge_keys(base))
         groups = set()
-        for key in store.counter_keys(base):
+        for key in keys:
             _, labels = _parse_key(key)
             if obj.group_by in labels:
                 groups.add(labels[obj.group_by])

@@ -427,9 +427,10 @@ class SeriesStore:
 
     def increase(self, selector: str, t0: float, t1: float) -> float:
         """Total counter increase over ``(t0, t1]`` across every
-        matching series, reset-aware: within one stream only positive
-        jumps count, so a process restart (absolute value drops to a
-        fresh base) contributes its post-restart growth instead of a
+        matching series, reset-aware: positive jumps count as deltas,
+        and a counter reset (absolute value drops below the previous
+        sample — a process restart) contributes its post-restart
+        absolute value, Prometheus ``increase`` style, instead of a
         bogus negative — budget accounting survives sampler gaps and
         restarts."""
         total = 0.0
@@ -445,6 +446,9 @@ class SeriesStore:
                     continue
                 if prev is not None and v > prev:
                     total += v - prev
+                elif prev is not None and v < prev:
+                    # counter reset: the fresh stream grew 0 -> v
+                    total += v
                 elif prev is None:
                     # first point inside the window of a stream that
                     # has no pre-window baseline: the segment's full

@@ -413,7 +413,10 @@ def run_series_store(run: LoadgenRun, *, max_samples: int = 240):
         err = r.status not in ("ok", "shed")
         events.append((run.wall_of(mono), bad, err,
                        r.latency_from_scheduled_s))
-    events.sort()
+    # timestamp only: the latency element can be None (never-completed
+    # requests), which full-tuple sort would compare on a (t, bad, err)
+    # tie and crash
+    events.sort(key=lambda e: e[0])
     t_start = run.wall_of(run.started_monotonic)
     t_end = max([t for (t, _b, _e, _l) in events] + [t_start + 1e-3])
     grid = max((t_end - t_start) / max_samples, 1e-3)

@@ -192,8 +192,9 @@ class TestTsdbRoundTrip:
         w.close()
 
     def test_increase_is_reset_aware_across_streams_and_gaps(self):
-        # one stream restarts mid-window (absolute value drops): only
-        # post-restart growth counts, never a negative
+        # one stream restarts mid-window (absolute value drops): the
+        # post-reset sample counts as 0 -> v growth (Prometheus
+        # increase), then deltas resume — never a negative
         store = SeriesStore([
             {"t": 0.0, "counters": {"c_total": 100.0}, "gauges": {}},
             {"t": 10.0, "counters": {"c_total": 200.0}, "gauges": {}},
@@ -202,7 +203,7 @@ class TestTsdbRoundTrip:
             {"t": 30.0, "counters": {"c_total": 50.0}, "gauges": {}},
         ])
         assert store.increase("c_total", 0.0, 30.0) == \
-            pytest.approx((200 - 100) + (50 - 5))
+            pytest.approx((200 - 100) + 5 + (50 - 5))
         # a sampler gap is just a wider delta, not lost growth
         gap = SeriesStore([
             {"t": 0.0, "counters": {"c_total": 0.0}, "gauges": {}},
@@ -481,6 +482,65 @@ class TestBurnRateMath:
         assert by_key["avail/a"].alert == "page"
         assert by_key["avail/b"].alert == "ok"
 
+    def test_group_by_latency_quantile_selects_suffixed_series(self):
+        # regression: the group label must wrap the SUFFIXED name
+        # (hist_count{endpoint=..}), not hist{endpoint=..}_count —
+        # the broken selector matched nothing and every grouped
+        # latency objective failed open (bad_fraction 0, alert ok)
+        obj = SloObjective(
+            name="lat", objective="latency_quantile", target=0.95,
+            threshold_ms=500.0, histogram="lat_seconds",
+            window_s=600.0, group_by="endpoint",
+            windows=[BurnWindow("page", 1.0, 600.0, 60.0)])
+
+        def counters(count, le50, endpoint):
+            return {
+                f'lat_seconds_count{{endpoint="{endpoint}"}}':
+                    float(count),
+                f'lat_seconds_bucket{{endpoint="{endpoint}",le="0.5"}}':
+                    float(le50),
+                f'lat_seconds_bucket{{endpoint="{endpoint}",le="+Inf"}}':
+                    float(count)}
+        samples = []
+        for t, slow_le50 in [(0.0, 0), (300.0, 0), (600.0, 0)]:
+            c = {}
+            n = int(t / 3)          # 0, 100, 200 requests per side
+            c.update(counters(n, slow_le50, "slow"))   # ALL over 500ms
+            c.update(counters(n, n, "fast"))           # all under
+            samples.append({"t": t, "counters": c, "gauges": {}})
+        sts = SloEngine([obj]).evaluate(SeriesStore(samples),
+                                        now=600.0)
+        by_key = {s.slo_key: s for s in sts}
+        assert set(by_key) == {"lat/fast", "lat/slow"}
+        assert by_key["lat/slow"].bad_fraction == pytest.approx(1.0)
+        assert by_key["lat/slow"].alert == "page"
+        assert by_key["lat/fast"].bad_fraction == 0.0
+        assert by_key["lat/fast"].alert == "ok"
+
+    def test_group_by_freshness_fans_out_over_gauges(self):
+        # regression: group discovery only scanned counter keys, so a
+        # gauge-backed freshness objective collapsed to one ungrouped
+        # budget and a single stale host could hide behind a live one
+        obj = SloObjective(
+            name="fresh", objective="freshness", target=0.5,
+            series="heartbeat", max_age_s=10.0, window_s=100.0,
+            group_by="host",
+            windows=[BurnWindow("page", 1.0, 100.0, 25.0)])
+        samples = []
+        for t in range(0, 101, 10):
+            gauges = {'heartbeat{host="live"}': 1.0}
+            if t <= 20:             # dies 80s before the evaluation
+                gauges['heartbeat{host="dead"}'] = 1.0
+            samples.append({"t": float(t), "counters": {},
+                            "gauges": gauges})
+        sts = SloEngine([obj]).evaluate(SeriesStore(samples),
+                                        now=100.0)
+        by_key = {s.slo_key: s for s in sts}
+        assert set(by_key) == {"fresh/live", "fresh/dead"}
+        assert by_key["fresh/live"].alert == "ok"
+        assert by_key["fresh/dead"].bad_fraction == pytest.approx(0.7)
+        assert by_key["fresh/dead"].alert == "page"
+
     def test_engine_publishes_gauges(self):
         reg = MetricsRegistry()
         obj = self._objective(target=0.5, window_s=21600.0,
@@ -719,6 +779,29 @@ class TestRunSeriesStore:
         assert store.increase(
             'loadgen_latency_seconds_bucket{le="0.05"}',
             t0 - 1, t1 + 1) == 2.0
+
+    def test_tied_never_completed_requests_do_not_crash(self):
+        # regression: two lost requests scheduled at the same offset
+        # tie on (t, bad, err) and full-tuple sort compared their
+        # None latencies -> TypeError, killing the whole verdict
+        from analytics_zoo_tpu.serving.loadgen.loadgen import (
+            LoadgenRun, RequestRecord, ScheduledRequest)
+        from analytics_zoo_tpu.serving.loadgen.verdict import \
+            run_series_store
+        recs = []
+        for i in range(2):
+            spec = ScheduledRequest(offset_s=1.0,
+                                    request_id=f"{i:032x}",
+                                    kind="ok")
+            recs.append(RequestRecord(spec=spec, scheduled=101.0,
+                                      done=None, status="lost"))
+        run = LoadgenRun(recs, started_monotonic=100.0,
+                         started_wall=1000.0,
+                         finished_monotonic=110.0)
+        store = run_series_store(run)
+        t0, t1 = store.time_range()
+        assert store.increase("loadgen_requests_bad_total",
+                              t0 - 1, t1 + 1) == 2.0
 
     def test_checked_in_specs_evaluate_over_a_run(self):
         from analytics_zoo_tpu.serving.loadgen.verdict import \
