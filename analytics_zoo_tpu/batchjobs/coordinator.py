@@ -76,6 +76,8 @@ class BatchCoordinator:
                  retry_times: int = 3, retry_window_s: float = 60.0,
                  backoff_base_s: float = 0.1,
                  backoff_max_s: float = 2.0):
+        from analytics_zoo_tpu.observability.flightrec import (
+            FlightRecorder)
         from analytics_zoo_tpu.parallel.launcher import ZooCluster
         from analytics_zoo_tpu.resilience.policy import RetryBudget
 
@@ -86,6 +88,11 @@ class BatchCoordinator:
         self.backoff_max_s = backoff_max_s
         self.restarts_total = 0
         self._deaths: List[Dict] = []
+        self._respawns: List[Dict] = []
+        # a PRIVATE recorder into the run-level events.jsonl: the
+        # process-wide slot belongs to workers (each journals into its
+        # own host-<k>/), the coordinator is the fleet's control plane
+        self._flightrec = FlightRecorder(run_dir, role="coordinator")
 
         # run-dir plumbing (host slots, ports, clock anchor,
         # cluster.json) + chaos env — reuse the launcher wholesale
@@ -153,12 +160,31 @@ class BatchCoordinator:
         self._deaths.append({"process_index": slot.index, "code": code,
                              "classification": cls})
         if not slot.budget.consume():
+            self._flightrec.record(
+                "fleet.degraded", component="batchjobs",
+                worker=slot.index, exit=cls,
+                reason="restart budget exhausted")
+            self._persist_respawns()
             raise _BudgetExhausted(slot, code, cls)
         self.restarts_total += 1
         delay = min(self.backoff_max_s,
                     self.backoff_base_s * (2 ** max(
                         0, slot.incarnation - 1)))
         slot.next_spawn_at = time.time() + delay
+        self._flightrec.record(
+            "worker.respawn", worker=slot.index, exit=cls, code=code,
+            incarnation=slot.incarnation, delay_s=round(delay, 3),
+            budget_left=slot.budget.remaining)
+        self._respawns.append({
+            "process_index": slot.index, "code": code,
+            "classification": cls, "incarnation": slot.incarnation,
+            "delay_s": round(delay, 3),
+            "budget_left": slot.budget.remaining,
+            "time_unix": round(time.time(), 3)})
+        # persisted AT DECISION TIME, not at job end: a coordinator
+        # that is itself killed later leaves the respawn ledger behind
+        # for zoo-doctor
+        self._persist_respawns()
         log.warning("batch worker %d died (%s); respawn in %.2fs "
                     "(%d budget left)", slot.index, cls, delay,
                     slot.budget.remaining)
@@ -259,6 +285,26 @@ class BatchCoordinator:
         self.stop()
         return [codes.get(i, -1) for i in range(self.num_workers)]
 
+    def _persist_respawns(self) -> None:
+        """Atomic snapshot of the death/respawn ledger
+        (``<run_dir>/job/respawns.json``) — one of zoo-doctor's join
+        inputs.  Best-effort: supervision never fails on forensics."""
+        import json
+        path = os.path.join(self.run_dir, "job", "respawns.json")
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump({
+                    "written_unix": round(time.time(), 3),
+                    "restarts_total": self.restarts_total,
+                    "deaths": self._deaths,
+                    "respawns": self._respawns,
+                }, f, indent=2, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            log.exception("could not persist respawns.json")
+
     def _write_degraded(self, record: Dict) -> None:
         import json
         path = os.path.join(self.run_dir, "degraded.json")
@@ -271,6 +317,10 @@ class BatchCoordinator:
         self.cluster.stop()
         for slot in self._slots:
             slot.proc = None
+        try:
+            self._flightrec.close()
+        except Exception:   # noqa: BLE001 — teardown best-effort
+            pass
 
 
 class _BudgetExhausted(Exception):

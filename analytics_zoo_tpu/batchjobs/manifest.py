@@ -53,6 +53,18 @@ class LeaseLost(RuntimeError):
     worker must abandon the shard (the thief recomputes it)."""
 
 
+def _flight(kind: str, **detail) -> None:
+    """Best-effort flight-recorder event.  Guarded lazy import: this
+    module's stdlib-only file-path-loadable contract (obs_report /
+    zoo-batch) must keep working with no package on sys.path."""
+    try:
+        from analytics_zoo_tpu.observability.flightrec import (
+            record_event)
+        record_event(kind, **detail)
+    except Exception:   # noqa: BLE001 — forensics never blocks leasing
+        pass
+
+
 def shard_lease_path(run_dir: str, shard_id: int) -> str:
     return os.path.join(
         _spec.job_dir(run_dir), _spec.LEASE_DIR, f"shard-{shard_id:05d}.json")
@@ -239,6 +251,7 @@ class LeaseClient:
             json.dump(doc, f)
             f.flush()
             os.fsync(f.fileno())
+        _flight("lease.claim", shard=shard_id, owner=self.owner)
         return True
 
     def _try_steal(self, shard_id: int, path: str) -> bool:
@@ -256,6 +269,10 @@ class LeaseClient:
         # replace; the victim's rows_done is the recompute debt.
         self._stolen_rows[shard_id] = int(held.get("rows_done", 0))
         _write_json_atomic(path, self._lease_doc(shard_id))
+        _flight("lease.steal", shard=shard_id, owner=self.owner,
+                victim=str(held.get("owner", "")),
+                stolen_rows=self._stolen_rows[shard_id],
+                age_s=round(age, 3))
         return True
 
     # ------------------------------------------------------------- renew
@@ -266,9 +283,10 @@ class LeaseClient:
         path = shard_lease_path(self.run_dir, shard_id)
         held = _read_json(path)
         if held is None or held.get("owner") != self.owner:
-            raise LeaseLost(
-                f"shard {shard_id}: lease lost to "
-                f"{held.get('owner') if held else 'release'}")
+            thief = held.get("owner") if held else "release"
+            _flight("lease.lost", shard=shard_id, owner=self.owner,
+                    to=str(thief))
+            raise LeaseLost(f"shard {shard_id}: lease lost to {thief}")
         held["renewed_at"] = self._clock()
         held["rows_done"] = int(rows_done)
         _write_json_atomic(path, held)

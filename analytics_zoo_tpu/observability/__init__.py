@@ -103,6 +103,20 @@ from analytics_zoo_tpu.observability.drift import (
     DriftWatch,
     drift_report,
 )
+from analytics_zoo_tpu.observability.flightrec import (
+    EVENT_KINDS,
+    FlightRecorder,
+    flush_active_flightrec,
+    get_active_flightrec,
+    init_flightrec,
+    record_event,
+    reset_flightrec,
+)
+from analytics_zoo_tpu.observability.incident import (
+    diagnose,
+    render_incident,
+    write_incident,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
@@ -163,4 +177,14 @@ __all__ = [
     "DriftDetector",
     "DriftWatch",
     "drift_report",
+    "EVENT_KINDS",
+    "FlightRecorder",
+    "flush_active_flightrec",
+    "get_active_flightrec",
+    "init_flightrec",
+    "record_event",
+    "reset_flightrec",
+    "diagnose",
+    "render_incident",
+    "write_incident",
 ]

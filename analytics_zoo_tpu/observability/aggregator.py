@@ -821,6 +821,19 @@ def init_worker_observability(run_dir: Optional[str] = None,
         except Exception:
             log.exception("worker tsdb bring-up failed")
 
+        # the black-box flight recorder (ISSUE 19): lifecycle events
+        # spool to host-<k>/events.jsonl from the first breath, and
+        # the crash hooks (atexit / excepthook / fatal signal) are
+        # armed so any death leaves a blackbox.json
+        try:
+            from analytics_zoo_tpu.observability import \
+                flightrec as _flightrec
+            _flightrec.init_flightrec(
+                wdir, process_index=int(process_index),
+                clock_anchor=anchor)
+        except Exception:
+            log.exception("worker flight-recorder bring-up failed")
+
         _worker_state.update({"dir": wdir, "meta": meta,
                               "server": server, "run_dir": run_dir})
     if register_atexit:
@@ -860,6 +873,15 @@ def flush_worker_observability() -> Optional[str]:
         flush_active_tsdb()   # the run dir ends on a fresh sample
     except Exception:
         log.exception("worker tsdb flush failed")
+    try:
+        from analytics_zoo_tpu.observability import \
+            flightrec as _flightrec
+        _flightrec.flush_active_flightrec(
+            "flush",
+            registry_snapshot=_flightrec._default_registry_snapshot(),
+            request_snapshot=_flightrec._default_request_snapshot())
+    except Exception:
+        log.exception("worker blackbox flush failed")
     return wdir
 
 
@@ -876,5 +898,11 @@ def reset_worker_observability() -> None:
     try:
         from analytics_zoo_tpu.observability.tsdb import reset_tsdb
         reset_tsdb()
+    except Exception:
+        pass
+    try:
+        from analytics_zoo_tpu.observability.flightrec import \
+            reset_flightrec
+        reset_flightrec()
     except Exception:
         pass

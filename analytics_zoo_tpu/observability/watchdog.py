@@ -133,6 +133,15 @@ class TrainingWatchdog:
                 labels=("kind",)).labels(kind).inc()
         except Exception:
             pass
+        # every watchdog episode is a flight event: the single
+        # chokepoint all detectors (nonfinite/divergence/plateau/
+        # stall/drift) funnel through
+        try:
+            from analytics_zoo_tpu.observability.flightrec import \
+                record_event
+            record_event("watchdog.episode", issue=kind, **detail)
+        except Exception:   # noqa: BLE001 — forensics never halts health
+            pass
 
     # ------------------------------------------------------- producers
     def beat(self) -> None:

@@ -28,6 +28,7 @@ from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.observability import (
     EPOCH_BUCKETS, flush_worker_observability, get_registry,
     get_tracer, sample_device_telemetry)
+from analytics_zoo_tpu.observability.flightrec import record_event
 from analytics_zoo_tpu.observability.watchdog import (
     TrainingHalted, TrainingWatchdog, set_active_watchdog)
 from analytics_zoo_tpu.parallel import mesh as mesh_lib
@@ -763,6 +764,12 @@ class Estimator:
                         exc, have_checkpoint=ckpt is not None)
                     met["failures"].labels(
                         decision.failure_class.value).inc()
+                    record_event(
+                        "train.failure",
+                        classification=decision.failure_class.value,
+                        action=decision.action.name.lower(),
+                        iteration=ts.iteration,
+                        cause=f"{type(exc).__name__}: {exc}"[:200])
                     if decision.action is RecoveryAction.RAISE:
                         log.error(
                             "training failure classified %s is not "
@@ -797,6 +804,16 @@ class Estimator:
                             "restoring the latest snapshot onto the "
                             "new topology", ts.iteration,
                             new_mesh.devices.size)
+                        old_mesh = getattr(trainer, "mesh",
+                                           None) or self._mesh
+                        old_devices = int(getattr(
+                            getattr(old_mesh, "devices", None),
+                            "size", 0) or 0)
+                        record_event(
+                            "mesh.reform",
+                            old_devices=old_devices,
+                            new_devices=int(new_mesh.devices.size),
+                            iteration=ts.iteration)
                         # rebuild every mesh-bound engine artifact: the
                         # old trainer's jitted programs, shardings and
                         # placed batches all name dead devices
@@ -825,6 +842,11 @@ class Estimator:
                         # re-raised terminal failures are not "retries"
                         met["retries"].inc()
                         met["recoveries"].labels("retry").inc()
+                        record_event(
+                            "train.retry",
+                            classification=decision.failure_class.value,
+                            retries_left=policy.budget.remaining,
+                            iteration=ts.iteration)
                         log.exception(
                             "training step failed (%s); restoring "
                             "latest checkpoint (%d retries left)",
@@ -966,6 +988,12 @@ class Estimator:
                 "(checkpoint-and-queue)").inc()
         except Exception:   # noqa: BLE001 — metrics never block the exit
             pass
+        record_event(
+            "train.degraded",
+            failure_class=decision.failure_class.value,
+            reason=str(detail or decision.reason)[:200],
+            epoch=ts.epoch, iteration=ts.iteration,
+            snapshot=snapshot or "")
         log.error("training DEGRADED (checkpoint-and-queue): %s", result)
         raise DegradedTraining(
             "no viable topology to continue training; run queued at "

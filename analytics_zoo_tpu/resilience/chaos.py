@@ -199,6 +199,17 @@ class ChaosPlan:
     def _execute(f: FaultSpec, site: str, step: int) -> None:
         msg = f.message or (
             f"injected {f.kind} fault at {site} step {step}")
+        # flight-record the trip BEFORE executing: ``kill`` is
+        # ``os._exit`` (no atexit, no blackbox) — the incrementally
+        # flushed journal line is the only evidence that survives,
+        # and it is exactly what zoo-doctor joins restarts against
+        try:
+            from analytics_zoo_tpu.observability.flightrec import \
+                record_event
+            record_event("chaos.trip", site=site, step=step,
+                         kind=f.kind)
+        except Exception:   # noqa: BLE001 — chaos must fire regardless
+            pass
         if f.kind == "raise":
             raise TransientFault(msg)
         if f.kind == "drop_collective":

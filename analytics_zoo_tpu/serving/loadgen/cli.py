@@ -152,8 +152,24 @@ def main(argv=None) -> int:
     ap.add_argument("--slo-out", default=None,
                     help="write the evaluated SLO statuses + burn "
                          "timeline JSON here (slo_report.json)")
+    ap.add_argument("--run-dir", default=None,
+                    help="attach the black-box flight recorder here: "
+                         "breaker transitions, dead letters, "
+                         "quarantines and chaos trips from the storm "
+                         "spool to <dir>/host-0/events.jsonl — the "
+                         "evidence `scripts/zoo-doctor <dir>` "
+                         "diagnoses afterwards")
     ap.add_argument("--seed", type=int, default=None)
     args = ap.parse_args(argv)
+
+    if args.run_dir:
+        import os
+        from analytics_zoo_tpu.observability import flightrec
+        flightrec.init_flightrec(
+            os.path.join(args.run_dir, "host-0"), process_index=0,
+            install_hooks=False)
+        print(f"flight recorder attached to {args.run_dir}",
+              flush=True)
 
     builder = SCENARIOS[args.scenario]
     kwargs = {}
@@ -259,6 +275,11 @@ def main(argv=None) -> int:
             serving.stop()
         if worker_thread is not None:
             worker_thread.join(timeout=15)
+        if args.run_dir:
+            from analytics_zoo_tpu.observability import flightrec
+            rec = flightrec.get_active_flightrec()
+            if rec is not None:
+                rec.close()
 
 
 if __name__ == "__main__":

@@ -693,6 +693,24 @@ def _breaker_failure_excs():
 
 
 BREAKER_CLOSED, BREAKER_HALF_OPEN, BREAKER_OPEN = 0, 1, 2
+_BREAKER_STATE_NAMES = {BREAKER_CLOSED: "closed",
+                        BREAKER_HALF_OPEN: "half_open",
+                        BREAKER_OPEN: "open"}
+
+
+def _note_breaker_transition(frm: int, to: int, **detail) -> None:
+    """Report a breaker state change to the flight recorder — the
+    primary forensic signal of a broker outage (zoo-doctor's
+    ``broker_outage`` rule).  Never raises; called OUTSIDE the
+    breaker's lock."""
+    try:
+        from analytics_zoo_tpu.observability.flightrec import \
+            record_event
+        record_event("breaker.transition",
+                     frm=_BREAKER_STATE_NAMES[frm],
+                     to=_BREAKER_STATE_NAMES[to], **detail)
+    except Exception:   # noqa: BLE001 — forensics must not break IO
+        pass
 
 
 class CircuitBreaker:
@@ -724,31 +742,47 @@ class CircuitBreaker:
     def allow(self) -> bool:
         """May a call be attempted right now?  (Claims the half-open
         probe slot when it grants one during cooldown recovery.)"""
+        trans = None
         with self._lock:
             if self._state == BREAKER_CLOSED:
                 return True
             if self._state == BREAKER_OPEN and \
                     self._clock() - self._opened_at >= self.cooldown_s:
                 self._state = BREAKER_HALF_OPEN
-            if self._state == BREAKER_HALF_OPEN and not self._probing:
+                trans = (BREAKER_OPEN, BREAKER_HALF_OPEN)
+            allowed = self._state == BREAKER_HALF_OPEN \
+                and not self._probing
+            if allowed:
                 self._probing = True
-                return True
-            return False
+        if trans is not None:
+            _note_breaker_transition(*trans)
+        return allowed
 
     def record_success(self) -> None:
         with self._lock:
+            trans = (self._state, BREAKER_CLOSED) \
+                if self._state != BREAKER_CLOSED else None
             self._consecutive = 0
             self._probing = False
             self._state = BREAKER_CLOSED
+        if trans is not None:
+            _note_breaker_transition(*trans)
 
     def record_failure(self) -> None:
+        trans = None
         with self._lock:
             self._consecutive += 1
             self._probing = False
             if self._state == BREAKER_HALF_OPEN or \
                     self._consecutive >= self.failures:
+                if self._state != BREAKER_OPEN:
+                    trans = (self._state, BREAKER_OPEN,
+                             self._consecutive)
                 self._state = BREAKER_OPEN
                 self._opened_at = self._clock()
+        if trans is not None:
+            _note_breaker_transition(trans[0], trans[1],
+                                     failures=trans[2])
 
 
 class BreakerClient:

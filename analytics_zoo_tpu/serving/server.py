@@ -36,6 +36,7 @@ import numpy as np
 from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.common.fsutil import atomic_write_text
 from analytics_zoo_tpu.data.stages import WorkerPool
+from analytics_zoo_tpu.observability import flightrec
 from analytics_zoo_tpu.observability import (
     MetricsServer, TelemetrySampler, get_registry, get_tracer)
 from analytics_zoo_tpu.observability.reqtrace import (
@@ -546,6 +547,13 @@ class ClusterServing:
             entry["error"] = f"{type(error).__name__}: {error}"
         entry.update(extra or {})
         self._m_dead_letter.labels(reason).inc()
+        if reason != "shed":
+            # flight-record the rare, diagnosis-bearing dead letters
+            # (write_abandoned = broker trouble, poison = quarantine);
+            # shed is normal overload control and would flood the ring
+            flightrec.record_event(
+                "dead_letter", reason=reason, uri=uri or "",
+                request_id=request_id or "")
         try:
             self.broker.xadd(DEAD_LETTER_STREAM, entry)
             return True
@@ -824,6 +832,9 @@ class ClusterServing:
             extra={"entry_id": str(entry_id),
                    "deliveries": str(deliveries),
                    "quarantined_unix": f"{time.time():.3f}"})
+        flightrec.record_event(
+            "quarantine", entry_id=str(entry_id), uri=uri or "",
+            request_id=rid or "", deliveries=deliveries)
         if uri:
             self._write_result(uri, json.dumps({
                 "error": f"poison: quarantined after "

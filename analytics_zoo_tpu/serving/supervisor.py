@@ -86,6 +86,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from analytics_zoo_tpu.common.config import get_config
 from analytics_zoo_tpu.common.fsutil import atomic_write_text
 from analytics_zoo_tpu.observability import get_registry
+from analytics_zoo_tpu.observability import flightrec as _flightrec
 from analytics_zoo_tpu.resilience.detector import (
     classify_exit, read_heartbeats)
 from analytics_zoo_tpu.resilience.policy import (
@@ -270,6 +271,12 @@ class ServingSupervisor:
         self._state_dir = run_dir or tempfile.mkdtemp(
             prefix="zoo-serving-supervisor-")
         os.makedirs(self._state_dir, exist_ok=True)
+        # the control plane's own flight recorder: lifecycle events
+        # journal to <run_dir>/events.jsonl (ring-only without a run
+        # dir) — deliberately a PRIVATE instance, the process-wide
+        # slot belongs to worker processes
+        self._flightrec = _flightrec.FlightRecorder(
+            run_dir, role="supervisor")
         self._replicas: List[_Replica] = [
             _Replica(index=i,
                      port_file=os.path.join(self._state_dir,
@@ -357,6 +364,9 @@ class ServingSupervisor:
         r.incarnation += 1
         r.spawned_at = self._clock()
         r.next_spawn_at = None
+        self._flightrec.record(
+            "replica.spawn", replica=r.index,
+            incarnation=r.incarnation, pid=r.proc.pid)
         log.info("replica %d spawned (incarnation %d, pid %d)",
                  r.index, r.incarnation, r.proc.pid)
 
@@ -378,11 +388,17 @@ class ServingSupervisor:
                             "drain; peers will reclaim its PEL",
                             r.index, code)
             self._m_exits.labels("retired").inc()
+            self._flightrec.record(
+                "replica.retire", replica=r.index, exit=code)
             return
         cls = ("killed_by_supervisor" if killed
                else "degraded" if code == DEGRADED_EXIT_CODE
                else classify_exit(code))
         self._m_exits.labels(cls).inc()
+        self._flightrec.record(
+            "replica.exit", replica=r.index, exit=code,
+            classification=cls,
+            **({"kill_reason": killed} if killed else {}))
         # a supervisor-initiated kill (wedged heartbeat, unreachable
         # /healthz) must be restarted no matter HOW the replica ended:
         # its SIGTERM handler drains gracefully to exit 0, and taking
@@ -446,6 +462,10 @@ class ServingSupervisor:
             except OSError:
                 log.exception("could not mirror degraded record to %s",
                               path)
+        self._flightrec.record(
+            "fleet.degraded", replica=r.index, exit=code,
+            classification=cls, restarts_total=self.restarts_total)
+        self._persist_state()
         raise DegradedTraining(record["reason"], result=record)
 
     # ------------------------------------------------------------ autoscale
@@ -463,6 +483,30 @@ class ServingSupervisor:
                 or self.replica_trajectory[-1][1] != size:
             self.replica_trajectory.append(
                 (time.time(), size, reason))
+            self._persist_state()
+
+    def _persist_state(self) -> None:
+        """Mirror ``scale_events`` + ``replica_trajectory`` to the run
+        dir AT DECISION TIME — ``summary()`` dies with the process, a
+        crashed supervisor must still leave its decisions for
+        ``zoo-doctor``."""
+        if not self.run_dir:
+            return
+        doc = {
+            "written_unix": time.time(),
+            "replicas": self.replicas,
+            "restarts_total": self.restarts_total,
+            "scale_events": list(self.scale_events),
+            "replica_trajectory": [
+                [t, size, reason]
+                for t, size, reason in self.replica_trajectory],
+        }
+        try:
+            atomic_write_text(
+                os.path.join(self.run_dir, "supervisor.json"),
+                json.dumps(doc, indent=2, sort_keys=True))
+        except OSError:
+            log.exception("could not persist supervisor state")
 
     def _replica_gauges(self, r: _Replica) -> Dict:
         """One replica's ``/metrics.json`` snapshot sections (gauges +
@@ -652,7 +696,11 @@ class ServingSupervisor:
         self.scale_events.append({
             "direction": "up", "replica": index,
             "fleet": self._fleet_size(), "signals": sig})
+        self._flightrec.record(
+            "scale.up", replica=index, fleet=self._fleet_size(),
+            signals=sig)
         self._record_fleet_size("scale_up")
+        self._persist_state()
         log.warning(
             "autoscaler: scale UP → replica %d spawned (fleet %d, "
             "queue=%.0f, p50=%.0fms)", index, self._fleet_size(),
@@ -683,7 +731,11 @@ class ServingSupervisor:
         self.scale_events.append({
             "direction": "down", "replica": victim.index,
             "fleet": self._fleet_size(), "signals": sig})
+        self._flightrec.record(
+            "scale.down", replica=victim.index,
+            fleet=self._fleet_size(), signals=sig)
         self._record_fleet_size("scale_down")
+        self._persist_state()
         log.warning(
             "autoscaler: scale DOWN → replica %d draining (fleet %d, "
             "idle %.1fs)", victim.index, self._fleet_size(),
@@ -756,6 +808,8 @@ class ServingSupervisor:
         log.error("killing replica %d (pid %d): %s", r.index,
                   proc.pid, reason)
         r.kill_reason = reason
+        self._flightrec.record(
+            "replica.kill", replica=r.index, reason=reason)
         proc.terminate()
         try:
             proc.wait(2.0)

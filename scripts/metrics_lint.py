@@ -29,6 +29,12 @@ instead (schema header, monotonic timestamps, non-decreasing
 counters, series-key charsets) — ``check_static
 --metrics-args='--tsdb RUN_DIR'`` wires it into the static lane.
 
+``--events DIR`` lints flight-recorder journals (``events.jsonl``,
+run-level + per-host): header per writer session, ``events_schema``
+version, non-decreasing ``t`` / strictly-increasing ``seq`` per
+session, kinds within ``flightrec.EVENT_KINDS``; a torn FINAL line is
+the crash-safety contract working and is allowed.
+
 Usage::
 
     python scripts/metrics_lint.py metrics.txt
@@ -359,6 +365,133 @@ def lint_tsdb(directory: str, schema: int = 1) -> List[str]:
     return issues
 
 
+# ----------------------------------------------------------- events lint
+def _load_event_kinds():
+    """The known-kind vocabulary from ``flightrec.EVENT_KINDS``,
+    loaded by file path (this script stays stdlib + jax-free).
+    Returns None when the repo layout isn't there — a standalone lint
+    of a copied journal still checks structure, just not kinds."""
+    import importlib.util
+    import os
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir,
+        "analytics_zoo_tpu", "observability", "flightrec.py")
+    if not os.path.exists(path):
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_zoo_flightrec_lint", path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        return frozenset(mod.EVENT_KINDS)
+    except Exception:   # noqa: BLE001 — structure lint still runs
+        return None
+
+
+def _events_roots(directory: str) -> List[str]:
+    """Accept one events.jsonl, a host-<k> slot, or a run dir (the
+    run-level journal plus every ``host-*/events.jsonl`` — the same
+    resolution ``flightrec.journal_paths`` does)."""
+    import os
+    if os.path.isfile(directory):
+        return [directory]
+    paths = []
+    run_level = os.path.join(directory, "events.jsonl")
+    if os.path.isfile(run_level):
+        paths.append(run_level)
+    if os.path.isdir(directory):
+        for n in sorted(os.listdir(directory)):
+            p = os.path.join(directory, n, "events.jsonl")
+            if n.startswith("host-") and os.path.isfile(p):
+                paths.append(p)
+    return paths
+
+
+def lint_events(directory: str, schema: int = 1) -> List[str]:
+    """Lint flight-recorder journals (``events.jsonl``):
+
+    * first parseable line of each writer session must be a header
+      with the expected ``events_schema`` version;
+    * ``t`` non-decreasing and ``seq`` strictly increasing within a
+      session (a new header re-opens the journal: respawned
+      incarnations append a fresh header and restart both);
+    * event kinds must be in ``flightrec.EVENT_KINDS``;
+    * unparseable NON-final lines flagged (a torn final line is the
+      crash-safety contract working as designed and is allowed).
+    """
+    import json as _json
+    issues: List[str] = []
+    kinds = _load_event_kinds()
+    paths = _events_roots(directory)
+    if not paths:
+        return [f"{directory}: no events.jsonl found"]
+    for path in paths:
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError as e:
+            issues.append(f"{path}: unreadable ({e})")
+            continue
+        header_seen = False
+        last_t = None
+        last_seq = None
+        for i, line in enumerate(lines, 1):
+            where = f"{path}:{i}"
+            try:
+                rec = _json.loads(line)
+            except ValueError:
+                if i == len(lines):
+                    continue        # torn tail: allowed by design
+                issues.append(f"{where}: unparseable non-final line")
+                continue
+            if not isinstance(rec, dict):
+                issues.append(f"{where}: record is not an object")
+                continue
+            if "events_schema" in rec:
+                # a new writer session: timestamps/seq restart
+                if rec.get("events_schema") != schema:
+                    issues.append(
+                        f"{where}: header events_schema="
+                        f"{rec.get('events_schema')!r} (expected "
+                        f"{schema})")
+                header_seen = True
+                last_t = None
+                last_seq = None
+                continue
+            if not header_seen:
+                issues.append(
+                    f"{where}: event before any events_schema header")
+                header_seen = True      # flag once per journal
+            kind = rec.get("kind")
+            if not isinstance(kind, str) or not kind:
+                issues.append(f"{where}: event without a 'kind'")
+            elif kinds is not None and kind not in kinds:
+                issues.append(f"{where}: unknown event kind {kind!r}")
+            t = rec.get("t")
+            if not isinstance(t, (int, float)):
+                issues.append(f"{where}: event without a numeric 't'")
+            else:
+                if last_t is not None and t < last_t:
+                    issues.append(
+                        f"{where}: timestamp {t} < previous {last_t} "
+                        f"(non-monotonic within session)")
+                last_t = t
+            seq = rec.get("seq")
+            if not isinstance(seq, int):
+                issues.append(f"{where}: event without an integer "
+                              f"'seq'")
+            else:
+                if last_seq is not None and seq <= last_seq:
+                    issues.append(
+                        f"{where}: seq {seq} <= previous {last_seq} "
+                        f"(must be strictly increasing per session)")
+                last_seq = seq
+        if lines and not header_seen:
+            issues.append(f"{path}: no parseable records")
+    return issues
+
+
 def lint_registry(registry) -> List[str]:
     """Lint a live ``MetricsRegistry`` (what the tier-1 test calls).
     The exemplar-enabled exposition is a strict superset of the plain
@@ -388,10 +521,19 @@ def main(argv=None) -> int:
                          "series-key charsets; wire through "
                          "check_static with "
                          "--metrics-args='--tsdb RUN_DIR'")
+    ap.add_argument("--events", metavar="DIR", default=None,
+                    help="lint flight-recorder journals (a run dir's "
+                         "events.jsonl + host-<k>/events.jsonl, or "
+                         "one file) instead of an exposition: schema "
+                         "header per writer session, monotonic "
+                         "timestamps, strictly-increasing seq, known "
+                         "event kinds; a torn FINAL line is allowed "
+                         "(crash-safety contract)")
     args = ap.parse_args(argv)
 
-    if args.tsdb:
-        issues = lint_tsdb(args.tsdb)
+    if args.tsdb or args.events:
+        issues = (lint_tsdb(args.tsdb) if args.tsdb else []) + \
+            (lint_events(args.events) if args.events else [])
         for issue in issues:
             print(issue)
         if issues:

@@ -33,12 +33,20 @@ straggler, pipeline bubble fraction, collective byte/time accounting,
 cluster-summed counters, and ONE merged Chrome trace aligned on the
 launcher's clock anchor (``<run_dir>/merged_trace.json``).
 
+Incident mode: ``obs_report.py --incident <run_dir>`` renders the
+zoo-doctor forensics view — the causally-ordered incident timeline
+joined from flight-recorder journals, heartbeats, blackboxes, the
+degraded record and tsdb SLO state, plus the ranked root-cause
+hypothesis list with evidence citations (reuses ``incident.json``
+when a prior ``zoo-doctor`` run left one in the run dir).
+
 Examples::
 
     python scripts/obs_report.py metrics.jsonl --trace trace.json
     python scripts/obs_report.py bench_metrics.json --workload ncf
     python scripts/obs_report.py run2.jsonl --diff run1.jsonl
     python scripts/obs_report.py --merge-hosts /runs/exp7
+    python scripts/obs_report.py --incident /runs/exp7
 
 Pure stdlib + file IO; never imports jax (usable on a laptop against
 artifacts scp'd from the pod).  The merge logic lives in
@@ -513,6 +521,26 @@ def _find_slo_spec(target: str, explicit: Optional[str]) -> Optional[str]:
     return None
 
 
+def render_incident_report(target: str) -> str:
+    """The ``--incident`` section: zoo-doctor's causally-ordered
+    timeline + ranked root-cause hypotheses for a finished run dir.
+    Renders an existing ``incident.json`` (a file, or one inside the
+    run dir) without re-diagnosing; otherwise runs the diagnoser
+    in-process.  Entirely jax-free: incident loads by file path."""
+    inc = _load_obs_module("incident")
+    if os.path.isfile(target):
+        with open(target) as f:
+            doc = json.load(f)
+    else:
+        existing = os.path.join(target, "incident.json")
+        if os.path.isfile(existing):
+            with open(existing) as f:
+                doc = json.load(f)
+        else:
+            doc = inc.diagnose(target)
+    return inc.render_incident(doc)
+
+
 def render_slo_report(target: str,
                       spec_path: Optional[str] = None) -> str:
     """The ``--slo`` section: error-budget timelines, burn-rate
@@ -919,14 +947,29 @@ def main(argv=None) -> int:
     ap.add_argument("--slo-spec", metavar="SLO_YAML", default=None,
                     help="--slo: SLO objective spec file (default: "
                          "<run_dir>/slo.yaml, then the repo slo.yaml)")
+    ap.add_argument("--incident", metavar="RUN_DIR_OR_FILE",
+                    default=None,
+                    help="render zoo-doctor's incident timeline + "
+                         "ranked root-cause hypotheses from a run "
+                         "dir's forensic artifacts (reuses an "
+                         "existing incident.json when present), or "
+                         "from an incident.json file directly")
     args = ap.parse_args(argv)
 
     if args.merge_hosts is None and args.snapshot is None \
             and args.requests is None and args.job is None \
-            and args.slo is None:
+            and args.slo is None and args.incident is None:
         ap.error("need a snapshot file, --merge-hosts RUN_DIR, "
-                 "--requests RUN_DIR, --job RUN_DIR, or --slo "
-                 "RUN_DIR")
+                 "--requests RUN_DIR, --job RUN_DIR, --slo RUN_DIR, "
+                 "or --incident RUN_DIR")
+
+    if args.incident:
+        print(render_incident_report(args.incident))
+        print()
+        if args.merge_hosts is None and args.snapshot is None \
+                and args.requests is None and args.job is None \
+                and args.slo is None:
+            return 0
 
     if args.slo:
         print(render_slo_report(args.slo, args.slo_spec))

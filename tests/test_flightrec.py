@@ -1,0 +1,397 @@
+"""Black-box flight recorder (ISSUE 19): bounded lifecycle-event ring,
+crash-safe append-only journal with the TSDB's torn-tail discipline,
+blackbox dumps on orderly shutdown / unhandled exception / SIGTERM,
+and the ``metrics_lint --events`` journal lint.
+
+The journal is the part that must survive anything: a SIGKILLed
+process (chaos ``kill`` = ``os._exit``) leaves no atexit and no
+blackbox, so every ``record()`` flushes its line — the subprocess
+tests here kill for real and read what the corpse left behind.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from analytics_zoo_tpu.observability import flightrec
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    flightrec.reset_flightrec()
+    yield
+    flightrec.reset_flightrec()
+
+
+def _load_lint():
+    path = os.path.join(REPO_ROOT, "scripts", "metrics_lint.py")
+    spec = importlib.util.spec_from_file_location("_mlint_t", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ recorder
+class TestRecorder:
+    def test_ring_is_bounded_but_journal_and_seq_are_not(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), ring_size=8)
+        for i in range(20):
+            rec.record("watchdog.episode", issue="plateau", i=i)
+        rec.close()
+        ring = rec.recent_events()
+        assert len(ring) == 8
+        assert [e["d"]["i"] for e in ring] == list(range(12, 20))
+        assert ring[-1]["seq"] == 20
+        parsed = flightrec.read_journal(
+            os.path.join(str(tmp_path), "events.jsonl"))
+        assert len(parsed["events"]) == 20      # journal kept them all
+
+    def test_journal_header_first_with_role_and_anchor(self, tmp_path):
+        rec = flightrec.FlightRecorder(
+            str(tmp_path), role="supervisor", process_index=3,
+            clock_anchor=123.5)
+        rec.record("scale.up", replica=1)
+        rec.close()
+        with open(os.path.join(str(tmp_path), "events.jsonl")) as f:
+            first = json.loads(f.readline())
+        assert first["events_schema"] == flightrec.EVENTS_SCHEMA
+        assert first["role"] == "supervisor"
+        assert first["process_index"] == 3
+        assert first["clock_anchor"] == 123.5
+
+    def test_timestamps_clamped_non_decreasing(self, tmp_path):
+        ticks = iter([100.0, 99.0, 101.0])
+        rec = flightrec.FlightRecorder(
+            str(tmp_path), clock=lambda: next(ticks, 101.0))
+        # first clock read is the header's "created"
+        a = rec.record("replica.spawn", replica=0)
+        b = rec.record("replica.exit", replica=0)
+        rec.close()
+        assert b["t"] >= a["t"]     # the 99.0 step back was clamped
+
+    def test_record_never_raises_on_exotic_detail(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path))
+        ev = rec.record("quarantine", obj=object(), nested={1: (2, 3)})
+        rec.close()
+        json.dumps(ev)              # fully JSON-clean after coercion
+        assert "object" in ev["d"]["obj"]
+
+    def test_kind_detail_key_does_not_collide(self, tmp_path):
+        # chaos.trip carries its own kind= detail; record(kind, /) is
+        # positional-only exactly so this works
+        rec = flightrec.FlightRecorder(str(tmp_path))
+        ev = rec.record("chaos.trip", site="serving.redis", kind="kill")
+        rec.close()
+        assert ev["kind"] == "chaos.trip"
+        assert ev["d"]["kind"] == "kill"
+
+    def test_ring_only_without_directory(self):
+        rec = flightrec.FlightRecorder(None)
+        rec.record("breaker.transition", frm="closed", to="open")
+        assert rec.path is None
+        assert len(rec.recent_events()) == 1
+        assert rec.dump_blackbox("shutdown") is None
+
+    def test_overhead_p50_is_measured(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path))
+        for i in range(32):
+            rec.record("watchdog.episode", issue="drift", i=i)
+        rec.close()
+        p50 = rec.overhead_p50()
+        assert 0.0 < p50 < 0.05     # a flushed line, not a disk sync
+
+
+# ----------------------------------------------------------- torn tail
+class TestTornTail:
+    def test_torn_tail_reported_and_allowed(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path))
+        rec.record("replica.spawn", replica=0)
+        rec.close()
+        path = os.path.join(str(tmp_path), "events.jsonl")
+        with open(path, "a") as f:
+            f.write('{"t": 1.0, "seq": 2, "kind": "replica.ex')
+        parsed = flightrec.read_journal(path)
+        assert parsed["torn_tail"] is True
+        assert parsed["skipped"] == 0
+        assert len(parsed["events"]) == 1
+
+    def test_reopen_seals_torn_line_and_starts_new_session(
+            self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path))
+        rec.record("replica.spawn", replica=0)
+        rec.close()
+        path = os.path.join(str(tmp_path), "events.jsonl")
+        with open(path, "a") as f:
+            f.write('{"t": 1.0, "seq": 2, "kind": "replica.ex')
+        # the respawned incarnation appends: torn line sealed, fresh
+        # header, seq restarts — the reader attributes sessions
+        rec2 = flightrec.FlightRecorder(str(tmp_path))
+        rec2.record("replica.spawn", replica=0, incarnation=1)
+        rec2.close()
+        parsed = flightrec.read_journal(path)
+        assert len(parsed["headers"]) == 2
+        assert parsed["torn_tail"] is False
+        assert parsed["skipped"] == 1       # the sealed torn line
+        assert [e["session"] for e in parsed["events"]] == [0, 1]
+
+
+# ------------------------------------------------------- run-dir reads
+class TestRunDirReads:
+    def test_read_events_merges_streams_with_citation_ids(
+            self, tmp_path):
+        run = str(tmp_path)
+        ticks = {"host-0": 10.0, "host-1": 10.5, None: 11.0}
+        sup = flightrec.FlightRecorder(
+            run, role="supervisor", clock=lambda: 11.0)
+        sup.record("scale.up", replica=2)
+        sup.close()
+        for k, t0 in (("host-0", 10.0), ("host-1", 10.5)):
+            r = flightrec.FlightRecorder(
+                os.path.join(run, k), clock=lambda t0=t0: t0)
+            r.record("replica.spawn", replica=int(k[-1]))
+            r.close()
+        merged = flightrec.read_events(run)
+        assert [e["id"] for e in merged] == [
+            "host-0/e1", "host-1/e1", "run/e1"]
+        assert [e["stream"] for e in merged] == [
+            "host-0", "host-1", "run"]
+
+    def test_journal_paths_resolution(self, tmp_path):
+        run = str(tmp_path)
+        flightrec.FlightRecorder(run).close()
+        flightrec.FlightRecorder(os.path.join(run, "host-0")).close()
+        assert [s for s, _ in flightrec.journal_paths(run)] == [
+            "run", "host-0"]
+        # a single host slot and a single file also resolve
+        assert [s for s, _ in flightrec.journal_paths(
+            os.path.join(run, "host-0", "events.jsonl"))] == ["host-0"]
+
+
+# ------------------------------------------------------------ blackbox
+class TestBlackbox:
+    def test_dump_is_enriched_and_atomic(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path), ring_size=4)
+        for i in range(6):
+            rec.record("watchdog.episode", issue="stall", i=i)
+        path = rec.dump_blackbox(
+            "shutdown", registry_snapshot={"counters": {"x": 1}},
+            request_snapshot={"timelines": []})
+        rec.close()
+        assert path == os.path.join(str(tmp_path), "blackbox.json")
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "shutdown"
+        assert len(doc["events"]) == 4          # last-N (the ring)
+        assert doc["events_total"] == 6
+        assert doc["registry"] == {"counters": {"x": 1}}
+        assert doc["requests"] == {"timelines": []}
+        assert any("MainThread" in k for k in doc["stacks"])
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if ".tmp." in n]            # rename, no debris
+
+    def test_fatal_dump_wins_over_later_shutdown_dump(self, tmp_path):
+        rec = flightrec.FlightRecorder(str(tmp_path))
+        rec.record("train.failure", classification="poisoned_state")
+        rec.dump_blackbox("exception:PoisonedState",
+                          error="PoisonedState: x", fatal=True)
+        assert rec.dump_blackbox("shutdown") is None    # skipped
+        rec.close()
+        with open(os.path.join(str(tmp_path), "blackbox.json")) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "exception:PoisonedState"
+        assert "PoisonedState" in doc["error"]
+
+    def test_unhandled_exception_dumps_blackbox(self, tmp_path):
+        code = textwrap.dedent("""
+            import sys
+            sys.path.insert(0, {repo!r})
+            from analytics_zoo_tpu.observability import flightrec
+            flightrec.init_flightrec({d!r})
+            flightrec.record_event("replica.spawn", replica=0)
+            raise RuntimeError("worker exploded")
+        """).format(repo=REPO_ROOT, d=str(tmp_path))
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, timeout=60,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 1
+        assert "worker exploded" in proc.stderr     # hook chained on
+        with open(os.path.join(str(tmp_path), "blackbox.json")) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "exception:RuntimeError"
+        assert "worker exploded" in doc["error"]
+        assert any(e["kind"] == "replica.spawn" for e in doc["events"])
+
+    def test_sigterm_dumps_blackbox_and_preserves_exit_class(
+            self, tmp_path):
+        code = textwrap.dedent("""
+            import os, sys, time
+            sys.path.insert(0, {repo!r})
+            from analytics_zoo_tpu.observability import flightrec
+            flightrec.init_flightrec({d!r})
+            flightrec.record_event("lease.claim", shard=0, owner="w")
+            print("READY", flush=True)
+            time.sleep(60)
+        """).format(repo=REPO_ROOT, d=str(tmp_path))
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code], stdout=subprocess.PIPE,
+            text=True, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.stdout.readline().startswith("READY")
+        proc.terminate()
+        rc = proc.wait(timeout=60)
+        # the hook re-delivers with default disposition: the detector
+        # still classifies this corpse as signal(TERM)
+        assert rc == -signal.SIGTERM
+        with open(os.path.join(str(tmp_path), "blackbox.json")) as f:
+            doc = json.load(f)
+        assert doc["reason"] == "signal:SIGTERM"
+
+    def test_sigkill_leaves_journal_but_no_blackbox(self, tmp_path):
+        code = textwrap.dedent("""
+            import os, signal, sys
+            sys.path.insert(0, {repo!r})
+            from analytics_zoo_tpu.observability import flightrec
+            flightrec.init_flightrec({d!r})
+            flightrec.record_event("replica.spawn", replica=0)
+            flightrec.record_event("chaos.trip", site="worker.step",
+                                   step=0, kind="kill")
+            os.kill(os.getpid(), signal.SIGKILL)
+        """).format(repo=REPO_ROOT, d=str(tmp_path))
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            timeout=60, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == -signal.SIGKILL
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), "blackbox.json"))
+        parsed = flightrec.read_journal(
+            os.path.join(str(tmp_path), "events.jsonl"))
+        kinds = [e["kind"] for e in parsed["events"]]
+        assert "chaos.trip" in kinds        # flushed before the kill
+
+
+# ------------------------------------------------------ process wiring
+class TestProcessWiring:
+    def test_record_event_attaches_lazily_from_env(self, tmp_path):
+        code = textwrap.dedent("""
+            import sys
+            sys.path.insert(0, {repo!r})
+            from analytics_zoo_tpu.observability.flightrec import (
+                record_event)
+            record_event("worker.respawn", worker=2)
+        """).format(repo=REPO_ROOT)
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   ZOO_TPU_METRICS_DIR=str(tmp_path),
+                   ZOO_TPU_PROCESS_ID="2",
+                   ZOO_TPU_CLOCK_ANCHOR="42.0")
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, timeout=60, env=env)
+        assert proc.returncode == 0
+        parsed = flightrec.read_journal(
+            os.path.join(str(tmp_path), "events.jsonl"))
+        assert parsed["headers"][0]["process_index"] == 2
+        assert parsed["headers"][0]["clock_anchor"] == 42.0
+        assert parsed["events"][0]["kind"] == "worker.respawn"
+
+    def test_init_is_idempotent_per_directory(self, tmp_path):
+        a = flightrec.init_flightrec(str(tmp_path), install_hooks=False)
+        b = flightrec.init_flightrec(str(tmp_path), install_hooks=False)
+        assert a is b
+        parsed = flightrec.read_journal(
+            os.path.join(str(tmp_path), "events.jsonl"))
+        assert len(parsed["headers"]) == 1
+        assert [e["kind"] for e in parsed["events"]] == \
+            ["recorder.start"]
+
+    def test_stdlib_contract_loads_by_path_without_package(
+            self, tmp_path):
+        """flightrec.py must load standalone with jax booby-trapped
+        AND the package absent — the aggregator.py contract."""
+        site = tmp_path / "site"
+        site.mkdir()
+        (site / "jax.py").write_text(
+            "raise ImportError('jax imported in jax-free path')\n")
+        code = textwrap.dedent("""
+            import importlib.util, sys
+            spec = importlib.util.spec_from_file_location(
+                "_fr", {path!r})
+            mod = importlib.util.module_from_spec(spec)
+            sys.modules[spec.name] = mod
+            spec.loader.exec_module(mod)
+            rec = mod.FlightRecorder({d!r})
+            rec.record("mesh.reform", old_devices=8, new_devices=4)
+            rec.close()
+            print(len(mod.read_events({d!r})))
+        """).format(
+            path=os.path.join(REPO_ROOT, "analytics_zoo_tpu",
+                              "observability", "flightrec.py"),
+            d=str(tmp_path / "slot"))
+        env = dict(os.environ, PYTHONPATH=str(site))
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=60, env=env)
+        assert proc.returncode == 0, proc.stderr
+        assert proc.stdout.strip() == "1"
+
+
+# ---------------------------------------------------------- event lint
+class TestEventsLint:
+    def _journal(self, tmp_path, n=3):
+        rec = flightrec.FlightRecorder(os.path.join(
+            str(tmp_path), "host-0"))
+        for i in range(n):
+            rec.record("watchdog.episode", issue="plateau", i=i)
+        rec.close()
+        return os.path.join(str(tmp_path), "host-0", "events.jsonl")
+
+    def test_clean_journal_lints_clean(self, tmp_path):
+        self._journal(tmp_path)
+        assert _load_lint().lint_events(str(tmp_path)) == []
+
+    def test_torn_final_line_is_allowed(self, tmp_path):
+        path = self._journal(tmp_path)
+        with open(path, "a") as f:
+            f.write('{"t": 9e9, "seq": 4, "kind": "replica.ex')
+        assert _load_lint().lint_events(str(tmp_path)) == []
+
+    def test_violations_are_flagged(self, tmp_path):
+        path = self._journal(tmp_path)
+        with open(path, "a") as f:
+            f.write('GARBAGE\n'
+                    '{"t": 0.5, "seq": 2, "kind": "made.up"}\n')
+        issues = "\n".join(_load_lint().lint_events(str(tmp_path)))
+        assert "unparseable non-final line" in issues
+        assert "unknown event kind 'made.up'" in issues
+        assert "non-monotonic" in issues
+        assert "strictly increasing" in issues
+
+    def test_missing_header_and_wrong_schema_flagged(self, tmp_path):
+        slot = tmp_path / "host-0"
+        slot.mkdir()
+        (slot / "events.jsonl").write_text(
+            '{"t": 1.0, "seq": 1, "kind": "replica.spawn"}\n'
+            '{"events_schema": 99, "created": 2.0, "pid": 1, '
+            '"role": "worker"}\n')
+        issues = "\n".join(_load_lint().lint_events(str(tmp_path)))
+        assert "before any events_schema header" in issues
+        assert "events_schema=99" in issues
+
+    def test_cli_exit_codes(self, tmp_path):
+        self._journal(tmp_path)
+        lint = os.path.join(REPO_ROOT, "scripts", "metrics_lint.py")
+        ok = subprocess.run(
+            [sys.executable, lint, "--events", str(tmp_path)],
+            capture_output=True, text=True, timeout=60)
+        assert ok.returncode == 0 and "clean" in ok.stdout
+        bad = subprocess.run(
+            [sys.executable, lint, "--events", str(tmp_path / "nope")],
+            capture_output=True, text=True, timeout=60)
+        assert bad.returncode == 1

@@ -1,0 +1,429 @@
+"""zoo-doctor incident forensics (ISSUE 19): three canned incident
+classes run END TO END — real subsystems under scripted faults leave
+real artifacts in a run dir, and the diagnoser must rank the true
+root cause FIRST with at least one concrete evidence citation:
+
+* a broker outage mid-traffic (chaos ``serving.redis`` → breaker
+  opens fleet-wide);
+* a poison record repeatedly killing its serving worker (reclaim →
+  per-record delivery cap → quarantine);
+* a lost host during elastic training (chaos ``lose_host`` →
+  mesh re-formed on the survivors).
+
+Plus the control planes' decision-time persistence (supervisor
+scale/trajectory state, coordinator respawn ledger), the chaos-SIGKILL
+journal-survival contract, and the jax-free surface contracts
+(``zoo-doctor`` CLI exit codes, ``obs_report --incident``).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from analytics_zoo_tpu.observability import flightrec
+from analytics_zoo_tpu.observability import incident
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ZOO_DOCTOR = os.path.join(REPO_ROOT, "scripts", "zoo-doctor")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_forensics():
+    from analytics_zoo_tpu.resilience.chaos import clear_chaos
+    flightrec.reset_flightrec()
+    clear_chaos()
+    yield
+    clear_chaos()
+    flightrec.reset_flightrec()
+
+
+def _host_slot(tmp_path):
+    run_dir = str(tmp_path / "run")
+    slot = os.path.join(run_dir, "host-0")
+    flightrec.init_flightrec(slot, process_index=0,
+                             install_hooks=False)
+    return run_dir
+
+
+def _doctor(run_dir, *args, jax_free=True, tmp_path=None):
+    env = dict(os.environ)
+    if jax_free:
+        site = tmp_path / "booby"
+        site.mkdir(exist_ok=True)
+        (site / "jax.py").write_text(
+            "raise ImportError('jax imported in jax-free path')\n")
+        env["PYTHONPATH"] = str(site)
+    return subprocess.run(
+        [sys.executable, ZOO_DOCTOR, run_dir, *args],
+        capture_output=True, text=True, timeout=120, env=env)
+
+
+# ============================================== incident 1: broker outage
+class TestBrokerOutageIncident:
+    def test_doctor_names_the_dead_broker(self, tmp_path):
+        from analytics_zoo_tpu.resilience import (
+            ChaosPlan, FaultSpec, install_chaos)
+        from analytics_zoo_tpu.resilience.chaos import (
+            SITE_SERVING_REDIS, TransientFault)
+        from analytics_zoo_tpu.serving.redis_client import (
+            BREAKER_OPEN, BreakerClient)
+
+        run_dir = _host_slot(tmp_path)
+
+        class _Conn:
+            def ping(self):
+                return True
+
+            def close(self):
+                pass
+
+        client = BreakerClient(lambda: _Conn(), failures=3,
+                               cooldown_s=60.0, conn=_Conn())
+        # scripted outage: the next 3 attempted broker ops fail
+        install_chaos(ChaosPlan([FaultSpec(
+            site=SITE_SERVING_REDIS, at_step=0, kind="raise",
+            times=3, message="connection reset by injected outage")]))
+        for _ in range(3):
+            with pytest.raises(TransientFault):
+                client.ping()
+        assert client.breaker.state == BREAKER_OPEN
+
+        doc = incident.diagnose(run_dir)
+        assert doc["identified"] is True
+        assert doc["root_cause"] == "broker_outage"
+        top = doc["hypotheses"][0]
+        assert top["cause"] == "broker_outage"
+        assert top["confidence"] >= incident.ROOT_CAUSE_THRESHOLD
+        assert len(top["evidence"]) >= 1
+        # citations point at concrete journal events
+        refs = [e["ref"] for e in top["evidence"]]
+        assert any(r.startswith("host-0/e") for r in refs)
+
+        # the CLI contract: jax-free, exit 0 = root cause identified,
+        # incident.json written beside the evidence
+        proc = _doctor(run_dir, tmp_path=tmp_path)
+        assert proc.returncode == 0, proc.stderr
+        assert "broker_outage" in proc.stdout
+        on_disk = json.load(
+            open(os.path.join(run_dir, "incident.json")))
+        assert on_disk["root_cause"] == "broker_outage"
+
+
+# ============================================== incident 2: poison record
+class _ReplicaDeath(BaseException):
+    """Escapes ``except Exception`` like a real crash, leaving the
+    batch un-acked in the PEL (the test_serving_resilience contract)."""
+
+
+class _PoisonKillsWorker:
+    def predict(self, x, batch_size=None):
+        if np.any(np.asarray(x) > 1e8):
+            raise _ReplicaDeath("poison payload crashed the replica")
+        return np.tile(np.arange(4, dtype=np.float32), (len(x), 1))
+
+
+class TestPoisonRecordIncident:
+    def test_doctor_names_the_poison_record(self, tmp_path):
+        from analytics_zoo_tpu.serving import InputQueue, OutputQueue
+        from analytics_zoo_tpu.serving.redis_client import \
+            EmbeddedBroker
+        from analytics_zoo_tpu.serving.server import (
+            ClusterServing, ServingConfig)
+
+        run_dir = _host_slot(tmp_path)
+        broker = EmbeddedBroker()
+
+        def worker(name):
+            return ClusterServing(
+                _PoisonKillsWorker(),
+                ServingConfig(batch_size=4, consumer_group="serve",
+                              consumer_name=name,
+                              poison_max_attempts=2),
+                broker=broker)
+
+        inq = InputQueue(broker=broker)
+        outq = OutputQueue(broker=broker)
+        inq.enqueue("h-0", np.zeros(3, np.float32))
+        rid = inq.enqueue("poison", np.full(3, 1e9, np.float32))
+        inq.enqueue("h-1", np.zeros(3, np.float32))
+
+        # delivery 1: the batch dies with its replica (un-acked)
+        w1 = worker("w1")
+
+        def _run_until_death():
+            try:
+                w1.run(poll_ms=5)
+            except _ReplicaDeath:
+                pass
+        t = threading.Thread(target=_run_until_death)
+        t.start()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        # delivery 2 (reclaim): poison kills again
+        with pytest.raises(_ReplicaDeath):
+            worker("w2")._reclaim_stale(min_idle_ms=0)
+        # delivery 3 would exceed the cap -> quarantine
+        worker("w3")._reclaim_stale(min_idle_ms=0)
+        res = outq.query("poison")
+        assert isinstance(res, dict) and "quarantined" in res["error"]
+
+        doc = incident.diagnose(run_dir)
+        assert doc["identified"] is True
+        assert doc["root_cause"] == "poison_record"
+        top = doc["hypotheses"][0]
+        assert len(top["evidence"]) >= 1
+        assert any(rid in (e.get("note") or "")
+                   for e in top["evidence"])
+        kinds = {e["kind"] for e in flightrec.read_events(run_dir)}
+        assert {"quarantine", "dead_letter"} <= kinds
+
+    def test_obs_report_incident_renders_jax_free(self, tmp_path):
+        # a minimal quarantined run dir rendered through the report
+        # surface with jax booby-trapped — the laptop contract
+        run_dir = _host_slot(tmp_path)
+        flightrec.record_event("quarantine", entry_id="1-1",
+                               uri="poison", request_id="r-1",
+                               deliveries=2)
+        flightrec.get_active_flightrec().close()
+        site = tmp_path / "booby"
+        site.mkdir(exist_ok=True)
+        (site / "jax.py").write_text(
+            "raise ImportError('jax imported in jax-free path')\n")
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO_ROOT, "scripts", "obs_report.py"),
+             "--incident", run_dir],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, PYTHONPATH=str(site)))
+        assert proc.returncode == 0, proc.stderr
+        assert "ROOT CAUSE: poison_record" in proc.stdout
+        assert "host-0/e" in proc.stdout        # citations rendered
+
+
+# ================================================ incident 3: lost host
+class TestLostHostIncident:
+    def test_doctor_names_the_lost_host(self, tmp_path):
+        import jax
+
+        from analytics_zoo_tpu.common.triggers import (
+            MaxEpoch, SeveralIteration)
+        from analytics_zoo_tpu.pipeline.api.keras import Sequential
+        from analytics_zoo_tpu.pipeline.api.keras.layers import Dense
+        from analytics_zoo_tpu.pipeline.api.keras.optimizers import SGD
+        from analytics_zoo_tpu.pipeline.estimator import Estimator
+        from analytics_zoo_tpu.data import DataPipeline
+        from analytics_zoo_tpu.resilience import (
+            ChaosPlan, FaultSpec, install_chaos)
+        from analytics_zoo_tpu.resilience.chaos import \
+            SITE_TRAINER_DISPATCH
+
+        devices = jax.devices()
+        assert len(devices) == 8
+        run_dir = _host_slot(tmp_path)
+
+        rs = np.random.RandomState(3)
+        x = rs.randn(256, 8).astype(np.float32)
+        y = (x @ rs.randn(8, 1)).astype(np.float32)
+        m = Sequential()
+        m.add(Dense(8, activation="relu", input_shape=(8,)))
+        m.add(Dense(1))
+        install_chaos(ChaosPlan([FaultSpec(
+            site=SITE_TRAINER_DISPATCH, at_step=5, kind="lose_host",
+            survivors=[d.id for d in devices[:4]])]))
+        est = Estimator(m, optim_method=SGD(learning_rate=0.05),
+                        model_dir=str(tmp_path / "model"))
+        est.train(DataPipeline(x, y, batch_size=32, seed=11,
+                               name="incident"),
+                  "mse", end_trigger=MaxEpoch(1),
+                  checkpoint_trigger=SeveralIteration(4))
+        assert est._mesh.devices.size == 4      # recovery happened
+
+        doc = incident.diagnose(run_dir)
+        assert doc["identified"] is True
+        assert doc["root_cause"] == "lost_host"
+        top = doc["hypotheses"][0]
+        assert top["confidence"] >= incident.ROOT_CAUSE_THRESHOLD
+        assert len(top["evidence"]) >= 1
+        kinds = {e["kind"] for e in flightrec.read_events(run_dir)}
+        assert {"train.failure", "mesh.reform", "chaos.trip"} <= kinds
+        # the reform citation carries the topology change
+        reform = [e for e in flightrec.read_events(run_dir)
+                  if e["kind"] == "mesh.reform"][0]
+        assert (reform["d"]["old_devices"],
+                reform["d"]["new_devices"]) == (8, 4)
+
+        proc = _doctor(run_dir, tmp_path=tmp_path)
+        assert proc.returncode == 0, proc.stderr
+        assert "lost_host" in proc.stdout
+
+
+# ===================================== chaos SIGKILL journal survival
+class TestJournalSurvivesChaosKill:
+    def test_chaos_kill_leaves_the_trip_in_the_journal(self, tmp_path):
+        """``kill`` is ``os._exit`` — no atexit, no blackbox.  The
+        incrementally flushed chaos.trip line is the only evidence
+        that survives, and it must both survive and lint clean."""
+        slot = str(tmp_path / "run" / "host-0")
+        code = (
+            "import sys\n"
+            f"sys.path.insert(0, {REPO_ROOT!r})\n"
+            "from analytics_zoo_tpu.observability import flightrec\n"
+            f"flightrec.init_flightrec({slot!r})\n"
+            "from analytics_zoo_tpu.resilience.chaos import (\n"
+            "    ChaosPlan, FaultSpec, install_chaos)\n"
+            "install_chaos(ChaosPlan([FaultSpec(\n"
+            "    site='worker.step', at_step=0, kind='kill')]))\n"
+            "from analytics_zoo_tpu.resilience.chaos import "
+            "active_chaos\n"
+            "active_chaos().trip('worker.step', 0)\n"
+            "print('UNREACHABLE')\n")
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, timeout=60,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 137
+        assert "UNREACHABLE" not in proc.stdout
+        events = flightrec.read_events(slot)
+        trips = [e for e in events if e["kind"] == "chaos.trip"]
+        assert len(trips) == 1
+        assert trips[0]["d"] == {"site": "worker.step", "step": 0,
+                                 "kind": "kill"}
+        # the corpse's journal lints clean (torn tail allowed)
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "_mlint_i", os.path.join(REPO_ROOT, "scripts",
+                                     "metrics_lint.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+        assert mod.lint_events(slot) == []
+
+
+# ===================================== control-plane decision-time state
+class TestDecisionTimePersistence:
+    def test_supervisor_persists_scale_state_and_events(self, tmp_path):
+        from analytics_zoo_tpu.resilience import DegradedTraining
+        from analytics_zoo_tpu.serving.supervisor import \
+            ServingSupervisor
+
+        run_dir = str(tmp_path / "run")
+        sup = ServingSupervisor(
+            lambda i, inc: ([sys.executable, "-c",
+                             "import sys; sys.exit(3)"], {}),
+            replicas=1, retry_times=2, retry_window_s=60.0,
+            backoff_base_s=0.05, backoff_max_s=0.1, run_dir=run_dir)
+        with pytest.raises(DegradedTraining):
+            sup.run(poll_interval_s=0.05)
+
+        state = json.load(open(os.path.join(run_dir,
+                                            "supervisor.json")))
+        assert state["restarts_total"] == 2
+        assert state["replica_trajectory"]      # [t, size, reason]
+        assert all(len(row) == 3
+                   for row in state["replica_trajectory"])
+        assert state["scale_events"] == []      # no autoscaler here
+        kinds = [e["kind"] for e in flightrec.read_events(run_dir)]
+        assert kinds.count("replica.spawn") == 3    # 1 + 2 restarts
+        assert "replica.exit" in kinds
+        assert "fleet.degraded" in kinds
+        # the degraded run diagnoses to budget exhaustion
+        doc = incident.diagnose(run_dir)
+        causes = [h["cause"] for h in doc["hypotheses"]]
+        assert "restart_budget_exhausted" in causes
+
+    def test_coordinator_persists_respawn_ledger(self, tmp_path):
+        from analytics_zoo_tpu.batchjobs.coordinator import (
+            BatchCoordinator, _BudgetExhausted)
+        from analytics_zoo_tpu.batchjobs.demo import demo_job
+
+        job = demo_job(str(tmp_path / "out"), num_rows=64,
+                       rows_per_shard=64)
+        run_dir = str(tmp_path / "run")
+        coord = BatchCoordinator(
+            job, run_dir, num_workers=1, retry_times=2,
+            backoff_base_s=0.01,
+            worker_factory=lambda i, inc: (
+                [sys.executable, "-c", "pass"], dict(os.environ)))
+        slot = coord._slots[0]
+        try:
+            slot.incarnation = 1
+            coord._handle_exit(slot, -9, complete=False)
+            ledger = json.load(open(os.path.join(
+                run_dir, "job", "respawns.json")))
+            assert ledger["restarts_total"] == 1
+            assert ledger["deaths"][0]["classification"] == \
+                "signal(SIGKILL)"
+            assert ledger["respawns"][0]["process_index"] == 0
+            assert ledger["respawns"][0]["budget_left"] == 1
+            # exhaust the budget: the ledger still lands AT decision
+            # time, with the terminal death recorded
+            coord._handle_exit(slot, -9, complete=False)
+            with pytest.raises(_BudgetExhausted):
+                coord._handle_exit(slot, -9, complete=False)
+            ledger = json.load(open(os.path.join(
+                run_dir, "job", "respawns.json")))
+            assert len(ledger["deaths"]) == 3
+            assert len(ledger["respawns"]) == 2
+            kinds = [e["kind"]
+                     for e in flightrec.read_events(run_dir)]
+            assert kinds.count("worker.respawn") == 2
+            assert "fleet.degraded" in kinds
+        finally:
+            coord.stop()
+
+    def test_lease_lifecycle_reports_flight_events(self, tmp_path):
+        from analytics_zoo_tpu.batchjobs import (
+            LeaseClient, LeaseLost, ShardManifest)
+        from analytics_zoo_tpu.batchjobs.demo import demo_job
+
+        run_dir = _host_slot(tmp_path)
+        job = demo_job(str(tmp_path / "out"), num_rows=64,
+                       rows_per_shard=64, lease_timeout_s=5.0)
+        ShardManifest.create(job, run_dir)
+        now = time.time()
+        a = LeaseClient(run_dir, owner="a", clock=lambda: now)
+        assert a.claim_shards(limit=1)
+        # b's clock is past a's lease expiry: steal, with debt
+        b = LeaseClient(run_dir, owner="b", clock=lambda: now + 60.0)
+        assert b.claim_shards(limit=1)
+        with pytest.raises(LeaseLost):
+            a.renew(0)
+        by_kind = {}
+        for ev in flightrec.read_events(run_dir):
+            by_kind.setdefault(ev["kind"], []).append(ev)
+        assert by_kind["lease.claim"][0]["d"]["owner"] == "a"
+        steal = by_kind["lease.steal"][0]["d"]
+        assert (steal["owner"], steal["victim"]) == ("b", "a")
+        assert by_kind["lease.lost"][0]["d"]["to"] == "b"
+
+
+# ------------------------------------------------------- CLI edge cases
+class TestDoctorCli:
+    def test_unidentified_run_exits_one(self, tmp_path):
+        run_dir = _host_slot(tmp_path)
+        flightrec.record_event("replica.spawn", replica=0)
+        flightrec.get_active_flightrec().close()
+        proc = _doctor(run_dir, tmp_path=tmp_path)
+        assert proc.returncode == 1             # healthy ≠ diagnosed
+        assert "no hypothesis" in proc.stdout.lower()
+
+    def test_unreadable_run_dir_exits_two(self, tmp_path):
+        proc = _doctor(str(tmp_path / "nope"), tmp_path=tmp_path)
+        assert proc.returncode == 2
+
+    def test_json_output_is_the_incident_doc(self, tmp_path):
+        run_dir = _host_slot(tmp_path)
+        flightrec.record_event("quarantine", entry_id="1-1",
+                               uri="u", request_id="r", deliveries=2)
+        flightrec.get_active_flightrec().close()
+        proc = _doctor(run_dir, "--json", tmp_path=tmp_path)
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc["incident_schema"] == 1
+        assert doc["root_cause"] == "poison_record"
