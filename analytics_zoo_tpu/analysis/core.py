@@ -154,6 +154,8 @@ def all_rule_classes() -> List[Type[Rule]]:
         rules_flow as _rules_flow)
     from analytics_zoo_tpu.analysis import (  # noqa: F401
         rules_graph as _rules_graph)
+    from analytics_zoo_tpu.analysis import (  # noqa: F401
+        rules_race as _rules_race)
     return list(_RULE_CLASSES)
 
 
@@ -281,6 +283,11 @@ class ModuleContext:
         #: dotted constant name -> axis string it denotes
         #: ("analytics_zoo_tpu.parallel.mesh.DATA_AXIS" -> "data")
         self.axis_constants: Dict[str, str] = {}
+        #: qualname -> thread-role set (zoolint v4 fact bundle); every
+        #: function not mentioned runs on the implicit "main" role
+        self.thread_roles: Dict[str, frozenset] = {}
+        #: qualname -> role set for discovered thread ENTRY points
+        self.thread_entries: Dict[str, frozenset] = {}
         self._index()
         # the tokenize-based suppression scan is LAZY (see
         # ``suppressed``): only modules that actually report findings
@@ -419,8 +426,15 @@ class ModuleContext:
         stack: List[ast.AST] = []
         self._name_assigns: Dict[str, List[ast.Assign]] = {}
         self._decorated_spans: List[List[int]] = []
+        #: every node, pre-order — the full-tree passes (jit
+        #: discovery, rule dispatch, project scans, lock registry)
+        #: iterate this flat list instead of re-running ``ast.walk``
+        #: over the tree; the generic-visit machinery (iter_fields +
+        #: a deque) is the single largest cost in the gate profile
+        self.all_nodes: List[ast.AST] = []
 
         def walk(node: ast.AST, parent: Optional[ast.AST]) -> None:
+            self.all_nodes.append(node)
             if parent is not None:
                 self._parents[id(node)] = parent
             is_func = isinstance(node, (ast.FunctionDef,
@@ -641,7 +655,7 @@ class ModuleContext:
         same node types — merged to keep ModuleContext construction
         at two tree passes total)."""
         roots: List[Tuple[ast.AST, bool]] = []   # (fn, compiled?)
-        for node in ast.walk(self.tree):
+        for node in self.all_nodes:
             # f = jax.jit(g) / @jax.jit / @partial(jax.jit, ...)
             if isinstance(node, ast.Call):
                 fname = self.resolve(node.func)
@@ -780,6 +794,11 @@ class ModuleContext:
         if axes is not None:
             self.axis_universe = set(axes)
         self.axis_constants.update(facts.get("axis_constants") or {})
+        # thread-role attribution (zoolint v4): qualname -> role set
+        for qual, roles in (facts.get("thread_roles") or {}).items():
+            self.thread_roles[qual] = frozenset(roles)
+        for qual, roles in (facts.get("thread_entries") or {}).items():
+            self.thread_entries[qual] = frozenset(roles)
 
 
 # --------------------------------------------------------------- driver
@@ -878,6 +897,19 @@ def _jobs_worker(i: int) -> List[Finding]:
     return _run_rules(ctx, _JOBS_STATE["rule_ids"])
 
 
+def _jobs_project_worker(i: int) -> List[Finding]:
+    """One project-rule GROUP (see project_rule_groups) in a pool
+    worker: the fork-inherited ProjectContext is fully linked, so a
+    child can run cross-module rules exactly as the parent would."""
+    from analytics_zoo_tpu.analysis import project as project_mod
+    out = project_mod.project_findings(_JOBS_STATE["proj"],
+                                       _JOBS_STATE["proj_groups"][i])
+    only = _JOBS_STATE["only_relpaths"]
+    if only is not None:
+        out = [f for f in out if f.path in only]
+    return out
+
+
 def analyze_paths(paths: Sequence[str], root: str = ".",
                   rule_ids: Optional[Iterable[str]] = None,
                   jobs: int = 1,
@@ -912,15 +944,19 @@ def analyze_paths(paths: Sequence[str], root: str = ".",
     run_contexts = contexts if only_relpaths is None else \
         [c for c in contexts if c.relpath in only_relpaths]
 
-    def run_project_rules() -> List[Finding]:
-        out = project_mod.project_findings(proj, rule_ids)
+    def run_project_rules(ids: Optional[Iterable[str]] = None
+                          ) -> List[Finding]:
+        out = project_mod.project_findings(
+            proj, rule_ids if ids is None else ids)
         if only_relpaths is not None:
             out = [f for f in out if f.path in only_relpaths]
         return out
 
     if jobs > 1 and len(run_contexts) > 1:
         findings.extend(_run_rules_pool(run_contexts, rule_ids, jobs,
-                                        overlap=run_project_rules))
+                                        overlap=run_project_rules,
+                                        proj=proj,
+                                        only_relpaths=only_relpaths))
     else:
         for ctx in run_contexts:
             findings.extend(_run_rules(ctx, rule_ids))
@@ -931,10 +967,16 @@ def analyze_paths(paths: Sequence[str], root: str = ".",
 
 def _run_rules_pool(contexts: List[ModuleContext],
                     rule_ids: Optional[Iterable[str]],
-                    jobs: int, overlap) -> List[Finding]:
-    """Fan the per-module rule runs over a fork-start process pool,
-    running ``overlap()`` (the project-level rules) in the parent
-    while the workers grind.  Fork (not spawn) is load-bearing:
+                    jobs: int, overlap, proj=None,
+                    only_relpaths: Optional[Set[str]] = None
+                    ) -> List[Finding]:
+    """Fan the per-module rule runs over a fork-start process pool.
+    The project-level rules are the wall-clock long pole (the race
+    index + lock summaries cost more than the whole fanned-out
+    module pass), so they are split by memo-sharing GROUP
+    (project_rule_groups): the parent runs the heaviest group as
+    ``overlap(ids)`` while the pool runs the remaining groups ahead
+    of the module chunks.  Fork (not spawn) is load-bearing:
     children inherit the parent's already-parsed contexts AND its
     stub ``analytics_zoo_tpu`` parent module, so a ``--jobs`` run
     stays jax-free even on images where the real package is
@@ -960,15 +1002,35 @@ def _run_rules_pool(contexts: List[ModuleContext],
     except ValueError:
         return serial()
     n = len(contexts)
+    groups: List[List[str]] = []
+    if proj is not None:
+        from analytics_zoo_tpu.analysis import project as project_mod
+        wanted = {r.upper() for r in rule_ids} if rule_ids else None
+        groups = [[rid for rid in g
+                   if wanted is None or rid in wanted]
+                  for g in project_mod.project_rule_groups()]
+        groups = [g for g in groups if g]
     _JOBS_STATE["contexts"] = contexts
     _JOBS_STATE["rule_ids"] = list(rule_ids) if rule_ids else None
+    _JOBS_STATE["proj"] = proj
+    _JOBS_STATE["proj_groups"] = groups[:-1]
+    _JOBS_STATE["only_relpaths"] = only_relpaths
     try:
         with mp.Pool(processes=min(jobs, n)) as pool:
+            # project groups are queued FIRST — they are the long
+            # poles, and a worker that picks up module chunks ahead
+            # of one would push the whole run past the serial time
+            proj_async = [pool.apply_async(_jobs_project_worker, (i,))
+                          for i in range(len(groups) - 1)]
             async_result = pool.map_async(
                 _jobs_worker, range(n),
                 chunksize=max(1, n // (min(jobs, n) * 2)))
-            out = list(overlap())   # parent works too, not just waits
+            # parent works too, not just waits: it takes the
+            # heaviest group (rules_race sorts last)
+            out = list(overlap(groups[-1])) if groups else []
             chunks = async_result.get()
+            for a in proj_async:
+                out.extend(a.get())
         return out + [f for chunk in chunks for f in chunk]
     finally:
         _JOBS_STATE.clear()
@@ -989,7 +1051,7 @@ def _run_rules(ctx: ModuleContext,
         for attr in dir(rule):
             if attr.startswith("visit_"):
                 dispatch.setdefault(attr[6:], []).append(rule)
-    for node in ast.walk(ctx.tree):
+    for node in ctx.all_nodes:
         for rule in dispatch.get(type(node).__name__, ()):
             getattr(rule, f"visit_{type(node).__name__}")(node, ctx)
     findings: List[Finding] = []

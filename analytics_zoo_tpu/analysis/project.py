@@ -116,10 +116,15 @@ class ProjectContext:
         self._rng_consumed: Dict[FuncKey, Set[str]] = {}
         self._rng_call_facts: Dict[str, Dict[Tuple[int, int],
                                              List[str]]] = {}
+        #: thread-role inference (zoolint v4): FuncKey -> role set,
+        #: and the spawn-target entry points seeding it
+        self.thread_entries: Dict[FuncKey, Set[str]] = {}
+        self.thread_roles: Dict[FuncKey, frozenset] = {}
         self._propagate_traced()
         self._propagate_hot_loops()
         self._summarize_rng_consumers()
         self._collect_train_steps()
+        self._infer_thread_roles()
 
     # ------------------------------------------------------------ indexing
     def _index_functions(self) -> None:
@@ -166,7 +171,7 @@ class ProjectContext:
             callback_sites: List[ast.Call] = []
             edges: List[Tuple[FuncKey, CallEdge]] = []
             wrappers: List[Tuple[ast.Call, str]] = []
-            for node in ast.walk(ctx.tree):
+            for node in ctx.all_nodes:
                 if not isinstance(node, ast.Call):
                     continue
                 fname = ctx.resolve(node.func) or ""
@@ -600,6 +605,138 @@ class ProjectContext:
                 })
         self.train_steps.sort(key=lambda d: (d["path"], d["line"]))
 
+    # ------------------------------------------------- thread roles (v4)
+    #: callables whose target/callback runs on ANOTHER thread (or in a
+    #: teardown context concurrent with daemon threads)
+    _SPAWN_CTORS = {"threading.Thread", "Thread", "threading.Timer",
+                    "Timer", "_thread.start_new_thread"}
+
+    def _infer_thread_roles(self) -> None:
+        """Discover thread entry points (``Thread(target=...)``,
+        executor ``submit``, ``atexit``/``signal`` hooks) and compute,
+        to fixpoint through the call graph, which functions run on
+        which ROLES — so every ``self.attr`` access site can be
+        attributed to the set of threads that may execute it.
+
+        Role naming: the spawn's literal ``name=`` kwarg when present
+        (its last ``-``-separated token: ``"zoo-serving-batcher"`` →
+        ``batcher``), else the entry function's qualname.  ``main`` is
+        the implicit role of everything reachable outside any spawn
+        target.  Propagation never flows INTO an entry function: its
+        roles come from its spawn sites only (a ``run()`` used both
+        foreground and as a thread target keeps the thread role — the
+        conservative choice for race detection)."""
+        entries: Dict[FuncKey, Set[str]] = {}
+        for ctx in self.contexts:
+            for node in ctx.all_nodes:
+                if not isinstance(node, ast.Call):
+                    continue
+                target, hint = self._spawn_target(ctx, node)
+                if target is None:
+                    continue
+                key = self.resolve_func_expr(ctx, target, node)
+                if key is None:
+                    continue
+                role = hint or key[1].rsplit(".", 1)[-1].lower()
+                entries.setdefault(key, set()).add(role)
+        self.thread_entries = entries
+        roles: Dict[FuncKey, Set[str]] = {
+            k: set(v) for k, v in entries.items()}
+        work = list(entries)
+        while work:
+            f = work.pop()
+            r = roles.get(f)
+            if not r:
+                continue
+            for edge in self.calls.get(f, ()):
+                if edge.callee in entries:
+                    continue
+                cur = roles.setdefault(edge.callee, set())
+                if not r <= cur:
+                    cur |= r
+                    work.append(edge.callee)
+        # main-reachability: seeds are functions nobody in the project
+        # calls (public API, handlers invoked by frameworks) that are
+        # not spawn targets; flows forward, never into entries
+        callers: Set[FuncKey] = set()
+        for edges in self.calls.values():
+            for e in edges:
+                callers.add(e.callee)
+        main: Set[FuncKey] = set()
+        work = [f for f in self.functions
+                if f not in entries and f not in callers]
+        while work:
+            f = work.pop()
+            if f in main:
+                continue
+            main.add(f)
+            for edge in self.calls.get(f, ()):
+                if edge.callee not in entries and edge.callee not in main:
+                    work.append(edge.callee)
+        final: Dict[FuncKey, frozenset] = {}
+        for f in self.functions:
+            r = set(roles.get(f, ()))
+            if f in main or not r:
+                r.add("main")
+            final[f] = frozenset(r)
+        self.thread_roles = final
+
+    def _spawn_target(self, ctx: ModuleContext, node: ast.Call
+                      ) -> Tuple[Optional[ast.AST], Optional[str]]:
+        """(target-callable expr, role-name hint) when ``node`` hands
+        a callable to another thread; (None, None) otherwise."""
+        fname = ctx.resolve(node.func) or ""
+        if fname in self._SPAWN_CTORS:
+            target = None
+            hint = None
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    target = kw.value
+                elif kw.arg == "name":
+                    hint = self._role_from_name(kw.value)
+            if target is None and node.args:
+                # Timer(interval, fn) / start_new_thread(fn, args)
+                tail = fname.rsplit(".", 1)[-1]
+                if tail == "Timer" and len(node.args) > 1:
+                    target = node.args[1]
+                elif tail == "start_new_thread":
+                    target = node.args[0]
+                elif tail == "Thread":
+                    target = node.args[0]
+            return target, hint
+        # atexit hooks run ON the main thread (after it finishes) —
+        # they are an entry point for reachability, but attributing a
+        # distinct role would mint false main-vs-atexit race pairs;
+        # "main" keeps them conflicting only with real worker threads
+        if fname == "atexit.register" and node.args:
+            return node.args[0], "main"
+        if fname == "signal.signal" and len(node.args) > 1:
+            return node.args[1], "signal"
+        # executor.submit(fn, ...) — only receivers that NAME a pool,
+        # so serving's engine.submit(requests) never misresolves
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in ("submit", "map") and node.args:
+            recv = (_dotted(node.func.value) or "").lower()
+            if "pool" in recv or "executor" in recv:
+                return node.args[0], "pool"
+        return None, None
+
+    @staticmethod
+    def _role_from_name(expr: ast.AST) -> Optional[str]:
+        """Role from a Thread ``name=`` value: the last dash token of
+        the literal prefix (``"zoo-serving-batcher"`` → ``batcher``,
+        ``f"zoo-metrics-http:{port}"`` → ``http``)."""
+        text = None
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            text = expr.value
+        elif isinstance(expr, ast.JoinedStr) and expr.values and \
+                isinstance(expr.values[0], ast.Constant):
+            text = str(expr.values[0].value)
+        if not text:
+            return None
+        token = text.split(":")[0].rstrip("-").rsplit("-", 1)[-1]
+        return token.lower() or None
+
     # ------------------------------------------------------------ facts
     def compute_facts(self) -> Dict[str, Dict]:
         axes = sorted(self.axis_names) if self.axis_names \
@@ -620,7 +757,15 @@ class ProjectContext:
                     ctx.relpath, {}),
                 "axes": axes,
                 "axis_constants": dict(self.axis_constants),
+                "thread_roles": {},
+                "thread_entries": {},
             }
+        for (rel, qual), roleset in self.thread_roles.items():
+            if rel in facts:
+                facts[rel]["thread_roles"][qual] = sorted(roleset)
+        for (rel, qual), roleset in self.thread_entries.items():
+            if rel in facts:
+                facts[rel]["thread_entries"][qual] = sorted(roleset)
         for (rel, qual), (kind, reason) in self._marks_traced.items():
             if rel in facts:
                 facts[rel]["traced"][qual] = (kind, reason)
@@ -672,16 +817,34 @@ def register_project_rule(cls):
 
 def project_rule_classes() -> List[type]:
     """The registered project-level rules (for --list-rules and the
-    docs catalog); rules_graph registers on import."""
+    docs catalog); rules_graph/rules_race register on import."""
     from analytics_zoo_tpu.analysis import rules_graph  # noqa: F401
+    from analytics_zoo_tpu.analysis import rules_race  # noqa: F401
     return list(_PROJECT_RULE_CLASSES)
+
+
+def project_rule_groups() -> List[List[str]]:
+    """Project-rule ids grouped by defining module, module names
+    sorted.  Rules that share a per-project memo (the race index
+    feeding both RACE016 and ATOM017) live in the same module by
+    construction, so a group can run in its own ``--jobs`` worker
+    without recomputing a sibling group's memo.  ``rules_race`` —
+    the heaviest group — sorts last; ``--jobs`` hands it to the
+    parent process and fans the rest over the pool."""
+    from analytics_zoo_tpu.analysis import rules_graph  # noqa: F401
+    from analytics_zoo_tpu.analysis import rules_race  # noqa: F401
+    by_mod: Dict[str, List[str]] = {}
+    for cls in _PROJECT_RULE_CLASSES:
+        by_mod.setdefault(cls.__module__, []).append(cls.rule_id)
+    return [by_mod[m] for m in sorted(by_mod)]
 
 
 def project_findings(proj: ProjectContext,
                      rule_ids: Optional[Iterable[str]] = None
                      ) -> List[Finding]:
-    # rules_graph registers its project rules on import
+    # rules_graph/rules_race register their project rules on import
     from analytics_zoo_tpu.analysis import rules_graph  # noqa: F401
+    from analytics_zoo_tpu.analysis import rules_race  # noqa: F401
     wanted = {r.upper() for r in rule_ids} if rule_ids else None
     out: List[Finding] = []
     for cls in _PROJECT_RULE_CLASSES:

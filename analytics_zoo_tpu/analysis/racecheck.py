@@ -1,0 +1,613 @@
+"""zoo-racecheck — deterministic schedule-fuzzing race sanitizer.
+
+The RUNTIME half of zoolint v4's race family.  RACE016 proves a
+lockset/role conflict *statically*; this module arms a sanitizer over
+opted-in classes and reports the races that actually happen-before-
+violate under a *deterministically perturbed* schedule, so every
+static finding can be labeled confirmed/unconfirmed and the sanitizer
+can be pointed at any tier-1 concurrency test.
+
+Detection model (FastTrack-style happens-before, not sampling):
+
+* every thread carries a **vector clock**; ``Thread.start``/``join``
+  draw fork/join edges (so pre-``start()`` initialization is ordered
+  and never reported);
+* lock ``acquire``/``release`` (including ``with lock:`` enter/exit,
+  observed through the ``sys.setprofile`` c_call hook — locks are C
+  objects and cannot be monkeypatched) draw release→acquire edges.
+  ``queue.Queue``/``Condition``/``Event`` synchronize through an
+  internal lock, so the sanctioned hand-off idioms are ordered *for
+  free* — no idiom allowlist to drift out of date;
+* attribute reads/writes on **opted-in classes** (``arm(watch=...)``
+  swaps in instrumented ``__getattribute__``/``__setattr__``) are
+  checked against the last write of the same ``(instance, attr)``:
+  two WRITES, distinct threads, no happens-before path → violation.
+  Write-write is the whole hazard class at attribute-rebind
+  granularity: under the GIL a lone read racing one writer is the
+  sanctioned monotonic-counter / atomic-swap idiom (static RACE016
+  grants the same write×read exemption), while every dangerous
+  RACE016 shape — RMW, check-then-act, mutation on ≥2 roles — lands
+  a write on each participating thread and surfaces here as an
+  unordered write pair.  Reads still take the chaos yield (they are
+  the interleaving points that turn a latent lost-update into a
+  visible one) but stay out of the ledger.
+
+Determinism: the access ledger is updated under one internal lock
+(excluded from the happens-before model), so a racy pair is detected
+on EVERY schedule, not just unlucky ones — the CI drill requires
+100/100, and pure happens-before needs no "did it actually
+interleave" luck.  Schedule fuzzing (seeded ``sys.setswitchinterval``
+plus per-thread chaos yields at access points) exists to shake out
+*consequences* (torn state, stomped entries) and to vary which access
+pair is reported first, not to make detection possible.
+
+Zero cost disarmed: importing this module patches nothing; ``arm()``
+installs the hooks and ``disarm()`` restores every original.
+
+CONTRACT: stdlib-only, loadable by file path (``scripts/zoo-racecheck``
+runs on control nodes without jax).
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import threading
+import time
+from typing import Any, Dict, FrozenSet, List, Optional, Set, Tuple
+
+__all__ = [
+    "Sanitizer", "Violation", "arm", "disarm", "active", "violations",
+    "join_static", "racy_fixture", "clean_fixture", "selftest",
+]
+
+#: attribute prefixes never tracked (dunders are protocol traffic;
+#: ``_rc_`` is this module's own namespace)
+_SKIP_PREFIXES = ("__", "_rc_")
+
+#: C method names that mean "this thread acquired/released a lock"
+_ACQUIRE_NAMES = ("acquire", "__enter__", "acquire_lock")
+_RELEASE_NAMES = ("release", "__exit__", "release_lock")
+
+#: types whose acquire/release draw happens-before edges.  Matched by
+#: name so the set works without importing _thread internals.
+_LOCK_TYPE_NAMES = ("lock", "RLock", "_RLock")
+
+
+class Violation:
+    """One happens-before violation on ``(class, attr)``."""
+
+    __slots__ = ("cls", "attr", "kind", "thread_a", "thread_b",
+                 "site_a", "site_b")
+
+    def __init__(self, cls: str, attr: str, kind: str,
+                 thread_a: str, thread_b: str,
+                 site_a: str, site_b: str):
+        self.cls = cls
+        self.attr = attr
+        self.kind = kind          # "write-write" (the GIL-level hazard)
+        self.thread_a = thread_a
+        self.thread_b = thread_b
+        self.site_a = site_a
+        self.site_b = site_b
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.cls, self.attr, self.kind)
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"class": self.cls, "attr": self.attr,
+                "kind": self.kind, "thread_a": self.thread_a,
+                "thread_b": self.thread_b, "site_a": self.site_a,
+                "site_b": self.site_b}
+
+    def __repr__(self) -> str:
+        return (f"Violation({self.cls}.{self.attr} {self.kind} "
+                f"{self.thread_a}@{self.site_a} vs "
+                f"{self.thread_b}@{self.site_b})")
+
+
+class _VC:
+    """Vector clock, thread-name keyed.  Plain dict ops only — every
+    mutation happens either under the ledger lock or on state owned
+    by exactly one thread (its own clock)."""
+
+    __slots__ = ("c",)
+
+    def __init__(self, c: Optional[Dict[str, int]] = None):
+        self.c = dict(c) if c else {}
+
+    def copy(self) -> "_VC":
+        return _VC(self.c)
+
+    def tick(self, tid: str) -> None:
+        self.c[tid] = self.c.get(tid, 0) + 1
+
+    def join(self, other: "_VC") -> None:
+        for k, v in other.c.items():
+            if v > self.c.get(k, 0):
+                self.c[k] = v
+
+    def covers(self, tid: str, clock: int) -> bool:
+        """Does this clock know of ``tid``'s event at ``clock``? —
+        the epoch happens-before test."""
+        return self.c.get(tid, 0) >= clock
+
+
+class _AccessRecord:
+    """Per ``(instance id, attr)`` ledger entry."""
+
+    __slots__ = ("last_write",)
+
+    def __init__(self):
+        # (tid, tid-clock, site)
+        self.last_write: Optional[Tuple[str, int, str]] = None
+
+
+def _site(depth: int) -> str:
+    """``file:lineno`` of the access site: the frame above the
+    instrumented accessor."""
+    try:
+        f = sys._getframe(depth)
+        return f"{f.f_code.co_filename}:{f.f_lineno}"
+    except ValueError:          # pragma: no cover - shallow stack
+        return "?"
+
+
+class Sanitizer:
+    """The armed state: instrumented classes, per-thread clocks, the
+    access ledger, and the profile/chaos hooks."""
+
+    def __init__(self, *, seed: int = 0, chaos: bool = True,
+                 switch_interval: Optional[float] = 1e-5,
+                 max_violations: int = 200):
+        self.seed = seed
+        self.chaos = chaos
+        self.switch_interval = switch_interval
+        self.max_violations = max_violations
+        self._armed = False
+        self._ledger_lock = threading.Lock()
+        self._patched: List[Tuple[type, Any, Any]] = []
+        self._thread_vc: Dict[str, _VC] = {}
+        self._lock_vc: Dict[int, _VC] = {}
+        self._records: Dict[Tuple[int, str], _AccessRecord] = {}
+        self._cls_of: Dict[int, str] = {}     # instance id -> class name
+        self._violations: List[Violation] = []
+        self._seen: Set[Tuple[str, str, str]] = set()
+        self._rngs: Dict[str, random.Random] = {}
+        self._tid_seq = 0
+        # per-session thread attr names: a thread outliving one
+        # arm()/disarm() cycle must not leak its old key or birth
+        # clock into the next session's (fresh) clock space
+        global _SESSION_SEQ
+        # sanitizers are constructed by arm(), which the controlling
+        # thread calls BEFORE any instrumented workload threads
+        # exist (single-controller contract)
+        # zoolint: disable=RACE005 — arm() runs pre-spawn, single-controller contract
+        _SESSION_SEQ += 1
+        self._tid_attr = f"_rc_tid_{_SESSION_SEQ}"
+        self._birth_attr = f"_rc_birth_{_SESSION_SEQ}"
+        self._tls = threading.local()
+        self._saved_start = None
+        self._saved_join = None
+        self._saved_switch: Optional[float] = None
+        self._saved_profile = None
+
+    # ------------------------------------------------------------ clocks
+    def _tid(self) -> str:
+        """Unique per-thread key.  NOT ``name#ident``: the OS reuses
+        idents and serving threads reuse names ("zoo-serving-batcher"
+        across a close()/run() restart), and a reused key would
+        resurrect the dead thread's clock — blocking birth-clock
+        adoption and minting false pre-start races.  A monotonic
+        sequence number keeps every incarnation distinct."""
+        t = threading.current_thread()
+        tid = getattr(t, self._tid_attr, None)
+        if tid is None:
+            with self._ledger_lock:
+                tid = getattr(t, self._tid_attr, None)
+                if tid is None:
+                    self._tid_seq += 1
+                    tid = f"{t.name}#{self._tid_seq}"
+                    setattr(t, self._tid_attr, tid)
+        return tid
+
+    def _vc(self, tid: str) -> _VC:
+        vc = self._thread_vc.get(tid)
+        if vc is None:
+            vc = self._thread_vc[tid] = _VC()
+            vc.tick(tid)
+        return vc
+
+    def _rng(self, tid: str) -> random.Random:
+        rng = self._rngs.get(tid)
+        if rng is None:
+            # per-thread stream: deterministic for a (seed, thread
+            # name) pair, no shared RNG lock to mask races with
+            rng = self._rngs[tid] = random.Random(
+                (self.seed, tid.split("#", 1)[0]).__repr__())
+        return rng
+
+    # ------------------------------------------------- fork/join edges
+    def _patch_thread_edges(self) -> None:
+        san = self
+        self._saved_start = threading.Thread.start
+        self._saved_join = threading.Thread.join
+        saved_start, saved_join = self._saved_start, self._saved_join
+
+        def start(thread, *a, **kw):          # type: ignore[no-redef]
+            parent = san._tid()
+            with san._ledger_lock:
+                pvc = san._vc(parent)
+                # fresh incarnation key + the parent's clock snapshot:
+                # the child adopts both on its first ledger touch
+                san._tid_seq += 1
+                setattr(thread, san._tid_attr,
+                        f"{thread.name}#{san._tid_seq}")
+                setattr(thread, san._birth_attr, pvc.copy())
+                pvc.tick(parent)
+            return saved_start(thread, *a, **kw)
+
+        def join(thread, *a, **kw):           # type: ignore[no-redef]
+            out = saved_join(thread, *a, **kw)
+            if not thread.is_alive():
+                me = san._tid()
+                dead = getattr(thread, san._tid_attr, None)
+                with san._ledger_lock:
+                    dvc = dead and san._thread_vc.get(dead)
+                    if dvc:
+                        san._vc(me).join(dvc)
+            return out
+
+        threading.Thread.start = start
+        threading.Thread.join = join
+
+    def _adopt_birth_vc(self, tid: str) -> None:
+        """First ledger touch on a thread: inherit the clock snapshot
+        its ``start()`` recorded (the fork edge)."""
+        if tid in self._thread_vc:
+            return
+        vc = self._vc(tid)
+        birth = getattr(threading.current_thread(),
+                        self._birth_attr, None)
+        if birth is not None:
+            vc.join(birth)
+
+    # ------------------------------------------------- lock HB edges
+    def _profile(self, frame, event, arg):
+        if event not in ("c_call", "c_return"):
+            return
+        name = getattr(arg, "__name__", "")
+        if name in _ACQUIRE_NAMES:
+            on_return = event == "c_return"
+        elif name in _RELEASE_NAMES:
+            on_return = False
+            if event != "c_call":
+                return
+        else:
+            return
+        obj = getattr(arg, "__self__", None)
+        if obj is None or \
+                type(obj).__name__ not in _LOCK_TYPE_NAMES:
+            return
+        if obj is self._ledger_lock:
+            return                        # our own lock: not modeled
+        tid = self._tid()
+        with self._ledger_lock:
+            self._adopt_birth_vc(tid)
+            vc = self._vc(tid)
+            if name in _ACQUIRE_NAMES:
+                if on_return:             # acquisition completed
+                    lvc = self._lock_vc.get(id(obj))
+                    if lvc is not None:
+                        vc.join(lvc)
+            else:                         # about to release
+                self._lock_vc[id(obj)] = vc.copy()
+                vc.tick(tid)
+
+    def _note_lock_read(self, lock: Any) -> None:
+        """A watched instance's lock-typed attribute was just read.
+
+        CPython's ``with`` statement emits a c_call profile event for
+        ``__exit__`` but NOT for ``__enter__`` (the special-method
+        lookup bypasses the profiler), so a raw ``with self.lock:``
+        would never get an acquire edge — only Condition/Queue do,
+        through their Python-level ``__enter__`` calling the inner
+        lock's explicitly.  The attribute READ in the with-header is
+        the observable proxy: by the time the thread's NEXT
+        instrumented write runs, the acquire has necessarily
+        completed, so the lock's clock is joined there (and while we
+        hold the lock nobody else can release it, so the join is
+        exact for the with-idiom).  A lock read that is never
+        followed by an acquire can only over-join — masking, never
+        inventing, a race."""
+        if not self._armed or lock is self._ledger_lock:
+            return
+        pend = getattr(self._tls, "pending_locks", None)
+        if pend is None:
+            pend = self._tls.pending_locks = []
+        pend.append(lock)
+
+    # --------------------------------------------------- access checks
+    def _on_access(self, obj: Any, attr: str, is_write: bool) -> None:
+        if not self._armed or attr.startswith(_SKIP_PREFIXES):
+            return
+        if getattr(self._tls, "busy", False):
+            return
+        self._tls.busy = True
+        try:
+            tid = self._tid()
+            if self.chaos and self._rng(tid).random() < 0.25:
+                time.sleep(0)             # forced schedule point
+            if not is_write:
+                return                    # reads: yield only (GIL)
+            site = _site(3)
+            with self._ledger_lock:
+                self._adopt_birth_vc(tid)
+                vc = self._vc(tid)
+                pend = getattr(self._tls, "pending_locks", None)
+                if pend:
+                    for lk in pend:       # see _note_lock_read
+                        lvc = self._lock_vc.get(id(lk))
+                        if lvc is not None:
+                            vc.join(lvc)
+                    del pend[:]
+                key = (id(obj), attr)
+                self._cls_of[id(obj)] = type(obj).__name__
+                rec = self._records.get(key)
+                if rec is None:
+                    rec = self._records[key] = _AccessRecord()
+                lw = rec.last_write
+                if lw is not None and lw[0] != tid and \
+                        not vc.covers(lw[0], lw[1]):
+                    self._emit(type(obj).__name__, attr,
+                               "write-write", lw[0], tid, lw[2], site)
+                vc.tick(tid)
+                rec.last_write = (tid, vc.c[tid], site)
+        finally:
+            self._tls.busy = False
+
+    def _emit(self, cls: str, attr: str, kind: str, ta: str, tb: str,
+              sa: str, sb: str) -> None:
+        v = Violation(cls, attr, kind, ta, tb, sa, sb)
+        if v.key() in self._seen or \
+                len(self._violations) >= self.max_violations:
+            return
+        self._seen.add(v.key())
+        self._violations.append(v)
+
+    # ------------------------------------------------- class instrumentation
+    def _instrument(self, cls: type) -> None:
+        san = self
+        orig_get = cls.__getattribute__
+        orig_set = cls.__setattr__
+
+        def __getattribute__(obj, name):      # noqa: N807
+            value = orig_get(obj, name)
+            if not name.startswith(_SKIP_PREFIXES):
+                try:
+                    inst = orig_get(obj, "__dict__")
+                except AttributeError:        # __slots__ classes
+                    inst = None
+                # data reads only: methods resolve on the class and
+                # carry no shared-state payload themselves
+                if inst is None or name in inst:
+                    san._on_access(obj, name, is_write=False)
+                    if type(value).__name__ in _LOCK_TYPE_NAMES:
+                        san._note_lock_read(value)
+            return value
+
+        def __setattr__(obj, name, value):    # noqa: N807
+            san._on_access(obj, name, is_write=True)
+            orig_set(obj, name, value)
+
+        self._patched.append((cls, orig_get, orig_set))
+        cls.__getattribute__ = __getattribute__   # type: ignore
+        cls.__setattr__ = __setattr__             # type: ignore
+
+    # ------------------------------------------------------------ lifecycle
+    def arm(self, watch) -> "Sanitizer":
+        if self._armed:
+            raise RuntimeError("sanitizer already armed")
+        self._armed = True
+        for cls in watch:
+            self._instrument(cls)
+        self._patch_thread_edges()
+        if self.switch_interval is not None:
+            self._saved_switch = sys.getswitchinterval()
+            # seeded perturbation: vary the interval a little per
+            # seed so reruns explore different preemption points
+            jitter = random.Random(self.seed).uniform(0.5, 1.5)
+            sys.setswitchinterval(self.switch_interval * jitter)
+        self._saved_profile = sys.getprofile()
+        sys.setprofile(self._profile)
+        threading.setprofile(self._profile)
+        tid = self._tid()       # outside the lock: _tid takes it too
+        with self._ledger_lock:
+            self._vc(tid)
+        return self
+
+    def disarm(self) -> List[Violation]:
+        if not self._armed:
+            return list(self._violations)
+        self._armed = False
+        sys.setprofile(self._saved_profile)
+        threading.setprofile(None)
+        if self._saved_switch is not None:
+            sys.setswitchinterval(self._saved_switch)
+        if self._saved_start is not None:
+            threading.Thread.start = self._saved_start
+            threading.Thread.join = self._saved_join
+        for cls, orig_get, orig_set in self._patched:
+            cls.__getattribute__ = orig_get       # type: ignore
+            cls.__setattr__ = orig_set            # type: ignore
+        self._patched.clear()
+        return list(self._violations)
+
+    @property
+    def violations(self) -> List[Violation]:
+        return list(self._violations)
+
+    def __enter__(self) -> "Sanitizer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.disarm()
+
+
+# ---------------------------------------------------------------- module API
+_ACTIVE: Optional[Sanitizer] = None
+_SESSION_SEQ = 0
+
+
+def arm(watch, *, seed: int = 0, chaos: bool = True,
+        switch_interval: Optional[float] = 1e-5) -> Sanitizer:
+    """Arm a fresh sanitizer over ``watch`` (iterable of classes)."""
+    global _ACTIVE
+    if _ACTIVE is not None and _ACTIVE._armed:
+        raise RuntimeError("zoo-racecheck: already armed")
+    # arm()/disarm() are controller-thread API by contract (the
+    # class-instrumentation swap cannot be raced); guarding the
+    # singleton would advertise a concurrency it does not support
+    # zoolint: disable=RACE005 — controller-thread API by contract
+    _ACTIVE = Sanitizer(seed=seed, chaos=chaos,
+                        switch_interval=switch_interval)
+    return _ACTIVE.arm(watch)
+
+
+def disarm() -> List[Violation]:
+    global _ACTIVE
+    if _ACTIVE is None:
+        return []
+    out = _ACTIVE.disarm()
+    # zoolint: disable=RACE005 — controller-thread API (see arm())
+    _ACTIVE = None
+    return out
+
+
+def active() -> Optional[Sanitizer]:
+    return _ACTIVE
+
+
+def violations() -> List[Violation]:
+    return [] if _ACTIVE is None else _ACTIVE.violations
+
+
+# ------------------------------------------------------------- static join
+def join_static(viols: List[Violation],
+                static_findings: List[Dict]) -> List[Dict]:
+    """Label every static RACE016 finding confirmed/unconfirmed
+    against the runtime violations, and surface runtime-only races.
+
+    Matching key: the static finding's ``symbol`` is
+    ``Class.Qual.attr``; a runtime violation matches when its class
+    name equals the symbol's class tail and the attr matches."""
+    runtime = {(v.cls, v.attr) for v in viols}
+    out: List[Dict] = []
+    matched: Set[Tuple[str, str]] = set()
+    for f in static_findings:
+        if f.get("rule") != "RACE016":
+            continue
+        sym = f.get("symbol") or ""
+        clsq, _, attr = sym.rpartition(".")
+        cls_tail = clsq.rpartition(".")[2]
+        hit = (cls_tail, attr) in runtime
+        if hit:
+            matched.add((cls_tail, attr))
+        out.append({"label": "confirmed" if hit else "unconfirmed",
+                    "symbol": sym, "path": f.get("path"),
+                    "line": f.get("line"),
+                    "message": f.get("message", "")})
+    for v in viols:
+        if (v.cls, v.attr) not in matched:
+            out.append({"label": "runtime-only",
+                        "symbol": f"{v.cls}.{v.attr}",
+                        "path": v.site_b.rsplit(":", 1)[0],
+                        "line": int(v.site_b.rsplit(":", 1)[1])
+                        if v.site_b.rsplit(":", 1)[1].isdigit() else 0,
+                        "message": f"{v.kind} between {v.thread_a} "
+                                   f"and {v.thread_b}"})
+    return out
+
+
+# --------------------------------------------------------------- fixtures
+class _RacyCounter:
+    """The CI drill's deliberately racy class: unlocked
+    read-modify-write from two threads — the exact RACE016 shape."""
+
+    def __init__(self):
+        self.value = 0
+
+    def bump(self, n: int) -> None:
+        for _ in range(n):
+            self.value = self.value + 1       # unlocked RMW
+
+
+class _QueueCounter:
+    """The clean twin: same workload, values handed to a single
+    owner thread through ``queue.Queue`` — must report ZERO."""
+
+    def __init__(self):
+        import queue
+        self.q = queue.Queue()
+        self.value = 0
+
+    def produce(self, n: int) -> None:
+        for _ in range(n):
+            self.q.put(1)
+
+    def drain(self, expect: int) -> None:
+        for _ in range(expect):
+            self.value = self.value + self.q.get()
+
+
+def racy_fixture(seed: int = 0, iters: int = 50) -> List[Violation]:
+    """Run the racy drill once under a fresh sanitizer; returns the
+    violations (non-empty on EVERY run — detection is happens-before,
+    not consequence-sampling)."""
+    san = Sanitizer(seed=seed)
+    san.arm([_RacyCounter])
+    try:
+        c = _RacyCounter()
+        ts = [threading.Thread(target=c.bump, args=(iters,),
+                               name=f"racer-{i}") for i in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        out = san.disarm()
+    return out
+
+
+def clean_fixture(seed: int = 0, iters: int = 50) -> List[Violation]:
+    """Run the queue-handoff twin once; must return []."""
+    san = Sanitizer(seed=seed)
+    san.arm([_QueueCounter])
+    try:
+        c = _QueueCounter()
+        producers = [threading.Thread(target=c.produce, args=(iters,),
+                                      name=f"producer-{i}")
+                     for i in (0, 1)]
+        owner = threading.Thread(target=c.drain, args=(2 * iters,),
+                                 name="owner")
+        for t in producers + [owner]:
+            t.start()
+        for t in producers + [owner]:
+            t.join()
+    finally:
+        out = san.disarm()
+    return out
+
+
+def selftest(runs: int = 100, seed: int = 0) -> Tuple[int, int]:
+    """(caught, runs) for the racy drill plus a clean-twin assertion
+    each round — the deterministic CI drill."""
+    caught = 0
+    for i in range(runs):
+        if racy_fixture(seed=seed + i):
+            caught += 1
+        leftover = clean_fixture(seed=seed + i)
+        if leftover:                          # pragma: no cover
+            raise AssertionError(
+                f"clean fixture reported {leftover!r}")
+    return caught, runs

@@ -784,14 +784,14 @@ class SharedStateRule(Rule):
         # names rebound via ``global X`` anywhere also count as shared
         # (the None-then-lazy-init singleton pattern)
         global_decls: Set[str] = set()
-        for node in ast.walk(ctx.tree):
+        for node in ctx.all_nodes:
             if isinstance(node, ast.Global):
                 global_decls.update(node.names)
         shared |= global_decls
         if not shared:
             return
         reads = self._read_counts(ctx, shared)
-        for node in ast.walk(ctx.tree):
+        for node in ctx.all_nodes:
             fn = ctx.enclosing_function(node)
             if fn is None:
                 continue   # module-level init runs before threads start
@@ -871,7 +871,7 @@ class SharedStateRule(Rule):
     def _read_counts(self, ctx: ModuleContext,
                      shared: Set[str]) -> Dict[str, int]:
         counts: Dict[str, int] = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.all_nodes:
             if isinstance(node, ast.Name) and node.id in shared:
                 counts[node.id] = counts.get(node.id, 0) + 1
         return counts

@@ -511,9 +511,16 @@ class LockOrderRule:
         Module-level ``X = threading.Lock()`` ->
         ``relpath::X``; ``self.X = threading.Lock()`` inside class C
         -> ``relpath::C.X`` (one id per class attribute: standard
-        instance-insensitive lock analysis)."""
+        instance-insensitive lock analysis).
+
+        Memoized on the ctx: the lock-order rule, the race index and
+        the publication rule all need this table, and the full AST
+        walk per module is the single hottest loop in the gate."""
+        cached = getattr(ctx, "_zoolint_lock_reg", None)
+        if cached is not None:
+            return cached
         reg: Dict[str, str] = {}
-        for node in ast.walk(ctx.tree):
+        for node in ctx.all_nodes:
             if not isinstance(node, ast.Assign) or \
                     not isinstance(node.value, ast.Call):
                 continue
@@ -533,6 +540,7 @@ class LockOrderRule:
                         reg[f"{ctx.relpath}::"
                             f"{ctx.class_qualname(cls)}."
                             f"{tgt.attr}"] = kind
+        ctx._zoolint_lock_reg = reg
         return reg
 
     def _lock_id(self, ctx: ModuleContext, registry: Dict[str, str],

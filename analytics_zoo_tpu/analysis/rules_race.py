@@ -1,0 +1,1212 @@
+"""zoolint v4 rule families — thread-role + lockset race analysis.
+
+Built on the PR 7 project graph (now carrying thread-role inference:
+``proj.thread_roles`` attributes every function to the set of thread
+roles that may execute it) and the LOCK010 lock-identity machinery.
+Catalog (docs/static-analysis.md renders the full entries with their
+runtime twins):
+
+==========  =========================================================
+RACE016     an instance attribute written on one thread role and
+            read/written on another with DISJOINT locksets — the
+            cross-thread data race class that is CPU-silent and
+            device-fatal (a skewed donated buffer, a stomped slot
+            free-list).  Exemptions: pre-``start()`` initialization,
+            ``queue.Queue``/``Event``/lock-typed attributes,
+            single-writer atomic-swap / monotonic-flag idioms
+            (plain write on one role, plain read on another) —
+            runtime twin: zoo-racecheck
+ATOM017     check-then-act on shared instance state under
+            inconsistent locks (``if self.x: ... self.x = y`` where
+            the guard and the write hold different locksets — the
+            PR 12 ``_backlog_seen`` registry-gauge-stomping class)
+            — runtime twin: the serving gauge/readiness metrics
+PUBLISH018  unsafe publication: an object handed to another thread
+            (Thread target/args, ``queue.put``, callback/registry
+            registration) while the constructing method keeps
+            mutating it un-locked — the consumer can observe the
+            half-initialized object — runtime twin: the
+            flight-recorder ``replica.spawn`` ordering
+WRITE019    non-atomic ``open(path, "w")`` to a run-dir-shared path
+            — a concurrent reader (obs_report, zoo-doctor, a peer
+            worker) sees a torn file; route through
+            ``common.fsutil.atomic_write_text``/``_bytes``
+==========  =========================================================
+
+RACE016/ATOM017 are project rules: they need the cross-module call
+graph (roles propagate through it; locksets inherited from callers
+are the intersection over all call sites).  PUBLISH018/WRITE019 are
+module rules with cheap source pre-filters, so the full-repo gate
+stays inside the PR 14 wall-time envelope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import (
+    Dict, FrozenSet, Iterable, List, Optional, Sequence, Set, Tuple)
+
+from analytics_zoo_tpu.analysis.cfg import (
+    EXC, FALSE, TRUE, CFGNode, State, run_forward)
+from analytics_zoo_tpu.analysis.core import (
+    Finding, ModuleContext, Rule, _dotted, register_rule)
+from analytics_zoo_tpu.analysis.project import (
+    FuncKey, ProjectContext, register_project_rule)
+from analytics_zoo_tpu.analysis.rules import _MUTATING_METHODS
+from analytics_zoo_tpu.analysis.rules_flow import (
+    _cfg_for, _functions, _walk_evaluated)
+from analytics_zoo_tpu.analysis.rules_graph import LockOrderRule, _short
+
+# ------------------------------------------------------------ access kinds
+
+READ = "read"
+WRITE = "write"       # plain ``self.x = v`` rebind
+RMW = "rmw"           # ``self.x += v`` read-modify-write
+MUT = "mut"           # in-place mutation: item store, ``.append()``…
+
+_STRENGTH = {READ: 0, WRITE: 1, RMW: 2, MUT: 3}
+_KIND_DESC = {READ: "read", WRITE: "assigned", RMW: "read-modified",
+              MUT: "mutated in place"}
+
+#: roles that name MANY concurrent threads even alone (executor pools)
+_MULTI_ROLES = frozenset({"pool"})
+
+#: attribute types that carry their own synchronization — handing
+#: state through these IS the sanctioned cross-thread idiom
+_SYNC_CTORS = frozenset({
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue", "Queue", "SimpleQueue", "LifoQueue",
+    "PriorityQueue", "multiprocessing.Queue",
+    "collections.deque", "deque",
+    "threading.Event", "Event", "threading.Barrier", "Barrier",
+    "threading.local", "concurrent.futures.ThreadPoolExecutor",
+    "ThreadPoolExecutor", "concurrent.futures.ProcessPoolExecutor",
+})
+
+#: thread-spawning constructors (mirrors the role-inference pass's
+#: set in project.py — kept in lockstep by test fixtures)
+_SPAWN_CTORS = frozenset({
+    "threading.Thread", "Thread", "threading.Timer", "Timer",
+    "_thread.start_new_thread",
+})
+
+#: methods that publish a callable to another thread — the cutoff for
+#: the pre-``start()`` initialization exemption
+_SPAWNING_ATTRS = ("start", "submit")
+_SPAWNING_RESOLVED = ("_thread.start_new_thread", "atexit.register",
+                      "signal.signal")
+
+#: method names whose body up to the first spawn is single-threaded
+#: construction (nothing else can hold the instance yet)
+_INIT_METHODS = ("__init__", "__post_init__")
+_START_METHODS = ("start", "open", "launch", "run_background")
+
+
+# --------------------------------------------------------------- helpers
+
+
+def _self_base(expr: ast.AST) -> Optional[str]:
+    """The innermost ``self.X`` attribute of an attribute/subscript
+    chain (``self.X.y[k]`` → ``X``); None when the chain is not
+    rooted at ``self``."""
+    cur = expr
+    while isinstance(cur, (ast.Attribute, ast.Subscript)):
+        if isinstance(cur, ast.Attribute) and \
+                isinstance(cur.value, ast.Name) and \
+                cur.value.id == "self":
+            return cur.attr
+        cur = cur.value
+    return None
+
+
+def _store_accesses(t: ast.AST) -> List[Tuple[str, str]]:
+    """(kind, attr) for every ``self``-rooted piece of a binding
+    target: direct ``self.X = …`` is a WRITE (rebind — candidates for
+    the atomic-swap exemption); ``self.X[k] = …`` / ``self.X.y = …``
+    mutate the object X already holds."""
+    out: List[Tuple[str, str]] = []
+    if isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            out.extend(_store_accesses(e))
+    elif isinstance(t, ast.Starred):
+        out.extend(_store_accesses(t.value))
+    elif isinstance(t, ast.Attribute) and \
+            isinstance(t.value, ast.Name) and t.value.id == "self":
+        out.append((WRITE, t.attr))
+    else:
+        base = _self_base(t)
+        if base is not None:
+            out.append((MUT, base))
+    return out
+
+
+def _fn_accesses(fn: ast.AST) -> Dict[Tuple[str, int],
+                                      Tuple[str, ast.AST, bool]]:
+    """(attr, line) -> (strongest kind, site node, constant-write?)
+    for every ``self.attr`` access lexically in ``fn`` (nested
+    defs/lambdas pruned — they are separate role-attributed
+    functions)."""
+    roots = fn.body if isinstance(fn.body, list) else [fn.body]
+    accs: Dict[Tuple[str, int], Tuple[str, ast.AST, bool]] = {}
+
+    def add(kind: str, attr: str, node: ast.AST,
+            const: bool = False) -> None:
+        key = (attr, getattr(node, "lineno", 0))
+        cur = accs.get(key)
+        if cur is None or _STRENGTH[kind] > _STRENGTH[cur[0]]:
+            accs[key] = (kind, node, const)
+        elif cur is not None and kind == cur[0] == WRITE:
+            accs[key] = (kind, cur[1], cur[2] and const)
+
+    for node in _walk_evaluated(roots):
+        if isinstance(node, ast.Assign):
+            const = isinstance(node.value, ast.Constant)
+            for t in node.targets:
+                for kind, attr in _store_accesses(t):
+                    add(kind, attr, t, const and kind == WRITE)
+        elif isinstance(node, ast.AugAssign):
+            t = node.target
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id == "self":
+                add(RMW, t.attr, t)
+            else:
+                base = _self_base(t)
+                if base is not None:
+                    add(MUT, base, t)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                for kind, attr in _store_accesses(t):
+                    add(MUT if kind == WRITE else kind, attr, t)
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and \
+                    f.attr in _MUTATING_METHODS:
+                base = _self_base(f.value)
+                if base is not None:
+                    add(MUT, base, node)
+        elif isinstance(node, ast.Attribute) and \
+                isinstance(getattr(node, "ctx", None), ast.Load) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            add(READ, node.attr, node)
+    return accs
+
+
+def _locks_at(ctx: ModuleContext, registry: Dict[str, str],
+              node: ast.AST, proj: Optional[ProjectContext],
+              lockrule: LockOrderRule) -> FrozenSet[str]:
+    """Lock ids structurally held at ``node``: every enclosing
+    ``with`` item that resolves through LOCK010's identity machinery.
+    A node inside a ``with`` ITEM (the acquisition expression itself)
+    does not yet hold that item's lock."""
+    out: Set[str] = set()
+    cur: ast.AST = node
+    parent = ctx.parent(cur)
+    while parent is not None and not isinstance(
+            parent, (ast.FunctionDef, ast.AsyncFunctionDef,
+                     ast.Lambda, ast.ClassDef, ast.Module)):
+        if isinstance(parent, (ast.With, ast.AsyncWith)) and \
+                not isinstance(cur, ast.withitem):
+            for item in parent.items:
+                lid = lockrule._lock_id(ctx, registry,
+                                        item.context_expr, node, proj)
+                if lid is not None:
+                    out.add(lid)
+        cur, parent = parent, ctx.parent(parent)
+    return frozenset(out)
+
+
+def _mentions_self(nodes: Iterable[ast.AST],
+                   methods: Optional[Set[str]] = None) -> bool:
+    """Does handing these expressions to a thread publish ``self``?
+    A bare ``self`` does; a bound method (``self._loop``) does — the
+    callee gets the instance through ``__self__``.  A plain attribute
+    handle (``self.engine``) hands only THAT object: the receiving
+    thread never sees our instance, so it is not a capture."""
+    for n in nodes:
+        bases: Set[int] = set()    # attr bases are not bare mentions
+        for sub in ast.walk(n):    # parents precede children
+            if isinstance(sub, ast.Attribute) and \
+                    isinstance(sub.value, ast.Name) and \
+                    sub.value.id == "self":
+                bases.add(id(sub.value))
+                if methods is None or sub.attr in methods:
+                    return True
+            elif isinstance(sub, ast.Name) and sub.id == "self" \
+                    and id(sub) not in bases:
+                return True
+    return False
+
+
+def _call_inputs(call: ast.Call) -> List[ast.AST]:
+    return list(call.args) + [kw.value for kw in call.keywords]
+
+
+def _spawn_captures(ctx: ModuleContext, scope: ast.AST,
+                    methods: Optional[Set[str]]) -> Dict[str, bool]:
+    """dotted target -> did its spawn-ctor assignment capture
+    ``self``?  (``self._t = Thread(target=self._loop)`` → True;
+    engines/transports built without a back-ref → absent)."""
+    out: Dict[str, bool] = {}
+    roots = scope.body if isinstance(scope.body, list) else [scope.body]
+    for node in _walk_evaluated(roots):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        if (ctx.resolve(node.value.func) or "") not in _SPAWN_CTORS:
+            continue
+        cap = _mentions_self(_call_inputs(node.value), methods)
+        for t in node.targets:
+            d = _dotted(t)
+            if d:
+                out[d] = cap
+    return out
+
+
+def _spawn_lines(ctx: ModuleContext, fn: ast.AST) -> List[int]:
+    """Linenos where this method hands THIS instance to another
+    thread — the cutoff after which ``self`` is visible cross-thread.
+    A ``.start()``/``.submit()`` only counts when ``self`` escapes
+    through it: a bound method / bare ``self`` in the args, or a
+    thread constructed (here or anywhere in the class) with a
+    ``self``-capturing target.  ``self.engine.start()`` spawns the
+    ENGINE's thread — that thread never sees our instance, so
+    construction after it is still single-threaded."""
+    roots = fn.body if isinstance(fn.body, list) else [fn.body]
+    cls = ctx.enclosing_class(fn)
+    methods: Optional[Set[str]] = None
+    if cls is not None:
+        methods = {n.name for n in cls.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))}
+    captures = _spawn_captures(ctx, fn, methods)
+    if cls is not None:
+        cls_caps = _spawn_captures(ctx, cls, methods)
+        cls_caps.update(captures)      # method-local wins
+        captures = cls_caps
+    lines: List[int] = []
+    for node in _walk_evaluated(roots):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _SPAWNING_ATTRS:
+            publishes = _mentions_self(_call_inputs(node), methods)
+            recv = f.value
+            if isinstance(recv, ast.Call):     # Thread(...).start()
+                publishes = publishes or _mentions_self(
+                    _call_inputs(recv), methods)
+            else:
+                d = _dotted(recv)
+                if d is not None:
+                    publishes = publishes or captures.get(d, False)
+            if publishes:
+                lines.append(node.lineno)
+        elif (ctx.resolve(f) or "") in _SPAWNING_RESOLVED:
+            if _mentions_self(_call_inputs(node), methods):
+                lines.append(node.lineno)
+    return lines
+
+
+def _prestart_cutoff(ctx: ModuleContext, fn: ast.AST,
+                     qual: str) -> Optional[int]:
+    """The lineno BELOW which accesses in this method run before the
+    instance is published to any thread: in ``__init__`` the whole
+    method when it spawns nothing (a sentinel of -1 → everything is
+    exempt), else up to the first spawn; in ``start()``-shaped
+    methods only up to the first spawn."""
+    tail = qual.rsplit(".", 1)[-1]
+    if tail in _INIT_METHODS:
+        spawns = _spawn_lines(ctx, fn)
+        return min(spawns) if spawns else -1
+    if tail in _START_METHODS:
+        spawns = _spawn_lines(ctx, fn)
+        return min(spawns) if spawns else None
+    return None
+
+
+def _sync_attrs(ctx: ModuleContext) -> Dict[str, Set[str]]:
+    """class qualname -> attrs assigned from a synchronized type
+    (queues, events, deques, executors, thread-locals) anywhere in
+    the module — these carry their own cross-thread contract.
+    Memoized on the ctx: the race index, ATOM017 and PUBLISH018 all
+    consult the same table."""
+    cached = getattr(ctx, "_zoolint_sync_attrs", None)
+    if cached is not None:
+        return cached
+    out: Dict[str, Set[str]] = {}
+    for node in ctx.all_nodes:
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):  # self.q: deque = deque()
+            targets = [node.target]
+        else:
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        resolved = ctx.resolve(node.value.func) or ""
+        if resolved not in _SYNC_CTORS:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id == "self":
+                cls = ctx.enclosing_class(node)
+                if cls is not None:
+                    out.setdefault(ctx.class_qualname(cls),
+                                   set()).add(t.attr)
+    ctx._zoolint_sync_attrs = out
+    return out
+
+
+# ---------------------------------------------------- shared access index
+
+
+class _Access:
+    __slots__ = ("kind", "node", "line", "col", "locks", "roles",
+                 "qual", "prestart", "const")
+
+    def __init__(self, kind: str, node: ast.AST, locks: FrozenSet[str],
+                 roles: FrozenSet[str], qual: str, prestart: bool,
+                 const: bool):
+        self.kind = kind
+        self.node = node
+        self.line = getattr(node, "lineno", 0)
+        self.col = getattr(node, "col_offset", 0)
+        self.locks = locks
+        self.roles = roles
+        self.qual = qual
+        self.prestart = prestart
+        self.const = const
+
+
+class _RaceIndex:
+    """Per-project cache shared by RACE016/ATOM017: every ``self.X``
+    access attributed to (thread roles, lockset), plus the inherited
+    base locks (intersection over all call sites) per function."""
+
+    __slots__ = ("access", "shared", "base", "registries", "lockrule")
+
+    def __init__(self, access, shared, base, registries, lockrule):
+        self.access: Dict[Tuple[str, str, str], List[_Access]] = access
+        self.shared: Set[Tuple[str, str, str]] = shared
+        self.base: Dict[FuncKey, FrozenSet[str]] = base
+        self.registries: Dict[str, Dict[str, str]] = registries
+        self.lockrule = lockrule
+
+
+def _base_locks(proj: ProjectContext, lockrule: LockOrderRule,
+                registries: Dict[str, Dict[str, str]]
+                ) -> Dict[FuncKey, FrozenSet[str]]:
+    """Locks a function inherits from EVERY caller: the intersection
+    over all resolvable call sites of (locks held at the site ∪ the
+    caller's own inherited locks), to optimistic fixpoint.  Thread
+    entry points inherit nothing (the spawn hands over no locks)."""
+    incoming: Dict[FuncKey, List[Tuple[FuncKey, FrozenSet[str]]]] = {}
+    for caller, edges in proj.calls.items():
+        cctx = proj.by_relpath.get(caller[0])
+        if cctx is None:
+            continue
+        reg = registries.get(caller[0], {})
+        for e in edges:
+            held = _locks_at(cctx, reg, e.site, proj, lockrule)
+            incoming.setdefault(e.callee, []).append((caller, held))
+    entries = set(proj.thread_entries)
+    tops: Set[FuncKey] = {k for k in incoming if k not in entries}
+    resolved: Dict[FuncKey, FrozenSet[str]] = {}
+
+    def caller_base(c: FuncKey) -> Optional[FrozenSet[str]]:
+        if c in tops:
+            return None          # still ⊤ — optimistic, skip the edge
+        return resolved.get(c, frozenset())
+
+    changed = True
+    while changed:
+        changed = False
+        for callee, srcs in incoming.items():
+            if callee in entries:
+                continue
+            acc: Optional[FrozenSet[str]] = None
+            for caller, held in srcs:
+                cb = caller_base(caller)
+                if cb is None:
+                    continue
+                h = held | cb
+                acc = h if acc is None else (acc & h)
+            if acc is None:
+                continue
+            if callee in tops:
+                tops.discard(callee)
+                resolved[callee] = acc
+                changed = True
+            elif resolved.get(callee) != acc:
+                resolved[callee] = acc
+                changed = True
+    # cycles never reached from an entry stay ⊤ — resolve to ∅ (the
+    # choice that reports rather than hides)
+    return resolved
+
+
+def _race_index(proj: ProjectContext) -> _RaceIndex:
+    cached = getattr(proj, "_zoolint_race_idx", None)
+    if cached is not None:
+        return cached
+    lockrule = LockOrderRule()
+    registries = {ctx.relpath: lockrule._lock_registry(ctx)
+                  for ctx in proj.contexts}
+    base = _base_locks(proj, lockrule, registries)
+    # class-method names per (relpath, class qualname): attribute
+    # accesses naming a METHOD are calls, not shared data
+    methods_of: Dict[Tuple[str, str], Set[str]] = {}
+    for rel, qual in proj.functions:
+        head, _, tail = qual.rpartition(".")
+        if head:
+            methods_of.setdefault((rel, head), set()).add(tail)
+    access: Dict[Tuple[str, str, str], List[_Access]] = {}
+    for ctx in proj.contexts:
+        if "self." not in ctx.source:
+            continue
+        reg = registries[ctx.relpath]
+        sync = _sync_attrs(ctx)
+        for fn in ctx.functions:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            qual = ctx.qualname_of(fn)
+            if not qual:
+                continue
+            cls = ctx.enclosing_class(fn)
+            if cls is None:
+                continue
+            clsq = ctx.class_qualname(cls)
+            fa = _fn_accesses(fn)
+            if not fa:
+                continue
+            key = (ctx.relpath, qual)
+            roles = proj.thread_roles.get(key, frozenset({"main"}))
+            basel = base.get(key, frozenset())
+            cutoff = _prestart_cutoff(ctx, fn, qual)
+            for (attr, line), (kind, node, const) in sorted(
+                    fa.items()):
+                if attr.startswith("__") and attr.endswith("__"):
+                    continue
+                if attr in methods_of.get((ctx.relpath, clsq), ()):
+                    continue
+                if attr in sync.get(clsq, ()):
+                    continue
+                if f"{ctx.relpath}::{clsq}.{attr}" in reg or \
+                        "lock" in attr.lower():
+                    continue
+                prestart = cutoff is not None and \
+                    (cutoff < 0 or line < cutoff)
+                locks = basel | _locks_at(ctx, reg, node, proj,
+                                          lockrule)
+                access.setdefault(
+                    (ctx.relpath, clsq, attr), []).append(
+                    _Access(kind, node, locks, roles, qual,
+                            prestart, const))
+    shared: Set[Tuple[str, str, str]] = set()
+    for k, accs in access.items():
+        all_roles: Set[str] = set()
+        for a in accs:
+            all_roles |= a.roles
+        if len(all_roles) >= 2 or all_roles & _MULTI_ROLES:
+            shared.add(k)
+    idx = _RaceIndex(access, shared, base, registries, lockrule)
+    proj._zoolint_race_idx = idx
+    return idx
+
+
+# ================================================================ RACE016
+
+
+@register_project_rule
+class CrossThreadAttrRaceRule:
+    """Instance attributes shared across thread roles with disjoint
+    locksets.
+
+    Why: the races that actually bit this repo (the PR 12
+    ``_backlog_seen`` gauge stomping, supervisor replica-map
+    mutation) live in ``self.*`` state shared between the serving
+    plane's threads — invisible to RACE005 (module globals) and
+    LOCK010 (lock ORDER, not coverage).  The thread-role inference
+    pass attributes every access site to the roles that may execute
+    it; two sites conflict when their role union spans ≥ 2 roles (or
+    one multi-instance pool role), their locksets share no lock, and
+    at least one side mutates in place / read-modify-writes (or both
+    plain-write non-constants).  Plain write-on-one-role /
+    read-on-another stays exempt: that is the sanctioned atomic-swap
+    / monotonic-flag publication idiom (GIL-atomic rebind), and
+    queue/Event/deque-typed attributes carry their own contract.
+    Pre-``start()`` initialization is exempt — nothing else can hold
+    the instance yet.
+    """
+
+    rule_id = "RACE016"
+    severity = "error"
+    doc = ("instance attribute shared across thread roles with "
+           "disjoint locksets — a cross-thread data race on self.* "
+           "state (CPU-silent, device-fatal)")
+
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        idx = _race_index(proj)
+        findings: List[Finding] = []
+        for key in sorted(idx.access):
+            rel, clsq, attr = key
+            accs = [a for a in idx.access[key] if not a.prestart]
+            if len(accs) < 1:
+                continue
+            accs.sort(key=lambda a: (a.line, -_STRENGTH[a.kind],
+                                     a.col))
+            pair = self._find_conflict(accs)
+            if pair is None:
+                continue
+            a, b = pair
+            ctx = proj.by_relpath[rel]
+            findings.append(self._emit(ctx, clsq, attr, a, b))
+        findings.sort(key=lambda f: (f.path, f.line, f.col))
+        return findings
+
+    # ----------------------------------------------------------- conflict
+    @staticmethod
+    def _dangerous(a: "_Access", b: "_Access") -> bool:
+        if a.locks & b.locks:
+            return False
+        union = a.roles | b.roles
+        if len(union) < 2 and not (union & _MULTI_ROLES):
+            return False
+        kinds = {a.kind, b.kind}
+        if RMW in kinds or MUT in kinds:
+            return True
+        if kinds == {WRITE}:
+            # both sides plain-assign: racy unless it is the
+            # constant-flag idiom (every write stores a constant —
+            # last-writer-wins on an immutable is benign)
+            return not (a.const and b.const)
+        return False              # write×read / read×read: exempt
+
+    def _find_conflict(self, accs: List["_Access"]
+                       ) -> Optional[Tuple["_Access", "_Access"]]:
+        for i, a in enumerate(accs):
+            # one site, many threads: an unlocked in-place mutation /
+            # RMW executed on ≥ 2 roles races with itself
+            if _STRENGTH[a.kind] >= _STRENGTH[RMW] and \
+                    not a.locks and (len(a.roles) >= 2
+                                     or a.roles & _MULTI_ROLES):
+                return (a, a)
+            for b in accs[i + 1:]:
+                if self._dangerous(a, b):
+                    return (a, b)
+        return None
+
+    # --------------------------------------------------------------- emit
+    def _emit(self, ctx: ModuleContext, clsq: str, attr: str,
+              a: "_Access", b: "_Access") -> Finding:
+        ra = ",".join(sorted(a.roles))
+        if a is b:
+            msg = (f"'{clsq}.{attr}' is {_KIND_DESC[a.kind]} with no "
+                   f"lock held, and this code runs on roles [{ra}] "
+                   f"concurrently — a cross-thread data race on "
+                   f"shared instance state. Guard it with one lock "
+                   f"on every access, or hand values off through "
+                   f"queue.Queue (runtime twin: zoo-racecheck)")
+        else:
+            rb = ",".join(sorted(b.roles))
+            la = f"held {{{', '.join(_short(x) for x in sorted(a.locks))}}}" \
+                if a.locks else "no lock held"
+            lb = f"held {{{', '.join(_short(x) for x in sorted(b.locks))}}}" \
+                if b.locks else "no lock held"
+            msg = (f"'{clsq}.{attr}' is {_KIND_DESC[a.kind]} on "
+                   f"role(s) [{ra}] at line {a.line} ({la}) and "
+                   f"{_KIND_DESC[b.kind]} on role(s) [{rb}] at line "
+                   f"{b.line} ({lb}) — the locksets share no lock, "
+                   f"so the accesses can interleave mid-update. "
+                   f"Guard both sides with the same lock, or hand "
+                   f"the value off through queue.Queue (runtime "
+                   f"twin: zoo-racecheck)")
+        return Finding(
+            rule=self.rule_id, severity=self.severity,
+            path=ctx.relpath, line=a.line, col=a.col, message=msg,
+            symbol=f"{clsq}.{attr}",
+            snippet=ctx.line_text(a.line).strip())
+
+
+# ================================================================ ATOM017
+
+
+@register_project_rule
+class CheckThenActRule:
+    """Check-then-act on shared instance state under inconsistent
+    locks.
+
+    Why: the PR 12 registry-gauge stomping was exactly this shape —
+    ``if key not in self._backlog_seen: … self._backlog_seen[key] =
+    gauge`` where the guard and the store did not hold the same lock,
+    so two samplers both passed the check and the second stomped the
+    first's gauge.  Flow-sensitive over the PR 14 CFG: a guard fact
+    (``if``/``while`` test reading ``self.X``, tagged with the locks
+    held at the test) flows forward along both branches; a later
+    write/mutation of ``X`` reached by a live guard fires when the
+    two locksets share no lock (a re-check under the write's lock
+    kills the stale outer guard — sanctioned double-checked locking
+    stays clean).  With NO lock on either side it fires only when
+    thread-role inference proves ``X`` is shared across roles.
+    """
+
+    rule_id = "ATOM017"
+    severity = "error"
+    doc = ("check-then-act on shared instance state under "
+           "inconsistent locks (guard and write hold different "
+           "locksets — the registry-gauge-stomping class)")
+
+    def check_project(self, proj: ProjectContext) -> List[Finding]:
+        idx = _race_index(proj)
+        findings: List[Finding] = []
+        for ctx in proj.contexts:
+            if "self." not in ctx.source:
+                continue
+            reg = idx.registries[ctx.relpath]
+            for fn in ctx.functions:
+                if not isinstance(fn, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    continue
+                cls = ctx.enclosing_class(fn)
+                if cls is None:
+                    continue
+                findings.extend(self._check_method(
+                    proj, idx, ctx, reg, fn,
+                    ctx.class_qualname(cls)))
+        findings.sort(key=lambda f: (f.path, f.line, f.col))
+        return findings
+
+    # ------------------------------------------------------------ per-fn
+    @staticmethod
+    def _acts_in(stmt: Optional[ast.AST]) -> List[Tuple[str, ast.AST]]:
+        """(attr, site) for every write/RMW/in-place mutation of a
+        ``self`` attribute in this one statement."""
+        if stmt is None:
+            return []
+        out: List[Tuple[str, ast.AST]] = []
+        for node in _walk_evaluated([stmt]):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for _kind, attr in _store_accesses(t):
+                        out.append((attr, t))
+            elif isinstance(node, ast.AugAssign):
+                base = _self_base(node.target)
+                if base is not None:
+                    out.append((base, node.target))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in _MUTATING_METHODS:
+                    base = _self_base(f.value)
+                    if base is not None:
+                        out.append((base, node))
+        return out
+
+    @staticmethod
+    def _guard_attrs(test: ast.AST, candidates: Set[str]) -> Set[str]:
+        return {sub.attr for sub in ast.walk(test)
+                if isinstance(sub, ast.Attribute)
+                and isinstance(getattr(sub, "ctx", None), ast.Load)
+                and isinstance(sub.value, ast.Name)
+                and sub.value.id == "self"
+                and sub.attr in candidates}
+
+    def _check_method(self, proj: ProjectContext, idx: _RaceIndex,
+                      ctx: ModuleContext, reg: Dict[str, str],
+                      fn: ast.AST, clsq: str) -> List[Finding]:
+        qual = ctx.qualname_of(fn)
+        if not qual:
+            return []
+        tail = qual.rsplit(".", 1)[-1]
+        if tail in _INIT_METHODS:
+            return []             # construction is single-threaded
+        roots = fn.body if isinstance(fn.body, list) else [fn.body]
+        acted: Set[str] = set()
+        for stmt in roots:
+            for attr, _site in self._acts_in(stmt):
+                acted.add(attr)
+        acted = {a for a in acted
+                 if not (a.startswith("__") and a.endswith("__"))
+                 and "lock" not in a.lower()}
+        if not acted:
+            return []
+        # any guard on an acted attr?  cheap structural pre-filter
+        # before paying for a CFG
+        has_guard = any(
+            isinstance(n, (ast.If, ast.While))
+            and self._guard_attrs(n.test, acted)
+            for n in _walk_evaluated(roots))
+        if not has_guard:
+            return []
+        basel = idx.base.get((ctx.relpath, qual), frozenset())
+        cfg = _cfg_for(ctx, fn)
+        guard_map: Dict[int, List[Tuple[str, Tuple[int, FrozenSet[str]]]]] = {}
+        for node in cfg.nodes:
+            if node.kind not in ("if", "while") or node.stmt is None:
+                continue
+            attrs = self._guard_attrs(node.stmt.test, acted)
+            if not attrs:
+                continue
+            glocks = basel | _locks_at(ctx, reg, node.stmt, proj,
+                                       idx.lockrule)
+            guard_map[id(node.stmt)] = [
+                (a, (node.stmt.lineno, glocks)) for a in sorted(attrs)]
+        if not guard_map:
+            return []
+        # acts are keyed to SIMPLE-statement nodes only: a compound
+        # header (if/with/for) lexically *contains* its body's writes,
+        # but they do not execute at the header — killing/checking
+        # there would judge the write against the state BEFORE the
+        # body's own locks/re-checks ran
+        acts_by_stmt: Dict[int, List[Tuple[str, ast.AST]]] = {}
+        for node in cfg.nodes:
+            if node.kind == "stmt" and node.stmt is not None:
+                acts = [(a, s) for a, s in self._acts_in(node.stmt)
+                        if a in acted]
+                if acts:
+                    acts_by_stmt[id(node.stmt)] = acts
+        # a plain READ of self.X between the guard and the act
+        # REFRESHES a live token (never creates one): the locked
+        # re-read idiom ``exe = self._compiled.get(sig)`` under the
+        # write's lock re-bases the decision on fresh data, so the act
+        # is judged against the NEAREST observation, not a stale guard
+        reads_by_stmt: Dict[int, List[Tuple[str, Tuple[int,
+                                                       FrozenSet[str]]]]] = {}
+        for node in cfg.nodes:
+            if node.kind != "stmt" or node.stmt is None or \
+                    id(node.stmt) in acts_by_stmt:
+                continue
+            attrs = {sub.attr for sub in ast.walk(node.stmt)
+                     if isinstance(sub, ast.Attribute)
+                     and isinstance(getattr(sub, "ctx", None), ast.Load)
+                     and isinstance(sub.value, ast.Name)
+                     and sub.value.id == "self" and sub.attr in acted}
+            if attrs:
+                rlocks = basel | _locks_at(ctx, reg, node.stmt, proj,
+                                           idx.lockrule)
+                reads_by_stmt[id(node.stmt)] = [
+                    (a, (node.stmt.lineno, rlocks))
+                    for a in sorted(attrs)]
+
+        def transfer(node: CFGNode, state: State
+                     ) -> Dict[Optional[str], State]:
+            out = dict(state)
+            for attr, _site in acts_by_stmt.get(
+                    id(node.stmt) if node.stmt is not None else -1,
+                    ()):
+                out.pop(f"g:{attr}", None)
+            for attr, token in reads_by_stmt.get(
+                    id(node.stmt) if node.stmt is not None else -1,
+                    ()):
+                if f"g:{attr}" in out:     # refresh only, never create
+                    out[f"g:{attr}"] = frozenset({token})
+            per: Dict[Optional[str], State] = {None: out, EXC: out}
+            guards = guard_map.get(
+                id(node.stmt) if node.stmt is not None else -1)
+            if guards and node.kind in ("if", "while"):
+                gout = dict(out)
+                for attr, token in guards:
+                    # REPLACE, don't union: a re-check supersedes any
+                    # stale outer guard (double-checked locking)
+                    gout[f"g:{attr}"] = frozenset({token})
+                per[TRUE] = gout
+                per[FALSE] = gout
+            return per
+
+        in_states = run_forward(cfg, {}, transfer)
+        findings: List[Finding] = []
+        reported: Set[str] = set()
+        for node in cfg.nodes:
+            if node.stmt is None or \
+                    id(node.stmt) not in acts_by_stmt:
+                continue
+            state = in_states.get(node.idx)
+            if not state:
+                continue
+            for attr, site in acts_by_stmt[id(node.stmt)]:
+                if attr in reported:
+                    continue
+                tokens = state.get(f"g:{attr}")
+                if not tokens:
+                    continue
+                wlocks = basel | _locks_at(ctx, reg, site, proj,
+                                           idx.lockrule)
+                for gline, glocks in sorted(tokens):
+                    if glocks & wlocks:
+                        continue
+                    if not glocks and not wlocks and (
+                            ctx.relpath, clsq, attr) not in idx.shared:
+                        continue
+                    reported.add(attr)
+                    findings.append(self._emit(
+                        ctx, clsq, attr, site, gline, glocks, wlocks))
+                    break
+        return findings
+
+    def _emit(self, ctx: ModuleContext, clsq: str, attr: str,
+              site: ast.AST, gline: int, glocks: FrozenSet[str],
+              wlocks: FrozenSet[str]) -> Finding:
+        gdesc = (f"under {{{', '.join(_short(x) for x in sorted(glocks))}}}"
+                 if glocks else "with no lock")
+        wdesc = (f"under {{{', '.join(_short(x) for x in sorted(wlocks))}}}"
+                 if wlocks else "with no lock")
+        msg = (f"check-then-act on '{clsq}.{attr}': the guard at "
+               f"line {gline} reads it {gdesc} but this write runs "
+               f"{wdesc} — two threads can both pass the check and "
+               f"the second stomps the first (the registry-gauge "
+               f"class). Hold ONE lock across both the test and the "
+               f"update, or re-check under the write's lock")
+        return Finding(
+            rule=self.rule_id, severity=self.severity,
+            path=ctx.relpath, line=getattr(site, "lineno", 0),
+            col=getattr(site, "col_offset", 0), message=msg,
+            symbol=f"{clsq}.{attr}",
+            snippet=ctx.line_text(getattr(site, "lineno", 0)).strip())
+
+
+# =============================================================== PUBLISH018
+
+
+#: callback-registration attrs that hand the argument to code running
+#: on another thread; ``atexit``/``signal`` register same-thread
+#: deferred hooks and are NOT publications for this rule
+_REGISTER_ATTRS = ("register", "add_done_callback", "subscribe")
+
+
+@register_rule
+class UnsafePublicationRule(Rule):
+    """Object published to another thread while still being mutated.
+
+    Why: the constructing thread hands the object over (a ``Thread``
+    target or args, ``queue.put``, a registry/callback registration)
+    and KEEPS initializing it — the consumer thread can observe the
+    half-built object (the flight-recorder ``replica.spawn`` ordering
+    incident: the watch loop read a replica record before its pid
+    field landed).  Publication points: ``t.start()`` on a
+    constructed Thread (including the chained
+    ``Thread(...).start()``), ``queue.put``/``put_nowait``,
+    ``executor.submit`` extra args, and callback registration.  For
+    ``target=self._run`` the published object is ``self`` — only
+    mutations of attributes the target method actually touches are
+    flagged (role-steady-state writes belong to RACE016; this rule is
+    about ORDERING).  A mutation under any held lock is exempt.
+    """
+
+    rule_id = "PUBLISH018"
+    severity = "warning"
+    doc = ("unsafe publication: object handed to another thread "
+           "(Thread target/args, queue.put, callback registration) "
+           "while the constructing method keeps mutating it")
+
+    _SPAWN_CTORS = ("threading.Thread", "Thread", "threading.Timer",
+                    "Timer")
+
+    def check_module(self, ctx: ModuleContext) -> None:
+        src = ctx.source
+        if not (".start(" in src or ".put(" in src
+                or ".submit(" in src or ".register(" in src
+                or "add_done_callback" in src):
+            return
+        self._registry = LockOrderRule()._lock_registry(ctx)
+        for fn in _functions(ctx):
+            self._check_function(ctx, fn)
+
+    # ----------------------------------------------------------- capture
+    def _target_attr_touches(self, ctx: ModuleContext, fn: ast.AST,
+                             target: Optional[ast.AST]
+                             ) -> Optional[Set[str]]:
+        """When the spawn target is ``self.m``, the set of ``self``
+        attributes the method ``m`` touches (reads or writes) — the
+        attrs whose post-publication mutation the consumer can
+        observe.  None when the target is not a resolvable
+        same-class method (→ don't track ``self``)."""
+        if not (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            return None
+        cls = ctx.enclosing_class(fn)
+        if cls is None:
+            return None
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    stmt.name == target.attr:
+                return {sub.attr for sub in ast.walk(stmt)
+                        if isinstance(sub, ast.Attribute)
+                        and isinstance(sub.value, ast.Name)
+                        and sub.value.id == "self"}
+        return None
+
+    def _thread_captures(self, ctx: ModuleContext, fn: ast.AST,
+                         call: ast.Call
+                         ) -> Tuple[Set[str], Optional[Set[str]]]:
+        """(published local names, self-attr filter) for a Thread/
+        Timer construction: every local Name referenced in target=/
+        args=/kwargs= is handed to the new thread; ``target=self.m``
+        publishes ``self`` filtered to the attrs ``m`` touches."""
+        names: Set[str] = set()
+        target: Optional[ast.AST] = None
+        self_filter: Optional[Set[str]] = None
+        for kw in call.keywords:
+            if kw.arg == "target":
+                target = kw.value
+            if kw.arg in ("target", "args", "kwargs"):
+                for sub in ast.walk(kw.value):
+                    if isinstance(sub, ast.Name) and \
+                            sub.id not in ("self", "cls"):
+                        names.add(sub.id)
+        touches = self._target_attr_touches(ctx, fn, target)
+        if touches is not None:
+            self_filter = touches
+        # a local-function target: its free-variable reads are
+        # captured names too (closure handoff)
+        if isinstance(target, ast.Name):
+            local = ctx._local_function_named(call, target.id)
+            if local is not None:
+                for sub in ast.walk(local):
+                    if isinstance(sub, ast.Name) and \
+                            isinstance(getattr(sub, "ctx", None),
+                                       ast.Load):
+                        names.add(sub.id)
+        return names, self_filter
+
+    # ------------------------------------------------------------- check
+    def _check_function(self, ctx: ModuleContext, fn: ast.AST) -> None:
+        roots = fn.body if isinstance(fn.body, list) else [fn.body]
+        #: constructed-but-unstarted threads: dotted target name of
+        #: the binding -> (captured names, self filter)
+        pending: Dict[str, Tuple[Set[str], Optional[Set[str]]]] = {}
+        #: published name -> (publication line, self filter or None)
+        published: Dict[str, Tuple[int, Optional[Set[str]]]] = {}
+
+        def publish(name: str, line: int,
+                    filt: Optional[Set[str]] = None) -> None:
+            cur = published.get(name)
+            if cur is None:
+                published[name] = (line, filt)
+            else:
+                merged = None if (cur[1] is None or filt is None) \
+                    else (cur[1] | filt)
+                published[name] = (min(cur[0], line), merged)
+
+        # _walk_evaluated yields in stack (reverse) order; this pass
+        # is a state machine (construct -> pending -> .start() ->
+        # published), so it must see the statements in SOURCE order
+        # or every non-chained publication is missed
+        ordered = sorted(
+            _walk_evaluated(roots),
+            key=lambda n: (getattr(n, "lineno", 0),
+                           getattr(n, "col_offset", 0)))
+        for node in ordered:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                resolved = ctx.resolve(node.value.func) or ""
+                if resolved in self._SPAWN_CTORS:
+                    caps = self._thread_captures(ctx, fn, node.value)
+                    for t in node.targets:
+                        d = _dotted(t)
+                        if d:
+                            pending[d] = caps
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr == "start" and not node.args:
+                # chained Thread(...).start() publishes immediately
+                if isinstance(f.value, ast.Call) and \
+                        (ctx.resolve(f.value.func) or "") in \
+                        self._SPAWN_CTORS:
+                    caps, filt = self._thread_captures(
+                        ctx, fn, f.value)
+                    for n in caps:
+                        publish(n, node.lineno)
+                    if filt is not None:
+                        publish("self", node.lineno, filt)
+                    continue
+                d = _dotted(f.value)
+                if d in pending:
+                    caps, filt = pending[d]
+                    for n in caps:
+                        publish(n, node.lineno)
+                    if filt is not None:
+                        publish("self", node.lineno, filt)
+            elif f.attr in ("put", "put_nowait"):
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        publish(a.id, node.lineno)
+            elif f.attr == "submit":
+                recv = (_dotted(f.value) or "").lower()
+                if "pool" in recv or "executor" in recv:
+                    for a in node.args[1:]:
+                        if isinstance(a, ast.Name):
+                            publish(a.id, node.lineno)
+            elif f.attr in _REGISTER_ATTRS:
+                resolved = ctx.resolve(f) or ""
+                if resolved.startswith(("atexit.", "signal.")):
+                    continue
+                for a in node.args:
+                    if isinstance(a, ast.Name):
+                        publish(a.id, node.lineno)
+                    elif isinstance(a, ast.Attribute) and \
+                            isinstance(a.value, ast.Name) and \
+                            a.value.id == "self":
+                        touches = self._target_attr_touches(
+                            ctx, fn, a)
+                        if touches is not None:
+                            publish("self", node.lineno, touches)
+        if not published:
+            return
+        self._flag_mutations(ctx, fn, roots, published)
+
+    def _flag_mutations(self, ctx: ModuleContext, fn: ast.AST,
+                        roots: Sequence[ast.AST],
+                        published: Dict[str, Tuple[int,
+                                                   Optional[Set[str]]]]
+                        ) -> None:
+        reported: Set[Tuple[str, str]] = set()
+        for node in _walk_evaluated(roots):
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AugAssign):
+                targets = [node.target]
+            else:
+                continue
+            for t in targets:
+                base = self._mut_base(t)
+                if base is None:
+                    continue
+                name, attr = base
+                pub = published.get(name)
+                if pub is None or node.lineno <= pub[0]:
+                    continue
+                if name == "self":
+                    filt = pub[1]
+                    if filt is None or attr not in filt:
+                        continue
+                if (name, attr) in reported:
+                    continue
+                if _locks_at(ctx, self._registry, node, None,
+                             LockOrderRule()):
+                    continue
+                reported.add((name, attr))
+                what = f"'{name}.{attr}'" if name != "self" \
+                    else f"'self.{attr}'"
+                self.report(
+                    node,
+                    f"{what} is mutated after '{name}' was handed "
+                    f"to another thread at line {pub[0]} — the "
+                    f"consumer can observe the half-initialized "
+                    f"object (unsafe publication). Finish "
+                    f"initializing BEFORE publishing, or guard "
+                    f"both sides with a lock (runtime twin: the "
+                    f"flight-recorder replica.spawn ordering)",
+                    line=node.lineno)
+
+    @staticmethod
+    def _mut_base(t: ast.AST) -> Optional[Tuple[str, str]]:
+        """(base local name, attr) for a mutation target rooted at a
+        bare Name: ``obj.x = …``, ``obj.x[k] = …``, ``obj[k] = …``."""
+        attr = ""
+        cur = t
+        while isinstance(cur, (ast.Attribute, ast.Subscript)):
+            if isinstance(cur, ast.Attribute):
+                attr = cur.attr
+            cur = cur.value
+        if isinstance(cur, ast.Name) and not isinstance(
+                t, ast.Name):
+            return (cur.id, attr or "[]")
+        return None
+
+
+# ================================================================ WRITE019
+
+
+#: path-variable spellings that mean "a directory some OTHER process
+#: or thread reads while we run" (the run-dir contract)
+_RUNDIR_TOKENS = ("run_dir", "rundir", "run_path", "run_root",
+                  "out_dir", "output_dir", "shared_dir", "out_path",
+                  "output_path", "report_path", "report_out")
+
+
+@register_rule
+class NonAtomicSharedWriteRule(Rule):
+    """Non-atomic ``open(path, "w")`` to a run-dir-shared path.
+
+    Why: every run-dir artifact has concurrent readers by contract —
+    obs_report tails progress files, zoo-doctor reads journals from
+    live runs, batch peers poll each other's markers.  A plain
+    ``open(.., "w")`` truncates THEN writes: a reader in that window
+    sees an empty or torn file (the PR 9 loader-duplication debt —
+    every site hand-rolled its own write-then-rename).  Route new
+    writes through ``common.fsutil.atomic_write_text`` /
+    ``atomic_write_bytes`` (write tmp sibling, ``os.replace``).
+    Temp-file spellings (a name or literal containing ``tmp``) are
+    the sanctioned pattern's first half and stay exempt.
+    """
+
+    rule_id = "WRITE019"
+    severity = "warning"
+    doc = ("non-atomic open(path, 'w') to a run-dir-shared path — "
+           "concurrent readers see a torn file; use "
+           "common.fsutil.atomic_write_text/_bytes")
+
+    def visit_Call(self, node: ast.Call, ctx: ModuleContext) -> None:
+        path_expr = self._open_write_path(node)
+        if path_expr is None:
+            return
+        texts = self._path_texts(path_expr)
+        low = [t.lower() for t in texts]
+        if any("tmp" in t for t in low):
+            return
+        if not any(tok in t for t in low for tok in _RUNDIR_TOKENS):
+            return
+        self.report(
+            node,
+            "non-atomic write to a run-dir-shared path: open(.., "
+            "'w') truncates before it writes, so a concurrent "
+            "reader (obs_report, zoo-doctor, a peer worker) sees an "
+            "empty or torn file. Use common.fsutil."
+            "atomic_write_text/atomic_write_bytes (tmp sibling + "
+            "os.replace)")
+
+    @staticmethod
+    def _open_write_path(node: ast.Call) -> Optional[ast.AST]:
+        """The path expression when this call is ``open(path, 'w'/
+        'wb')`` or ``path.open('w'/'wb')``; None otherwise."""
+        f = node.func
+        mode: Optional[str] = None
+        args = node.args
+        if isinstance(f, ast.Name) and f.id == "open":
+            if len(args) >= 2 and isinstance(args[1], ast.Constant):
+                mode = args[1].value
+            path = args[0] if args else None
+        elif isinstance(f, ast.Attribute) and f.attr == "open":
+            if args and isinstance(args[0], ast.Constant):
+                mode = args[0].value
+            path = f.value
+        else:
+            return None
+        for kw in node.keywords:
+            if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                mode = kw.value.value
+        if mode in ("w", "wb") and path is not None:
+            return path
+        return None
+
+    @staticmethod
+    def _path_texts(expr: ast.AST) -> List[str]:
+        out: List[str] = []
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Name):
+                out.append(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                out.append(sub.attr)
+            elif isinstance(sub, ast.Constant) and \
+                    isinstance(sub.value, str):
+                out.append(sub.value)
+        return out

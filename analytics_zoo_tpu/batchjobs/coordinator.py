@@ -290,28 +290,24 @@ class BatchCoordinator:
         (``<run_dir>/job/respawns.json``) — one of zoo-doctor's join
         inputs.  Best-effort: supervision never fails on forensics."""
         import json
+        from analytics_zoo_tpu.common.fsutil import atomic_write_text
         path = os.path.join(self.run_dir, "job", "respawns.json")
-        tmp = f"{path}.tmp.{os.getpid()}"
         try:
             os.makedirs(os.path.dirname(path), exist_ok=True)
-            with open(tmp, "w") as f:
-                json.dump({
-                    "written_unix": round(time.time(), 3),
-                    "restarts_total": self.restarts_total,
-                    "deaths": self._deaths,
-                    "respawns": self._respawns,
-                }, f, indent=2, sort_keys=True)
-            os.replace(tmp, path)
+            atomic_write_text(path, json.dumps({
+                "written_unix": round(time.time(), 3),
+                "restarts_total": self.restarts_total,
+                "deaths": self._deaths,
+                "respawns": self._respawns,
+            }, indent=2, sort_keys=True))
         except OSError:
             log.exception("could not persist respawns.json")
 
     def _write_degraded(self, record: Dict) -> None:
         import json
-        path = os.path.join(self.run_dir, "degraded.json")
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(record, f, indent=2, sort_keys=True)
-        os.replace(tmp, path)
+        from analytics_zoo_tpu.common.fsutil import atomic_write_text
+        atomic_write_text(os.path.join(self.run_dir, "degraded.json"),
+                          json.dumps(record, indent=2, sort_keys=True))
 
     def stop(self) -> None:
         self.cluster.stop()

@@ -80,6 +80,11 @@ def shard_output_path(output_dir: str, shard_id: int) -> str:
 
 
 def _write_json_atomic(path: str, doc: Dict[str, Any]) -> None:
+    # local twin of common.fsutil.atomic_write_text, hand-rolled on
+    # purpose: this module is stdlib-only/file-path-loadable (no
+    # package on sys.path), and lease/commit markers additionally
+    # fsync before the rename — the exactly-once protocol trusts the
+    # marker only if its bytes are durable
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(doc, f, sort_keys=True)

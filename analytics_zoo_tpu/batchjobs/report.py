@@ -123,6 +123,8 @@ def build_report(run_dir: str, *, num_chips: int,
         "straggler": straggler,
     }
     out = os.path.join(_spec.job_dir(run_dir), _spec.REPORT_FILE)
+    # hand-rolled atomic write: stdlib-only file-path-loadable module,
+    # so it cannot import common.fsutil (same carve-out as manifest.py)
     tmp = f"{out}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(report, f, indent=2, sort_keys=True)

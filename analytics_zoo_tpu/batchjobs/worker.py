@@ -176,6 +176,10 @@ class BatchWorker:
         t0 = time.perf_counter()
         result = self._score_shard(shard_id, shard)
         out_path = shard_output_path(self.job.output_dir, shard_id)
+        # hand-rolled (not common.fsutil): np.save STREAMS the array
+        # into the tmp file — a bytes-twin call would buffer the whole
+        # shard in memory — and the commit protocol needs the fsync
+        # ordered before the rename
         tmp = f"{out_path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             np.save(f, result)

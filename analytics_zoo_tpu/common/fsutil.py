@@ -16,3 +16,13 @@ def atomic_write_text(path: str, text: str) -> None:
     with open(tmp, "w") as f:
         f.write(text)
     os.replace(tmp, path)
+
+
+def atomic_write_bytes(path: str, data: bytes) -> None:
+    """Bytes twin of :func:`atomic_write_text` — same pid-unique tmp +
+    ``os.replace`` contract for binary payloads (pickles, npz blobs).
+    OSError propagates — callers own their degrade/log policy."""
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)

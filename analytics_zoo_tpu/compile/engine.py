@@ -278,6 +278,7 @@ class EngineJit:
                 if exe is None:
                     exe = self._acquire(args, sig)
                     if exe is None:
+                        # zoolint: disable=ATOM017 — the unlocked guard at the top of _call_slow is a fast-path skip; set.add is idempotent, so two threads passing it merely both mark the same sig
                         self._fallback.add(sig)
                         self._solo = None
                         return self._jit(*args)
@@ -298,9 +299,15 @@ class EngineJit:
                 "path", self.key_hint, exc_info=True)
             from analytics_zoo_tpu.compile.cache import _count_error
             _count_error("call")
-            self._fallback.add(sig)
-            self._compiled.pop(sig, None)
-            self._solo = None
+            with self._lock:
+                # eviction after the executable itself raised: keyed on
+                # the exception, not on the earlier (unlocked fast-path)
+                # cache probes, and add/pop-with-default are idempotent
+                # zoolint: disable=ATOM017 — idempotent eviction, not a stale-guard decision
+                self._fallback.add(sig)
+                # zoolint: disable=ATOM017 — idempotent eviction, not a stale-guard decision
+                self._compiled.pop(sig, None)
+                self._solo = None
             return self._jit(*args)
 
     # ---------------------------------------------------------- warm-start

@@ -150,9 +150,11 @@ class WorkerPool:
                 f.cancel()
 
     def shutdown(self, wait: bool = False) -> None:
-        if not self._closed:
-            self._closed = True
-            self._pool.shutdown(wait=wait)
+        # no closed-guard: Executor.shutdown is itself thread-safe and
+        # idempotent, so a check-then-act here would only add a window
+        # where two closers race on the flag
+        self._closed = True
+        self._pool.shutdown(wait=wait)
 
     close = shutdown
 

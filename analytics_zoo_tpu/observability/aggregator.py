@@ -623,9 +623,19 @@ def merge_traces(run_dir: str, out_path: Optional[str] = None) -> Dict:
         },
     }
     if out_path:
-        with open(out_path, "w") as f:
-            json.dump(merged, f)
+        _atomic_dump_json(merged, out_path)
     return merged
+
+
+def _atomic_dump_json(doc: Dict, out_path: str) -> None:
+    """Local twin of ``common.fsutil.atomic_write_text`` (pid-unique
+    tmp + ``os.replace``): this module is path-loaded by obs_report
+    with NO package on sys.path, so it cannot fold onto fsutil — same
+    carve-out as resilience/detector.py's stdlib-only contract."""
+    tmp = f"{out_path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f)
+    os.replace(tmp, out_path)
 
 
 # ---------------------------------------------------------- request merge
@@ -700,8 +710,7 @@ def merge_requests(run_dir: str,
         "timelines": reqtrace.merge_timeline_dicts(docs),
     }
     if out_path:
-        with open(out_path, "w") as f:
-            json.dump(merged, f)
+        _atomic_dump_json(merged, out_path)
     return merged
 
 

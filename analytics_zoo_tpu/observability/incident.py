@@ -648,6 +648,8 @@ def write_incident(run_dir: str, out_path: Optional[str] = None,
     write-then-rename, like every other run-dir artifact)."""
     doc = diagnose(run_dir, **kw)
     path = out_path or os.path.join(run_dir, INCIDENT_FILENAME)
+    # hand-rolled atomic write: stdlib-only file-path-loadable module
+    # (zoo-doctor), so it cannot import common.fsutil
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(doc, f, indent=2, sort_keys=True)

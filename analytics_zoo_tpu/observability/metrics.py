@@ -267,6 +267,7 @@ class _Family:
                                     self._max_dropped_keys:
                                 self._dropped_keys.add(values)
                             else:
+                                # zoolint: disable=ATOM017 — deliberate saturating memo (see labels() docstring above): the unlocked fast-path guard may admit a few extra writers, each of which sets the same monotonic True under _lock
                                 self._dropped_saturated = True
                     else:
                         child = self._children.setdefault(

@@ -518,6 +518,11 @@ class TsdbSampler:
         self._perf = perf
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # appends land on the sampler thread while overhead_p50 sorts
+        # on the caller's thread — deque append is atomic, but
+        # iterating (sorted/list) during a concurrent append raises
+        # RuntimeError, so both sides take this lock
+        self._cost_lock = threading.Lock()
         self._scrape_costs: deque = deque(maxlen=512)
         self.samples_total = 0
         if registry is None:
@@ -548,7 +553,8 @@ class TsdbSampler:
         snap = self.registry.snapshot()
         self.writer.append(snap, now=now)
         cost = self._perf() - t0
-        self._scrape_costs.append(cost)
+        with self._cost_lock:
+            self._scrape_costs.append(cost)
         self.samples_total += 1
         if self._samples_counter is not None:
             self._samples_counter.inc()
@@ -557,9 +563,10 @@ class TsdbSampler:
         return cost
 
     def overhead_p50(self) -> float:
-        if not self._scrape_costs:
+        with self._cost_lock:
+            costs = sorted(self._scrape_costs)
+        if not costs:
             return 0.0
-        costs = sorted(self._scrape_costs)
         return costs[len(costs) // 2]
 
     def _loop(self) -> None:

@@ -148,8 +148,9 @@ class TrainingWatchdog:
         """A train step completed — feeds the stall deadline.  A beat
         after a flagged stall ends that episode and re-arms the
         detector for the next one."""
-        self._last_beat = self._clock()
-        self._stall_fired = False
+        with self._lock:     # vs the heartbeat thread's check_stall
+            self._last_beat = self._clock()
+            self._stall_fired = False
 
     def record_nonfinite(self, source: str = "step") -> None:
         """A non-finite loss/grad was detected (host-callback thread
@@ -228,12 +229,18 @@ class TrainingWatchdog:
     def check_stall(self) -> bool:
         """One stall check against the injectable clock (the heartbeat
         thread calls this; tests call it directly with a fake clock)."""
-        if self.stall_timeout_s <= 0 or self._stall_fired:
+        if self.stall_timeout_s <= 0:
             return False
-        idle = self._clock() - self._last_beat
-        if idle <= self.stall_timeout_s:
-            return False
-        self._stall_fired = True          # once per stall episode
+        # guard and flag-set under one lock so a beat() landing between
+        # them can't be stomped by a stale stall verdict; _push takes
+        # the same (non-reentrant) lock, so it runs after release
+        with self._lock:
+            if self._stall_fired:
+                return False
+            idle = self._clock() - self._last_beat
+            if idle <= self.stall_timeout_s:
+                return False
+            self._stall_fired = True      # once per stall episode
         self._push("stall", idle_s=round(idle, 1),
                    deadline_s=self.stall_timeout_s)
         log.error(

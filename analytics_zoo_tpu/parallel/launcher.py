@@ -227,14 +227,17 @@ class ZooCluster:
                 "hostname": hostname,
                 "metrics_port": self.worker_ports[pid],
             })
-        with open(os.path.join(run_dir, agg_lib.CLUSTER_FILE),
-                  "w") as f:
-            json.dump({
+        from analytics_zoo_tpu.common.fsutil import atomic_write_text
+        # cluster.json is read by obs_report/zoo-doctor while the run
+        # is live — publish it whole or not at all
+        atomic_write_text(
+            os.path.join(run_dir, agg_lib.CLUSTER_FILE),
+            json.dumps({
                 "clock_anchor": self.clock_anchor,
                 "num_processes": self.num_processes,
                 "coordinator": self.coordinator,
                 "workers": workers,
-            }, f, indent=2)
+            }, indent=2))
 
     def worker_env(self, process_id: int) -> Dict[str, str]:
         env = dict(os.environ)

@@ -558,7 +558,11 @@ class LoadGenerator:
         never wait on results — this is what keeps the redis path
         open-loop at any outstanding depth."""
         conn = None
-        while not self._stop.is_set() or self._outstanding:
+        # the in-body empty+stopped check below is the real exit
+        # condition, and it reads _outstanding under its lock — a
+        # `while ... or self._outstanding` header would re-read it
+        # unlocked for no extra information
+        while True:
             with self._outstanding_lock:
                 uris = list(self._outstanding)
             if not uris:

@@ -494,6 +494,9 @@ class BrokerServer:
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()[:2]
         self._stop = threading.Event()
+        # accept loop adds, per-conn threads discard, stop() snapshots:
+        # three threads on one set, so every touch holds the lock
+        self._conns_lock = threading.Lock()
         self._conns: set = set()
         self._accept = threading.Thread(target=self._accept_loop,
                                         daemon=True)
@@ -509,7 +512,8 @@ class BrokerServer:
                 conn, _addr = self._srv.accept()
             except OSError:
                 return
-            self._conns.add(conn)
+            with self._conns_lock:
+                self._conns.add(conn)
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True).start()
 
@@ -544,7 +548,8 @@ class BrokerServer:
         except (ConnectionError, OSError):
             pass
         finally:
-            self._conns.discard(conn)
+            with self._conns_lock:
+                self._conns.discard(conn)
             try:
                 conn.close()
             except OSError:
@@ -662,7 +667,9 @@ class BrokerServer:
             self._srv.close()
         except OSError:
             pass
-        for c in list(self._conns):   # copy: serve threads discard
+        with self._conns_lock:        # copy: serve threads discard
+            conns = list(self._conns)
+        for c in conns:
             try:
                 c.close()
             except OSError:

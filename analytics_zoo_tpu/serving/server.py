@@ -567,6 +567,7 @@ class ClusterServing:
     # ------------------------------------------------------------ main loop
     def run_once(self, block_ms: int = 100) -> int:
         """One poll/predict/write cycle; returns #records served."""
+        # zoolint: disable=RACE016 — serve-loop confined: run()/run_once() are driven by exactly ONE thread (foreground main or the single background runner), never both
         self._serve_start = self._serve_start or time.perf_counter()
         entries = self._read_entries(self.config.batch_size, block_ms)
         if not entries:
@@ -577,6 +578,7 @@ class ClusterServing:
             self.summary.add_scalar(
                 "Serving Throughput",
                 real / max(time.perf_counter() - t0, 1e-9),
+                # zoolint: disable=RACE016 — serve-loop confined counter (single driver thread)
                 self.total_records)
         self._observe_queue()
         return real
@@ -613,6 +615,7 @@ class ClusterServing:
             self._note_backlog(qlen)
         elif time.perf_counter() - self._backlog_obs_at >= 0.25:
             self._note_backlog(self._backlog())
+            # zoolint: disable=ATOM017 — serve-loop confined throttle clock: only the single driver thread runs _observe_queue
             self._backlog_obs_at = time.perf_counter()
         if qlen > self.config.max_stream_len:
             self.broker.xtrim(INPUT_STREAM, self.config.max_stream_len)
@@ -675,6 +678,7 @@ class ClusterServing:
         if not self._group_ready:
             self.broker.xgroup_create(INPUT_STREAM,
                                       self.config.consumer_group)
+            # zoolint: disable=ATOM017 — serve-loop confined lazy init (and xgroup_create is idempotent MKSTREAM)
             self._group_ready = True
 
     def _read_entries(self, count: int, block_ms: int):
@@ -1381,6 +1385,7 @@ class ClusterServing:
         # replica liveness for the supervisor / launcher plane
         # (ZOO_TPU_METRICS_DIR names this worker's host-<k>/ slot)
         heartbeat = HostHeartbeat.from_env()
+        # zoolint: disable=RACE016 — serve-loop confined: run() holds the sampler, close() runs on the same driver (run's finally / the context owner)
         self._telemetry = TelemetrySampler(
             float(get_config().get(
                 "observability.telemetry_interval_s", 10.0))).start()
@@ -1566,6 +1571,7 @@ class ClusterServing:
             self.summary.close()
         if self._telemetry is not None:
             self._telemetry.stop()
+            # zoolint: disable=ATOM017 — idempotent teardown: a second closer re-stops an already-stopped sampler, which is a no-op
             self._telemetry = None
         if self.metrics_server is not None:
             self.metrics_server.stop()

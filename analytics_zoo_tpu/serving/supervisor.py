@@ -261,6 +261,15 @@ class ServingSupervisor:
         self._idle_since: Optional[float] = None
         self._last_scale_at = 0.0
         self._last_autoscale_poll = 0.0
+        # fleet bookkeeping (_replicas, scale_events,
+        # replica_trajectory, restarts_total, the autoscale clock) is
+        # mutated on the supervision loop thread while summary()/
+        # wait_ready()/drain_fleet() read from the caller's thread —
+        # every touch holds this lock.  Reentrant because
+        # _record_fleet_size → _persist_state nest read sections
+        # inside write sections.  NEVER held across _spawn or a
+        # health probe: iteration sites copy the list and release.
+        self._fleet_lock = threading.RLock()
         #: [(unix time, fleet size, reason)] — every size change,
         #: including the initial spawn; the acceptance trajectory
         self.replica_trajectory: List[Tuple[float, int, str]] = []
@@ -424,7 +433,8 @@ class ServingSupervisor:
         r.consecutive_failures += 1
         if not r.budget.consume():
             self._degrade(r, code, cls)
-        self.restarts_total += 1
+        with self._fleet_lock:
+            self.restarts_total += 1
         self._m_restarts.inc()
         delay = min(self.backoff_max_s,
                     self.backoff_base_s
@@ -442,6 +452,8 @@ class ServingSupervisor:
         # must still show which replica took the fleet down
         r.degraded = True
         r.last_exit = code
+        with self._fleet_lock:
+            restarts = self.restarts_total
         record = {
             "status": "degraded",
             "component": "serving",
@@ -452,7 +464,7 @@ class ServingSupervisor:
             "exit_code": code,
             "classification": cls,
             "incarnations": r.incarnation,
-            "restarts_total": self.restarts_total,
+            "restarts_total": restarts,
             "replicas": self.replicas,
         }
         if self.run_dir:
@@ -464,25 +476,34 @@ class ServingSupervisor:
                               path)
         self._flightrec.record(
             "fleet.degraded", replica=r.index, exit=code,
-            classification=cls, restarts_total=self.restarts_total)
+            classification=cls, restarts_total=restarts)
         self._persist_state()
         raise DegradedTraining(record["reason"], result=record)
 
     # ------------------------------------------------------------ autoscale
+    def _fleet(self) -> List[_Replica]:
+        """Locked copy of the replica slots — iterate the copy so the
+        lock is never held across a spawn or a health probe."""
+        with self._fleet_lock:
+            return list(self._replicas)
+
     def _fleet_size(self) -> int:
         """The live fleet: slots that are neither finished nor on
         their way out (a retiring replica still drains, but traffic
         planning must not count it)."""
-        return sum(1 for r in self._replicas
+        return sum(1 for r in self._fleet()
                    if not r.done and not r.degraded and not r.retiring)
 
     def _record_fleet_size(self, reason: str) -> None:
         size = self._fleet_size()
         self._m_fleet.set(size)
-        if not self.replica_trajectory \
-                or self.replica_trajectory[-1][1] != size:
-            self.replica_trajectory.append(
-                (time.time(), size, reason))
+        with self._fleet_lock:
+            changed = (not self.replica_trajectory
+                       or self.replica_trajectory[-1][1] != size)
+            if changed:
+                self.replica_trajectory.append(
+                    (time.time(), size, reason))
+        if changed:
             self._persist_state()
 
     def _persist_state(self) -> None:
@@ -492,15 +513,16 @@ class ServingSupervisor:
         ``zoo-doctor``."""
         if not self.run_dir:
             return
-        doc = {
-            "written_unix": time.time(),
-            "replicas": self.replicas,
-            "restarts_total": self.restarts_total,
-            "scale_events": list(self.scale_events),
-            "replica_trajectory": [
-                [t, size, reason]
-                for t, size, reason in self.replica_trajectory],
-        }
+        with self._fleet_lock:
+            doc = {
+                "written_unix": time.time(),
+                "replicas": self.replicas,
+                "restarts_total": self.restarts_total,
+                "scale_events": list(self.scale_events),
+                "replica_trajectory": [
+                    [t, size, reason]
+                    for t, size, reason in self.replica_trajectory],
+            }
         try:
             atomic_write_text(
                 os.path.join(self.run_dir, "supervisor.json"),
@@ -559,7 +581,7 @@ class ServingSupervisor:
         interval."""
         queue = fill = p50_ms = 0.0
         saw_metrics = False
-        for r in self._replicas:
+        for r in self._fleet():
             if r.proc is None or r.done or r.degraded or r.retiring:
                 continue
             snap = self._replica_gauges(r)
@@ -584,7 +606,7 @@ class ServingSupervisor:
         error_rate right now?  Only called when a scale-up is
         otherwise ready to fire."""
         return any(
-            self._healthz_error_rate(r) for r in self._replicas
+            self._healthz_error_rate(r) for r in self._fleet()
             if r.proc is not None and not r.done and not r.degraded
             and not r.retiring)
 
@@ -595,7 +617,7 @@ class ServingSupervisor:
         (broker invisible) cannot vouch that the backlog is really
         empty — retiring capacity on their say-so is the cold-boot
         scale-to-floor failure mode."""
-        live = [r for r in self._replicas
+        live = [r for r in self._fleet()
                 if r.proc is not None and not r.done
                 and not r.degraded and not r.retiring]
         return bool(live) and all(r.last_health == "ok"
@@ -616,9 +638,11 @@ class ServingSupervisor:
     def _autoscale(self, now: float) -> None:
         if not self.autoscale or self._stop.is_set():
             return
-        if now - self._last_autoscale_poll < self.autoscale_interval_s:
-            return
-        self._last_autoscale_poll = now
+        with self._fleet_lock:
+            if now - self._last_autoscale_poll \
+                    < self.autoscale_interval_s:
+                return
+            self._last_autoscale_poll = now
         sig = self._collect_signals()
         if not sig["saw_metrics"]:
             # nobody reachable yet (cold fleet / every port pending):
@@ -682,20 +706,23 @@ class ServingSupervisor:
             self._scale_down(now, sig)
 
     def _scale_up(self, now: float, sig: Dict) -> None:
-        index = len(self._replicas)
-        r = _Replica(index=index,
-                     port_file=os.path.join(self._state_dir,
-                                            f"replica-{index}.port"),
-                     budget=RetryBudget(self.retry_times,
-                                        self.retry_window_s))
-        self._replicas.append(r)
+        with self._fleet_lock:
+            index = len(self._replicas)
+            r = _Replica(index=index,
+                         port_file=os.path.join(
+                             self._state_dir,
+                             f"replica-{index}.port"),
+                         budget=RetryBudget(self.retry_times,
+                                            self.retry_window_s))
+            self._replicas.append(r)
         self._spawn(r)
         self._last_scale_at = now
         self._pressure_since = None
         self._m_scale.labels("up").inc()
-        self.scale_events.append({
-            "direction": "up", "replica": index,
-            "fleet": self._fleet_size(), "signals": sig})
+        with self._fleet_lock:
+            self.scale_events.append({
+                "direction": "up", "replica": index,
+                "fleet": self._fleet_size(), "signals": sig})
         self._flightrec.record(
             "scale.up", replica=index, fleet=self._fleet_size(),
             signals=sig)
@@ -711,7 +738,7 @@ class ServingSupervisor:
         drain contract: it finishes + acks in-flight batches, flushes
         metrics, and exits 0 — and is never restarted."""
         victim = None
-        for r in reversed(self._replicas):
+        for r in reversed(self._fleet()):
             if r.proc is not None and r.proc.poll() is None \
                     and not r.retiring and not r.done \
                     and not r.degraded:
@@ -728,9 +755,10 @@ class ServingSupervisor:
         self._last_scale_at = now
         self._idle_since = None
         self._m_scale.labels("down").inc()
-        self.scale_events.append({
-            "direction": "down", "replica": victim.index,
-            "fleet": self._fleet_size(), "signals": sig})
+        with self._fleet_lock:
+            self.scale_events.append({
+                "direction": "down", "replica": victim.index,
+                "fleet": self._fleet_size(), "signals": sig})
         self._flightrec.record(
             "scale.down", replica=victim.index,
             fleet=self._fleet_size(), signals=sig)
@@ -824,7 +852,7 @@ class ServingSupervisor:
     def _tick(self) -> None:
         now = self._clock()
         alive = 0
-        for r in self._replicas:
+        for r in self._fleet():
             if r.proc is None:
                 if (not r.done and not r.degraded
                         and r.next_spawn_at is not None
@@ -856,12 +884,12 @@ class ServingSupervisor:
         :class:`DegradedTraining` on budget exhaustion (wrap the CLI
         in ``degraded_exit()`` for the exit-17 protocol)."""
         self.install_signal_handlers()
-        for r in self._replicas:
+        for r in self._fleet():
             self._spawn(r)
         try:
             while not self._stop.is_set():
                 self._tick()
-                if all(r.done or r.degraded for r in self._replicas):
+                if all(r.done or r.degraded for r in self._fleet()):
                     break
                 time.sleep(poll_interval_s)
         finally:
@@ -880,7 +908,7 @@ class ServingSupervisor:
         tests and scripted bring-up)."""
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
-            live = [r for r in self._replicas
+            live = [r for r in self._fleet()
                     if not r.done and not r.degraded]
             if live and all(self._probe(r) == "ok" for r in live):
                 return True
@@ -892,7 +920,7 @@ class ServingSupervisor:
         finishes + acks in-flight batches and exits 0), escalate to
         SIGKILL per process past ``drain_timeout_s``, reap everything.
         Returns {replica_index: exit code}."""
-        live = [r for r in self._replicas
+        live = [r for r in self._fleet()
                 if r.proc is not None and r.proc.poll() is None]
         for r in live:
             r.proc.terminate()
@@ -919,21 +947,21 @@ class ServingSupervisor:
         return codes
 
     def summary(self) -> Dict:
-        out = {
-            "replicas": self.replicas,
-            "restarts_total": self.restarts_total,
-            "done": [r.index for r in self._replicas if r.done],
-            "degraded": [r.index for r in self._replicas
-                         if r.degraded],
-            "exit_codes": {r.index: r.last_exit
-                           for r in self._replicas},
-        }
-        if self.autoscale:
-            out["min_replicas"] = self.min_replicas
-            out["max_replicas"] = self.max_replicas
-            out["scale_events"] = list(self.scale_events)
-            out["replica_trajectory"] = [
-                size for _t, size, _r in self.replica_trajectory]
+        with self._fleet_lock:
+            rs = list(self._replicas)
+            out = {
+                "replicas": self.replicas,
+                "restarts_total": self.restarts_total,
+                "done": [r.index for r in rs if r.done],
+                "degraded": [r.index for r in rs if r.degraded],
+                "exit_codes": {r.index: r.last_exit for r in rs},
+            }
+            if self.autoscale:
+                out["min_replicas"] = self.min_replicas
+                out["max_replicas"] = self.max_replicas
+                out["scale_events"] = list(self.scale_events)
+                out["replica_trajectory"] = [
+                    size for _t, size, _r in self.replica_trajectory]
         return out
 
 

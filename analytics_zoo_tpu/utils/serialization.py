@@ -17,14 +17,11 @@ from typing import Any, Optional
 from flax import serialization as fser
 
 
-def _atomic_write(path: str, data: bytes) -> None:
-    # pid-unique tmp name: on a shared filesystem two processes writing
-    # the same snapshot concurrently must not interleave into one tmp
-    # file or rename a partially-written one
-    tmp = f"{path}.{os.getpid()}.tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)
+# pid-unique tmp name + os.replace: on a shared filesystem two
+# processes writing the same snapshot concurrently must not interleave
+# into one tmp file or rename a partially-written one
+from analytics_zoo_tpu.common.fsutil import \
+    atomic_write_bytes as _atomic_write
 
 
 def save_variables(path: str, variables: Any, over_write: bool = True) -> None:

@@ -704,6 +704,50 @@ def bench_serving_engine(n_records: int = 1024, batch_size: int = 16,
         zoo_cfg.set("observability.reqtrace", prev_reqtrace)
         reset_request_log()
 
+    # ---- racecheck-overhead guard (ISSUE 20): the same HTTP leg
+    # around the schedule-fuzzing race sanitizer.  Disarm restores
+    # the batcher's __getattribute__/__setattr__ and
+    # Thread.start/join to the EXACT pre-arm objects, so a disarmed
+    # leg executes bit-identical code to a plain one — the sanitizer
+    # is pay-for-use, and --compare self-gates the measured delta.
+    # The delta is measured PAIRED and INTERLEAVED: a plain slice,
+    # then a fresh arm→disarm cycle, then a disarmed slice, four
+    # rounds, p50s over the pooled distributions — a single
+    # sequential pair is dominated by drift (warm caches / CPU
+    # contention move this closed loop's p50 by >10% between legs,
+    # far above any real delta), while interleaving puts both
+    # populations under the same drift and the per-round re-arm
+    # means a wrapper leaked by ANY disarm lands in the disarmed
+    # pool, never in the plain one.  The ARMED leg (chaos yields and
+    # the shortened switch interval OFF — those are deliberate
+    # schedule fuzzing, not instrumentation cost) is informational
+    # only, and its verdicts are DISCARDED: the serving worker and
+    # HTTP handler threads were spawned before arm(), so they carry
+    # no fork edges and no profile hook — arming mid-flight measures
+    # cost, not races (correctness runs arm pre-spawn: the seeded
+    # drill, zoo-racecheck --watch --pytest).
+    from analytics_zoo_tpu.analysis.racecheck import Sanitizer
+    from analytics_zoo_tpu.serving.engine.batcher import \
+        ContinuousBatcher
+    hit = lambda cid, i: http.predict_http("default", record)  # noqa: E731
+    slice_n = max(16, n_records // 4)
+    lat_plain, lat_disarmed = [], []
+    for _ in range(4):
+        _, lat_p, _ = closed_loop(slice_n, hit)
+        lat_plain.extend(lat_p)
+        Sanitizer(seed=0, chaos=False, switch_interval=None) \
+            .arm([ContinuousBatcher]).disarm()
+        _, lat_d, _ = closed_loop(slice_n, hit)
+        lat_disarmed.extend(lat_d)
+    lat_plain.sort()
+    lat_disarmed.sort()
+    san = Sanitizer(seed=0, chaos=False, switch_interval=None)
+    san.arm([ContinuousBatcher])
+    try:
+        _, http_lat_armed, _ = closed_loop(n_records, hit)
+    finally:
+        san.disarm()
+
     # ---- Redis bulk path (closed loop: enqueue then poll the result)
     inq = InputQueue(broker=broker)
     outq = OutputQueue(broker=broker)
@@ -748,6 +792,18 @@ def bench_serving_engine(n_records: int = 1024, batch_size: int = 16,
         "reqtrace_p50_overhead_fraction": round(
             (pct(http_lat, 50) / pct(http_lat_off, 50) - 1.0)
             if pct(http_lat_off, 50) > 0 else 0.0, 4),
+        "http_latency_p50_ms_racecheck_plain": round(
+            pct(lat_plain, 50), 2),
+        "http_latency_p50_ms_racecheck_disarmed": round(
+            pct(lat_disarmed, 50), 2),
+        "http_latency_p50_ms_racecheck_armed": round(
+            pct(http_lat_armed, 50), 2),
+        "racecheck_disarmed_p50_overhead_fraction": round(
+            (pct(lat_disarmed, 50) / pct(lat_plain, 50) - 1.0)
+            if pct(lat_plain, 50) > 0 else 0.0, 4),
+        "racecheck_armed_p50_overhead_fraction": round(
+            (pct(http_lat_armed, 50) / pct(lat_plain, 50) - 1.0)
+            if pct(lat_plain, 50) > 0 else 0.0, 4),
         "redis_rps": round(redis_rps, 1),
         "redis_latency_p50_ms": round(pct(redis_lat, 50), 2),
         "redis_latency_p99_ms": round(pct(redis_lat, 99), 2),
@@ -1797,6 +1853,8 @@ def _compare_against_baseline(baseline_path, threshold=0.10):
     cur_trace_overhead = {}
     cur_tsdb_overhead = {}
     cur_flight_overhead = {}
+    cur_racecheck_overhead = {}
+    cur_racecheck_armed = {}
     try:
         with open(ARTIFACT_PATH) as f:
             for r in json.load(f).get("results", []):
@@ -1817,6 +1875,16 @@ def _compare_against_baseline(baseline_path, threshold=0.10):
                         (int, float)):
                     cur_flight_overhead[r.get("metric")] = \
                         r["flightrec_p50_overhead_fraction"]
+                if isinstance(
+                        r.get("racecheck_disarmed_p50_overhead_fraction"),
+                        (int, float)):
+                    cur_racecheck_overhead[r.get("metric")] = \
+                        r["racecheck_disarmed_p50_overhead_fraction"]
+                if isinstance(
+                        r.get("racecheck_armed_p50_overhead_fraction"),
+                        (int, float)):
+                    cur_racecheck_armed[r.get("metric")] = \
+                        r["racecheck_armed_p50_overhead_fraction"]
     except Exception:  # noqa: BLE001
         pass
     # compile-time changes are INFORMATIONAL, never a regression: a
@@ -1877,10 +1945,31 @@ def _compare_against_baseline(baseline_path, threshold=0.10):
                 "metric": metric + ":flightrec_p50_overhead_fraction",
                 "baseline": 0.01, "current": round(frac, 7),
                 "change": round(frac, 7)})
+    # race-sanitizer pay-for-use self-gate (ISSUE 20): the serving
+    # bench ran interleaved plain / arm→disarm HTTP slices — disarm
+    # restores the watched class's slots and Thread.start/join to the
+    # exact pre-arm objects, so the disarmed pool executes the SAME
+    # code as the plain pool and its true cost is 0%.  The gate's 2%
+    # bound is the paired measurement's empirical resolution (pooled
+    # p50s still jitter ±2-3% under closed-loop contention), not an
+    # allowance: a surviving wrapper costs far more than that on
+    # every attribute access.  The ARMED fraction stays informational
+    # — the sanitizer is a debugging harness, not a production path.
+    for metric, frac in sorted(cur_racecheck_overhead.items()):
+        if frac > 0.02:
+            regressions.append({
+                "metric":
+                    metric + ":racecheck_disarmed_p50_overhead_fraction",
+                "baseline": 0.0, "current": round(frac, 4),
+                "change": round(frac, 4)})
     _emit({"compare": baseline_path, "threshold": threshold,
            "metrics_compared": compared, "regressions": regressions,
            "skipped": skipped,
-           "informational": {"compile_time_changes": compile_changes},
+           "informational": {
+               "compile_time_changes": compile_changes,
+               "racecheck_armed_p50_overhead_fraction":
+                   {m: round(f, 4)
+                    for m, f in sorted(cur_racecheck_armed.items())}},
            "ok": not regressions})
     return 1 if regressions else 0
 

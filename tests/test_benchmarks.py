@@ -384,3 +384,38 @@ def test_probe_degraded_no_cache_tail_is_workload_line(
     assert lines[-1].get("workload") == "ncf"
     assert lines[-1]["value"] == 0
     assert "bench_status" not in lines[-1]
+
+
+def test_compare_self_gates_racecheck_disarmed_overhead(
+        tmp_path, monkeypatch, capsys):
+    """ISSUE 20 pay-for-use contract: a disarmed-sanitizer p50 delta
+    above the 1% noise floor fails --compare even when every
+    baseline-relative metric held, while the ARMED fraction is
+    informational and never gates (the sanitizer is a debugging
+    harness, not a production path)."""
+    art = tmp_path / "art.json"
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(
+        {"serving_engine_http_throughput": 100.0}))
+    monkeypatch.setattr(bench, "ARTIFACT_PATH", str(art))
+
+    def write_art(disarmed_frac):
+        art.write_text(json.dumps({"meta": {}, "runs": [], "results": [
+            {"metric": "serving_engine_http_throughput", "value": 100.0,
+             "racecheck_disarmed_p50_overhead_fraction": disarmed_frac,
+             "racecheck_armed_p50_overhead_fraction": 2.5}]}))
+
+    write_art(0.05)                       # a wrapper survived disarm
+    assert bench._compare_against_baseline(str(base)) == 1
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert any(r["metric"].endswith(
+        ":racecheck_disarmed_p50_overhead_fraction")
+        for r in doc["regressions"])
+
+    write_art(0.004)                      # below the noise floor
+    assert bench._compare_against_baseline(str(base)) == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["ok"]
+    assert doc["informational"][
+        "racecheck_armed_p50_overhead_fraction"][
+        "serving_engine_http_throughput"] == 2.5

@@ -2559,6 +2559,310 @@ class TestHistoricalBugRegressions:
         assert findings == [], [f.render() for f in findings]
 
 
+# ======================== RACE016 / ATOM017 / PUBLISH018 / WRITE019
+
+
+RACE016_CROSS_ROLE = (
+    "import threading\n"
+    "\n"
+    "class BacklogDrain:\n"
+    "    def __init__(self):\n"
+    "        self.pending = []\n"
+    "        self._thread = None\n"
+    "\n"
+    "    def start(self):\n"
+    "        self._thread = threading.Thread(\n"
+    "            target=self._loop, name='zoo-drain-loop')\n"
+    "        self._thread.start()\n"
+    "\n"
+    "    def _loop(self):\n"
+    "        while self.pending:\n"
+    "            self.pending.pop()\n"
+    "\n"
+    "    def submit(self, item):\n"
+    "        self.pending.append(item)\n")
+
+#: the Queue-handoff version of the same pipeline: the sync-typed
+#: attribute carries its own ordering contract
+RACE016_QUEUE_HANDOFF = RACE016_CROSS_ROLE.replace(
+    "import threading\n",
+    "import queue\nimport threading\n").replace(
+    "        self.pending = []\n",
+    "        self.pending = queue.Queue()\n").replace(
+    "        while self.pending:\n"
+    "            self.pending.pop()\n",
+    "        while True:\n"
+    "            self.pending.get()\n").replace(
+    "        self.pending.append(item)\n",
+    "        self.pending.put(item)\n")
+
+RACE016_SAME_LOCK = (
+    "import threading\n"
+    "\n"
+    "class BacklogDrain:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.pending = []\n"
+    "        self._thread = None\n"
+    "\n"
+    "    def start(self):\n"
+    "        self._thread = threading.Thread(\n"
+    "            target=self._loop, name='zoo-drain-loop')\n"
+    "        self._thread.start()\n"
+    "\n"
+    "    def _loop(self):\n"
+    "        with self._lock:\n"
+    "            while self.pending:\n"
+    "                self.pending.pop()\n"
+    "\n"
+    "    def submit(self, item):\n"
+    "        with self._lock:\n"
+    "            self.pending.append(item)\n")
+
+RACE016_PRESTART_INIT = (
+    "import threading\n"
+    "\n"
+    "class Warmup:\n"
+    "    def __init__(self):\n"
+    "        self.table = {}\n"
+    "        self._thread = None\n"
+    "\n"
+    "    def start(self):\n"
+    "        self.table['seed'] = 1\n"
+    "        self.table.update({'a': 2})\n"
+    "        self._thread = threading.Thread(\n"
+    "            target=self._loop, name='zoo-warm-loop')\n"
+    "        self._thread.start()\n"
+    "\n"
+    "    def _loop(self):\n"
+    "        while True:\n"
+    "            _ = self.table.get('seed')\n")
+
+RACE016_MONOTONIC_FLAG = (
+    "import threading\n"
+    "\n"
+    "class Loop:\n"
+    "    def __init__(self):\n"
+    "        self._stop = False\n"
+    "        self._thread = None\n"
+    "\n"
+    "    def start(self):\n"
+    "        self._thread = threading.Thread(\n"
+    "            target=self._loop, name='zoo-loop')\n"
+    "        self._thread.start()\n"
+    "\n"
+    "    def _loop(self):\n"
+    "        while not self._stop:\n"
+    "            pass\n"
+    "\n"
+    "    def close(self):\n"
+    "        self._stop = True\n")
+
+
+class TestRACE016:
+    def test_cross_role_mutation_fires(self):
+        out = lint(RACE016_CROSS_ROLE, rules=["RACE016"])
+        assert rule_ids(out) == ["RACE016"]
+        f = out[0]
+        assert f.severity == "error"
+        assert f.symbol == "BacklogDrain.pending"
+        assert "role" in f.message
+        assert "zoo-racecheck" in f.message    # the runtime twin
+
+    def test_queue_handoff_is_clean(self):
+        assert lint(RACE016_QUEUE_HANDOFF, rules=["RACE016"]) == []
+
+    def test_same_lock_both_sides_is_clean(self):
+        assert lint(RACE016_SAME_LOCK, rules=["RACE016"]) == []
+
+    def test_prestart_initialization_is_clean(self):
+        """Writes in __init__ AND in start() before the spawn are
+        construction: nothing else can hold the instance yet."""
+        assert lint(RACE016_PRESTART_INIT, rules=["RACE016"]) == []
+
+    def test_monotonic_flag_publication_is_clean(self):
+        """Plain constant write on one role / read on another is the
+        sanctioned GIL-atomic stop-flag idiom."""
+        assert lint(RACE016_MONOTONIC_FLAG, rules=["RACE016"]) == []
+
+
+ATOM017_BACKLOG_SEEN = (
+    "import threading\n"
+    "\n"
+    "class GaugeRegistry:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._backlog_seen = {}\n"
+    "\n"
+    "    def observe(self, key, gauge):\n"
+    "        if key not in self._backlog_seen:\n"
+    "            with self._lock:\n"
+    "                self._backlog_seen[key] = gauge\n")
+
+ATOM017_BACKLOG_FIXED = (
+    "import threading\n"
+    "\n"
+    "class GaugeRegistry:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._backlog_seen = {}\n"
+    "\n"
+    "    def observe(self, key, gauge):\n"
+    "        with self._lock:\n"
+    "            if key not in self._backlog_seen:\n"
+    "                self._backlog_seen[key] = gauge\n")
+
+ATOM017_DOUBLE_CHECKED = (
+    "import threading\n"
+    "\n"
+    "class GaugeRegistry:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self._backlog_seen = {}\n"
+    "\n"
+    "    def observe(self, key, gauge):\n"
+    "        if key not in self._backlog_seen:\n"
+    "            with self._lock:\n"
+    "                if key not in self._backlog_seen:\n"
+    "                    self._backlog_seen[key] = gauge\n")
+
+
+class TestATOM017:
+    def test_backlog_seen_shape_fires(self):
+        """The PR 12 registry-gauge stomping: guard reads the dict
+        with no lock, the store runs under the lock — two samplers
+        both pass the check, the second stomps the first's gauge."""
+        out = lint(ATOM017_BACKLOG_SEEN, rules=["ATOM017"])
+        assert rule_ids(out) == ["ATOM017"]
+        assert out[0].severity == "error"
+        assert "_backlog_seen" in out[0].message
+
+    def test_guard_under_the_same_lock_is_clean(self):
+        assert lint(ATOM017_BACKLOG_FIXED, rules=["ATOM017"]) == []
+
+    def test_double_checked_locking_is_clean(self):
+        """The re-check under the write's lock kills the stale outer
+        guard — sanctioned double-checked locking."""
+        assert lint(ATOM017_DOUBLE_CHECKED, rules=["ATOM017"]) == []
+
+
+PUBLISH018_LATE_PID = (
+    "import threading\n"
+    "\n"
+    "class Replica:\n"
+    "    def spawn(self):\n"
+    "        t = threading.Thread(target=self._watch)\n"
+    "        t.start()\n"
+    "        self.pid = 4242\n"
+    "\n"
+    "    def _watch(self):\n"
+    "        return self.pid\n")
+
+PUBLISH018_INIT_FIRST = (
+    "import threading\n"
+    "\n"
+    "class Replica:\n"
+    "    def spawn(self):\n"
+    "        self.pid = 4242\n"
+    "        t = threading.Thread(target=self._watch)\n"
+    "        t.start()\n"
+    "\n"
+    "    def _watch(self):\n"
+    "        return self.pid\n")
+
+
+class TestPUBLISH018:
+    def test_mutation_after_start_fires(self):
+        """The flight-recorder replica.spawn ordering incident: the
+        watch loop read a replica record before its pid field
+        landed.  Regression for the state-machine walk order too —
+        the non-chained construct-then-start form must publish."""
+        out = lint(PUBLISH018_LATE_PID, rules=["PUBLISH018"])
+        assert rule_ids(out) == ["PUBLISH018"]
+        assert out[0].severity == "warning"
+        assert "self.pid" in out[0].message
+        assert "unsafe publication" in out[0].message
+
+    def test_untouched_attr_mutation_is_not_flagged(self):
+        """Only attrs the spawn target actually touches can be
+        observed half-built; others belong to RACE016."""
+        src = PUBLISH018_LATE_PID.replace("self.pid = 4242",
+                                          "self.other = 4242")
+        assert lint(src, rules=["PUBLISH018"]) == []
+
+    def test_init_before_start_is_clean(self):
+        assert lint(PUBLISH018_INIT_FIRST, rules=["PUBLISH018"]) == []
+
+
+WRITE019_TORN = (
+    "import json\n"
+    "\n"
+    "def write_progress(run_dir, doc):\n"
+    "    with open(run_dir + '/progress.json', 'w') as f:\n"
+    "        json.dump(doc, f)\n")
+
+WRITE019_ATOMIC = (
+    "import json\n"
+    "from analytics_zoo_tpu.common.fsutil import atomic_write_text\n"
+    "\n"
+    "def write_progress(run_dir, doc):\n"
+    "    atomic_write_text(run_dir + '/progress.json',\n"
+    "                      json.dumps(doc))\n")
+
+
+class TestWRITE019:
+    def test_non_atomic_rundir_write_fires(self):
+        out = lint(WRITE019_TORN, rules=["WRITE019"])
+        assert rule_ids(out) == ["WRITE019"]
+        assert out[0].severity == "warning"
+        assert "atomic_write_text" in out[0].message
+
+    def test_atomic_write_helper_is_clean(self):
+        assert lint(WRITE019_ATOMIC, rules=["WRITE019"]) == []
+
+    def test_tmp_sibling_is_the_sanctioned_first_half(self):
+        src = WRITE019_TORN.replace("'/progress.json'",
+                                    "'/progress.json.tmp'")
+        assert lint(src, rules=["WRITE019"]) == []
+
+    def test_non_rundir_path_is_not_gated(self):
+        src = WRITE019_TORN.replace("run_dir", "scratch")
+        assert lint(src, rules=["WRITE019"]) == []
+
+
+class TestHistoricalBugRegressionsV4:
+    """ISSUE 20 acceptance: the historical concurrency bugs are
+    re-detected statically — each as a positive fixture plus the
+    fixed-code negative — and the shipped trees (which contain the
+    FIXES) lint clean under the new families."""
+
+    def test_pr12_backlog_seen_stomping_detected(self):
+        out = lint(ATOM017_BACKLOG_SEEN, rules=["ATOM017"])
+        assert [f.rule for f in out] == ["ATOM017"]
+
+    def test_pr12_fixed_shape_is_clean(self):
+        assert lint(ATOM017_BACKLOG_FIXED, rules=["ATOM017"]) == []
+
+    def test_prestart_then_cross_thread_mutation_detected(self):
+        out = lint(RACE016_CROSS_ROLE, rules=["RACE016"])
+        assert [f.rule for f in out] == ["RACE016"]
+
+    def test_queue_handoff_twin_is_clean(self):
+        assert lint(RACE016_QUEUE_HANDOFF, rules=["RACE016"]) == []
+
+    def test_real_serving_and_observability_trees_are_clean(self):
+        """The shipped serving/observability/batchjobs code (which
+        contains the fix-pass) passes the v4 families."""
+        from analytics_zoo_tpu.analysis import analyze_paths
+        findings, errors = analyze_paths(
+            [os.path.join(REPO_ROOT, "analytics_zoo_tpu", sub)
+             for sub in ("serving", "observability", "batchjobs")],
+            root=REPO_ROOT,
+            rule_ids=["RACE016", "ATOM017", "PUBLISH018", "WRITE019"])
+        assert errors == []
+        assert findings == [], [f.render() for f in findings]
+
+
 class TestSarifExport:
     def test_sarif_document_schema_and_results(self, tmp_path,
                                                capsys):
